@@ -1,0 +1,222 @@
+"""Accelerator abstraction (ref: accelerator/abstract_accelerator.py:10
+DeepSpeedAccelerator — ~80-method ABC).
+
+The JAX execution model eliminates several method families by construction:
+streams/events (XLA async dispatch + program order), graph capture (jit IS
+capture), pinned memory (handled by the runtime's transfer manager).  Those
+appear here as explicit no-ops so engine code written against the reference
+surface keeps working; the meaningful surface (device/memory/dtype/RNG/
+communication-backend probes and op-builder lookup) is real.
+"""
+
+import abc
+
+
+class DeepSpeedAccelerator(abc.ABC):
+
+    def __init__(self):
+        self._name = None
+        self._communication_backend_name = None
+
+    # ---- device APIs
+    @abc.abstractmethod
+    def device_name(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def device(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def device_count(self):
+        ...
+
+    @abc.abstractmethod
+    def current_device(self):
+        ...
+
+    def current_device_name(self):
+        return self.device_name(self.current_device())
+
+    def set_device(self, device_index):
+        pass  # single-controller: placement is via shardings, not a current-device
+
+    @abc.abstractmethod
+    def synchronize(self, device_index=None):
+        ...
+
+    # ---- RNG (threaded PRNG keys; these exist for API parity)
+    def random(self):
+        import jax
+        return jax.random
+
+    def manual_seed(self, seed):
+        self._seed = seed
+
+    def manual_seed_all(self, seed):
+        self._seed = seed
+
+    def initial_seed(self):
+        return getattr(self, "_seed", 0)
+
+    def default_generator(self, device_index):
+        import jax
+        return jax.random.PRNGKey(getattr(self, "_seed", 0))
+
+    # ---- streams/events: no-ops (XLA program order replaces stream discipline)
+    class _NoOpStream:
+
+        def __init__(self, *a, **k):
+            ...
+
+        def synchronize(self):
+            import jax
+            jax.effects_barrier()
+
+        def wait_stream(self, other):
+            ...
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    def Stream(self, *args, **kwargs):
+        return self._NoOpStream()
+
+    def stream(self, stream):
+        return stream if hasattr(stream, "__enter__") else self._NoOpStream()
+
+    def current_stream(self, device_index=None):
+        return self._NoOpStream()
+
+    def default_stream(self, device_index=None):
+        return self._NoOpStream()
+
+    class _NoOpEvent:
+
+        def __init__(self, *a, **k):
+            ...
+
+        def record(self, stream=None):
+            ...
+
+        def synchronize(self):
+            import jax
+            jax.effects_barrier()
+
+        def elapsed_time(self, other):
+            return 0.0
+
+        def query(self):
+            return True
+
+    def Event(self, *args, **kwargs):
+        return self._NoOpEvent()
+
+    # ---- memory
+    @abc.abstractmethod
+    def memory_allocated(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def max_memory_allocated(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def total_memory(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def available_memory(self, device_index=None):
+        ...
+
+    def reset_peak_memory_stats(self, device_index=None):
+        ...
+
+    def empty_cache(self):
+        ...
+
+    def memory_stats(self, device_index=None):
+        return {}
+
+    # ---- dtype support
+    @abc.abstractmethod
+    def is_bf16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self):
+        ...
+
+    # ---- misc
+    @abc.abstractmethod
+    def is_available(self):
+        ...
+
+    @abc.abstractmethod
+    def communication_backend_name(self):
+        ...
+
+    def is_triton_supported(self):
+        return False
+
+    def use_host_timers(self):
+        return True
+
+    def range_push(self, msg):
+        """NVTX analog: jax profiler trace annotation."""
+        try:
+            import jax.profiler
+            self._trace_ctx = jax.profiler.TraceAnnotation(msg)
+            self._trace_ctx.__enter__()
+        except Exception:
+            self._trace_ctx = None
+
+    def range_pop(self):
+        ctx = getattr(self, "_trace_ctx", None)
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+            self._trace_ctx = None
+
+    # ---- graph capture: jit IS the graph; these gate the reference's CUDA-graph paths off
+    def create_graph(self):
+        return None
+
+    def capture_to_graph(self, graph, pool=None, stream=None):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def replay_graph(self, graph):
+        ...
+
+    # ---- op builder surface
+    @abc.abstractmethod
+    def create_op_builder(self, class_name):
+        ...
+
+    @abc.abstractmethod
+    def get_op_builder(self, class_name):
+        ...
+
+    def op_builder_dir(self):
+        return "deepspeed_tpu.ops"
+
+    # ---- tensor helpers
+    def pin_memory(self, tensor, align_bytes=1):
+        return tensor
+
+    def is_pinned(self, tensor):
+        return True
+
+    def on_accelerator(self, tensor):
+        try:
+            import jax
+            return isinstance(tensor, jax.Array)
+        except Exception:
+            return False

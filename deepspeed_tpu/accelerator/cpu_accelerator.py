@@ -1,0 +1,72 @@
+"""CPU accelerator (host-device testing; ref: accelerator/cpu_accelerator.py)."""
+
+import jax
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class CPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "xla"
+
+    def device_name(self, device_index=None):
+        return "cpu"
+
+    def device(self, device_index=None):
+        return jax.devices("cpu")[device_index or 0]
+
+    def device_count(self):
+        return jax.device_count()
+
+    def current_device(self):
+        return 0
+
+    def synchronize(self, device_index=None):
+        jax.effects_barrier()
+
+    def memory_allocated(self, device_index=None):
+        return 0
+
+    def max_memory_allocated(self, device_index=None):
+        return 0
+
+    def total_memory(self, device_index=None):
+        try:
+            import psutil
+            return psutil.virtual_memory().total
+        except Exception:
+            return 0
+
+    def available_memory(self, device_index=None):
+        try:
+            import psutil
+            return psutil.virtual_memory().available
+        except Exception:
+            return 0
+
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        return True
+
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+        return [jnp.float32, jnp.bfloat16, jnp.float16]
+
+    def is_available(self):
+        return True
+
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    def create_op_builder(self, class_name):
+        builder = self.get_op_builder(class_name)
+        return builder() if builder is not None else None
+
+    def get_op_builder(self, class_name):
+        from ..ops.op_builder import get_builder
+        return get_builder(class_name)

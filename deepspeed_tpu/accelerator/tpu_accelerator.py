@@ -1,0 +1,91 @@
+"""TPU accelerator (the BASELINE.json north-star's ``tpu_accelerator``;
+pattern ref: accelerator/cuda_accelerator.py)."""
+
+import jax
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        self._communication_backend_name = "xla"
+
+    # ---- device
+    def device_name(self, device_index=None):
+        if device_index is None:
+            return "tpu"
+        return f"tpu:{device_index}"
+
+    def device(self, device_index=None):
+        return jax.devices("tpu")[device_index or 0]
+
+    def device_count(self):
+        return jax.device_count()
+
+    def current_device(self):
+        return 0
+
+    def synchronize(self, device_index=None):
+        jax.effects_barrier()
+
+    # ---- memory
+    def _stats(self, device_index=None):
+        try:
+            return jax.local_devices()[device_index or 0].memory_stats() or {}
+        except Exception:
+            return {}
+
+    def memory_allocated(self, device_index=None):
+        return self._stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index=None):
+        return self._stats(device_index).get("peak_bytes_in_use", 0)
+
+    def total_memory(self, device_index=None):
+        return self._stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index=None):
+        s = self._stats(device_index)
+        return s.get("bytes_limit", 0) - s.get("bytes_in_use", 0)
+
+    def memory_stats(self, device_index=None):
+        return self._stats(device_index)
+
+    # ---- dtypes
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        return True  # emulated via fp32 accumulate; bf16 is the native type
+
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8]
+
+    # ---- misc
+    def is_available(self):
+        try:
+            return any(d.platform == "tpu" for d in jax.devices())
+        except Exception:
+            return False
+
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    def is_triton_supported(self):
+        return False
+
+    def device_kind(self):
+        return jax.devices()[0].device_kind
+
+    # ---- op builders: return Pallas/XLA-implemented op modules
+    def create_op_builder(self, class_name):
+        builder = self.get_op_builder(class_name)
+        return builder() if builder is not None else None
+
+    def get_op_builder(self, class_name):
+        from ..ops.op_builder import get_builder
+        return get_builder(class_name)

@@ -1,0 +1,10 @@
+"""``deepspeed_tpu.comm`` — the communication façade (ref: deepspeed/comm/__init__.py)."""
+
+from .comm import (ReduceOp, all_gather_into_tensor, all_reduce, all_to_all_single, barrier, broadcast, comms_logger,
+                   configure, get_local_rank, get_rank, get_world_group, get_world_size, has_all_gather_into_tensor,
+                   has_reduce_scatter_tensor, init_distributed, initialize_mesh_device, is_initialized, log_summary,
+                   reduce_scatter_tensor, t_all_gather, t_all_reduce, t_all_to_all, t_axis_index, t_ppermute,
+                   t_reduce_scatter, get_mesh)
+from .mesh import (BATCH_AXES, DATA_AXIS, EXPERT_AXIS, MESH_AXES, PIPE_AXIS, SEQ_AXIS, TENSOR_AXIS, ZERO_AXES,
+                   MeshSpec, axis_size, batch_sharding, create_mesh, dp_world_size, get_global_mesh, has_global_mesh,
+                   replicated, set_global_mesh)

@@ -1,0 +1,292 @@
+"""Llama-family causal LM, TPU-first.
+
+This is the flagship training model (BASELINE.json configs 3–4: Llama-3-8B
+ZeRO-3 / Ulysses 32k).  Where the reference injects fused CUDA kernels into a
+HF torch module (ref: deepspeed/module_inject/containers/llama.py), we define
+the model natively in flax.linen with:
+
+  * ``nn.scan`` over the decoder stack — one compiled layer body, weights get
+    a leading ``layers`` axis.  This is what makes ZeRO-3 memory behaviour
+    fall out of XLA: sharded weights are all-gathered per scan iteration and
+    freed after, the same live-window the reference's param coordinator
+    maintains by hand (ref: runtime/zero/partitioned_param_coordinator.py).
+  * logical axis names on every param, mapped to mesh axes by the sharding
+    rules in ``module_inject/tp_rules.py`` (the AutoTP analog).
+  * optional remat (``jax.checkpoint``) per layer — the analog of
+    ``runtime/activation_checkpointing/checkpointing.py:948``.
+  * a pluggable attention kernel (jnp reference or Pallas flash attention,
+    or the Ulysses all-to-all wrapper from ``deepspeed_tpu.sequence``).
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+# Logical axis vocabulary (consumed by module_inject/tp_rules.py)
+BATCH = "batch"
+SEQ = "seq_len"
+EMBED = "embed"
+MLP = "mlp"
+HEADS = "heads"
+KV_HEADS = "kv_heads"
+HEAD_DIM = "head_dim"
+VOCAB = "vocab"
+LAYERS = "layers"
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 8192
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+    remat_policy: str = "nothing_saveable"
+    attention_impl: str = "reference"  # reference | flash | ulysses
+
+    @staticmethod
+    def from_hf(hf_cfg, **overrides):
+        """Build from a transformers LlamaConfig (duck-typed)."""
+        fields = dict(
+            vocab_size=hf_cfg.vocab_size,
+            hidden_size=hf_cfg.hidden_size,
+            intermediate_size=hf_cfg.intermediate_size,
+            num_hidden_layers=hf_cfg.num_hidden_layers,
+            num_attention_heads=hf_cfg.num_attention_heads,
+            num_key_value_heads=getattr(hf_cfg, "num_key_value_heads", hf_cfg.num_attention_heads),
+            max_position_embeddings=hf_cfg.max_position_embeddings,
+            rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+            rms_norm_eps=getattr(hf_cfg, "rms_norm_eps", 1e-5),
+            tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
+        )
+        fields.update(overrides)
+        return LlamaConfig(**fields)
+
+
+PRESETS = {
+    "llama3-8b": LlamaConfig(vocab_size=128256, hidden_size=4096, intermediate_size=14336, num_hidden_layers=32,
+                             num_attention_heads=32, num_key_value_heads=8),
+    "llama2-7b": LlamaConfig(vocab_size=32000, hidden_size=4096, intermediate_size=11008, num_hidden_layers=32,
+                             num_attention_heads=32, num_key_value_heads=32, rope_theta=10000.0),
+    "tiny": LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=256,
+                        rope_theta=10000.0),
+    "125m": LlamaConfig(vocab_size=32000, hidden_size=768, intermediate_size=2048, num_hidden_layers=12,
+                        num_attention_heads=12, num_key_value_heads=12, rope_theta=10000.0),
+}
+
+
+def _logical(init, names):
+    return nn.with_logical_partitioning(init, names)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("weight", _logical(nn.initializers.ones_init(), (EMBED, )), (x.shape[-1], ),
+                           self.param_dtype)
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        normed = x32 * jax.lax.rsqrt(var + self.eps)
+        return (normed * scale.astype(jnp.float32)).astype(self.dtype)
+
+
+def rotary_embedding(positions, head_dim, theta):
+    """RoPE tables; fp32 for precision (ref kernel: csrc/transformer/inference
+    rotary — here a pure-jnp pair that XLA fuses into the attention matmuls)."""
+    inv_freq = 1.0 / (theta**(jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [B, S, D/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin):
+    # x: [B, S, N, D]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def reference_attention(q, k, v, *, causal=True, segment_ids=None):
+    """Pure-jnp softmax attention (the golden path; swapped for the Pallas
+    flash kernel via config.attention_impl)."""
+    b, sq, nh, hd = q.shape
+    _, sk, nkv, _ = k.shape
+    if nkv != nh:
+        rep = nh // nkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
+    logits = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        qpos = jnp.arange(sq)[:, None]
+        kpos = jnp.arange(sk)[None, :]
+        mask = qpos >= kpos
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(seg_mask[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bnqk,bknd->bqnd", probs.astype(v.dtype), v)
+
+
+def get_attention_impl(name: str) -> Callable:
+    if name == "reference":
+        return reference_attention
+    if name == "flash":
+        from ..ops.flash_attention import flash_attention
+        return flash_attention
+    if name == "ulysses":
+        from ..sequence.layer import DistributedAttention
+        return DistributedAttention(reference_attention)
+    raise ValueError(f"Unknown attention impl {name}")
+
+
+class LlamaAttention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        q = dense(features=(cfg.num_attention_heads, head_dim),
+                  kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, HEADS, HEAD_DIM)),
+                  name="q_proj")(x)
+        k = dense(features=(cfg.num_key_value_heads, head_dim),
+                  kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
+                  name="k_proj")(x)
+        v = dense(features=(cfg.num_key_value_heads, head_dim),
+                  kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
+                  name="v_proj")(x)
+        cos, sin = rotary_embedding(positions, head_dim, cfg.rope_theta)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        attn_fn = get_attention_impl(cfg.attention_impl)
+        out = attn_fn(q, k, v, causal=True, segment_ids=segment_ids)
+        out = nn.DenseGeneral(features=cfg.hidden_size,
+                              axis=(-2, -1),
+                              use_bias=False,
+                              dtype=cfg.dtype,
+                              param_dtype=cfg.param_dtype,
+                              kernel_init=_logical(nn.initializers.lecun_normal(), (HEADS, HEAD_DIM, EMBED)),
+                              name="o_proj")(out)
+        return out
+
+
+class LlamaMLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        gate = dense(features=cfg.intermediate_size,
+                     kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, MLP)),
+                     name="gate_proj")(x)
+        up = dense(features=cfg.intermediate_size,
+                   kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, MLP)),
+                   name="up_proj")(x)
+        h = nn.silu(gate) * up
+        return dense(features=cfg.hidden_size,
+                     kernel_init=_logical(nn.initializers.lecun_normal(), (MLP, EMBED)),
+                     name="down_proj")(h)
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        h = x + LlamaAttention(cfg, name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="input_layernorm")(x), positions, segment_ids)
+        out = h + LlamaMLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="post_attention_layernorm")(h))
+        if self.scanned:
+            return out, None
+        return out
+
+
+class ScannedBlocks(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        block_cls = LlamaBlock
+        if cfg.remat:
+            policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+            block_cls = nn.remat(LlamaBlock, policy=policy, prevent_cse=not cfg.scan_layers)
+        if cfg.scan_layers:
+            blocks = nn.scan(block_cls,
+                             variable_axes={"params": 0},
+                             split_rngs={"params": True},
+                             in_axes=(nn.broadcast, nn.broadcast),
+                             length=cfg.num_hidden_layers,
+                             metadata_params={nn.PARTITION_NAME: LAYERS})
+            x, _ = blocks(cfg, scanned=True, name="layers")(x, positions, segment_ids)
+            return x
+        for i in range(cfg.num_hidden_layers):
+            x = block_cls(cfg, name=f"layers_{i}")(x, positions, segment_ids)
+        return x
+
+
+class LlamaForCausalLM(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+        embed = nn.Embed(num_embeddings=cfg.vocab_size,
+                         features=cfg.hidden_size,
+                         dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype,
+                         embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                         name="embed_tokens")
+        x = embed(input_ids)
+        x = ScannedBlocks(cfg, name="model")(x, positions, segment_ids)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="norm")(x)
+        if cfg.tie_word_embeddings:
+            logits = embed.attend(x)
+        else:
+            logits = nn.DenseGeneral(features=cfg.vocab_size,
+                                     use_bias=False,
+                                     dtype=cfg.dtype,
+                                     param_dtype=cfg.param_dtype,
+                                     kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, VOCAB)),
+                                     name="lm_head")(x)
+        return logits
+
+
+def causal_lm_loss(logits, labels, loss_mask=None):
+    """Token-mean cross entropy in fp32 (ref: sequence/cross_entropy.py's
+    vocab-parallel CE is realised by GSPMD when lm_head is vocab-sharded)."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if loss_mask is not None:
+        denom = jnp.maximum(loss_mask.sum(), 1.0)
+        return (nll * loss_mask).sum() / denom
+    return nll.mean()

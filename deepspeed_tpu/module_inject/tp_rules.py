@@ -1,0 +1,85 @@
+"""Sharding rules: logical param axes → mesh axes.
+
+TPU-native analog of AutoTP (ref: deepspeed/module_inject/auto_tp.py:193 —
+which parses a torch module and shards Linear rows/cols, inserting
+allreduces) and of the ZeRO partitioners.  Here the model's params carry
+logical axis names (see models/llama.py) and this module decides, per
+(zero_stage, tp degree), which mesh axis each logical axis maps to.  GSPMD
+then inserts exactly the collectives AutoTP hand-wires: a row-sharded matmul
+followed by a column-sharded one yields the same single allreduce
+(ref: module_inject/layers.py LinearAllreduce).
+
+ZeRO staging (ref: runtime/zero/stage_1_and_2.py, stage3.py):
+  stage 0-2 — params replicated over the DP axes (grad/optimizer partitioning
+              is handled on the optimizer-state pytree, see
+              runtime/zero/partition.py).
+  stage 3   — params themselves sharded over the combined DP axes along the
+              largest available logical dim ("fsdp" style); with scan-over-
+              layers XLA gathers one layer at a time, reproducing the
+              reference's live-param window.
+"""
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..comm.mesh import DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS, ZERO_AXES
+
+# Logical axis names used across the model zoo
+from ..models.llama import EMBED, HEADS, HEAD_DIM, KV_HEADS, LAYERS, MLP, VOCAB  # noqa: F401
+
+EXPERTS = "experts"  # MoE expert axis (moe/experts.py)
+
+Rules = List[Tuple[str, Optional[object]]]
+
+
+def make_logical_rules(zero_stage: int, mesh: Mesh, fsdp_axes: Sequence[str] = ZERO_AXES) -> Rules:
+    """Build flax logical-axis rules for the given ZeRO stage and mesh."""
+    tp = mesh.shape.get(TENSOR_AXIS, 1)
+    zero_axes = tuple(a for a in fsdp_axes if mesh.shape.get(a, 1) > 1)
+    fsdp = zero_axes if (zero_stage >= 3 and zero_axes) else None
+
+    rules: Rules = [
+        # column-parallel outputs (Megatron-style) → tensor axis
+        (MLP, TENSOR_AXIS if tp > 1 else None),
+        (HEADS, TENSOR_AXIS if tp > 1 else None),
+        (KV_HEADS, TENSOR_AXIS if tp > 1 else None),
+        (VOCAB, TENSOR_AXIS if tp > 1 else None),
+        # ZeRO-3: shard the reduction dim over the combined DP axes
+        (EMBED, fsdp),
+        (HEAD_DIM, None),
+        (LAYERS, None),
+        (EXPERTS, EXPERT_AXIS if mesh.shape.get(EXPERT_AXIS, 1) > 1 else None),
+        # expert weights: the 'expert' axis is taken by the expert dim, so
+        # their ZeRO (fsdp) sharding uses the remaining DP axes only
+        # (ref: groups._create_expert_data_and_model_parallel — expert params
+        # are DP-replicated over expert-data groups, ZeRO-shards over them)
+        ("expert_embed", tuple(a for a in (fsdp or ()) if a != EXPERT_AXIS) or None),
+        ("expert_mlp", TENSOR_AXIS if tp > 1 else None),
+        ("experts_gate", None),
+        ("batch", (DATA_AXIS, EXPERT_AXIS)),
+        ("seq_len", SEQ_AXIS if mesh.shape.get(SEQ_AXIS, 1) > 1 else None),
+    ]
+    return rules
+
+
+def logical_to_sharding(logical_spec_tree, mesh: Mesh, rules: Rules):
+    """Convert a pytree of flax logical PartitionSpecs to NamedShardings."""
+    import jax
+
+    def convert(spec):
+        mesh_spec = nn.logical_to_mesh_axes(spec, rules)
+        return NamedSharding(mesh, mesh_spec)
+
+    return jax.tree.map(convert, logical_spec_tree, is_leaf=lambda x: isinstance(x, P))
+
+
+def param_shardings(abs_boxed_variables, mesh: Mesh, zero_stage: int):
+    """NamedShardings for a flax variables pytree carrying ``nn.Partitioned``
+    metadata (from nn.with_logical_partitioning).  Returns a tree with the
+    UNBOXED structure (P leaves where boxes were), suitable as jit
+    out_shardings for an init that applies ``nn.meta.unbox``."""
+    logical = nn.get_partition_spec(abs_boxed_variables)
+    rules = make_logical_rules(zero_stage, mesh)
+    return logical_to_sharding(logical, mesh, rules)

@@ -1,0 +1,41 @@
+"""Expert FFN bank (ref: deepspeed/moe/experts.py:13 Experts).
+
+The reference deep-copies the expert module E/ep times per rank; here the
+expert bank is ONE weight tensor with a leading expert dim carrying the
+``experts`` logical axis → sharded over the ``expert`` mesh axis (see
+module_inject/tp_rules.py).  Compute is a batched einsum that XLA maps onto
+the MXU per expert shard.
+"""
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+EXPERTS = "experts"
+EXPERT_EMBED = "expert_embed"  # distinct from dense EMBED: ZeRO shards these
+EXPERT_MLP = "expert_mlp"    # over (data, seq) only — "expert" axis already taken
+
+
+class ExpertsFFN(nn.Module):
+    """E parallel SwiGLU FFNs: input [G, E, C, d] → [G, E, C, d]."""
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        init = nn.initializers.lecun_normal()
+        w_gate = self.param("w_gate", nn.with_logical_partitioning(init, (EXPERTS, EXPERT_EMBED, EXPERT_MLP)),
+                            (self.num_experts, self.hidden_size, self.intermediate_size), self.param_dtype)
+        w_up = self.param("w_up", nn.with_logical_partitioning(init, (EXPERTS, EXPERT_EMBED, EXPERT_MLP)),
+                          (self.num_experts, self.hidden_size, self.intermediate_size), self.param_dtype)
+        w_down = self.param("w_down", nn.with_logical_partitioning(init, (EXPERTS, EXPERT_MLP, EXPERT_EMBED)),
+                            (self.num_experts, self.intermediate_size, self.hidden_size), self.param_dtype)
+        x = x.astype(self.dtype)
+        gate = jnp.einsum("gecd,edf->gecf", x, w_gate.astype(self.dtype))
+        up = jnp.einsum("gecd,edf->gecf", x, w_up.astype(self.dtype))
+        h = nn.silu(gate) * up
+        return jnp.einsum("gecf,efd->gecd", h, w_down.astype(self.dtype))

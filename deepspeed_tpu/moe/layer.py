@@ -1,0 +1,87 @@
+"""MoE layer (ref: deepspeed/moe/layer.py:17 MoE → sharded_moe.py:533 MOELayer).
+
+Drop-in FFN replacement: [B, S, d] → ([B, S, d], l_aux, exp_counts).
+Wire it into a transformer block in place of the dense MLP; add ``l_aux``
+(times a coefficient) to the loss — same contract as the reference, where
+the MoE layer returns (output, l_aux, exp_counts).
+"""
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ..comm.mesh import BATCH_AXES, axis_size, get_global_mesh
+from ..models.llama import EMBED
+from .experts import ExpertsFFN
+from .sharded_moe import _capacity, dispatch_combine, top1_gating, topk_gating
+
+
+class MoE(nn.Module):
+    """ref: deepspeed/moe/layer.py MoE(hidden_size, expert, num_experts, ep_size,
+    k, capacity_factor, eval_capacity_factor, min_capacity, drop_tokens,
+    use_rts, noisy_gate_policy)."""
+    hidden_size: int
+    num_experts: int = 1
+    intermediate_size: Optional[int] = None
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    drop_tokens: bool = True
+    noisy_gate_policy: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b, s, d = x.shape
+        mesh = get_global_mesh()
+        groups = axis_size(mesh, *BATCH_AXES)
+        if b % groups != 0:
+            groups = 1
+        tokens_per_group = (b // groups) * s
+
+        # gate projection (ref: TopKGate.wg — kept fp32 for stable softmax)
+        gate_logits = nn.Dense(self.num_experts,
+                               use_bias=False,
+                               dtype=jnp.float32,
+                               param_dtype=jnp.float32,
+                               kernel_init=nn.with_logical_partitioning(nn.initializers.lecun_normal(),
+                                                                        (EMBED, "experts_gate")),
+                               name="gate")(x.astype(jnp.float32))
+
+        cap_factor = self.capacity_factor if train else self.eval_capacity_factor
+        if self.drop_tokens:
+            capacity = _capacity(tokens_per_group, self.num_experts, cap_factor, self.min_capacity, self.k)
+        else:
+            capacity = tokens_per_group
+
+        xg = x.reshape(groups, tokens_per_group, d)
+        lg = gate_logits.reshape(groups, tokens_per_group, self.num_experts)
+
+        if self.k == 1:
+            import jax
+            use_noise = bool(self.noisy_gate_policy and train and self.has_rng("gating"))
+            if use_noise:
+                rngs = jax.random.split(self.make_rng("gating"), groups)
+                l_aux, combine, dispatch, exp_counts = jax.vmap(
+                    lambda lg_i, rng_i: top1_gating(lg_i, capacity, self.noisy_gate_policy, rng_i))(lg, rngs)
+            else:
+                l_aux, combine, dispatch, exp_counts = jax.vmap(
+                    lambda lg_i: top1_gating(lg_i, capacity, None, None))(lg)
+        else:
+            import jax
+            l_aux, combine, dispatch, exp_counts = jax.vmap(
+                lambda lg_i: topk_gating(lg_i, self.k, capacity, self.drop_tokens))(lg)
+
+        experts = ExpertsFFN(num_experts=self.num_experts,
+                             hidden_size=d,
+                             intermediate_size=self.intermediate_size or 4 * d,
+                             dtype=self.dtype,
+                             param_dtype=self.param_dtype,
+                             name="experts")
+        out = dispatch_combine(xg, combine, dispatch, experts)
+        out = out.reshape(b, s, d).astype(x.dtype)
+        return out, jnp.mean(l_aux), jnp.sum(exp_counts, axis=0)

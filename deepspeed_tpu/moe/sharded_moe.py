@@ -1,0 +1,139 @@
+"""Mixture-of-Experts core: gating + dispatch/combine.
+
+Reference: ``deepspeed/moe/sharded_moe.py`` — ``TopKGate:449`` (top1/top2/topk
+gating at ``:183,290,374``), ``MOELayer:533`` with all-to-all dispatch
+(``_AllToAll:96``) to local ``Experts``.
+
+TPU-native realisation (GShard-style, compiler-scheduled): tokens are grouped
+by their data shard ([G, S, d], G sharded over the batch axes); gating
+produces per-group dispatch/combine tensors; the dispatch einsum produces
+[G, E, C, d] which we resharding-constrain from group-sharded to
+expert-sharded — GSPMD lowers that to the same all-to-all the reference
+issues explicitly, riding ICI.  Capacity/drop semantics follow the
+reference: ``capacity = ceil(k * S / E * capacity_factor)``, clamped to
+``min_capacity``, tokens beyond capacity dropped (or kept when
+``drop_tokens=False`` → capacity = S).
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..comm.mesh import BATCH_AXES, EXPERT_AXIS, get_global_mesh
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float, min_capacity: int, k: int) -> int:
+    """ref: sharded_moe.py _capacity — ceil(k*S/E * factor), >= min_capacity."""
+    cap = int(np.ceil(k * num_tokens / num_experts * capacity_factor))
+    return max(cap, min_capacity)
+
+
+def _one_hot(idx, n):
+    return jax.nn.one_hot(idx, n, dtype=jnp.float32)
+
+
+def top1_gating(logits,
+                capacity: int,
+                noisy_gate_policy: Optional[str] = None,
+                rng=None,
+                used_token_mask=None):
+    """Top-1 gating (ref: sharded_moe.py:183 top1gating).
+
+    logits: [S, E] per group.  Returns (l_aux, combine [S,E,C], dispatch
+    [S,E,C] bool, exp_counts [E]).
+    """
+    s, e = logits.shape
+    if noisy_gate_policy == "RSample" and rng is not None:
+        noisy = logits + jax.random.gumbel(rng, logits.shape)
+    else:
+        noisy = logits
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx1 = jnp.argmax(noisy, axis=-1)  # [S]
+    mask1 = _one_hot(idx1, e)  # [S, E]
+    if used_token_mask is not None:
+        mask1 = mask1 * used_token_mask[:, None]
+
+    # aux load-balancing loss (ref: l_aux = E * sum(me * ce))
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * e
+
+    locations1 = jnp.cumsum(mask1, axis=0) - mask1  # position within expert
+    pos_in_expert = jnp.sum(locations1 * mask1, axis=-1)  # [S]
+    keep = pos_in_expert < capacity
+    mask1 = mask1 * keep[:, None]
+    gate_val = jnp.sum(gates * mask1, axis=-1)  # [S], 0 for dropped
+
+    loc_onehot = _one_hot(pos_in_expert.astype(jnp.int32), capacity) * keep[:, None]
+    combine = gate_val[:, None, None] * mask1[:, :, None] * loc_onehot[:, None, :]
+    dispatch = combine > 0
+    exp_counts = jnp.sum(mask1, axis=0)
+    return l_aux, combine, dispatch, exp_counts
+
+
+def topk_gating(logits, k: int, capacity: int, drop_tokens: bool = True, normalize: bool = True):
+    """Generic top-k gating (covers top2gating :290 and topkgating :374).
+
+    Selection priority is expert-local arrival order after flattening the k
+    choices (k-major), matching the reference's cumsum-over-(k*S) ordering.
+    """
+    s, e = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)
+    topk_vals, topk_idx = jax.lax.top_k(gates, k)  # [S, k]
+    if normalize:
+        denom = jnp.sum(topk_vals, axis=-1, keepdims=True)
+        topk_vals = topk_vals / jnp.maximum(denom, 1e-9)
+
+    # masks per choice: [k, S, E]
+    masks = _one_hot(topk_idx.transpose(1, 0), e)  # [k, S, E]
+
+    # aux loss uses the top-1 mask (ref top2gating: mask1)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(masks[0], axis=0)
+    l_aux = jnp.sum(me * ce) * e
+
+    # order: choice-major flatten so 1st choices win capacity first
+    flat = masks.reshape(k * s, e)
+    locations = jnp.cumsum(flat, axis=0) - flat  # [k*S, E]
+    pos = jnp.sum(locations * flat, axis=-1).reshape(k, s)
+    keep = pos < capacity if drop_tokens else jnp.ones_like(pos, dtype=bool)
+
+    combine = jnp.zeros((s, e, capacity), jnp.float32)
+    for i in range(k):
+        loc_onehot = _one_hot(pos[i].astype(jnp.int32), capacity) * keep[i][:, None]
+        combine = combine + topk_vals[:, i][:, None, None] * masks[i][:, :, None] * loc_onehot[:, None, :]
+    dispatch = combine > 0
+    exp_counts = jnp.sum(masks.sum(0), axis=0)
+    return l_aux, combine, dispatch, exp_counts
+
+
+def dispatch_combine(x_grouped, combine, dispatch, expert_fn):
+    """Dispatch → expert compute → combine, with GSPMD all-to-all.
+
+    x_grouped: [G, S, d]; combine/dispatch: [G, S, E, C].
+    expert_fn: [G?, E, C, d] → [E, C, d]-shaped output per group stack —
+    called with dispatched [G, E, C, d].
+    """
+    mesh = get_global_mesh()
+    has_ep = mesh.shape.get(EXPERT_AXIS, 1) > 1
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..comm.mesh import DATA_AXIS
+
+    dispatched = jnp.einsum("gsec,gsd->gecd", dispatch.astype(x_grouped.dtype), x_grouped)
+    if has_ep:
+        # groups go from (data, expert)-sharded to data-sharded while the
+        # expert dim picks up the expert axis: GSPMD lowers this resharding
+        # to the dispatch all-to-all (ref: _AllToAll sharded_moe.py:96)
+        g = x_grouped.shape[0]
+        dsize = mesh.shape.get(DATA_AXIS, 1)
+        g_axis = DATA_AXIS if (dsize > 1 and g % dsize == 0) else None
+        ep_sh = NamedSharding(mesh, P(g_axis, EXPERT_AXIS, None, None))
+        dispatched = jax.lax.with_sharding_constraint(dispatched, ep_sh)
+    expert_out = expert_fn(dispatched)  # [G, E, C, d_out]
+    if has_ep:
+        expert_out = jax.lax.with_sharding_constraint(expert_out, ep_sh)
+    out = jnp.einsum("gsec,gecd->gsd", combine.astype(expert_out.dtype), expert_out)
+    return out

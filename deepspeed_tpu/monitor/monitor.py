@@ -1,0 +1,116 @@
+"""Experiment monitoring (ref: deepspeed/monitor/monitor.py:30 MonitorMaster).
+
+Fans out ``write_events([(tag, value, step)])`` to the enabled backends:
+TensorBoard (ref: monitor/tensorboard.py), WandB (monitor/wandb.py), CSV
+(monitor/csv_monitor.py), Comet (monitor/comet.py).  Only process 0 writes.
+"""
+
+import csv
+import os
+from typing import List, Tuple
+
+from ..utils.logging import logger
+
+
+class Monitor:
+
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+        self.enabled = False
+
+    def write_events(self, event_list: List[Tuple]):
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+
+    def __init__(self, tensorboard_config):
+        super().__init__(tensorboard_config)
+        self.summary_writer = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            out = os.path.join(tensorboard_config.output_path or "./runs", tensorboard_config.job_name)
+            self.summary_writer = SummaryWriter(log_dir=out)
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"TensorBoard monitor disabled: {e}")
+
+    def write_events(self, event_list):
+        if self.summary_writer is None:
+            return
+        for name, value, step in event_list:
+            self.summary_writer.add_scalar(name, value, step)
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+
+    def __init__(self, wandb_config):
+        super().__init__(wandb_config)
+        try:
+            import wandb
+            wandb.init(project=wandb_config.project, group=wandb_config.group, entity=wandb_config.team)
+            self.wandb = wandb
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"WandB monitor disabled: {e}")
+            self.wandb = None
+
+    def write_events(self, event_list):
+        if self.wandb is None:
+            return
+        for name, value, step in event_list:
+            self.wandb.log({name: value}, step=step)
+
+
+class csvMonitor(Monitor):
+
+    def __init__(self, csv_config):
+        super().__init__(csv_config)
+        self.filenames = {}
+        self.output_path = os.path.join(csv_config.output_path or "./csv_logs", csv_config.job_name)
+        os.makedirs(self.output_path, exist_ok=True)
+        self.enabled = True
+
+    def write_events(self, event_list):
+        for name, value, step in event_list:
+            safe = name.replace("/", "_")
+            path = os.path.join(self.output_path, f"{safe}.csv")
+            new = not os.path.exists(path)
+            with open(path, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, value])
+
+
+class MonitorMaster(Monitor):
+    """ref: monitor/monitor.py:30 — routes events to every enabled writer."""
+
+    def __init__(self, monitor_config):
+        super().__init__(monitor_config)
+        self.monitors = []
+        try:
+            import jax
+            is_rank0 = jax.process_index() == 0
+        except Exception:
+            is_rank0 = True
+        if not is_rank0:
+            return
+        if monitor_config.tensorboard.enabled:
+            m = TensorBoardMonitor(monitor_config.tensorboard)
+            if m.enabled:
+                self.monitors.append(m)
+        if monitor_config.wandb.enabled:
+            m = WandbMonitor(monitor_config.wandb)
+            if m.enabled:
+                self.monitors.append(m)
+        if monitor_config.csv_monitor.enabled:
+            m = csvMonitor(monitor_config.csv_monitor)
+            if m.enabled:
+                self.monitors.append(m)
+        self.enabled = bool(self.monitors)
+
+    def write_events(self, event_list):
+        for m in self.monitors:
+            m.write_events(event_list)

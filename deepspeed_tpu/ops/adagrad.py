@@ -1,0 +1,51 @@
+"""Adagrad (ref: csrc/adagrad/cpu_adagrad.cpp, deepspeed/ops/adagrad)."""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .optimizer import GradientTransformation, add_weight_decay, resolve_lr, tree_zeros_like
+
+
+class AdagradState(NamedTuple):
+    step: jnp.ndarray
+    accum: Any
+
+
+def adagrad(lr=1e-2, eps=1e-10, weight_decay=0.0) -> GradientTransformation:
+
+    def init(params):
+        return AdagradState(step=jnp.zeros((), jnp.int32), accum=tree_zeros_like(params, jnp.float32))
+
+    def update(grads, state: AdagradState, params=None):
+        lr_v = resolve_lr(lr, state.step + 1)
+        g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        g32 = add_weight_decay(g32, params, weight_decay)
+        accum = jax.tree.map(lambda a, g: a + jnp.square(g), state.accum, g32)
+        updates = jax.tree.map(lambda g, a: -lr_v * g / (jnp.sqrt(a) + eps), g32, accum)
+        return updates, AdagradState(step=state.step + 1, accum=accum)
+
+    return GradientTransformation(init, update)
+
+
+class SGDState(NamedTuple):
+    momentum: Any
+
+
+def sgd(lr=1e-2, momentum=0.0, weight_decay=0.0, nesterov=False) -> GradientTransformation:
+
+    def init(params):
+        return SGDState(momentum=tree_zeros_like(params, jnp.float32) if momentum else ())
+
+    def update(grads, state: SGDState, params=None):
+        lr_v = resolve_lr(lr, 0)
+        g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        g32 = add_weight_decay(g32, params, weight_decay)
+        if momentum:
+            buf = jax.tree.map(lambda b, g: momentum * b + g, state.momentum, g32)
+            eff = jax.tree.map(lambda g, b: g + momentum * b, g32, buf) if nesterov else buf
+            return jax.tree.map(lambda e: -lr_v * e, eff), SGDState(momentum=buf)
+        return jax.tree.map(lambda g: -lr_v * g, g32), state
+
+    return GradientTransformation(init, update)

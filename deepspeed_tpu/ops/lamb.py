@@ -1,0 +1,64 @@
+"""Fused LAMB (ref: csrc/lamb/fused_lamb_cuda_kernel.cu, deepspeed/ops/lamb).
+
+Layer-wise adaptive rate: per-parameter trust ratio ||w|| / ||update||.
+The CUDA kernel does a two-pass reduction per tensor; here each leaf's norms
+fuse into the single XLA update program.
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .optimizer import GradientTransformation, resolve_lr, tree_zeros_like
+
+
+class LambState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: Any
+    exp_avg_sq: Any
+
+
+def fused_lamb(lr=1e-3,
+               betas=(0.9, 0.999),
+               eps=1e-8,
+               weight_decay=0.0,
+               bias_correction=True,
+               max_coeff=10.0,
+               min_coeff=0.01) -> GradientTransformation:
+    b1, b2 = betas
+
+    def init(params):
+        return LambState(step=jnp.zeros((), jnp.int32),
+                         exp_avg=tree_zeros_like(params, jnp.float32),
+                         exp_avg_sq=tree_zeros_like(params, jnp.float32))
+
+    def update(grads, state: LambState, params=None):
+        assert params is not None, "LAMB requires params for the trust ratio"
+        step = state.step + 1
+        lr_v = resolve_lr(lr, step)
+        g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, state.exp_avg, g32)
+        v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * jnp.square(g), state.exp_avg_sq, g32)
+        if bias_correction:
+            c1 = 1 - b1**step.astype(jnp.float32)
+            c2 = 1 - b2**step.astype(jnp.float32)
+        else:
+            c1 = c2 = jnp.ones((), jnp.float32)
+
+        def leaf_update(m_, v_, p):
+            p32 = p.astype(jnp.float32)
+            u = (m_ / c1) / (jnp.sqrt(v_ / c2) + eps) + weight_decay * p32
+            w_norm = jnp.linalg.norm(p32.reshape(-1))
+            u_norm = jnp.linalg.norm(u.reshape(-1))
+            trust = jnp.where(u_norm > 0, jnp.where(w_norm > 0, w_norm / u_norm, 1.0), 1.0)
+            trust = jnp.clip(trust, min_coeff, max_coeff)
+            return -lr_v * trust * u
+
+        updates = jax.tree.map(leaf_update, m, v, params)
+        return updates, LambState(step=step, exp_avg=m, exp_avg_sq=v)
+
+    return GradientTransformation(init, update)
+
+
+lamb = fused_lamb

@@ -1,0 +1,38 @@
+"""Lion (ref: csrc/lion/fused_lion*.cu + deepspeed/ops/lion).
+
+sign-of-interpolated-momentum update; decoupled weight decay.
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .optimizer import GradientTransformation, resolve_lr, tree_zeros_like
+
+
+class LionState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: Any
+
+
+def fused_lion(lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0) -> GradientTransformation:
+    b1, b2 = betas
+
+    def init(params):
+        return LionState(step=jnp.zeros((), jnp.int32), exp_avg=tree_zeros_like(params, jnp.float32))
+
+    def update(grads, state: LionState, params=None):
+        step = state.step + 1
+        lr_v = resolve_lr(lr, step)
+        g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        updates = jax.tree.map(lambda m_, g: -lr_v * jnp.sign(b1 * m_ + (1 - b1) * g), state.exp_avg, g32)
+        if weight_decay > 0.0 and params is not None:
+            updates = jax.tree.map(lambda u, p: u - lr_v * weight_decay * p.astype(jnp.float32), updates, params)
+        m = jax.tree.map(lambda m_, g: b2 * m_ + (1 - b2) * g, state.exp_avg, g32)
+        return updates, LionState(step=step, exp_avg=m)
+
+    return GradientTransformation(init, update)
+
+
+lion = fused_lion

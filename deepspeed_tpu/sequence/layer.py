@@ -1,0 +1,89 @@
+"""DeepSpeed-Ulysses sequence parallelism, TPU-native.
+
+Reference: ``deepspeed/sequence/layer.py`` — ``DistributedAttention:311``
+wraps any attention impl with a head-scatter/seq-gather all-to-all before it
+(``_SeqAllToAll:257``, ``single_all_to_all:221``) and the reverse after.
+
+TPU-native realisation: activations live sequence-sharded over the ``seq``
+mesh axis.  Around the attention core we simply *change the sharding
+constraint* from (seq→``seq`` axis, heads→``tensor``) to (seq replicated,
+heads→(``seq``, ``tensor``)); GSPMD lowers that resharding to exactly the
+all-to-all the reference hand-codes, scheduled on ICI.  Two code paths:
+
+* ``DistributedAttention`` — GSPMD constraint-based (works under plain jit).
+* ``ulysses_all_to_all`` / ``UlyssesAttentionShardMap`` — explicit
+  ``jax.lax.all_to_all`` for use inside ``shard_map`` (parity with
+  ``single_all_to_all``'s explicit scatter/gather semantics).
+"""
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..comm.mesh import BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, get_global_mesh
+
+
+def _mesh_has(axis):
+    mesh = get_global_mesh()
+    return mesh.shape.get(axis, 1) > 1
+
+
+class DistributedAttention:
+    """Wraps an attention impl with Ulysses seq↔head resharding
+    (ref: sequence/layer.py:311 DistributedAttention).
+
+    ``attn_fn(q, k, v, **kw)`` takes [B, S, H, D] tensors.  scatter_idx=2
+    (heads), gather_idx=1 (sequence) mirror the reference's defaults.
+    """
+
+    def __init__(self, attn_fn: Callable, scatter_idx: int = 2, gather_idx: int = 1):
+        self.attn_fn = attn_fn
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def __call__(self, q, k, v, **kwargs):
+        if not _mesh_has(SEQ_AXIS):
+            return self.attn_fn(q, k, v, **kwargs)
+        from jax.sharding import NamedSharding
+        mesh = get_global_mesh()
+        # pre-attention: gather sequence, scatter heads over (seq, tensor)
+        head_axes = (SEQ_AXIS, TENSOR_AXIS)
+        inner = NamedSharding(mesh, P(BATCH_AXES, None, head_axes, None))
+        q, k, v = (jax.lax.with_sharding_constraint(t, inner) for t in (q, k, v))
+        out = self.attn_fn(q, k, v, **kwargs)
+        # post-attention: scatter sequence back, heads back to tensor-only
+        outer = NamedSharding(mesh, P(BATCH_AXES, SEQ_AXIS,
+                                      TENSOR_AXIS if _mesh_has(TENSOR_AXIS) else None, None))
+        return jax.lax.with_sharding_constraint(out, outer)
+
+
+def ulysses_all_to_all(x, axis_name: str, scatter_idx: int, gather_idx: int):
+    """Explicit all-to-all for shard_map bodies (ref: single_all_to_all:221).
+
+    Scatters dim ``scatter_idx`` across the axis and gathers dim
+    ``gather_idx`` — e.g. [B, s_local, H, D] → [B, S, H/sp, D].
+    """
+    return jax.lax.all_to_all(x, axis_name, split_axis=scatter_idx, concat_axis=gather_idx, tiled=True)
+
+
+def ulysses_attention_shard_map(attn_fn: Callable, mesh=None, seq_axis: str = SEQ_AXIS):
+    """Build a shard_map'd Ulysses attention: explicit collectives, for
+    kernels (e.g. Pallas flash) that must see the full sequence locally."""
+    mesh = mesh or get_global_mesh()
+    qkv_spec = P(BATCH_AXES, seq_axis, TENSOR_AXIS if mesh.shape.get(TENSOR_AXIS, 1) > 1 else None, None)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec)
+    def wrapped(q, k, v):
+        if mesh.shape.get(seq_axis, 1) > 1:
+            q = ulysses_all_to_all(q, seq_axis, 2, 1)
+            k = ulysses_all_to_all(k, seq_axis, 2, 1)
+            v = ulysses_all_to_all(v, seq_axis, 2, 1)
+        out = attn_fn(q, k, v, causal=True)
+        if mesh.shape.get(seq_axis, 1) > 1:
+            out = ulysses_all_to_all(out, seq_axis, 1, 2)
+        return out
+
+    return wrapped

@@ -1,0 +1,36 @@
+"""Test harness: single-process multi-device CPU mesh.
+
+Mirrors the reference's distributed-without-a-cluster strategy
+(ref: tests/unit/common.py DistributedExec — which spawns real localhost
+process groups).  On the JAX side the analogous trick is
+``--xla_force_host_platform_device_count=8``: one process, 8 virtual CPU
+devices, real XLA collectives over them (SURVEY.md §4 "lesson for the TPU
+rebuild").
+
+The environment may have eagerly initialised a TPU backend at interpreter
+start (sitecustomize); we force a reset onto the 8-device CPU platform
+before any test imports run.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 " + os.environ.get("XLA_FLAGS", ""))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as _xb
+    _xb._clear_backends()
+except Exception:
+    pass
+assert jax.device_count() == 8, f"expected 8 CPU devices, got {jax.devices()}"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    yield
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    mesh_lib._GLOBAL_MESH = None

@@ -1,0 +1,92 @@
+"""Config-system tests (analog of tests/unit/runtime/test_ds_config_dict.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_batch_triad_inference():
+    cfg = DeepSpeedConfig({"train_batch_size": 16}, dp_world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 1
+
+    cfg = DeepSpeedConfig({"train_batch_size": 16, "train_micro_batch_size_per_gpu": 2}, dp_world_size=4)
+    assert cfg.gradient_accumulation_steps == 2
+
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 3}, dp_world_size=4)
+    assert cfg.train_batch_size == 24
+
+
+def test_batch_triad_mismatch_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 10, "train_micro_batch_size_per_gpu": 3,
+                         "gradient_accumulation_steps": 2}, dp_world_size=4)
+
+
+def test_no_batch_info_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, dp_world_size=1)
+
+
+def test_zero_config_aliases():
+    cfg = DeepSpeedConfig(
+        {
+            "train_batch_size": 8,
+            "zero_optimization": {
+                "stage": 3,
+                "stage3_max_live_parameters": 123,
+                "stage3_prefetch_bucket_size": 456,
+                "stage3_gather_16bit_weights_on_model_save": True,
+            },
+        },
+        dp_world_size=1)
+    assert cfg.zero_config.stage == 3
+    assert cfg.zero_config.max_live_parameters == 123
+    assert cfg.zero_config.prefetch_bucket_size == 456
+    assert cfg.zero_config.gather_16bit_weights_on_model_save is True
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True}, "bf16": {"enabled": True}},
+                        dp_world_size=1)
+
+
+def test_precision_dtype():
+    import jax.numpy as jnp
+    assert DeepSpeedConfig({"train_batch_size": 8, "bf16": {"enabled": True}},
+                           dp_world_size=1).precision_dtype == jnp.bfloat16
+    assert DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True}},
+                           dp_world_size=1).precision_dtype == jnp.float16
+    assert DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=1).precision_dtype == jnp.float32
+
+
+def test_offload_configs():
+    cfg = DeepSpeedConfig(
+        {
+            "train_batch_size": 8,
+            "zero_optimization": {
+                "stage": 3,
+                "offload_optimizer": {"device": "cpu", "pin_memory": True},
+                "offload_param": {"device": "cpu"},
+            },
+        },
+        dp_world_size=1)
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+    assert cfg.zero_config.offload_param.device == "cpu"
+
+
+def test_unknown_keys_warn_not_fail():
+    DeepSpeedConfig({"train_batch_size": 8, "zero_optimization": {"stage": 1, "bogus_key": 1}}, dp_world_size=1)
+
+
+def test_scheduler_optimizer_blocks():
+    cfg = DeepSpeedConfig(
+        {
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "weight_decay": 0.1}},
+            "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+        },
+        dp_world_size=1)
+    assert cfg.optimizer_config.type == "AdamW"
+    assert cfg.scheduler_config.type == "WarmupLR"

@@ -1,0 +1,182 @@
+"""End-to-end engine tests across ZeRO stages and precisions (analog of
+tests/unit/runtime/zero/test_zero.py + half_precision/test_fp16.py)."""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.llama import LlamaForCausalLM
+
+from simple_model import TINY, base_config, random_batch
+
+
+def make_engine(config_over=None, model_cfg=None):
+    cfg = base_config(**(config_over or {}))
+    model = LlamaForCausalLM(model_cfg or TINY)
+    engine, _, _, _ = ds.initialize(model=model, config=cfg)
+    return engine
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stages_train(stage):
+    engine = make_engine({"zero_optimization": {"stage": stage}})
+    batch = random_batch()
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(4)]
+    assert losses[-1] < losses[0], f"stage {stage}: loss did not decrease: {losses}"
+    assert np.isfinite(losses).all()
+
+
+@pytest.mark.parametrize("stage", [0, 3])
+def test_zero_stages_match_stage0(stage):
+    """All stages must compute the SAME optimization trajectory — sharding is
+    a layout choice, not a math choice (core ZeRO invariant)."""
+    ref = make_engine({"zero_optimization": {"stage": 0}})
+    test = make_engine({"zero_optimization": {"stage": stage}})
+    batch = random_batch()
+    for _ in range(3):
+        l0 = float(ref.train_batch(batch=batch))
+        l1 = float(test.train_batch(batch=batch))
+        # tolerance: sharded matmuls change fp32 reduction order
+        assert abs(l0 - l1) / abs(l0) < 3e-3, f"stage {stage} diverged from stage 0: {l0} vs {l1}"
+
+
+def test_bf16_training():
+    engine = make_engine({"bf16": {"enabled": True}, "zero_optimization": {"stage": 2}})
+    batch = random_batch()
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(4)]
+    assert losses[-1] < losses[0]
+    # params stored in bf16, master in fp32
+    leaf = jax.tree.leaves(engine.state.params)[0]
+    assert leaf.dtype == jnp.bfloat16
+    mleaf = jax.tree.leaves(engine.state.master)[0]
+    assert mleaf.dtype == jnp.float32
+
+
+def test_fp16_dynamic_loss_scale():
+    engine = make_engine({"fp16": {"enabled": True, "initial_scale_power": 8}})
+    batch = random_batch()
+    for _ in range(3):
+        loss = float(engine.train_batch(batch=batch))
+    assert np.isfinite(loss)
+    assert engine.loss_scale == 2.0**8  # no overflow happened
+
+
+def test_gradient_accumulation_equivalence():
+    """gas=2 with half micro-batches must match gas=1 on the full batch."""
+    e1 = make_engine({"train_batch_size": 16, "gradient_accumulation_steps": 1})
+    e2 = make_engine({"train_batch_size": 16, "gradient_accumulation_steps": 2})
+    batch = random_batch(16)
+    for _ in range(2):
+        l1 = float(e1.train_batch(batch=batch))
+        l2 = float(e2.train_batch(batch=batch))
+        assert abs(l1 - l2) / abs(l1) < 1e-3, f"gas mismatch {l1} vs {l2}"
+
+
+def test_forward_backward_step_api():
+    """Imperative API parity (ref: engine.forward/backward/step)."""
+    engine = make_engine()
+    batch = random_batch()
+    fused = make_engine()
+    for _ in range(2):
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        assert engine.is_gradient_accumulation_boundary()
+        metrics = engine.step()
+        fused_loss = fused.train_batch(batch=batch)
+        assert abs(float(metrics.loss) - float(fused_loss)) < 1e-4
+
+
+def test_forward_backward_step_gas2():
+    """Imperative path with gradient accumulation: one backward() per
+    micro-batch, step() at the boundary — must match the fused path
+    (regression: backward() used to re-split each micro-batch by gas)."""
+    over = {"train_batch_size": 16, "gradient_accumulation_steps": 2}
+    imp = make_engine(over)
+    fused = make_engine(over)
+    full = random_batch(16)
+    micro = [jax.tree.map(lambda x: x[:8], full), jax.tree.map(lambda x: x[8:], full)]
+    for _ in range(2):
+        for mb in micro:
+            imp.backward(batch=mb)
+        metrics = imp.step()
+        fused_loss = fused.train_batch(batch=full)
+        assert abs(float(metrics.loss) - float(fused_loss)) / abs(float(fused_loss)) < 1e-3, \
+            f"{float(metrics.loss)} vs {float(fused_loss)}"
+
+
+def test_dataloader_micro_batch_size():
+    """initialize(training_data=...) loader must yield micro-batches of
+    train_batch_size // gas (regression: yielded full global batches)."""
+    import deepspeed_tpu as ds
+    model = LlamaForCausalLM(TINY)
+    data = [{"input_ids": np.zeros((16, ), np.int32), "labels": np.zeros((16, ), np.int32)} for _ in range(64)]
+    cfg = base_config(train_batch_size=16, gradient_accumulation_steps=2)
+    engine, _, loader, _ = ds.initialize(model=model, config=cfg, training_data=data)
+    first = next(iter(loader))
+    assert first["input_ids"].shape[0] == 8  # 16 global / 2 gas
+    loss = engine.train_batch(data_iter=iter(loader))
+    assert np.isfinite(float(loss))
+
+
+def test_gradient_clipping():
+    # use SGD: Adam's update is invariant to gradient scaling, so clipping
+    # must be observed through an optimizer whose step scales with the grads
+    engine = make_engine({
+        "gradient_clipping": 1e-5,
+        "optimizer": {"type": "SGD", "params": {"lr": 1.0}},
+    })
+    batch = random_batch()
+    l0 = float(engine.train_batch(batch=batch))
+    l1 = float(engine.train_batch(batch=batch))
+    # grad norm clipped to 1e-5 with lr=1 → negligible param movement
+    assert abs(l1 - l0) < 1e-3
+
+
+def test_train_batch_from_iterator():
+    engine = make_engine({"train_batch_size": 16, "gradient_accumulation_steps": 2})
+
+    def gen():
+        i = 0
+        while True:
+            yield random_batch(8, seed=i)
+            i += 1
+
+    loss = engine.train_batch(data_iter=gen())
+    assert np.isfinite(float(loss))
+
+
+def test_param_shardings_stage3():
+    """Stage 3 must actually shard params over the DP axes."""
+    engine = make_engine({"zero_optimization": {"stage": 3}})
+    engine.train_batch(batch=random_batch())
+    # find a 2D+ param and check it is not fully replicated
+    from deepspeed_tpu.comm.mesh import ZERO_AXES
+    sharded = 0
+    for leaf in jax.tree.leaves(engine.state_shardings.params):
+        spec_flat = []
+        for e in leaf.spec:
+            spec_flat.extend(e if isinstance(e, tuple) else (e, ))
+        if any(a in spec_flat for a in ZERO_AXES):
+            sharded += 1
+    assert sharded > 0, "no param sharded over DP axes in stage 3"
+
+
+def test_optimizer_state_sharded_stage1():
+    engine = make_engine({"zero_optimization": {"stage": 1}})
+    engine.train_batch(batch=random_batch())
+    from deepspeed_tpu.comm.mesh import ZERO_AXES
+    found = 0
+    for leaf in jax.tree.leaves(engine.state_shardings.opt_state):
+        spec_flat = []
+        for e in getattr(leaf, "spec", ()):  # NamedSharding
+            spec_flat.extend(e if isinstance(e, tuple) else (e, ))
+        if any(a in spec_flat for a in ZERO_AXES):
+            found += 1
+    assert found > 0, "stage 1 did not shard optimizer state"

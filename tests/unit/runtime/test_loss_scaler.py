@@ -1,0 +1,55 @@
+"""Dynamic loss scaler semantics (analog of the fp16 scaler coverage in
+tests/unit/runtime/half_precision/test_dynamic_loss_scale.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import (DynamicLossScaler, StaticLossScaler, found_inf_or_nan)
+
+
+def test_overflow_halves_scale():
+    s = DynamicLossScaler(init_scale=2**16, scale_window=1000, delayed_shift=1)
+    st = s.init_state()
+    st = s.update(st, jnp.asarray(True))
+    assert float(st.cur_scale) == 2**15
+
+
+def test_growth_after_window():
+    s = DynamicLossScaler(init_scale=4.0, scale_window=3, delayed_shift=1)
+    st = s.init_state()
+    for _ in range(3):
+        st = s.update(st, jnp.asarray(False))
+    assert float(st.cur_scale) == 8.0
+
+
+def test_hysteresis_delays_shift():
+    s = DynamicLossScaler(init_scale=16.0, scale_window=1000, delayed_shift=2)
+    st = s.init_state()
+    st = s.update(st, jnp.asarray(True))
+    assert float(st.cur_scale) == 16.0  # first overflow only burns hysteresis
+    st = s.update(st, jnp.asarray(True))
+    assert float(st.cur_scale) == 8.0
+
+
+def test_min_scale_floor():
+    s = DynamicLossScaler(init_scale=2.0, min_scale=1.0, delayed_shift=1)
+    st = s.init_state()
+    for _ in range(5):
+        st = s.update(st, jnp.asarray(True))
+    assert float(st.cur_scale) == 1.0
+
+
+def test_static_scaler_never_changes():
+    s = StaticLossScaler(scale=128.0)
+    st = s.init_state()
+    st = s.update(st, jnp.asarray(True))
+    assert float(st.cur_scale) == 128.0
+
+
+def test_found_inf_or_nan():
+    ok = {"a": jnp.ones((3, ))}
+    bad = {"a": jnp.asarray([1.0, np.inf, 2.0])}
+    nan = {"a": jnp.asarray([np.nan])}
+    assert not bool(found_inf_or_nan(ok))
+    assert bool(found_inf_or_nan(bad))
+    assert bool(found_inf_or_nan(nan))

@@ -1,0 +1,69 @@
+"""LR schedule tests (analog of tests/unit/runtime/test_lr_schedulers.py)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (VALID_LR_SCHEDULES, get_lr_schedule, lr_range_test, one_cycle,
+                                                warmup_cosine_lr, warmup_decay_lr, warmup_lr, LRSchedulerShim)
+
+
+def test_warmup_lr_linear():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=10, warmup_type="linear")
+    assert float(s(0)) == pytest.approx(0.0)
+    assert float(s(5)) == pytest.approx(0.5)
+    assert float(s(10)) == pytest.approx(1.0)
+    assert float(s(100)) == pytest.approx(1.0)
+
+
+def test_warmup_lr_log():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=100, warmup_type="log")
+    assert float(s(1)) == pytest.approx(0.0)
+    assert float(s(10)) == pytest.approx(0.5)
+    assert float(s(100)) == pytest.approx(1.0)
+
+
+def test_warmup_decay():
+    s = warmup_decay_lr(total_num_steps=110, warmup_max_lr=1.0, warmup_num_steps=10, warmup_type="linear")
+    assert float(s(10)) == pytest.approx(1.0)
+    assert float(s(60)) == pytest.approx(0.5)
+    assert float(s(110)) == pytest.approx(0.0)
+
+
+def test_warmup_cosine():
+    s = warmup_cosine_lr(total_num_steps=110, warmup_num_steps=10, cos_min_ratio=0.0, warmup_type="linear", lr=2.0)
+    assert float(s(10)) == pytest.approx(2.0, abs=1e-3)
+    assert float(s(60)) == pytest.approx(1.0, abs=1e-2)
+    assert float(s(110)) == pytest.approx(0.0, abs=1e-3)
+
+
+def test_lr_range_test_staircase():
+    s = lr_range_test(lr_range_test_min_lr=0.1, lr_range_test_step_size=10, lr_range_test_step_rate=1.0,
+                      lr_range_test_staircase=True)
+    assert float(s(5)) == pytest.approx(0.1)
+    assert float(s(15)) == pytest.approx(0.2)
+
+
+def test_one_cycle_triangle():
+    s = one_cycle(cycle_min_lr=0.0, cycle_max_lr=1.0, cycle_first_step_size=10)
+    assert float(s(0)) == pytest.approx(0.0)
+    assert float(s(10)) == pytest.approx(1.0)
+    assert float(s(20)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_get_lr_schedule_names():
+    for name in VALID_LR_SCHEDULES:
+        params = {"total_num_steps": 100} if "Decay" in name or "Cosine" in name else {}
+        fn = get_lr_schedule(name, params)
+        assert np.isfinite(float(fn(5)))
+    with pytest.raises(ValueError):
+        get_lr_schedule("NotASchedule", {})
+
+
+def test_scheduler_shim_state_dict():
+    shim = LRSchedulerShim(warmup_lr(warmup_max_lr=1.0, warmup_num_steps=10, warmup_type="linear"))
+    for _ in range(5):
+        shim.step()
+    sd = shim.state_dict()
+    shim2 = LRSchedulerShim(warmup_lr(warmup_max_lr=1.0, warmup_num_steps=10, warmup_type="linear"))
+    shim2.load_state_dict(sd)
+    assert shim2.get_last_lr() == shim.get_last_lr()

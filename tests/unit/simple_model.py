@@ -1,0 +1,33 @@
+"""Test fixtures (analog of tests/unit/simple_model.py in the reference)."""
+
+import numpy as np
+
+from deepspeed_tpu.models.llama import LlamaConfig
+
+TINY = LlamaConfig(vocab_size=128,
+                   hidden_size=64,
+                   intermediate_size=128,
+                   num_hidden_layers=2,
+                   num_attention_heads=4,
+                   num_key_value_heads=2,
+                   max_position_embeddings=64,
+                   rope_theta=10000.0)
+
+
+def random_batch(batch_size=8, seq_len=16, vocab=128, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, size=(batch_size, seq_len), dtype=np.int32)
+    return {"input_ids": ids, "labels": ids}
+
+
+def base_config(**over):
+    cfg = {
+        "train_batch_size": 8,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 0,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "bf16": {"enabled": False},
+    }
+    cfg.update(over)
+    return cfg
