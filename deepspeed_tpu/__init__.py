@@ -57,15 +57,18 @@ def initialize(args=None,
     init_distributed(distributed_port=distributed_port, dist_init_required=dist_init_required)
 
     ds_config = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(config, mpu=mpu)
-    engine = DeepSpeedEngine(model=model,
-                             config=ds_config,
-                             optimizer=optimizer,
-                             lr_scheduler=lr_scheduler,
-                             loss_fn=loss_fn,
-                             model_inputs_fn=model_inputs_fn,
-                             mesh=mesh,
-                             params=params,
-                             init_rng=init_rng)
+    from .runtime.pipe.engine import PipelineEngine
+    from .runtime.pipe.module import PipelineModule
+    engine_cls = PipelineEngine if isinstance(model, PipelineModule) else DeepSpeedEngine
+    engine = engine_cls(model=model,
+                        config=ds_config,
+                        optimizer=optimizer,
+                        lr_scheduler=lr_scheduler,
+                        loss_fn=loss_fn,
+                        model_inputs_fn=model_inputs_fn,
+                        mesh=mesh,
+                        params=params,
+                        init_rng=init_rng)
 
     dataloader = None
     if training_data is not None:

@@ -1,0 +1,3 @@
+from .ragged import (BlockedAllocator, BlockedKVCache, RaggedBatch, SequenceDescriptor,  # noqa: F401
+                     StateManager)
+from .scheduler import SchedulerConfig, SplitFuseScheduler, StepPlan  # noqa: F401

@@ -1,0 +1,148 @@
+"""Ragged-batching state: blocked KV allocator, sequence descriptors,
+batch packing.
+
+Reference: ``deepspeed/inference/v2/ragged/`` —
+  BlockedAllocator   (blocked_allocator.py)  → :class:`BlockedAllocator`
+  BlockedKVCache     (kv_cache.py:40)        → :class:`BlockedKVCache`
+  DSSequenceDescriptor (sequence_descriptor.py) → :class:`SequenceDescriptor`
+  RaggedBatchWrapper (ragged_wrapper.py:31)  → :class:`RaggedBatch`
+  DSStateManager     (ragged_manager.py:19)  → :class:`StateManager`
+
+The reference's C++ atom-builder/fast-host-buffer machinery
+(``ragged/csrc``) exists to assemble device metadata quickly per step; here
+the metadata are small numpy arrays handed to a jitted program, so plain
+Python suffices on the host side while the device side stays compiled.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class BlockedAllocator:
+    """Free-list allocator over KV pages (ref: blocked_allocator.py).
+    Page 0 is reserved as the null page that unused block-table slots
+    reference."""
+
+    def __init__(self, num_pages: int):
+        assert num_pages >= 2
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(1, num_pages))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(f"KV cache exhausted: need {n} pages, have {len(self._free)}")
+        pages, self._free = self._free[:n], self._free[n:]
+        return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            assert 0 < p < self.num_pages
+        self._free.extend(pages)
+
+
+@dataclasses.dataclass
+class SequenceDescriptor:
+    """Host-side state of one generation (ref: DSSequenceDescriptor)."""
+    uid: int
+    tokens: List[int]                      # full token history (prompt + generated)
+    pages: List[int] = dataclasses.field(default_factory=list)
+    seen_tokens: int = 0                   # tokens whose KV is in cache
+    generated: List[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+
+    @property
+    def remaining_prefill(self) -> int:
+        return len(self.tokens) - self.seen_tokens
+
+    @property
+    def in_prefill(self) -> bool:
+        return self.remaining_prefill > 0
+
+
+class BlockedKVCache:
+    """Geometry + allocator pairing (ref: kv_cache.py:40).  The device
+    arena itself lives in the engine (a donated jax array)."""
+
+    def __init__(self, num_pages: int, page_size: int, max_pages_per_seq: int):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_pages_per_seq = max_pages_per_seq
+        self.allocator = BlockedAllocator(num_pages)
+
+    def pages_needed(self, seq: SequenceDescriptor, new_tokens: int) -> int:
+        total = len(seq.tokens) if new_tokens == 0 else seq.seen_tokens + new_tokens
+        needed = -(-total // self.page_size)  # ceil
+        return max(0, needed - len(seq.pages))
+
+    def ensure_capacity(self, seq: SequenceDescriptor, new_tokens: int) -> None:
+        n = self.pages_needed(seq, new_tokens)
+        if n:
+            if len(seq.pages) + n > self.max_pages_per_seq:
+                raise RuntimeError(f"sequence {seq.uid} exceeds max_pages_per_seq={self.max_pages_per_seq}")
+            seq.pages.extend(self.allocator.allocate(n))
+
+    def release(self, seq: SequenceDescriptor) -> None:
+        self.allocator.free(seq.pages)
+        seq.pages = []
+
+
+@dataclasses.dataclass
+class RaggedBatch:
+    """One step's packed device inputs (ref: RaggedBatchWrapper) — fixed
+    max shapes so the compiled program is reused across steps."""
+    tokens: np.ndarray        # [B, C] int32 (padded)
+    start_pos: np.ndarray     # [B] int32 — context length before this chunk
+    block_tables: np.ndarray  # [B, max_pages] int32 (null page 0 padded)
+    chunk_lens: np.ndarray    # [B] int32 — real tokens this step
+    uids: List[int]           # row → uid (len ≤ B; padding rows map to -1)
+
+    @property
+    def batch(self) -> int:
+        return self.tokens.shape[0]
+
+
+class StateManager:
+    """uid → descriptor bookkeeping + batch packing (ref: DSStateManager)."""
+
+    def __init__(self, kv: BlockedKVCache, max_batch: int = 64):
+        self.kv = kv
+        self.max_batch = max_batch
+        self.seqs: Dict[int, SequenceDescriptor] = {}
+
+    def get_or_create(self, uid: int, tokens: Optional[Sequence[int]] = None) -> SequenceDescriptor:
+        if uid not in self.seqs:
+            self.seqs[uid] = SequenceDescriptor(uid=uid, tokens=list(tokens or []))
+        elif tokens:
+            self.seqs[uid].tokens.extend(tokens)
+        return self.seqs[uid]
+
+    def flush(self, uid: int) -> None:
+        """Release a sequence's KV + state (ref: engine_v2.py flush)."""
+        seq = self.seqs.pop(uid, None)
+        if seq is not None:
+            self.kv.release(seq)
+
+    def pack(self, work: List[Tuple[SequenceDescriptor, int]], chunk: int) -> RaggedBatch:
+        """Pack (seq, n_tokens) work items into fixed [B, chunk] buffers."""
+        b = len(work)
+        tokens = np.zeros((b, chunk), np.int32)
+        start_pos = np.zeros((b, ), np.int32)
+        block_tables = np.zeros((b, self.kv.max_pages_per_seq), np.int32)
+        chunk_lens = np.zeros((b, ), np.int32)
+        uids = []
+        for i, (seq, n) in enumerate(work):
+            self.kv.ensure_capacity(seq, n)
+            sl = seq.tokens[seq.seen_tokens:seq.seen_tokens + n]
+            tokens[i, :len(sl)] = sl
+            start_pos[i] = seq.seen_tokens
+            block_tables[i, :len(seq.pages)] = seq.pages
+            chunk_lens[i] = n
+            uids.append(seq.uid)
+        return RaggedBatch(tokens=tokens, start_pos=start_pos, block_tables=block_tables,
+                           chunk_lens=chunk_lens, uids=uids)

@@ -1,0 +1,59 @@
+"""Dynamic SplitFuse continuous-batching scheduler.
+
+Reference: FastGen's scheduling policy (``deepspeed/inference/v2/engine_v2.py
+put()`` + the SplitFuse description in ``blogs/deepspeed-fastgen``): each
+engine step runs a *fixed token budget*, filled by (a) every running decode
+sequence (1 token each) and (b) chunks of pending prefills — long prompts
+are split across steps, short ones fused, keeping step latency flat.
+
+Here the budget additionally quantises to a few chunk-size buckets so XLA
+reuses a handful of compiled programs (TPU static shapes) instead of
+recompiling per ragged shape — the scheduling *policy* is the reference's,
+the *shapes* are TPU-friendly.
+"""
+
+import dataclasses
+from typing import List, Tuple
+
+from .ragged import SequenceDescriptor, StateManager
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    token_budget: int = 512            # ref: max ragged batch token count
+    max_seqs: int = 64                 # ref: max ragged sequence count
+    prefill_chunk: int = 128           # SplitFuse chunk quantum
+    decode_bucket: int = 8             # decode batch rounds up to a multiple
+
+
+@dataclasses.dataclass
+class StepPlan:
+    """One engine step = one decode batch + up to one prefill chunk batch."""
+    decode: List[SequenceDescriptor]
+    prefill: List[Tuple[SequenceDescriptor, int]]   # (seq, n_tokens)
+
+
+class SplitFuseScheduler:
+
+    def __init__(self, config: SchedulerConfig):
+        self.config = config
+
+    def plan(self, manager: StateManager) -> StepPlan:
+        cfg = self.config
+        running = [s for s in manager.seqs.values() if not s.done]
+        decodes = [s for s in running if not s.in_prefill and s.seen_tokens > 0]
+        prefills = [s for s in running if s.in_prefill]
+
+        decodes = decodes[:cfg.max_seqs]
+        budget = cfg.token_budget - len(decodes)
+
+        plan_prefill: List[Tuple[SequenceDescriptor, int]] = []
+        for seq in prefills:
+            if budget <= 0 or len(plan_prefill) + len(decodes) >= cfg.max_seqs:
+                break
+            n = min(seq.remaining_prefill, cfg.prefill_chunk, budget)
+            if n <= 0:
+                break
+            plan_prefill.append((seq, n))
+            budget -= n
+        return StepPlan(decode=decodes, prefill=plan_prefill)

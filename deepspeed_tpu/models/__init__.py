@@ -1,0 +1,8 @@
+"""Model zoo (TPU-native analogs of the reference's model coverage:
+module_inject containers + inference/v2/model_implementations)."""
+
+from .llama import LlamaConfig, LlamaForCausalLM, causal_lm_loss  # noqa: F401
+from .gpt2 import GPT2Config, GPT2LMHeadModel  # noqa: F401
+from .bert import (BertConfig, BertForMaskedLM, BertForSequenceClassification,  # noqa: F401
+                   BertModel, masked_lm_loss)
+from .mixtral import MixtralConfig, MixtralForCausalLM, make_mixtral_loss_fn, mixtral_lm_loss  # noqa: F401

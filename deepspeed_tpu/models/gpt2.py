@@ -1,0 +1,185 @@
+"""GPT-2 family causal LM, TPU-first.
+
+Reference coverage: the reference ships GPT-2 as an inference injection
+policy (``deepspeed/module_inject/containers/gpt2.py``, HFGPT2LayerPolicy)
+and as the Megatron_GPT2 integration test family (``tests/model/
+Megatron_GPT2``).  Here it is a native flax model sharing the Llama stack's
+design: scan-over-layers, logical-axis params (module_inject/tp_rules.py),
+per-layer remat, pluggable attention.
+
+Architecture notes (GPT-2 vs Llama): learned absolute position embeddings,
+pre-LN with bias, GELU MLP (4×), fused-qkv-style biases, tied LM head.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .llama import (EMBED, HEAD_DIM, HEADS, LAYERS, MLP, VOCAB, _logical, causal_lm_loss, get_attention_impl)
+
+POSITIONS = "positions"  # learned position table axis (replicated)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    layer_norm_epsilon: float = 1e-5
+    tie_word_embeddings: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+    remat_policy: str = "nothing_saveable"
+    attention_impl: str = "reference"
+
+    @staticmethod
+    def from_hf(hf_cfg, **overrides):
+        fields = dict(
+            vocab_size=hf_cfg.vocab_size,
+            n_positions=getattr(hf_cfg, "n_positions", 1024),
+            hidden_size=getattr(hf_cfg, "n_embd", getattr(hf_cfg, "hidden_size", 768)),
+            num_hidden_layers=getattr(hf_cfg, "n_layer", getattr(hf_cfg, "num_hidden_layers", 12)),
+            num_attention_heads=getattr(hf_cfg, "n_head", getattr(hf_cfg, "num_attention_heads", 12)),
+            layer_norm_epsilon=getattr(hf_cfg, "layer_norm_epsilon", 1e-5),
+        )
+        fields.update(overrides)
+        return GPT2Config(**fields)
+
+
+PRESETS = {
+    "gpt2-125m": GPT2Config(),
+    "gpt2-medium": GPT2Config(hidden_size=1024, num_hidden_layers=24, num_attention_heads=16),
+    "gpt2-large": GPT2Config(hidden_size=1280, num_hidden_layers=36, num_attention_heads=20),
+    "gpt2-xl": GPT2Config(hidden_size=1600, num_hidden_layers=48, num_attention_heads=25),
+    "tiny": GPT2Config(vocab_size=128, n_positions=64, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4),
+}
+
+
+class GPT2Attention(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, segment_ids=None):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        dense = partial(nn.DenseGeneral, use_bias=True, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        qkv = dense(features=(3, cfg.num_attention_heads, head_dim),
+                    kernel_init=_logical(nn.initializers.normal(0.02), (EMBED, None, HEADS, HEAD_DIM)),
+                    bias_init=_logical(nn.initializers.zeros_init(), (None, HEADS, HEAD_DIM)),
+                    name="c_attn")(x)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        attn_fn = get_attention_impl(cfg.attention_impl)
+        out = attn_fn(q, k, v, causal=True, segment_ids=segment_ids)
+        return nn.DenseGeneral(features=cfg.hidden_size,
+                               axis=(-2, -1),
+                               use_bias=True,
+                               dtype=cfg.dtype,
+                               param_dtype=cfg.param_dtype,
+                               kernel_init=_logical(nn.initializers.normal(0.02), (HEADS, HEAD_DIM, EMBED)),
+                               bias_init=_logical(nn.initializers.zeros_init(), (EMBED, )),
+                               name="c_proj")(out)
+
+
+class GPT2MLP(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.DenseGeneral(features=4 * cfg.hidden_size,
+                            use_bias=True,
+                            dtype=cfg.dtype,
+                            param_dtype=cfg.param_dtype,
+                            kernel_init=_logical(nn.initializers.normal(0.02), (EMBED, MLP)),
+                            bias_init=_logical(nn.initializers.zeros_init(), (MLP, )),
+                            name="c_fc")(x)
+        h = nn.gelu(h, approximate=True)
+        return nn.DenseGeneral(features=cfg.hidden_size,
+                               use_bias=True,
+                               dtype=cfg.dtype,
+                               param_dtype=cfg.param_dtype,
+                               kernel_init=_logical(nn.initializers.normal(0.02), (MLP, EMBED)),
+                               bias_init=_logical(nn.initializers.zeros_init(), (EMBED, )),
+                               name="c_proj")(h)
+
+
+class GPT2Block(nn.Module):
+    cfg: GPT2Config
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, x, segment_ids=None):
+        cfg = self.cfg
+        ln = partial(nn.LayerNorm, epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     scale_init=_logical(nn.initializers.ones_init(), (EMBED, )),
+                     bias_init=_logical(nn.initializers.zeros_init(), (EMBED, )))
+        h = x + GPT2Attention(cfg, name="attn")(ln(name="ln_1")(x), segment_ids)
+        out = h + GPT2MLP(cfg, name="mlp")(ln(name="ln_2")(h))
+        if self.scanned:
+            return out, None
+        return out
+
+
+class GPT2LMHeadModel(nn.Module):
+    """GPT-2 causal LM (``transformers.GPT2LMHeadModel`` surface)."""
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+        wte = nn.Embed(num_embeddings=cfg.vocab_size,
+                       features=cfg.hidden_size,
+                       dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype,
+                       embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                       name="wte")
+        wpe = nn.Embed(num_embeddings=cfg.n_positions,
+                       features=cfg.hidden_size,
+                       dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype,
+                       embedding_init=_logical(nn.initializers.normal(0.01), (POSITIONS, EMBED)),
+                       name="wpe")
+        x = wte(input_ids) + wpe(positions)
+
+        block_cls = GPT2Block
+        if cfg.remat:
+            policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+            block_cls = nn.remat(GPT2Block, policy=policy, prevent_cse=not cfg.scan_layers)
+        if cfg.scan_layers:
+            blocks = nn.scan(block_cls,
+                             variable_axes={"params": 0},
+                             split_rngs={"params": True},
+                             in_axes=(nn.broadcast, ),
+                             length=cfg.num_hidden_layers,
+                             metadata_params={nn.PARTITION_NAME: LAYERS})
+            x, _ = blocks(cfg, scanned=True, name="h")(x, segment_ids)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                x = block_cls(cfg, name=f"h_{i}")(x, segment_ids)
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         scale_init=_logical(nn.initializers.ones_init(), (EMBED, )),
+                         bias_init=_logical(nn.initializers.zeros_init(), (EMBED, )),
+                         name="ln_f")(x)
+        if cfg.tie_word_embeddings:
+            return wte.attend(x)
+        return nn.DenseGeneral(features=cfg.vocab_size,
+                               use_bias=False,
+                               dtype=cfg.dtype,
+                               param_dtype=cfg.param_dtype,
+                               kernel_init=_logical(nn.initializers.normal(0.02), (EMBED, VOCAB)),
+                               name="lm_head")(x)
+
+
+gpt2_lm_loss = causal_lm_loss

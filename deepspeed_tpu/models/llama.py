@@ -290,3 +290,60 @@ def causal_lm_loss(logits, labels, loss_mask=None):
         denom = jnp.maximum(loss_mask.sum(), 1.0)
         return (nll * loss_mask).sum() / denom
     return nll.mean()
+
+
+# --------------------------------------------------------------------------
+# Pipeline-parallel building blocks (consumed by runtime/pipe/module.py).
+# The reference expresses pipelined GPT models as a flat LayerSpec list
+# (embed → N×block → norm → head); these are the Llama equivalents.  The
+# block derives positions from the sequence length so the residual stream
+# is the only tensor travelling through the pipeline rotation.
+
+
+class LlamaEmbedLayer(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        return nn.Embed(num_embeddings=cfg.vocab_size,
+                        features=cfg.hidden_size,
+                        dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype,
+                        embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                        name="embed_tokens")(input_ids)
+
+
+class LlamaPipeBlock(nn.Module):
+    """One decoder block with self-derived positions (pipeline body)."""
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
+        return LlamaBlock(self.cfg, name="block")(x, positions)
+
+
+class LlamaHeadLayer(nn.Module):
+    """Final norm + LM head (last pipeline stage tail)."""
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="norm")(x)
+        return nn.DenseGeneral(features=cfg.vocab_size,
+                               use_bias=False,
+                               dtype=cfg.dtype,
+                               param_dtype=cfg.param_dtype,
+                               kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, VOCAB)),
+                               name="lm_head")(x)
+
+
+def llama_pipeline_layers(cfg: LlamaConfig):
+    """Flat layer list for PipelineModule (ref: the GPT2ModelPipe pattern in
+    DeepSpeed examples built on pipe/module.py LayerSpec)."""
+    from ..runtime.pipe.module import LayerSpec
+    return ([LayerSpec(LlamaEmbedLayer, cfg)] + [LayerSpec(LlamaPipeBlock, cfg)
+                                                 for _ in range(cfg.num_hidden_layers)] +
+            [LayerSpec(LlamaHeadLayer, cfg)])

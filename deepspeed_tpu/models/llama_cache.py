@@ -1,0 +1,197 @@
+"""Llama with paged KV cache — the inference-path twin of models/llama.py.
+
+Reference: the v1 kernel-injection containers keep KV in a global inference
+context arena (``csrc/transformer/inference/includes/inference_context.h``)
+and v2 FastGen uses a blocked KV cache with blocked-flash kernels
+(``deepspeed/inference/v2/ragged/kv_cache.py:40 BlockedKVCache``,
+``inference/v2/kernels/ragged_ops``).  TPU-native realisation: the cache is
+an explicit JAX array arena of fixed-size pages, functionally threaded
+through the forward pass (donated between steps so XLA updates it in
+place); attention gathers a sequence's pages via its block table.
+
+Param-tree compatibility: module/submodule names mirror LlamaForCausalLM
+exactly (embed_tokens, model/layers/{self_attn/{q,k,v,o}_proj,
+input_layernorm, post_attention_layernorm, mlp/{gate,up,down}_proj}, norm,
+lm_head), so weights trained with the training model apply unchanged.
+
+One program serves prefill chunks, continuation chunks and decode (C=1) —
+the Dynamic-SplitFuse property that all phases are the same computation at
+different chunk sizes (ref: blogs/deepspeed-fastgen — SplitFuse; here it
+falls out of the unified chunked forward).
+"""
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .llama import (EMBED, HEAD_DIM, HEADS, KV_HEADS, LAYERS, MLP, VOCAB, LlamaConfig, LlamaMLP, RMSNorm, _logical,
+                    apply_rope, rotary_embedding)
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedKVConfig:
+    """Cache geometry (ref: inference/v2/ragged/manager_configs.py)."""
+    num_pages: int = 128
+    page_size: int = 16
+    max_pages_per_seq: int = 8
+
+
+def init_kv_cache(cfg: LlamaConfig, kv: PagedKVConfig, dtype=jnp.bfloat16):
+    """Allocate the paged arena: [L, P, page, 2, n_kv, hd].  Page 0 is the
+    reserved null page (block tables point unused slots at it)."""
+    head_dim = cfg.hidden_size // cfg.num_attention_heads
+    return jnp.zeros((cfg.num_hidden_layers, kv.num_pages, kv.page_size, 2, cfg.num_key_value_heads, head_dim),
+                     dtype)
+
+
+def _write_pages(pages, k_new, v_new, block_table, start_pos, page_size):
+    """Scatter a chunk's K/V into the arena pages.
+
+    pages: [P, page, 2, n_kv, hd] (one layer)   k/v_new: [B, C, n_kv, hd]
+    block_table: [B, max_pages]  start_pos: [B]
+    """
+    b, c = k_new.shape[0], k_new.shape[1]
+    positions = start_pos[:, None] + jnp.arange(c)[None, :]          # [B, C]
+    page_idx = jnp.take_along_axis(block_table, positions // page_size, axis=1)  # [B, C]
+    slot_idx = positions % page_size                                  # [B, C]
+    kv_chunk = jnp.stack([k_new, v_new], axis=2)                      # [B, C, 2, n_kv, hd]
+    flat_kv = kv_chunk.reshape((-1, ) + kv_chunk.shape[2:])           # [B*C, 2, n_kv, hd]
+    return pages.at[page_idx.reshape(-1), slot_idx.reshape(-1)].set(flat_kv)
+
+
+def paged_attention(q, pages, block_table, start_pos, chunk_len, page_size):
+    """Attention of a chunk's queries against (history + chunk) keys.
+
+    q: [B, C, H, hd] (RoPE applied); pages: [P, page, 2, n_kv, hd] with the
+    chunk's K/V already written; block_table: [B, max_pages]; start_pos: [B]
+    = context length before this chunk.  jnp reference implementation — the
+    Pallas blocked-decode kernel slots in behind the same signature
+    (ops/paged_attention.py).
+    """
+    b, c, h, d = q.shape
+    max_pages = block_table.shape[1]
+    n_kv = pages.shape[3]
+    gathered = pages[block_table.reshape(-1)]                         # [B*maxp, page, 2, n_kv, hd]
+    gathered = gathered.reshape(b, max_pages * page_size, 2, n_kv, d)
+    k = gathered[:, :, 0]                                             # [B, S_kv, n_kv, hd]
+    v = gathered[:, :, 1]
+    if n_kv != h:
+        rep = h // n_kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    logits = jnp.einsum("bcnd,bknd->bnck", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    qpos = start_pos[:, None] + jnp.arange(c)[None, :]                # [B, C]
+    kpos = jnp.arange(max_pages * page_size)[None, :]                 # [1, S_kv]
+    mask = kpos[:, None, :] <= qpos[..., None]                        # [B, C, S_kv]
+    logits = jnp.where(mask[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bnck,bknd->bcnd", probs.astype(v.dtype), v)
+
+
+class LlamaAttentionCache(nn.Module):
+    cfg: LlamaConfig
+    page_size: int = 16
+
+    @nn.compact
+    def __call__(self, x, positions, pages, block_table, start_pos):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        from functools import partial
+        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        q = dense(features=(cfg.num_attention_heads, head_dim),
+                  kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, HEADS, HEAD_DIM)),
+                  name="q_proj")(x)
+        k = dense(features=(cfg.num_key_value_heads, head_dim),
+                  kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
+                  name="k_proj")(x)
+        v = dense(features=(cfg.num_key_value_heads, head_dim),
+                  kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
+                  name="v_proj")(x)
+        cos, sin = rotary_embedding(positions, head_dim, cfg.rope_theta)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        pages = _write_pages(pages, k.astype(pages.dtype), v.astype(pages.dtype), block_table, start_pos,
+                             self.page_size)
+        out = paged_attention(q, pages, block_table, start_pos, x.shape[1], self.page_size)
+        out = nn.DenseGeneral(features=cfg.hidden_size,
+                              axis=(-2, -1),
+                              use_bias=False,
+                              dtype=cfg.dtype,
+                              param_dtype=cfg.param_dtype,
+                              kernel_init=_logical(nn.initializers.lecun_normal(), (HEADS, HEAD_DIM, EMBED)),
+                              name="o_proj")(out)
+        return out, pages
+
+
+class LlamaBlockCache(nn.Module):
+    cfg: LlamaConfig
+    page_size: int = 16
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, carry, layer_pages, positions=None, block_table=None, start_pos=None):
+        cfg = self.cfg
+        x = carry
+        attn_out, layer_pages = LlamaAttentionCache(cfg, self.page_size, name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="input_layernorm")(x), positions, layer_pages,
+            block_table, start_pos)
+        h = x + attn_out
+        out = h + LlamaMLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="post_attention_layernorm")(h))
+        if self.scanned:
+            return out, layer_pages
+        return out, layer_pages
+
+
+class LlamaForCausalLMWithCache(nn.Module):
+    """Chunked forward with paged KV.  ``apply(variables, tokens, start_pos,
+    block_table, cache)`` → (logits, new_cache)."""
+    cfg: LlamaConfig
+    page_size: int = 16
+
+    @nn.compact
+    def __call__(self, input_ids, start_pos, block_table, cache):
+        cfg = self.cfg
+        positions = start_pos[:, None] + jnp.arange(input_ids.shape[1])[None, :]
+        embed = nn.Embed(num_embeddings=cfg.vocab_size,
+                         features=cfg.hidden_size,
+                         dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype,
+                         embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                         name="embed_tokens")
+        x = embed(input_ids)
+
+        class _Trunk(nn.Module):
+            """Named 'model' to match LlamaForCausalLM's param tree."""
+            cfg: LlamaConfig
+            page_size: int
+
+            @nn.compact
+            def __call__(self, x, cache, positions, block_table, start_pos):
+                blocks = nn.scan(LlamaBlockCache,
+                                 variable_axes={"params": 0},
+                                 split_rngs={"params": True},
+                                 in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast),
+                                 out_axes=0,
+                                 length=self.cfg.num_hidden_layers,
+                                 metadata_params={nn.PARTITION_NAME: LAYERS})
+                x, cache = blocks(self.cfg, self.page_size, scanned=True,
+                                  name="layers")(x, cache, positions, block_table, start_pos)
+                return x, cache
+
+        x, cache = _Trunk(cfg, self.page_size, name="model")(x, cache, positions, block_table, start_pos)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="norm")(x)
+        if cfg.tie_word_embeddings:
+            logits = embed.attend(x)
+        else:
+            logits = nn.DenseGeneral(features=cfg.vocab_size,
+                                     use_bias=False,
+                                     dtype=cfg.dtype,
+                                     param_dtype=cfg.param_dtype,
+                                     kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, VOCAB)),
+                                     name="lm_head")(x)
+        return logits, cache

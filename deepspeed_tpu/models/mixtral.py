@@ -1,0 +1,179 @@
+"""Mixtral-style MoE causal LM (Llama attention + sparse-MoE FFN), TPU-first.
+
+Reference coverage: the MoE training path — ``deepspeed/moe/layer.py`` MoE
+wired into a GPT stack (Megatron-DeepSpeed MoE models; BASELINE.json config
+5: Mixtral-8x7B EP) and the v2 inference implementation
+``inference/v2/model_implementations/mixtral``.  The block swaps the dense
+SwiGLU MLP for ``deepspeed_tpu.moe.MoE`` (top-k gating → expert-axis
+all-to-all → expert FFN bank → combine) and threads the auxiliary
+load-balancing loss through the layer scan, matching the reference's
+contract where the MoE layer returns (out, l_aux, exp_counts) and the user
+adds ``l_aux`` to the loss.
+"""
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..moe.layer import MoE
+from .llama import (EMBED, LAYERS, VOCAB, LlamaAttention, LlamaConfig, RMSNorm, _logical, causal_lm_loss)
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 32768
+    rope_theta: float = 1e6
+    rms_norm_eps: float = 1e-5
+    num_local_experts: int = 8
+    num_experts_per_tok: int = 2
+    router_aux_loss_coef: float = 0.02
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    drop_tokens: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+    remat_policy: str = "nothing_saveable"
+    attention_impl: str = "reference"
+
+    def as_llama(self) -> LlamaConfig:
+        return LlamaConfig(vocab_size=self.vocab_size,
+                           hidden_size=self.hidden_size,
+                           intermediate_size=self.intermediate_size,
+                           num_hidden_layers=self.num_hidden_layers,
+                           num_attention_heads=self.num_attention_heads,
+                           num_key_value_heads=self.num_key_value_heads,
+                           max_position_embeddings=self.max_position_embeddings,
+                           rope_theta=self.rope_theta,
+                           rms_norm_eps=self.rms_norm_eps,
+                           dtype=self.dtype,
+                           param_dtype=self.param_dtype,
+                           attention_impl=self.attention_impl)
+
+    @staticmethod
+    def from_hf(hf_cfg, **overrides):
+        fields = dict(
+            vocab_size=hf_cfg.vocab_size,
+            hidden_size=hf_cfg.hidden_size,
+            intermediate_size=hf_cfg.intermediate_size,
+            num_hidden_layers=hf_cfg.num_hidden_layers,
+            num_attention_heads=hf_cfg.num_attention_heads,
+            num_key_value_heads=getattr(hf_cfg, "num_key_value_heads", 8),
+            max_position_embeddings=hf_cfg.max_position_embeddings,
+            rope_theta=getattr(hf_cfg, "rope_theta", 1e6),
+            num_local_experts=getattr(hf_cfg, "num_local_experts", 8),
+            num_experts_per_tok=getattr(hf_cfg, "num_experts_per_tok", 2),
+            router_aux_loss_coef=getattr(hf_cfg, "router_aux_loss_coef", 0.02),
+        )
+        fields.update(overrides)
+        return MixtralConfig(**fields)
+
+
+PRESETS = {
+    "mixtral-8x7b": MixtralConfig(),
+    "tiny": MixtralConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+                          rope_theta=1e4, num_local_experts=4, num_experts_per_tok=2),
+}
+
+
+class MixtralBlock(nn.Module):
+    cfg: MixtralConfig
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, carry, positions, segment_ids=None):
+        cfg = self.cfg
+        x, l_aux_acc = carry if self.scanned else (carry, jnp.zeros((), jnp.float32))
+        lcfg = cfg.as_llama()
+        h = x + LlamaAttention(lcfg, name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="input_layernorm")(x), positions, segment_ids)
+        moe_out, l_aux, _counts = MoE(hidden_size=cfg.hidden_size,
+                                      num_experts=cfg.num_local_experts,
+                                      intermediate_size=cfg.intermediate_size,
+                                      k=cfg.num_experts_per_tok,
+                                      capacity_factor=cfg.capacity_factor,
+                                      eval_capacity_factor=cfg.eval_capacity_factor,
+                                      min_capacity=cfg.min_capacity,
+                                      drop_tokens=cfg.drop_tokens,
+                                      dtype=cfg.dtype,
+                                      param_dtype=cfg.param_dtype,
+                                      name="block_sparse_moe")(
+                                          RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype,
+                                                  name="post_attention_layernorm")(h))
+        out = h + moe_out
+        l_aux_acc = l_aux_acc + l_aux.astype(jnp.float32)
+        if self.scanned:
+            return (out, l_aux_acc), None
+        return out, l_aux_acc
+
+
+class MixtralForCausalLM(nn.Module):
+    """Returns ``(logits, l_aux_total)`` — pair the engine with
+    ``mixtral_lm_loss``."""
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+        embed = nn.Embed(num_embeddings=cfg.vocab_size,
+                         features=cfg.hidden_size,
+                         dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype,
+                         embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                         name="embed_tokens")
+        x = embed(input_ids)
+        l_aux = jnp.zeros((), jnp.float32)
+
+        block_cls = MixtralBlock
+        if cfg.remat:
+            policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+            block_cls = nn.remat(MixtralBlock, policy=policy, prevent_cse=not cfg.scan_layers)
+        if cfg.scan_layers:
+            blocks = nn.scan(block_cls,
+                             variable_axes={"params": 0},
+                             split_rngs={"params": True},
+                             in_axes=(nn.broadcast, nn.broadcast),
+                             length=cfg.num_hidden_layers,
+                             metadata_params={nn.PARTITION_NAME: LAYERS})
+            (x, l_aux), _ = blocks(cfg, scanned=True, name="layers")((x, l_aux), positions, segment_ids)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                x, l_aux_i = block_cls(cfg, name=f"layers_{i}")(x, positions, segment_ids)
+                l_aux = l_aux + l_aux_i
+
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="norm")(x)
+        logits = nn.DenseGeneral(features=cfg.vocab_size,
+                                 use_bias=False,
+                                 dtype=cfg.dtype,
+                                 param_dtype=cfg.param_dtype,
+                                 kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, VOCAB)),
+                                 name="lm_head")(x)
+        return logits, l_aux
+
+
+def mixtral_lm_loss(outputs, labels, loss_mask=None, aux_loss_coef=0.02):
+    """CE + router aux loss (ref: the user-side ``loss += l_aux * coef``
+    contract of deepspeed/moe/layer.py)."""
+    logits, l_aux = outputs
+    return causal_lm_loss(logits, labels, loss_mask) + aux_loss_coef * l_aux
+
+
+def make_mixtral_loss_fn(cfg: MixtralConfig):
+    def loss_fn(outputs, batch):
+        return mixtral_lm_loss(outputs, batch["labels"], batch.get("loss_mask"), cfg.router_aux_loss_coef)
+
+    return loss_fn

@@ -24,7 +24,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..comm.mesh import DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS, ZERO_AXES
+from ..comm.mesh import DATA_AXIS, EXPERT_AXIS, PIPE_AXIS, SEQ_AXIS, TENSOR_AXIS, ZERO_AXES
 
 # Logical axis names used across the model zoo
 from ..models.llama import EMBED, HEADS, HEAD_DIM, KV_HEADS, LAYERS, MLP, VOCAB  # noqa: F401
@@ -50,6 +50,8 @@ def make_logical_rules(zero_stage: int, mesh: Mesh, fsdp_axes: Sequence[str] = Z
         (EMBED, fsdp),
         (HEAD_DIM, None),
         (LAYERS, None),
+        # pipelined stacked-block leading axis (runtime/pipe/pipeline.py)
+        ("stage_layers", PIPE_AXIS if mesh.shape.get(PIPE_AXIS, 1) > 1 else None),
         (EXPERTS, EXPERT_AXIS if mesh.shape.get(EXPERT_AXIS, 1) > 1 else None),
         # expert weights: the 'expert' axis is taken by the expert dim, so
         # their ZeRO (fsdp) sharding uses the remaining DP axes only

@@ -499,16 +499,20 @@ class DeepSpeedEngine:
         self._maybe_print(metrics)
         return metrics.loss
 
-    def forward(self, batch):
-        """Compute loss for a micro-batch (eval path shares the jitted fn)."""
-        self._ensure_ready(batch)
-        self._last_batch = batch
+    def _build_eval_fn(self):
         if self._eval_fn is None:
             def eval_loss(state, b):
                 return self._microbatch_loss(state.params, b)
             self._eval_fn = jax.jit(eval_loss, in_shardings=(self.state_shardings, self._batch_shardings))
+        return self._eval_fn
+
+    def forward(self, batch):
+        """Compute loss for a micro-batch (eval path shares the jitted fn)."""
+        self._ensure_ready(batch)
+        self._last_batch = batch
+        fn = self._build_eval_fn()
         self.timers(FORWARD_GLOBAL_TIMER).start()
-        loss = self._eval_fn(self.state, batch)
+        loss = fn(self.state, batch)
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss
 

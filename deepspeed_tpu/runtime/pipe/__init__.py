@@ -1,0 +1,5 @@
+from .engine import PipelineEngine  # noqa: F401
+from .module import LayerSpec, PipelineError, PipelineModule, TiedLayerSpec  # noqa: F401
+from .pipeline import pipelined_apply  # noqa: F401
+from .schedule import InferenceSchedule, TrainSchedule  # noqa: F401
+from .topology import PipeDataParallelTopology, PipelineParallelGrid, ProcessTopology  # noqa: F401

@@ -1,0 +1,101 @@
+"""PipelineEngine — training engine for PipelineModule models.
+
+Reference: ``deepspeed/runtime/pipe/engine.py:61 PipelineEngine`` — a
+1,400-LoC subclass that executes instruction schedules with p2p comms,
+pipeline buffers and per-stage optimizers.  Here pipelining happens inside
+the compiled train step (the PipelineModule's apply lowers to the
+shard_map/ppermute program in pipeline.py), so this subclass only:
+
+* folds ``gradient_accumulation_steps`` into the pipeline's micro-batch
+  count (ref: pipe/engine.py:338 ``train_batch`` consumes gas microbatches),
+* exposes the stage-query parity surface (``is_first_stage`` …) — in SPMD
+  every process participates in every stage, so these reflect the logical
+  schedule rather than a rank's position,
+* keeps ``forward``/``backward`` blocked like the reference (pipeline
+  training must go through ``train_batch``/``eval_batch``,
+  ref: pipe/engine.py:1345 _disabled docstrings).
+"""
+
+from ...utils.logging import log_dist
+from ..engine import DeepSpeedEngine
+from .module import PipelineModule
+from .schedule import TrainSchedule, bubble_fraction
+
+
+class PipelineEngine(DeepSpeedEngine):
+
+    def __init__(self, model, config, **kwargs):
+        assert isinstance(model, PipelineModule), "PipelineEngine requires a PipelineModule"
+        if config.pipeline.stages != model.num_stages:
+            # the module is authoritative (ref: PipelineModule carries the
+            # topology); re-resolve batch sizing for the new dp degree
+            config.pipeline.stages = model.num_stages
+            config._configure_train_batch_size()
+        # microbatches = gradient accumulation steps (ref: pipe/engine.py:81)
+        self.micro_batches = config.gradient_accumulation_steps
+        model.micro_batches = self.micro_batches
+        # the pipeline consumes the full batch in one compiled call; the
+        # outer GAS scan must not re-split it
+        config.gradient_accumulation_steps = 1
+        super().__init__(model=model, config=config, **kwargs)
+        config.gradient_accumulation_steps = self.micro_batches
+        self.num_stages = model.num_stages
+        log_dist(
+            f"PipelineEngine: stages={self.num_stages} micro_batches={self.micro_batches} "
+            f"bubble={bubble_fraction(self.micro_batches, self.num_stages):.2%}",
+            ranks=[0])
+
+    def train_batch(self, data_iter=None, batch=None):
+        """Assemble ``micro_batches`` loader micro-batches into the full
+        batch the compiled pipeline consumes (the outer engine runs gas=1;
+        micro-batching happens inside the pipeline program)."""
+        if batch is None:
+            assert data_iter is not None, "provide data_iter or batch"
+            import jax
+            import numpy as np
+            micro = [next(data_iter) for _ in range(self.micro_batches)]
+            batch = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *micro) \
+                if self.micro_batches > 1 else micro[0]
+        return super().train_batch(batch=batch)
+
+    def gradient_accumulation_steps(self):
+        return self.micro_batches
+
+    # ------------------------------------------------------- parity queries
+
+    def is_first_stage(self):
+        return True  # SPMD: this process computes every stage
+
+    def is_last_stage(self):
+        return True
+
+    def is_pipe_parallel(self):
+        return self.num_stages > 1
+
+    def num_pipeline_stages(self):
+        return self.num_stages
+
+    def train_schedule(self, stage_id: int = 0) -> TrainSchedule:
+        """The logical instruction schedule this step executes (for
+        inspection/tests; ref: pipe/engine.py _exec_schedule)."""
+        return TrainSchedule(micro_batches=self.micro_batches, stages=self.num_stages, stage_id=stage_id)
+
+    # the reference blocks these for pipeline engines (pipe/engine.py:1345)
+    def forward(self, *args, **kwargs):
+        raise RuntimeError("Only train_batch() / eval_batch() are accessible when using pipeline parallelism "
+                           "(parity with reference PipelineEngine).")
+
+    def backward(self, *args, **kwargs):
+        raise RuntimeError("Only train_batch() / eval_batch() are accessible when using pipeline parallelism "
+                           "(parity with reference PipelineEngine).")
+
+    def step(self, *args, **kwargs):
+        raise RuntimeError("Only train_batch() / eval_batch() are accessible when using pipeline parallelism "
+                           "(parity with reference PipelineEngine).")
+
+    def eval_batch(self, data_iter=None, batch=None):
+        """Forward-only over the pipeline (InferenceSchedule semantics)."""
+        if batch is None:
+            batch = next(data_iter)
+        self._ensure_ready(batch)
+        return self._build_eval_fn()(self.state, batch)

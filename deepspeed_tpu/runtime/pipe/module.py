@@ -1,0 +1,321 @@
+"""PipelineModule — layer-list model container for pipeline parallelism.
+
+API parity with ``deepspeed/runtime/pipe/module.py`` (``LayerSpec:30``,
+``TiedLayerSpec:78``, ``PipelineModule:96``): the user supplies a flat list
+of layers; the module partitions them over pipeline stages.
+
+TPU-native semantics: SPMD pipelining (runtime/pipe/pipeline.py) requires
+the pipelined body to be *homogeneous* — the same block program runs on
+every stage with stage-resident weights.  ``PipelineModule`` therefore
+splits the layer list into:
+
+  pre   — everything before the longest run of same-class layers (embedding
+          etc.); computed pipe-replicated (cheap, params replicated on pipe),
+  body  — the longest run of same-class layers (the transformer stack),
+          stacked ``[L, ...]`` and sharded over the ``pipe`` mesh axis,
+  post  — the remainder (final norm, LM head); pipe-replicated.
+
+This matches how the reference is used in practice (embed → N×block →
+norm/head) while replacing its per-rank module slicing
+(``PipelineModule._partition_layers``) with sharding of the stacked-layer
+axis.  ``partition_method`` is accepted for parity; SPMD stacking implies
+a uniform split, so "parameters"/"type:" methods reduce to uniform here.
+
+The class duck-types the flax Module surface the engine consumes
+(``init(rng, *args)`` / ``apply(variables, *args)``) so DeepSpeedEngine and
+checkpointing work unchanged.
+"""
+
+import warnings
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from flax.core import meta as nn_meta
+
+from ...comm.mesh import get_global_mesh
+from ...utils.logging import logger
+from .pipeline import STAGE_LAYERS, pipelined_apply
+
+
+class PipelineError(Exception):
+    """Errors in pipeline-parallel module construction."""
+
+
+class LayerSpec:
+    """Lazily-built layer description (ref: pipe/module.py:30 LayerSpec).
+    ``typename`` is a flax ``nn.Module`` subclass (or any callable for
+    param-less layers like reshapes)."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        name = getattr(self.typename, "__name__", str(self.typename))
+        return f"LayerSpec({name})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """A layer whose params are shared with every other TiedLayerSpec of the
+    same ``key`` (ref: pipe/module.py:78 — tied embeddings).  ``forward_fn``
+    maps ``(module, variables, x) -> out`` for reuse sites that call the tied
+    module differently (e.g. ``embed.attend`` for the LM head)."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None, tied_weight_attr=("weight", ), **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Stage boundary indices for a uniform split (ref: ds_utils
+    partition_uniform); returns num_parts+1 boundaries."""
+    if num_items % num_parts != 0:
+        raise PipelineError(f"{num_items} layers not divisible into {num_parts} stages")
+    step = num_items // num_parts
+    return [i * step for i in range(num_parts + 1)]
+
+
+def _build(layer):
+    if isinstance(layer, LayerSpec):
+        return layer.build()
+    return layer
+
+
+def _is_module(layer) -> bool:
+    return isinstance(layer, nn.Module)
+
+
+def _apply_layer(module, variables, x, extras):
+    """Call a layer, passing extras only if its signature accepts them
+    (signature inspection, NOT try/except — a TypeError raised *inside* the
+    layer must surface, and init/apply must bind extras identically)."""
+    take = extras if _accepts_extras(module, x, extras, init=False) else ()
+    if not _is_module(module):
+        return module(x, *take)
+    return module.apply(variables, x, *take)
+
+
+def _longest_same_class_run(layers) -> tuple:
+    """(start, stop) of the longest run of same-class nn.Module layers."""
+    best = (0, 0)
+    i = 0
+    n = len(layers)
+    while i < n:
+        if not _is_module(layers[i]):
+            i += 1
+            continue
+        j = i + 1
+        while j < n and _is_module(layers[j]) and type(layers[j]) is type(layers[i]):
+            j += 1
+        if j - i > best[1] - best[0]:
+            best = (i, j)
+        i = j
+    return best
+
+
+class PipelineModule:
+    """Sequential container executed as an SPMD pipeline.
+
+    ref: deepspeed/runtime/pipe/module.py:96 ``PipelineModule(layers,
+    num_stages, topology, loss_fn, partition_method,
+    activation_checkpoint_interval)``.
+    """
+
+    def __init__(self,
+                 layers: Sequence,
+                 num_stages: Optional[int] = None,
+                 topology=None,
+                 loss_fn: Optional[Callable] = None,
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 1,
+                 checkpointable_layers=None):
+        if num_stages is None and topology is None:
+            raise PipelineError("must provide num_stages or topology")
+        if topology is not None and num_stages is None:
+            num_stages = topology.get_dim("pipe")
+        self.num_stages = int(num_stages)
+        self.loss_fn = loss_fn
+        self.micro_batches = 1  # set by PipelineEngine (= gradient_accumulation_steps)
+        self.remat = activation_checkpoint_interval != 0
+        if partition_method not in ("parameters", "uniform") and not partition_method.startswith("type:"):
+            raise PipelineError(f"unknown partition_method {partition_method}")
+
+        built = [(_build(l), l) for l in layers]
+        self._layers = [b for b, _ in built]
+        self._specs = [s for _, s in built]
+
+        start, stop = _longest_same_class_run(self._layers)
+        n_body = stop - start
+        if self.num_stages > 1:
+            if n_body == 0:
+                raise PipelineError("no homogeneous block run found to pipeline")
+            partition_uniform(n_body, self.num_stages)  # raises if not divisible
+        self._body_range = (start, stop)
+        self.pre = self._layers[:start]
+        self.body = self._layers[start:stop]
+        self.post = self._layers[stop:]
+        self.forward_funcs = self._layers  # parity attribute
+        # tied-module registry: key → (module, first_index)
+        self._tied: dict = {}
+        for idx, spec in enumerate(self._specs):
+            if isinstance(spec, TiedLayerSpec):
+                if not (idx < start or idx >= stop):
+                    raise PipelineError("tied layers inside the pipelined body are not supported")
+                self._tied.setdefault(spec.key, (self._layers[idx], idx))
+        if n_body:
+            logger.debug(f"PipelineModule: pre={start} body={n_body}x{type(self.body[0]).__name__} "
+                         f"post={len(self._layers) - stop} stages={self.num_stages}")
+
+    # ------------------------------------------------------------- flax duck
+
+    def _param_name(self, idx: int) -> Optional[str]:
+        spec = self._specs[idx]
+        if isinstance(spec, TiedLayerSpec):
+            return f"tied_{spec.key}"
+        return f"layer_{idx}"
+
+    def init(self, rng, x, *extras, **kwargs):
+        if kwargs:
+            raise PipelineError(
+                f"PipelineModule does not accept keyword model inputs {sorted(kwargs)}; pipeline "
+                "blocks derive positions internally and batches must not carry segment_ids — "
+                "pass a model_inputs_fn returning positional extras instead.")
+        return self._init(rng, x, *extras)
+
+    def _init(self, rng, x, *extras):
+        """Initialise boxed (logically-partitioned) variables.  The body is
+        init'd per-layer with split rngs and stacked — the ``zero.Init``-
+        style partition-at-construction applies because the engine jits this
+        with ZeRO/pipe out_shardings (engine._materialize_state)."""
+        start, stop = self._body_range
+        params = {}
+        h = x
+
+        def init_one(mod, rng, h, idx):
+            spec = self._specs[idx]
+            if isinstance(spec, TiedLayerSpec) and self._param_name(idx) in params:
+                variables = {"params": params[self._param_name(idx)]}
+                if spec.forward_fn is not None:
+                    return spec.forward_fn(mod, variables, h)
+                return _apply_layer(mod, variables, h, extras)
+            variables = mod.init(rng, h, *extras) if _accepts_extras(mod, h, extras, init=True) else mod.init(rng, h)
+            params[self._param_name(idx)] = variables["params"]
+            return _apply_layer(mod, variables, h, extras)
+
+        for idx in range(start):
+            mod = self._layers[idx]
+            if not _is_module(mod):
+                h = mod(h)
+                continue
+            rng, sub = jax.random.split(rng)
+            h = init_one(mod, sub, h, idx)
+
+        if self.body:
+            block = self.body[0]
+            rng, sub = jax.random.split(rng)
+            rngs = jax.random.split(sub, len(self.body))
+            stacked = jax.vmap(lambda r: block.init(r, h, *extras)
+                               if _accepts_extras(block, h, extras, init=True) else block.init(r, h))(rngs)
+            # prepend the stacked-layer logical axis to each box's names
+            stacked = jax.tree.map(
+                lambda box: nn_meta.Partitioned(box.value, names=(STAGE_LAYERS, ) + tuple(box.names))
+                if isinstance(box, nn_meta.Partitioned) else box,
+                stacked,
+                is_leaf=lambda v: isinstance(v, nn_meta.AxisMetadata))
+            params["body"] = stacked["params"]
+            layer0 = jax.tree.map(lambda b: b.value[0] if isinstance(b, nn_meta.Partitioned) else b[0],
+                                  stacked["params"],
+                                  is_leaf=lambda v: isinstance(v, nn_meta.AxisMetadata))
+            h_out = jax.eval_shape(lambda p, hh: _apply_layer(block, {"params": p}, hh, extras), layer0, h)
+            if h_out.shape != jnp.shape(h) or h_out.dtype != jnp.result_type(h):
+                raise PipelineError(f"pipelined block must preserve shape/dtype: {jnp.shape(h)} -> {h_out.shape}")
+            # post-layer param shapes depend only on h's shape, not values
+            h = jnp.zeros(h_out.shape, h_out.dtype)
+
+        for idx in range(stop, len(self._layers)):
+            mod = self._layers[idx]
+            if not _is_module(mod):
+                h = mod(h)
+                continue
+            rng, sub = jax.random.split(rng)
+            h = init_one(mod, sub, h, idx)
+
+        return {"params": params}
+
+    def apply(self, variables, x, *extras, **kwargs):
+        if kwargs:
+            raise PipelineError(
+                f"PipelineModule does not accept keyword model inputs {sorted(kwargs)}; pipeline "
+                "blocks derive positions internally — pass positional extras via model_inputs_fn.")
+        params = variables["params"]
+        mesh = get_global_mesh()
+        start, stop = self._body_range
+        h = x
+
+        for idx in range(start):
+            h = self._apply_indexed(idx, params, h, extras)
+
+        if self.body:
+            block = self.body[0]
+
+            def body_fn(layer_params, h, *ex):
+                return block.apply({"params": layer_params}, h, *ex) \
+                    if _accepts_extras(block, h, ex, init=False) else block.apply({"params": layer_params}, h)
+
+            h = pipelined_apply(body_fn, params["body"], h, extras,
+                                mesh=mesh,
+                                num_stages=self.num_stages,
+                                micro_batches=self.micro_batches,
+                                remat=self.remat)
+
+        for idx in range(stop, len(self._layers)):
+            h = self._apply_indexed(idx, params, h, extras)
+        return h
+
+    def __call__(self, variables, x, *extras, **kwargs):
+        return self.apply(variables, x, *extras, **kwargs)
+
+    def _apply_indexed(self, idx, params, h, extras):
+        mod = self._layers[idx]
+        if not _is_module(mod):
+            return mod(h)
+        spec = self._specs[idx]
+        variables = {"params": params[self._param_name(idx)]}
+        if isinstance(spec, TiedLayerSpec) and spec.forward_fn is not None and idx != self._tied[spec.key][1]:
+            return spec.forward_fn(mod, variables, h)
+        return _apply_layer(mod, variables, h, extras)
+
+    # ------------------------------------------------------------ parity API
+
+    def topology(self):
+        from ...comm.mesh import BATCH_AXES, axis_size
+        from .topology import PipeDataParallelTopology
+        mesh = get_global_mesh()
+        # dp counts only the batch-splitting axes (data, expert) — tensor/seq
+        # are model-parallel degrees (matches config._resolve_dp_world_size)
+        return PipeDataParallelTopology(self.num_stages, axis_size(mesh, *BATCH_AXES))
+
+    def num_pipeline_stages(self):
+        return self.num_stages
+
+
+def _accepts_extras(mod, h, extras, init: bool) -> bool:
+    if not extras:
+        return False
+    try:
+        import inspect
+        sig = inspect.signature(mod.__call__)
+        pos = [p for p in sig.parameters.values()
+               if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD) and p.name != "self"]
+        has_varargs = any(p.kind == p.VAR_POSITIONAL for p in sig.parameters.values())
+        return has_varargs or len(pos) >= 1 + len(extras)
+    except (TypeError, ValueError):
+        return False

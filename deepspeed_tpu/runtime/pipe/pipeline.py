@@ -1,0 +1,177 @@
+"""SPMD pipeline-parallel executor.
+
+TPU-native replacement for the reference's instruction-driven pipeline
+(``deepspeed/runtime/pipe/engine.py:61 PipelineEngine`` executing
+``schedule.py:189 TrainSchedule`` with p2p send/recv between stage
+processes, ``runtime/pipe/p2p.py``).  There, each rank runs a different
+instruction stream (MPMD) and overlap comes from hand-managed buffers and
+streams.  Here the whole pipeline is ONE compiled SPMD program:
+
+* block weights are stacked with a leading layer axis sharded over the
+  ``pipe`` mesh axis — each pipe device owns ``layers_per_stage`` layers;
+* a ``lax.scan`` over "ticks" runs the GPipe schedule: at tick ``t`` stage
+  ``s`` computes microbatch ``t - s``; activations rotate stage→stage+1 via
+  ``lax.ppermute`` on ICI (the p2p.send/recv analog);
+* reverse-mode AD through ``ppermute`` yields the reverse pipeline — the
+  backward schedule the reference encodes as SendGrad/RecvGrad instructions
+  falls out of the transpose rule;
+* the driver loop costs ``M + S - 1`` ticks for M microbatches on S stages,
+  i.e. the classic GPipe bubble ``(S-1)/(M+S-1)`` — same pipeline
+  efficiency as the reference's 1F1B for equal M (1F1B improves *memory*,
+  which remat already bounds here).
+
+The per-microbatch extras (positions, segment ids, ...) travel with the
+activation through the rotation, since stage ``s`` needs microbatch
+``t - s``'s extras at tick ``t``.
+"""
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ...comm.mesh import PIPE_AXIS
+
+# Logical name for the stacked-layer leading axis of pipelined blocks;
+# mapped to the ``pipe`` mesh axis by module_inject/tp_rules.py.
+STAGE_LAYERS = "stage_layers"
+
+
+def num_pipeline_ticks(micro_batches: int, stages: int) -> int:
+    """Total schedule length (fwd ticks; ref: schedule.py total_steps is
+    2*(M+S-1) counting fwd+bwd separately — AD supplies the factor 2)."""
+    return micro_batches + stages - 1
+
+
+def _microbatch(tree, num_micro):
+    """[B, ...] → [M, B/M, ...] on every array leaf."""
+
+    def split(x):
+        if np.ndim(x) == 0:
+            return x
+        b = x.shape[0]
+        assert b % num_micro == 0, (f"batch dim {b} not divisible by micro_batches={num_micro}")
+        return x.reshape((num_micro, b // num_micro) + x.shape[1:])
+
+    return jax.tree.map(split, tree)
+
+
+def _unmicrobatch(tree):
+    def join(x):
+        if np.ndim(x) < 2:
+            return x
+        return x.reshape((x.shape[0] * x.shape[1], ) + x.shape[2:])
+
+    return jax.tree.map(join, tree)
+
+
+def pipelined_apply(body_fn: Callable,
+                    body_params: Any,
+                    x: jnp.ndarray,
+                    extras: Sequence[Any],
+                    *,
+                    mesh,
+                    num_stages: int,
+                    micro_batches: int,
+                    remat: bool = True):
+    """Run ``x`` through the stacked pipelined blocks.
+
+    Args:
+      body_fn: ``(layer_params, h, *extras_mb) -> h`` — applies ONE block.
+        Output must have the same shape/dtype as ``h`` (residual stream).
+      body_params: pytree whose leaves are stacked ``[L, ...]`` with the
+        leading axis sharded over the ``pipe`` mesh axis.
+      x: ``[B, ...]`` activations entering the first block.
+      extras: per-batch auxiliary inputs (``[B, ...]`` leading dim each,
+        e.g. positions/segment_ids) consumed by every block.
+      num_stages: pipeline depth S (== mesh.shape['pipe']).
+      micro_batches: M — the reference's gradient_accumulation_steps
+        (ref: pipe/engine.py micro_batches = gas).
+    """
+    S, M = num_stages, micro_batches
+    if S == 1:
+        # degenerate path: plain scan over layers, no pipeline overhead
+        fn = jax.checkpoint(body_fn) if remat else body_fn
+
+        def body(h, p):
+            return fn(p, h, *extras), None
+
+        out, _ = jax.lax.scan(body, x, body_params)
+        return out
+
+    mbs = _microbatch(x, M)
+    extras_mb = tuple(_microbatch(e, M) for e in extras)
+    fn = jax.checkpoint(body_fn) if remat else body_fn
+    rotate = [(i, (i + 1) % S) for i in range(S)]
+
+    # CPU only: keep pipe-replicated inputs fp32 at the shard_map boundary —
+    # their backward transpose is a psum over ``pipe``, and *bf16* psum trips
+    # an XLA-CPU check failure ("invalid binary instruction opcode copy").
+    # On TPU bf16 collectives are native; no upcast, no extra HBM traffic.
+    x_dtype = x.dtype
+    upcast_wire = jax.default_backend() == "cpu"
+
+    def _wire32(t):
+        if not upcast_wire:
+            return t
+        return jax.tree.map(lambda a: a.astype(jnp.float32) if jnp.issubdtype(a.dtype, jnp.floating) else a, t)
+
+    extras_dtypes = jax.tree.map(lambda a: a.dtype, extras_mb)
+    mbs = _wire32(mbs)
+    extras_mb = _wire32(extras_mb)
+
+    @partial(jax.shard_map,
+             mesh=mesh,
+             axis_names={PIPE_AXIS},
+             in_specs=(P(PIPE_AXIS), P(), P()),
+             out_specs=P(),
+             check_vma=False)
+    def run(params, mbs, extras_mb):
+        stage = jax.lax.axis_index(PIPE_AXIS)
+
+        def stage_layers(h, ex):
+            def body(h, p):
+                return fn(p, h, *ex), None
+
+            h, _ = jax.lax.scan(body, h, params)
+            return h
+
+        def tick(carry, t):
+            state, state_ex, outputs = carry
+            mb_idx = jnp.minimum(t, M - 1)
+            x_in = jax.lax.dynamic_index_in_dim(mbs, mb_idx, 0, keepdims=False).astype(x_dtype)
+            ex_in = jax.tree.map(lambda a: jax.lax.dynamic_index_in_dim(a, mb_idx, 0, keepdims=False), extras_mb)
+            ex_in = jax.tree.map(lambda a, dt: a.astype(dt), ex_in, extras_dtypes)
+            first = stage == 0
+            state = jnp.where(first, x_in, state)
+            state_ex = jax.tree.map(lambda new, old: jnp.where(first, new, old), ex_in, state_ex)
+            h = stage_layers(state, state_ex)
+            out_idx = t - (S - 1)
+            write = jnp.logical_and(stage == S - 1, out_idx >= 0)
+            updated = jax.lax.dynamic_update_index_in_dim(outputs, h, jnp.maximum(out_idx, 0), axis=0)
+            outputs = jnp.where(write, updated, outputs)
+            # rotate activation + its extras to the next stage (the
+            # SendActivation/RecvActivation pair, ref: pipe/p2p.py:45)
+            state = jax.lax.ppermute(h, PIPE_AXIS, rotate)
+            state_ex = jax.tree.map(lambda a: jax.lax.ppermute(a, PIPE_AXIS, rotate), state_ex)
+            return (state, state_ex, outputs), None
+
+        zero_state = jnp.zeros(mbs.shape[1:], x_dtype)
+        zero_ex = jax.tree.map(lambda a, dt: jnp.zeros(a.shape[1:], dt), extras_mb, extras_dtypes)
+        outputs0 = jnp.zeros(mbs.shape, x_dtype)
+        (_, _, outputs), _ = jax.lax.scan(tick, (zero_state, zero_ex, outputs0),
+                                          jnp.arange(num_pipeline_ticks(M, S)))
+        # only the last stage holds real outputs; masked psum broadcasts them
+        # to the whole pipe group (the _aggregate_total_loss broadcast analog,
+        # ref: pipe/engine.py:584 — generalised to the full activation so the
+        # replicated post-stage (norm/head/loss) can run everywhere)
+        # fp32 for the wire: bf16 psum trips an XLA-CPU check failure
+        # ("invalid binary instruction opcode copy"), and fp32 accumulation
+        # is numerically safer on the real reduction anyway
+        masked = jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)).astype(jnp.float32)
+        return jax.lax.psum(masked, PIPE_AXIS).astype(outputs.dtype)
+
+    return _unmicrobatch(run(body_params, mbs, extras_mb))

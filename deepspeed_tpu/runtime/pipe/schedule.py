@@ -1,0 +1,182 @@
+"""Pipeline instruction schedules (parity/introspection layer).
+
+Reference: ``deepspeed/runtime/pipe/schedule.py`` — ``PipeSchedule:51``,
+``InferenceSchedule:135``, ``TrainSchedule:189`` and the instruction
+classes ``:327-489``.  There these drive per-rank MPMD execution; here the
+compiled SPMD pipeline (pipeline.py) IS the schedule, so these generators
+exist for (a) API/test parity, (b) documentation of the tick↔microbatch
+mapping, (c) cost modelling (`num_ticks`, bubble fraction) used by the
+autotuner.
+"""
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class PipeInstruction:
+    buffer_id: int = -1
+
+    def __repr__(self):
+        if self.buffer_id >= 0:
+            return f"{type(self).__name__}(buffer_id={self.buffer_id})"
+        return type(self).__name__
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    pass
+
+
+class ForwardPass(PipeInstruction):
+    pass
+
+
+class BackwardPass(PipeInstruction):
+    pass
+
+
+class SendActivation(PipeInstruction):
+    pass
+
+
+class RecvActivation(PipeInstruction):
+    pass
+
+
+class SendGrad(PipeInstruction):
+    pass
+
+
+class RecvGrad(PipeInstruction):
+    pass
+
+
+class PipeSchedule:
+    """Base: iterate over per-step instruction lists for one (stage,
+    micro_batches, stages) coordinate (ref: schedule.py:51)."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self):
+        raise NotImplementedError
+
+    def num_pipe_buffers(self):
+        raise NotImplementedError
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, mb: int) -> bool:
+        return 0 <= mb < self.micro_batches
+
+    def _valid_stage(self, stage: int) -> bool:
+        return 0 <= stage < self.stages
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain (ref: schedule.py:135)."""
+
+    def num_pipe_buffers(self):
+        return 2
+
+    def steps(self):
+        total = self.micro_batches + self.stages - 1
+        for step_id in range(total):
+            mb = step_id - self.stage_id
+            cmds = []
+            buf = step_id % 2
+            if self._valid_micro_batch(mb):
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buf))
+                else:
+                    cmds.append(RecvActivation(buf))
+            if self._valid_micro_batch(mb - 1) and self._valid_stage(self.next_stage) \
+                    and not self.is_last_stage:
+                cmds.append(SendActivation((step_id - 1) % 2))
+            if self._valid_micro_batch(mb):
+                cmds.append(ForwardPass(buf))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """Synchronous 1F1B (ref: schedule.py:189 TrainSchedule).  Produces, per
+    stage, an alternating forward/backward step stream with warmup/cooldown;
+    total length 2*(micro_batches + stages - 1)."""
+
+    def num_pipe_buffers(self):
+        return max(2, min(self.stages - self.stage_id, self.micro_batches))
+
+    def _step_to_micro_batch(self, step_id):
+        """Map a schedule step to (micro_batch_id, is_forward).  Even steps
+        are forwards on even stages; parity alternates per stage so that
+        sends and recvs line up (same tick algebra as the reference)."""
+        even_step = step_id % 2 == 0
+        even_stage = self.stage_id % 2 == 0
+        if even_step == even_stage:
+            mb = (step_id - self.stage_id) // 2
+            return mb, True
+        mb = (step_id - 2 * self.stages + self.stage_id + 2) // 2
+        return mb, False
+
+    def steps(self):
+        prev_mb = -1
+        total = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total):
+            mb, is_forward = self._step_to_micro_batch(step_id)
+            cmds = []
+            if is_forward:
+                if self._valid_micro_batch(prev_mb) and self._valid_stage(self.prev_stage):
+                    cmds.append(SendGrad(self._buffer_idx(prev_mb)))
+                if self._valid_micro_batch(mb) and self._valid_stage(self.prev_stage):
+                    cmds.append(RecvActivation(self._buffer_idx(mb)))
+                if self._valid_micro_batch(mb) and (self.is_first_stage or self.is_last_stage):
+                    cmds.append(LoadMicroBatch(self._buffer_idx(mb)))
+                if self._valid_micro_batch(mb):
+                    cmds.append(ForwardPass(self._buffer_idx(mb)))
+            else:
+                if self._valid_micro_batch(mb) and self._valid_stage(self.next_stage):
+                    cmds.append(RecvGrad(self._buffer_idx(mb)))
+                if self._valid_micro_batch(prev_mb) and self._valid_stage(self.next_stage):
+                    cmds.append(SendActivation(self._buffer_idx(prev_mb)))
+                if self._valid_micro_batch(mb):
+                    cmds.append(BackwardPass(self._buffer_idx(mb)))
+            if step_id == total - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+            prev_mb = mb
+            yield cmds
+
+    def _buffer_idx(self, mb):
+        assert self._valid_micro_batch(mb)
+        return mb % self.num_pipe_buffers()
+
+
+def bubble_fraction(micro_batches: int, stages: int) -> float:
+    """GPipe/1F1B bubble overhead — used by the autotuner cost model."""
+    return (stages - 1) / (micro_batches + stages - 1)
