@@ -64,6 +64,12 @@ class SequenceDescriptor:
     def in_prefill(self) -> bool:
         return self.remaining_prefill > 0
 
+    @property
+    def in_decode(self) -> bool:
+        """Generating: the single unseen token is a sampled one (its KV write
+        + next-token logits are one C=1 step)."""
+        return bool(self.generated) and self.remaining_prefill <= 1
+
 
 class BlockedKVCache:
     """Geometry + allocator pairing (ref: kv_cache.py:40).  The device
@@ -99,8 +105,8 @@ class RaggedBatch:
     tokens: np.ndarray        # [B, C] int32 (padded)
     start_pos: np.ndarray     # [B] int32 — context length before this chunk
     block_tables: np.ndarray  # [B, max_pages] int32 (null page 0 padded)
-    chunk_lens: np.ndarray    # [B] int32 — real tokens this step
-    uids: List[int]           # row → uid (len ≤ B; padding rows map to -1)
+    chunk_lens: np.ndarray    # [B] int32 — real tokens this step (0 = padding row)
+    uids: List[int]           # row → uid (len B; padding rows map to -1)
 
     @property
     def batch(self) -> int:
@@ -128,14 +134,20 @@ class StateManager:
         if seq is not None:
             self.kv.release(seq)
 
-    def pack(self, work: List[Tuple[SequenceDescriptor, int]], chunk: int) -> RaggedBatch:
-        """Pack (seq, n_tokens) work items into fixed [B, chunk] buffers."""
-        b = len(work)
+    def pack(self, work: List[Tuple[SequenceDescriptor, int]], chunk: int,
+             pad_to: Optional[int] = None) -> RaggedBatch:
+        """Pack (seq, n_tokens) work items into fixed [B, chunk] buffers.
+
+        B is padded to ``pad_to`` (default ``max_batch``) so the compiled
+        step program keeps ONE shape across scheduler decisions — padding
+        rows have uid -1, chunk_len 0, and an all-null block table."""
+        b = pad_to if pad_to is not None else self.max_batch
+        assert len(work) <= b, f"{len(work)} work items exceed batch capacity {b}"
         tokens = np.zeros((b, chunk), np.int32)
         start_pos = np.zeros((b, ), np.int32)
         block_tables = np.zeros((b, self.kv.max_pages_per_seq), np.int32)
         chunk_lens = np.zeros((b, ), np.int32)
-        uids = []
+        uids = [-1] * b
         for i, (seq, n) in enumerate(work):
             self.kv.ensure_capacity(seq, n)
             sl = seq.tokens[seq.seen_tokens:seq.seen_tokens + n]
@@ -143,6 +155,6 @@ class StateManager:
             start_pos[i] = seq.seen_tokens
             block_tables[i, :len(seq.pages)] = seq.pages
             chunk_lens[i] = n
-            uids.append(seq.uid)
+            uids[i] = seq.uid
         return RaggedBatch(tokens=tokens, start_pos=start_pos, block_tables=block_tables,
                            chunk_lens=chunk_lens, uids=uids)

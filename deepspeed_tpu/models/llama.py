@@ -157,6 +157,9 @@ def get_attention_impl(name: str) -> Callable:
     if name == "ulysses":
         from ..sequence.layer import DistributedAttention
         return DistributedAttention(reference_attention)
+    if name == "ring":
+        from ..sequence.ring import ring_attention
+        return ring_attention
     raise ValueError(f"Unknown attention impl {name}")
 
 
@@ -303,15 +306,21 @@ def causal_lm_loss(logits, labels, loss_mask=None):
 class LlamaEmbedLayer(nn.Module):
     cfg: LlamaConfig
 
-    @nn.compact
-    def __call__(self, input_ids):
+    def setup(self):
         cfg = self.cfg
-        return nn.Embed(num_embeddings=cfg.vocab_size,
-                        features=cfg.hidden_size,
-                        dtype=cfg.dtype,
-                        param_dtype=cfg.param_dtype,
-                        embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
-                        name="embed_tokens")(input_ids)
+        self.embed_tokens = nn.Embed(num_embeddings=cfg.vocab_size,
+                                     features=cfg.hidden_size,
+                                     dtype=cfg.dtype,
+                                     param_dtype=cfg.param_dtype,
+                                     embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)))
+
+    def __call__(self, input_ids):
+        return self.embed_tokens(input_ids)
+
+    def attend(self, x):
+        """Tied LM head: logits via the embedding matrix (used by the
+        pipeline's TiedLayerSpec forward_fn when tie_word_embeddings)."""
+        return self.embed_tokens.attend(x)
 
 
 class LlamaPipeBlock(nn.Module):
@@ -340,10 +349,26 @@ class LlamaHeadLayer(nn.Module):
                                name="lm_head")(x)
 
 
+class LlamaNormLayer(nn.Module):
+    """Final norm alone (last-stage tail when the LM head is tied)."""
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        return RMSNorm(self.cfg.rms_norm_eps, self.cfg.dtype, self.cfg.param_dtype, name="norm")(x)
+
+
 def llama_pipeline_layers(cfg: LlamaConfig):
     """Flat layer list for PipelineModule (ref: the GPT2ModelPipe pattern in
-    DeepSpeed examples built on pipe/module.py LayerSpec)."""
-    from ..runtime.pipe.module import LayerSpec
-    return ([LayerSpec(LlamaEmbedLayer, cfg)] + [LayerSpec(LlamaPipeBlock, cfg)
-                                                 for _ in range(cfg.num_hidden_layers)] +
-            [LayerSpec(LlamaHeadLayer, cfg)])
+    DeepSpeed examples built on pipe/module.py LayerSpec).  With
+    ``tie_word_embeddings`` the head reuses the embedding matrix via
+    TiedLayerSpec (ref: pipe/module.py TiedLayerSpec), matching
+    LlamaForCausalLM's ``embed.attend`` path."""
+    from ..runtime.pipe.module import LayerSpec, TiedLayerSpec
+    blocks = [LayerSpec(LlamaPipeBlock, cfg) for _ in range(cfg.num_hidden_layers)]
+    if cfg.tie_word_embeddings:
+        embed = TiedLayerSpec("embed", LlamaEmbedLayer, cfg)
+        head = TiedLayerSpec("embed", LlamaEmbedLayer, cfg,
+                             forward_fn=lambda mod, variables, x: mod.apply(variables, x, method="attend"))
+        return [embed] + blocks + [LayerSpec(LlamaNormLayer, cfg), head]
+    return ([LayerSpec(LlamaEmbedLayer, cfg)] + blocks + [LayerSpec(LlamaHeadLayer, cfg)])

@@ -47,29 +47,35 @@ def init_kv_cache(cfg: LlamaConfig, kv: PagedKVConfig, dtype=jnp.bfloat16):
                      dtype)
 
 
-def _write_pages(pages, k_new, v_new, block_table, start_pos, page_size):
+def _write_pages(pages, k_new, v_new, block_table, start_pos, page_size, chunk_lens=None):
     """Scatter a chunk's K/V into the arena pages.
 
     pages: [P, page, 2, n_kv, hd] (one layer)   k/v_new: [B, C, n_kv, hd]
-    block_table: [B, max_pages]  start_pos: [B]
+    block_table: [B, max_pages]  start_pos: [B]  chunk_lens: [B] or None —
+    positions at/after a row's chunk_len are padding; their writes are
+    redirected to the reserved null page 0.
     """
     b, c = k_new.shape[0], k_new.shape[1]
     positions = start_pos[:, None] + jnp.arange(c)[None, :]          # [B, C]
     page_idx = jnp.take_along_axis(block_table, positions // page_size, axis=1)  # [B, C]
+    if chunk_lens is not None:
+        valid = jnp.arange(c)[None, :] < chunk_lens[:, None]          # [B, C]
+        page_idx = jnp.where(valid, page_idx, 0)
     slot_idx = positions % page_size                                  # [B, C]
     kv_chunk = jnp.stack([k_new, v_new], axis=2)                      # [B, C, 2, n_kv, hd]
     flat_kv = kv_chunk.reshape((-1, ) + kv_chunk.shape[2:])           # [B*C, 2, n_kv, hd]
     return pages.at[page_idx.reshape(-1), slot_idx.reshape(-1)].set(flat_kv)
 
 
-def paged_attention(q, pages, block_table, start_pos, chunk_len, page_size):
+def paged_attention(q, pages, block_table, start_pos, chunk_lens, page_size):
     """Attention of a chunk's queries against (history + chunk) keys.
 
     q: [B, C, H, hd] (RoPE applied); pages: [P, page, 2, n_kv, hd] with the
     chunk's K/V already written; block_table: [B, max_pages]; start_pos: [B]
-    = context length before this chunk.  jnp reference implementation — the
-    Pallas blocked-decode kernel slots in behind the same signature
-    (ops/paged_attention.py).
+    = context length before this chunk; chunk_lens: [B] or None — query rows
+    at/after a row's chunk_len (ragged padding) get zero output.  jnp
+    reference implementation — the Pallas blocked-decode kernel slots in
+    behind the same signature (ops/paged_attention.py).
     """
     b, c, h, d = q.shape
     max_pages = block_table.shape[1]
@@ -89,7 +95,11 @@ def paged_attention(q, pages, block_table, start_pos, chunk_len, page_size):
     mask = kpos[:, None, :] <= qpos[..., None]                        # [B, C, S_kv]
     logits = jnp.where(mask[:, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bnck,bknd->bcnd", probs.astype(v.dtype), v)
+    out = jnp.einsum("bnck,bknd->bcnd", probs.astype(v.dtype), v)
+    if chunk_lens is not None:
+        valid = jnp.arange(c)[None, :] < chunk_lens[:, None]          # [B, C]
+        out = jnp.where(valid[..., None, None], out, 0)
+    return out
 
 
 class LlamaAttentionCache(nn.Module):
@@ -97,7 +107,7 @@ class LlamaAttentionCache(nn.Module):
     page_size: int = 16
 
     @nn.compact
-    def __call__(self, x, positions, pages, block_table, start_pos):
+    def __call__(self, x, positions, pages, block_table, start_pos, chunk_lens=None):
         cfg = self.cfg
         head_dim = cfg.hidden_size // cfg.num_attention_heads
         from functools import partial
@@ -115,8 +125,13 @@ class LlamaAttentionCache(nn.Module):
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         pages = _write_pages(pages, k.astype(pages.dtype), v.astype(pages.dtype), block_table, start_pos,
-                             self.page_size)
-        out = paged_attention(q, pages, block_table, start_pos, x.shape[1], self.page_size)
+                             self.page_size, chunk_lens)
+        if cfg.attention_impl == "flash":
+            # Pallas blocked-decode kernel (ops/paged_attention.py)
+            from ..ops.paged_attention import paged_attention_pallas
+            out = paged_attention_pallas(q, pages, block_table, start_pos, chunk_lens, self.page_size)
+        else:
+            out = paged_attention(q, pages, block_table, start_pos, chunk_lens, self.page_size)
         out = nn.DenseGeneral(features=cfg.hidden_size,
                               axis=(-2, -1),
                               use_bias=False,
@@ -133,12 +148,12 @@ class LlamaBlockCache(nn.Module):
     scanned: bool = False
 
     @nn.compact
-    def __call__(self, carry, layer_pages, positions=None, block_table=None, start_pos=None):
+    def __call__(self, carry, layer_pages, positions=None, block_table=None, start_pos=None, chunk_lens=None):
         cfg = self.cfg
         x = carry
         attn_out, layer_pages = LlamaAttentionCache(cfg, self.page_size, name="self_attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="input_layernorm")(x), positions, layer_pages,
-            block_table, start_pos)
+            block_table, start_pos, chunk_lens)
         h = x + attn_out
         out = h + LlamaMLP(cfg, name="mlp")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="post_attention_layernorm")(h))
@@ -154,7 +169,7 @@ class LlamaForCausalLMWithCache(nn.Module):
     page_size: int = 16
 
     @nn.compact
-    def __call__(self, input_ids, start_pos, block_table, cache):
+    def __call__(self, input_ids, start_pos, block_table, cache, chunk_lens=None):
         cfg = self.cfg
         positions = start_pos[:, None] + jnp.arange(input_ids.shape[1])[None, :]
         embed = nn.Embed(num_embeddings=cfg.vocab_size,
@@ -171,19 +186,19 @@ class LlamaForCausalLMWithCache(nn.Module):
             page_size: int
 
             @nn.compact
-            def __call__(self, x, cache, positions, block_table, start_pos):
+            def __call__(self, x, cache, positions, block_table, start_pos, chunk_lens):
                 blocks = nn.scan(LlamaBlockCache,
                                  variable_axes={"params": 0},
                                  split_rngs={"params": True},
-                                 in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast),
+                                 in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
                                  out_axes=0,
                                  length=self.cfg.num_hidden_layers,
                                  metadata_params={nn.PARTITION_NAME: LAYERS})
                 x, cache = blocks(self.cfg, self.page_size, scanned=True,
-                                  name="layers")(x, cache, positions, block_table, start_pos)
+                                  name="layers")(x, cache, positions, block_table, start_pos, chunk_lens)
                 return x, cache
 
-        x, cache = _Trunk(cfg, self.page_size, name="model")(x, cache, positions, block_table, start_pos)
+        x, cache = _Trunk(cfg, self.page_size, name="model")(x, cache, positions, block_table, start_pos, chunk_lens)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="norm")(x)
         if cfg.tie_word_embeddings:
             logits = embed.attend(x)

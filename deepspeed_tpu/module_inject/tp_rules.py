@@ -28,6 +28,7 @@ from ..comm.mesh import DATA_AXIS, EXPERT_AXIS, PIPE_AXIS, SEQ_AXIS, TENSOR_AXIS
 
 # Logical axis names used across the model zoo
 from ..models.llama import EMBED, HEADS, HEAD_DIM, KV_HEADS, LAYERS, MLP, VOCAB  # noqa: F401
+from ..runtime.pipe.pipeline import STAGE_LAYERS
 
 EXPERTS = "experts"  # MoE expert axis (moe/experts.py)
 
@@ -51,7 +52,7 @@ def make_logical_rules(zero_stage: int, mesh: Mesh, fsdp_axes: Sequence[str] = Z
         (HEAD_DIM, None),
         (LAYERS, None),
         # pipelined stacked-block leading axis (runtime/pipe/pipeline.py)
-        ("stage_layers", PIPE_AXIS if mesh.shape.get(PIPE_AXIS, 1) > 1 else None),
+        (STAGE_LAYERS, PIPE_AXIS if mesh.shape.get(PIPE_AXIS, 1) > 1 else None),
         (EXPERTS, EXPERT_AXIS if mesh.shape.get(EXPERT_AXIS, 1) > 1 else None),
         # expert weights: the 'expert' axis is taken by the expert dim, so
         # their ZeRO (fsdp) sharding uses the remaining DP axes only

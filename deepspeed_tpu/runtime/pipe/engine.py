@@ -45,17 +45,22 @@ class PipelineEngine(DeepSpeedEngine):
             f"bubble={bubble_fraction(self.micro_batches, self.num_stages):.2%}",
             ranks=[0])
 
+    def _assemble_batch(self, data_iter):
+        """Concatenate ``micro_batches`` loader micro-batches into the full
+        batch the compiled pipeline consumes (ref: pipe/engine.py train_batch
+        and eval_batch both pull gas micro-batches from the iterator)."""
+        import jax
+        import numpy as np
+        micro = [next(data_iter) for _ in range(self.micro_batches)]
+        return jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *micro) \
+            if self.micro_batches > 1 else micro[0]
+
     def train_batch(self, data_iter=None, batch=None):
-        """Assemble ``micro_batches`` loader micro-batches into the full
-        batch the compiled pipeline consumes (the outer engine runs gas=1;
-        micro-batching happens inside the pipeline program)."""
+        """The outer engine runs gas=1; micro-batching happens inside the
+        compiled pipeline program."""
         if batch is None:
             assert data_iter is not None, "provide data_iter or batch"
-            import jax
-            import numpy as np
-            micro = [next(data_iter) for _ in range(self.micro_batches)]
-            batch = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *micro) \
-                if self.micro_batches > 1 else micro[0]
+            batch = self._assemble_batch(data_iter)
         return super().train_batch(batch=batch)
 
     def gradient_accumulation_steps(self):
@@ -94,8 +99,10 @@ class PipelineEngine(DeepSpeedEngine):
                            "(parity with reference PipelineEngine).")
 
     def eval_batch(self, data_iter=None, batch=None):
-        """Forward-only over the pipeline (InferenceSchedule semantics)."""
+        """Forward-only over the pipeline (InferenceSchedule semantics).
+        Pulls ``micro_batches`` micro-batches like train_batch — the compiled
+        pipeline splits its input batch by the same factor."""
         if batch is None:
-            batch = next(data_iter)
+            batch = self._assemble_batch(data_iter)
         self._ensure_ready(batch)
         return self._build_eval_fn()(self.state, batch)

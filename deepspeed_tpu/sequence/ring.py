@@ -1,0 +1,251 @@
+"""Ring attention — true context parallelism over the ``seq`` mesh axis.
+
+The reference has no blockwise ring attention (SURVEY §2.3: long-context
+there is Ulysses + FPDT chunking, ``deepspeed/sequence/fpdt_layer.py``).  On
+TPU a ring schedule is the natural long-context design: KV blocks rotate
+around the ICI ring via ``lax.ppermute`` while each device accumulates
+attention for its resident Q block with an online-softmax merge — the same
+math as FPDT's ``update_out_and_lse`` (ref: sequence/fpdt_layer.py:58) but
+with the chunk stream coming from neighbours over ICI instead of from host
+memory.  Sequence length per device stays constant as the ``seq`` axis grows,
+so context scales linearly with chips.
+
+Design notes:
+  * SPMD via ``shard_map``; the per-step ``ppermute`` is independent of that
+    step's block compute, so XLA's latency-hiding scheduler overlaps the
+    collective-permute with the attention matmuls (the hand-rolled double
+    buffering of the reference's FPDT falls out of program order).
+  * Causal skip: a block whose source rank sits strictly after ours is fully
+    masked; a per-device ``lax.cond`` skips its FLOPs entirely.  Rank r
+    computes r+1 of the P blocks — the usual causal ring imbalance; the
+    ``striped`` layout (each rank holds an interleaved stripe of the
+    sequence, see ``striped_ring_attention``) rebalances it.
+  * Gradients flow through ``lax.scan`` + ``ppermute`` transpose rules, so
+    the backward pass is itself a ring program — no custom VJP needed.
+"""
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..comm.mesh import BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, get_global_mesh
+
+_NEG_INF = -1e30
+
+
+def _match_vma(like):
+    """Return a fn casting an unvarying array to the varying-manual-axes set
+    of ``like`` (shard_map vma typing; no-op outside shard_map)."""
+    axes = getattr(jax.typeof(like), "vma", None) if hasattr(jax, "typeof") else None
+    if not axes:
+        return lambda x: x
+    return lambda x: jax.lax.pcast(x, tuple(axes), to="varying")
+
+
+def _block_partials(q32, k_blk, v_blk, q_pos, k_pos, scale, causal):
+    """One Q-block × KV-block attention with running-softmax partials.
+
+    q32: [B, sq, H, D] fp32; k_blk/v_blk: [B, sk, Hkv, D].
+    Returns (m, l, o): [B, H, sq], [B, H, sq], [B, H, sq, D].
+    """
+    nh = q32.shape[2]
+    nkv = k_blk.shape[2]
+    if nkv != nh:
+        rep = nh // nkv
+        k_blk = jnp.repeat(k_blk, rep, axis=2)
+        v_blk = jnp.repeat(v_blk, rep, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]  # [sq, sk]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)  # [B, H, sq]
+    p = jnp.exp(s - m[..., None])
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+    return m, l, o
+
+
+def _merge(m, l, acc, m_blk, l_blk, o_blk):
+    """Online-softmax merge of a new block into the running accumulator
+    (same recurrence as ref sequence/fpdt_layer.py:58 update_out_and_lse)."""
+    m_new = jnp.maximum(m, m_blk)
+    a1 = jnp.exp(m - m_new)
+    a2 = jnp.exp(m_blk - m_new)
+    l_new = a1 * l + a2 * l_blk
+    acc_new = acc * a1[..., None] + o_blk * a2[..., None]
+    return m_new, l_new, acc_new
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          block_ids: Optional[jnp.ndarray] = None):
+    """Ring attention on local shards [B, s_local, H(local), D].
+
+    ``block_ids``: for the plain layout, rank r holds contiguous block r; the
+    striped layout passes explicit per-rank block indices instead.
+    """
+    ring = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    b, sq, nh, hd = q.shape
+    scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
+    q32 = q.astype(jnp.float32)
+    my_block = me if block_ids is None else block_ids
+    q_pos = my_block * sq + jnp.arange(sq)
+
+    m0 = jnp.full((b, nh, sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, nh, sq), jnp.float32)
+    acc0 = jnp.zeros((b, nh, sq, hd), jnp.float32)
+    # match the varying-manual-axes type of the computed branch so the causal
+    # skip cond and the scan carry typecheck under shard_map's vma system
+    m0, l0, acc0 = jax.tree.map(_match_vma(q), (m0, l0, acc0))
+    perm = [(j, (j + 1) % ring) for j in range(ring)]
+
+    def step(carry, t):
+        m, l, acc, k_blk, v_blk, src_block = carry
+        k_pos = src_block * sq + jnp.arange(sq)
+
+        def compute(args):
+            m, l, acc = args
+            m_b, l_b, o_b = _block_partials(q32, k_blk, v_blk, q_pos, k_pos, scale, causal)
+            return _merge(m, l, acc, m_b, l_b, o_b)
+
+        if causal:
+            # Fully-masked block (source strictly after us): skip its FLOPs.
+            visible = src_block <= my_block
+            m, l, acc = jax.lax.cond(visible, compute, lambda args: args, (m, l, acc))
+        else:
+            m, l, acc = compute((m, l, acc))
+
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        src_nxt = jax.lax.ppermute(src_block, axis_name, perm)
+        return (m, l, acc, k_nxt, v_nxt, src_nxt), None
+
+    (m, l, acc, _, _, _), _ = jax.lax.scan(step, (m0, l0, acc0, k, v, my_block),
+                                           jnp.arange(ring))
+    out = acc / jnp.where(l == 0.0, 1.0, l)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B, sq, H, D]
+
+
+def ring_attention(q, k, v, *, causal: bool = True, segment_ids=None,
+                   mesh=None, seq_axis: str = SEQ_AXIS):
+    """Context-parallel attention on globally [B, S, H, D] arrays whose S dim
+    is sharded over ``seq_axis``.  Falls back to the jnp reference when the
+    mesh has no sequence axis (so it is safe as a default attention impl)."""
+    mesh = mesh or get_global_mesh()
+    if mesh.shape.get(seq_axis, 1) == 1:
+        from ..models.llama import reference_attention
+        return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    if segment_ids is not None:
+        raise NotImplementedError("ring attention does not support segment_ids yet")
+
+    q_spec, kv_spec = _qkv_specs(mesh, q.shape, k.shape, seq_axis)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec)
+    def mapped(q, k, v):
+        return _ring_attention_local(q, k, v, axis_name=seq_axis, causal=causal)
+
+    return mapped(q, k, v)
+
+
+def _qkv_specs(mesh, q_shape, kv_shape, seq_axis: str):
+    """[B, S, H, D] specs: batch over the data axes when divisible, sequence
+    over the ring axis, heads over tensor ONLY when both the q and the kv head
+    counts divide the tensor axis — otherwise heads stay replicated (sharding
+    just one of them would break the GQA head↔group alignment per shard)."""
+    import numpy as _np
+    bsz_axes = [a for a in BATCH_AXES if mesh.shape.get(a, 1) > 1]
+    bspec = tuple(bsz_axes) if bsz_axes and q_shape[0] % int(
+        _np.prod([mesh.shape[a] for a in bsz_axes])) == 0 else None
+    tp_size = mesh.shape.get(TENSOR_AXIS, 1)
+    hspec = (TENSOR_AXIS if tp_size > 1 and q_shape[2] % tp_size == 0
+             and kv_shape[2] % tp_size == 0 else None)
+    return (P(bspec, seq_axis, hspec, None), P(bspec, seq_axis, hspec, None))
+
+
+def striped_ring_attention(q, k, v, *, causal: bool = True, segment_ids=None,
+                           mesh=None, seq_axis: str = SEQ_AXIS):
+    """Load-balanced ("zigzag") causal ring attention.
+
+    The plain causal ring gives rank r work proportional to r+1.  Here each
+    rank holds TWO half-blocks — the r-th from the front of the sequence and
+    the r-th from the back — so every rank sees the same masked/unmasked mix.
+    The caller must lay out the sequence in zigzag order (see
+    ``zigzag_reorder`` / ``zigzag_restore``); positions are reconstructed
+    internally for the causal mask.
+    """
+    mesh = mesh or get_global_mesh()
+    ring = mesh.shape.get(seq_axis, 1)
+    if ring == 1:
+        from ..models.llama import reference_attention
+        return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    if segment_ids is not None:
+        raise NotImplementedError("striped ring attention does not support segment_ids")
+
+    q_spec, kv_spec = _qkv_specs(mesh, q.shape, k.shape, seq_axis)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec)
+    def mapped(q, k, v):
+        me = jax.lax.axis_index(seq_axis)
+        b, sl, nh, hd = q.shape
+        half = sl // 2
+        scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
+        q32 = q.astype(jnp.float32)
+        # local halves: front block index = me, back block index = 2*ring-1-me
+        front, back = me, 2 * ring - 1 - me
+        pos = jnp.concatenate([front * half + jnp.arange(half),
+                               back * half + jnp.arange(half)])
+        m0 = jnp.full((b, nh, sl), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, nh, sl), jnp.float32)
+        acc0 = jnp.zeros((b, nh, sl, hd), jnp.float32)
+        m0, l0, acc0 = jax.tree.map(_match_vma(q), (m0, l0, acc0))
+        perm = [(j, (j + 1) % ring) for j in range(ring)]
+
+        def step(carry, t):
+            m, l, acc, k_blk, v_blk, src_front, src_back = carry
+            k_pos = jnp.concatenate([src_front * half + jnp.arange(half),
+                                     src_back * half + jnp.arange(half)])
+            m_b, l_b, o_b = _block_partials(q32, k_blk, v_blk, pos, k_pos, scale, causal)
+            m, l, acc = _merge(m, l, acc, m_b, l_b, o_b)
+            k_nxt = jax.lax.ppermute(k_blk, seq_axis, perm)
+            v_nxt = jax.lax.ppermute(v_blk, seq_axis, perm)
+            sf = jax.lax.ppermute(src_front, seq_axis, perm)
+            sb = jax.lax.ppermute(src_back, seq_axis, perm)
+            return (m, l, acc, k_nxt, v_nxt, sf, sb), None
+
+        (m, l, acc, _, _, _, _), _ = jax.lax.scan(
+            step, (m0, l0, acc0, k, v, front, back), jnp.arange(ring))
+        out = acc / jnp.where(l == 0.0, 1.0, l)[..., None]
+        return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+    return mapped(q, k, v)
+
+
+def zigzag_reorder(x, ring: int, axis: int = 1):
+    """Permute a sequence dim into the zigzag layout consumed by
+    ``striped_ring_attention``: rank r gets chunks (r, 2*ring-1-r)."""
+    n = x.shape[axis]
+    chunk = n // (2 * ring)
+    idx = []
+    for r in range(ring):
+        idx.extend(range(r * chunk, (r + 1) * chunk))
+        idx.extend(range((2 * ring - 1 - r) * chunk, (2 * ring - r) * chunk))
+    return jnp.take(x, jnp.asarray(idx), axis=axis)
+
+
+def zigzag_restore(x, ring: int, axis: int = 1):
+    """Inverse of ``zigzag_reorder``."""
+    n = x.shape[axis]
+    chunk = n // (2 * ring)
+    idx = []
+    for r in range(ring):
+        idx.extend(range(r * chunk, (r + 1) * chunk))
+        idx.extend(range((2 * ring - 1 - r) * chunk, (2 * ring - r) * chunk))
+    inv = [0] * n
+    for new_pos, old_pos in enumerate(idx):
+        inv[old_pos] = new_pos
+    return jnp.take(x, jnp.asarray(inv), axis=axis)

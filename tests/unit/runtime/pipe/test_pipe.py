@@ -171,3 +171,48 @@ def test_pipeline_engine_llama_train():
     from deepspeed_tpu.runtime.pipe.module import PipelineError
     with pytest.raises(PipelineError):
         model.apply({"params": {}}, ids, segment_ids=ids)
+
+
+def test_tied_embedding_pipeline():
+    """tie_word_embeddings=True routes through TiedLayerSpec: one shared
+    embedding matrix, head = embed.attend (parity with LlamaForCausalLM)."""
+    import dataclasses
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.comm.mesh import MeshSpec, create_mesh, set_global_mesh
+    from deepspeed_tpu.models.llama import llama_pipeline_layers
+    from deepspeed_tpu.runtime.pipe import PipelineModule
+
+    cfg = dataclasses.replace(TINY, tie_word_embeddings=True)
+    mesh = create_mesh(MeshSpec(pipe=2, data=-1))
+    set_global_mesh(mesh)
+    model = PipelineModule(layers=llama_pipeline_layers(cfg), num_stages=2)
+    config = {
+        "train_batch_size": 8,
+        "gradient_accumulation_steps": 2,
+        "steps_per_print": 0,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "pipeline": {"stages": 2},
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config, mesh=mesh)
+
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, cfg.vocab_size, size=(8, 16), dtype=np.int32)
+    losses = [float(engine.train_batch(batch={"input_ids": ids, "labels": ids})) for _ in range(4)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+    params = engine.state.params
+    assert "tied_embed" in params, sorted(params)
+    assert not any("lm_head" in k for k in params), sorted(params)
+
+    # eval_batch consumes micro_batches loader batches, like train_batch
+    micro = {"input_ids": ids[:4], "labels": ids[:4]}
+
+    def it():
+        while True:
+            yield micro
+
+    out = engine.eval_batch(data_iter=it())
+    assert np.isfinite(float(out))
