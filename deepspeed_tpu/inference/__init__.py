@@ -1,0 +1,1 @@
+from .engine import DeepSpeedInferenceConfig, InferenceEngine  # noqa: F401

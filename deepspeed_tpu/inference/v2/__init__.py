@@ -1,3 +1,5 @@
 from .ragged import (BlockedAllocator, BlockedKVCache, RaggedBatch, SequenceDescriptor,  # noqa: F401
                      StateManager)
 from .scheduler import SchedulerConfig, SplitFuseScheduler, StepPlan  # noqa: F401
+from .engine_v2 import (InferenceEngineV2, RaggedInferenceEngineConfig,  # noqa: F401
+                        build_engine)

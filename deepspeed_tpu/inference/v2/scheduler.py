@@ -41,8 +41,8 @@ class SplitFuseScheduler:
     def plan(self, manager: StateManager) -> StepPlan:
         cfg = self.config
         running = [s for s in manager.seqs.values() if not s.done]
-        decodes = [s for s in running if not s.in_prefill and s.seen_tokens > 0]
-        prefills = [s for s in running if s.in_prefill]
+        decodes = [s for s in running if s.in_decode]
+        prefills = [s for s in running if s.in_prefill and not s.in_decode]
 
         decodes = decodes[:cfg.max_seqs]
         budget = cfg.token_budget - len(decodes)

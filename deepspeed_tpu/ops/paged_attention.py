@@ -1,0 +1,125 @@
+"""Pallas TPU paged (blocked-KV) decode attention.
+
+TPU-native equivalent of the reference FastGen's blocked-flash/linear-KV
+attention kernels (ref: deepspeed/inference/v2/kernels/ragged_ops —
+``blocked_flash``, ``linear_blocked_kv_rotary``; KV geometry from
+``inference/v2/ragged/kv_cache.py``).  The kernel attends a (small) chunk of
+queries per sequence against that sequence's paged KV history, gathering
+pages from the shared arena through the block table.
+
+Implementation notes:
+  * the block table and start positions ride in scalar-prefetch SMEM
+    (``PrefetchScalarGridSpec``) so each grid step's page DMA address is
+    computed from ``block_table[b, j]`` — the Pallas analog of the
+    reference's atom-builder indirection (ragged/csrc/fast_host_buffer.cpp).
+  * grid = (batch, kv_heads, pages); the page dimension is "arbitrary"
+    (sequential) and carries the online-softmax state in VMEM scratch, like
+    ops/flash_attention.py.
+  * GQA: queries are laid out group-major ([B, n_kv, rep·C, D]) so each
+    kv-head grid step contracts its whole query group against one page.
+  * pages whose first key is beyond the chunk's last visible position are
+    skipped (`pl.when`), so decode cost scales with the sequence's true
+    length, not max_pages — SplitFuse's "decode is O(context)" property.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _paged_kernel(bt_ref, sp_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  page_size, max_pages, chunk, scale):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    start = sp_ref[b]
+    # last visible key position of this chunk is start + chunk - 1
+    @pl.when(j * page_size <= start + chunk - 1)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)            # [repC, D]
+        k = k_ref[0, :, 0, 0].astype(jnp.float32)      # [page, D]
+        v = v_ref[0, :, 0, 0].astype(jnp.float32)      # [page, D]
+        rep_c = q.shape[0]
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale  # [repC, page]
+        # row r of the group-major q block is chunk position r % chunk
+        row_c = jax.lax.broadcasted_iota(jnp.int32, (rep_c, page_size), 0) % chunk
+        kpos = j * page_size + jax.lax.broadcasted_iota(jnp.int32, (rep_c, page_size), 1)
+        s = jnp.where(kpos <= start + row_c, s, DEFAULT_MASK_VALUE)
+        m_prev = m_scr[:]
+        l_prev = l_scr[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1, ), (0, )), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(j == max_pages - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_attention_pallas(q, pages, block_table, start_pos, chunk_lens, page_size,
+                           *, interpret: Optional[bool] = None):
+    """Drop-in twin of ``models/llama_cache.paged_attention`` (jnp golden).
+
+    q: [B, C, H, D]; pages: [P, page, 2, n_kv, D] (chunk K/V already
+    written); block_table: [B, max_pages]; start_pos/chunk_lens: [B].
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    b, c, h, d = q.shape
+    n_kv = pages.shape[3]
+    max_pages = block_table.shape[1]
+    rep = h // n_kv
+    scale = 1.0 / (d**0.5)
+
+    # group-major query layout: [B, n_kv, rep*C, D], row = r*C + c
+    qg = q.transpose(0, 2, 1, 3).reshape(b, n_kv, rep, c, d).reshape(b, n_kv, rep * c, d)
+
+    grid = (b, n_kv, max_pages)
+    kernel = functools.partial(_paged_kernel, page_size=page_size, max_pages=max_pages,
+                               chunk=c, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, rep * c, d), lambda b, h, j, bt, sp: (b, h, 0, 0)),
+                pl.BlockSpec((1, page_size, 1, 1, d), lambda b, h, j, bt, sp: (bt[b, j], 0, 0, h, 0)),
+                pl.BlockSpec((1, page_size, 1, 1, d), lambda b, h, j, bt, sp: (bt[b, j], 0, 1, h, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, rep * c, d), lambda b, h, j, bt, sp: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rep * c, 1), jnp.float32),
+                pltpu.VMEM((rep * c, 1), jnp.float32),
+                pltpu.VMEM((rep * c, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, n_kv, rep * c, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(block_table, start_pos, qg, pages, pages)
+
+    out = out.reshape(b, n_kv, rep, c, d).reshape(b, h, c, d).transpose(0, 2, 1, 3)
+    if chunk_lens is not None:
+        valid = jnp.arange(c)[None, :] < chunk_lens[:, None]
+        out = jnp.where(valid[..., None, None], out, 0)
+    return out

@@ -45,89 +45,48 @@ def _match_vma(like):
     return lambda x: jax.lax.pcast(x, tuple(axes), to="varying")
 
 
-def _block_partials(q32, k_blk, v_blk, q_pos, k_pos, scale, causal):
-    """One Q-block × KV-block attention with running-softmax partials.
-
-    q32: [B, sq, H, D] fp32; k_blk/v_blk: [B, sk, Hkv, D].
-    Returns (m, l, o): [B, H, sq], [B, H, sq], [B, H, sq, D].
-    """
-    nh = q32.shape[2]
-    nkv = k_blk.shape[2]
-    if nkv != nh:
-        rep = nh // nkv
-        k_blk = jnp.repeat(k_blk, rep, axis=2)
-        v_blk = jnp.repeat(v_blk, rep, axis=2)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
-    if causal:
-        mask = q_pos[:, None] >= k_pos[None, :]  # [sq, sk]
-        s = jnp.where(mask[None, None], s, _NEG_INF)
-    m = jnp.max(s, axis=-1)  # [B, H, sq]
-    p = jnp.exp(s - m[..., None])
-    if causal:
-        p = jnp.where(mask[None, None], p, 0.0)
-    l = jnp.sum(p, axis=-1)
-    o = jnp.einsum("bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
-    return m, l, o
-
-
-def _merge(m, l, acc, m_blk, l_blk, o_blk):
-    """Online-softmax merge of a new block into the running accumulator
-    (same recurrence as ref sequence/fpdt_layer.py:58 update_out_and_lse)."""
-    m_new = jnp.maximum(m, m_blk)
-    a1 = jnp.exp(m - m_new)
-    a2 = jnp.exp(m_blk - m_new)
-    l_new = a1 * l + a2 * l_blk
-    acc_new = acc * a1[..., None] + o_blk * a2[..., None]
-    return m_new, l_new, acc_new
-
-
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
-                          block_ids: Optional[jnp.ndarray] = None):
-    """Ring attention on local shards [B, s_local, H(local), D].
-
-    ``block_ids``: for the plain layout, rank r holds contiguous block r; the
-    striped layout passes explicit per-rank block indices instead.
-    """
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
+    """Ring attention on local shards [B, s_local, H(local), D].  Block
+    partials and the online-softmax merge are shared with FPDT
+    (fpdt_layer._chunk_partials / update_out_and_lse)."""
+    from .fpdt_layer import _chunk_partials, update_out_and_lse
     ring = jax.lax.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     b, sq, nh, hd = q.shape
     scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
     q32 = q.astype(jnp.float32)
-    my_block = me if block_ids is None else block_ids
-    q_pos = my_block * sq + jnp.arange(sq)
+    q_pos = me * sq + jnp.arange(sq)
 
-    m0 = jnp.full((b, nh, sq), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, nh, sq), jnp.float32)
-    acc0 = jnp.zeros((b, nh, sq, hd), jnp.float32)
+    out0 = jnp.zeros((b, nh, sq, hd), jnp.float32)
+    lse0 = jnp.full((b, nh, sq), _NEG_INF, jnp.float32)
     # match the varying-manual-axes type of the computed branch so the causal
     # skip cond and the scan carry typecheck under shard_map's vma system
-    m0, l0, acc0 = jax.tree.map(_match_vma(q), (m0, l0, acc0))
+    out0, lse0 = jax.tree.map(_match_vma(q), (out0, lse0))
     perm = [(j, (j + 1) % ring) for j in range(ring)]
 
     def step(carry, t):
-        m, l, acc, k_blk, v_blk, src_block = carry
+        out, lse, k_blk, v_blk, src_block = carry
         k_pos = src_block * sq + jnp.arange(sq)
 
         def compute(args):
-            m, l, acc = args
-            m_b, l_b, o_b = _block_partials(q32, k_blk, v_blk, q_pos, k_pos, scale, causal)
-            return _merge(m, l, acc, m_b, l_b, o_b)
+            out, lse = args
+            b_out, b_lse = _chunk_partials(q32, k_blk, v_blk, q_pos, k_pos, scale, causal)
+            return update_out_and_lse(out, lse, b_out, b_lse)
 
         if causal:
             # Fully-masked block (source strictly after us): skip its FLOPs.
-            visible = src_block <= my_block
-            m, l, acc = jax.lax.cond(visible, compute, lambda args: args, (m, l, acc))
+            visible = src_block <= me
+            out, lse = jax.lax.cond(visible, compute, lambda args: args, (out, lse))
         else:
-            m, l, acc = compute((m, l, acc))
+            out, lse = compute((out, lse))
 
         k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
         src_nxt = jax.lax.ppermute(src_block, axis_name, perm)
-        return (m, l, acc, k_nxt, v_nxt, src_nxt), None
+        return (out, lse, k_nxt, v_nxt, src_nxt), None
 
-    (m, l, acc, _, _, _), _ = jax.lax.scan(step, (m0, l0, acc0, k, v, my_block),
-                                           jnp.arange(ring))
-    out = acc / jnp.where(l == 0.0, 1.0, l)[..., None]
+    (out, lse, _, _, _), _ = jax.lax.scan(step, (out0, lse0, k, v, me),
+                                          jnp.arange(ring))
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B, sq, H, D]
 
 
@@ -188,6 +147,8 @@ def striped_ring_attention(q, k, v, *, causal: bool = True, segment_ids=None,
 
     q_spec, kv_spec = _qkv_specs(mesh, q.shape, k.shape, seq_axis)
 
+    from .fpdt_layer import _chunk_partials, update_out_and_lse
+
     @partial(jax.shard_map, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec)
     def mapped(q, k, v):
         me = jax.lax.axis_index(seq_axis)
@@ -199,27 +160,25 @@ def striped_ring_attention(q, k, v, *, causal: bool = True, segment_ids=None,
         front, back = me, 2 * ring - 1 - me
         pos = jnp.concatenate([front * half + jnp.arange(half),
                                back * half + jnp.arange(half)])
-        m0 = jnp.full((b, nh, sl), _NEG_INF, jnp.float32)
-        l0 = jnp.zeros((b, nh, sl), jnp.float32)
-        acc0 = jnp.zeros((b, nh, sl, hd), jnp.float32)
-        m0, l0, acc0 = jax.tree.map(_match_vma(q), (m0, l0, acc0))
+        out0 = jnp.zeros((b, nh, sl, hd), jnp.float32)
+        lse0 = jnp.full((b, nh, sl), _NEG_INF, jnp.float32)
+        out0, lse0 = jax.tree.map(_match_vma(q), (out0, lse0))
         perm = [(j, (j + 1) % ring) for j in range(ring)]
 
         def step(carry, t):
-            m, l, acc, k_blk, v_blk, src_front, src_back = carry
+            out, lse, k_blk, v_blk, src_front, src_back = carry
             k_pos = jnp.concatenate([src_front * half + jnp.arange(half),
                                      src_back * half + jnp.arange(half)])
-            m_b, l_b, o_b = _block_partials(q32, k_blk, v_blk, pos, k_pos, scale, causal)
-            m, l, acc = _merge(m, l, acc, m_b, l_b, o_b)
+            b_out, b_lse = _chunk_partials(q32, k_blk, v_blk, pos, k_pos, scale, causal)
+            out, lse = update_out_and_lse(out, lse, b_out, b_lse)
             k_nxt = jax.lax.ppermute(k_blk, seq_axis, perm)
             v_nxt = jax.lax.ppermute(v_blk, seq_axis, perm)
             sf = jax.lax.ppermute(src_front, seq_axis, perm)
             sb = jax.lax.ppermute(src_back, seq_axis, perm)
-            return (m, l, acc, k_nxt, v_nxt, sf, sb), None
+            return (out, lse, k_nxt, v_nxt, sf, sb), None
 
-        (m, l, acc, _, _, _, _), _ = jax.lax.scan(
-            step, (m0, l0, acc0, k, v, front, back), jnp.arange(ring))
-        out = acc / jnp.where(l == 0.0, 1.0, l)[..., None]
+        (out, lse, _, _, _, _), _ = jax.lax.scan(
+            step, (out0, lse0, k, v, front, back), jnp.arange(ring))
         return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
     return mapped(q, k, v)
@@ -229,6 +188,7 @@ def zigzag_reorder(x, ring: int, axis: int = 1):
     """Permute a sequence dim into the zigzag layout consumed by
     ``striped_ring_attention``: rank r gets chunks (r, 2*ring-1-r)."""
     n = x.shape[axis]
+    assert n % (2 * ring) == 0, f"seq len {n} not divisible by 2*ring={2*ring}"
     chunk = n // (2 * ring)
     idx = []
     for r in range(ring):
@@ -240,6 +200,7 @@ def zigzag_reorder(x, ring: int, axis: int = 1):
 def zigzag_restore(x, ring: int, axis: int = 1):
     """Inverse of ``zigzag_reorder``."""
     n = x.shape[axis]
+    assert n % (2 * ring) == 0, f"seq len {n} not divisible by 2*ring={2*ring}"
     chunk = n // (2 * ring)
     idx = []
     for r in range(ring):

@@ -1,0 +1,129 @@
+"""FastGen-v2 engine tests (ref: tests/unit/inference/v2 — ragged batching,
+scheduler, engine generate correctness vs the cache-free reference path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2, RaggedInferenceEngineConfig,
+                                        build_engine)
+from deepspeed_tpu.inference.v2.ragged import BlockedKVCache, StateManager
+from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig, SplitFuseScheduler
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.models.llama_cache import PagedKVConfig
+
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128,
+                  rope_theta=1e4, dtype=jnp.float32, scan_layers=True, remat=False)
+
+
+@pytest.fixture(scope="module")
+def trained_params():
+    model = LlamaForCausalLM(CFG)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    return model.init(jax.random.PRNGKey(0), ids)
+
+
+def _engine(trained_params, **overrides):
+    kv = PagedKVConfig(num_pages=64, page_size=8, max_pages_per_seq=8)
+    sched = SchedulerConfig(token_budget=64, max_seqs=8, prefill_chunk=8, decode_bucket=4)
+    eng_cfg = RaggedInferenceEngineConfig(kv=kv, scheduler=sched, kv_dtype=jnp.float32,
+                                          **overrides)
+    return build_engine(CFG, trained_params, eng_cfg)
+
+
+def _reference_greedy(params, prompt, n_new):
+    """Cache-free greedy decode via the training model (golden)."""
+    model = LlamaForCausalLM(CFG)
+    ids = jnp.asarray([prompt], jnp.int32)
+    for _ in range(n_new):
+        logits = model.apply(params, ids)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+    return list(np.asarray(ids[0, len(prompt):]))
+
+
+def test_generate_matches_cachefree_reference(trained_params):
+    eng = _engine(trained_params)
+    prompts = [[5, 9, 2, 7, 1], [3, 3, 8]]
+    outs = eng.generate(prompts, max_new_tokens=6)
+    for prompt, got in zip(prompts, outs):
+        expected = _reference_greedy(trained_params, prompt, 6)
+        assert got == expected, (got, expected)
+
+
+def test_long_prompt_splitfuse_chunking(trained_params):
+    """Prompt longer than prefill_chunk is split across steps yet matches."""
+    eng = _engine(trained_params)
+    prompt = list(np.random.default_rng(0).integers(1, 100, size=21))
+    outs = eng.generate([prompt], max_new_tokens=4)
+    assert outs[0] == _reference_greedy(trained_params, prompt, 4)
+
+
+def test_continuous_batching_join_mid_flight(trained_params):
+    """A sequence admitted while another decodes shares step programs and
+    both match the golden (continuous batching)."""
+    eng = _engine(trained_params)
+    p1, p2 = [5, 9, 2, 7, 1], [11, 4, 6, 2]
+    eng.put([100], [p1], max_new_tokens=5)
+    eng.step()  # p1 prefill
+    eng.step()  # p1 first decode
+    eng.put([200], [p2], max_new_tokens=5)
+    for _ in range(12):
+        eng.step()
+        if eng.state.seqs[100].done and eng.state.seqs[200].done:
+            break
+    assert list(eng.state.seqs[100].generated) == _reference_greedy(trained_params, p1, 5)
+    assert list(eng.state.seqs[200].generated) == _reference_greedy(trained_params, p2, 5)
+
+
+def test_eos_stops_generation(trained_params):
+    eng = _engine(trained_params)
+    ref = _reference_greedy(trained_params, [5, 9, 2, 7, 1], 8)
+    eos = ref[2]
+    eng2 = _engine(trained_params, eos_token_id=eos)
+    outs = eng2.generate([[5, 9, 2, 7, 1]], max_new_tokens=8)
+    assert outs[0] == ref[:3], (outs[0], ref)
+
+
+def test_compiled_program_reuse(trained_params):
+    """Steady-state decode reuses ONE compiled program (shape bucketing)."""
+    eng = _engine(trained_params)
+    eng.generate([[5, 9, 2, 7, 1], [3, 3, 8]], max_new_tokens=8)
+    # one prefill-chunk program + one decode program
+    assert len(eng._step_fns) <= 2, list(eng._step_fns)
+
+
+def test_kv_pages_released_on_flush(trained_params):
+    eng = _engine(trained_params)
+    free0 = eng.kv.allocator.free_pages
+    eng.generate([[5, 9, 2, 7, 1]], max_new_tokens=4)
+    assert eng.kv.allocator.free_pages == free0
+
+
+def test_v1_engine_generate_matches(trained_params):
+    """v1 init_inference greedy generate == cache-free golden."""
+    import deepspeed_tpu as ds
+    model = LlamaForCausalLM(CFG)
+    eng = ds.init_inference(model=model, config={"tensor_parallel": 1, "dtype": "fp32"},
+                            params=trained_params)
+    prompt = [5, 9, 2, 7, 1]
+    out = eng.generate(np.asarray([prompt], np.int32), max_new_tokens=6)
+    assert list(out[0, len(prompt):]) == _reference_greedy(trained_params, prompt, 6)
+
+
+def test_v1_kernel_inject_and_dtype(trained_params):
+    """replace_with_kernel_inject switches to the Pallas attention impl;
+    dtype casts params (ref: inference/engine.py kernel-injection + dtype)."""
+    import deepspeed_tpu as ds
+    model = LlamaForCausalLM(CFG)
+    eng = ds.init_inference(model=model, config={"replace_with_kernel_inject": True,
+                                                 "dtype": "bf16"}, params=trained_params)
+    assert eng.module.cfg.attention_impl == "flash"
+    ids = jnp.zeros((1, 8), jnp.int32)
+    logits = eng.forward(ids)
+    leaf = jax.tree.leaves(eng.params)[0]
+    assert leaf.dtype == jnp.bfloat16
+    assert np.isfinite(np.asarray(logits, np.float32)).all()
