@@ -127,12 +127,19 @@ class InferenceEngine:
 
     def forward(self, *args, **kwargs):
         """Jitted module forward (ref: engine.py:554 — the cuda-graph-capture
-        branch is simply jit here)."""
+        branch is simply jit here).  Non-array kwargs (flags like
+        ``deterministic``) are static — closed over per cache entry — so
+        module control flow sees real Python values, not tracers."""
         self._ensure_params(*args)
-        if self._fwd is None:
-            self._fwd = jax.jit(lambda p, a, kw: self.module.apply(p, *a, **kw))
+        static = {k: v for k, v in kwargs.items() if not hasattr(v, "shape")
+                  and not isinstance(v, (np.ndarray, jnp.ndarray))}
+        traced = {k: v for k, v in kwargs.items() if k not in static}
+        key = tuple(sorted(static.items()))
+        if not isinstance(self._fwd, dict) or self._fwd.get("key") != key:
+            self._fwd = {"key": key,
+                         "fn": jax.jit(lambda p, a, kw: self.module.apply(p, *a, **kw, **static))}
         with self.mesh:
-            return self._fwd(self.params, args, kwargs)
+            return self._fwd["fn"](self.params, args, traced)
 
     __call__ = forward
 
@@ -167,7 +174,7 @@ class InferenceEngine:
             buf = jax.lax.dynamic_update_slice_in_dim(buf, nxt[:, None], cur, axis=1)
             return buf, nxt
 
-        key = (buf.shape, do_sample)
+        key = (buf.shape, do_sample, float(temperature))
         if self._gen_step.get("key") != key:
             self._gen_step = {"key": key, "fn": jax.jit(step, donate_argnums=(1, ))}
         jstep = self._gen_step["fn"]

@@ -1,0 +1,108 @@
+"""Python handle API over the native async-IO engine.
+
+Reference: ``deepspeed/ops/aio`` + ``csrc/aio/py_lib/deepspeed_py_aio_handle
+.cpp`` — ``aio_handle`` with async_pread/async_pwrite/sync_pread/
+sync_pwrite/wait.  Buffers are numpy arrays (the host staging side of a
+device↔host↔NVMe pipeline; ``jax.device_get/put`` moves the device leg).
+"""
+
+import ctypes
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from ..op_builder import AsyncIOBuilder
+
+_LIB = None
+
+
+def _lib():
+    global _LIB
+    if _LIB is None:
+        lib = AsyncIOBuilder().load()
+        lib.aio_handle_new.restype = ctypes.c_void_p
+        lib.aio_handle_new.argtypes = [ctypes.c_longlong, ctypes.c_longlong, ctypes.c_int, ctypes.c_int]
+        lib.aio_handle_free.argtypes = [ctypes.c_void_p]
+        lib.aio_pread.restype = ctypes.c_int
+        lib.aio_pread.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_longlong, ctypes.c_longlong]
+        lib.aio_pwrite.restype = ctypes.c_int
+        lib.aio_pwrite.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_longlong, ctypes.c_longlong]
+        lib.aio_wait.restype = ctypes.c_longlong
+        lib.aio_wait.argtypes = [ctypes.c_void_p]
+        lib.aio_pending.restype = ctypes.c_longlong
+        lib.aio_pending.argtypes = [ctypes.c_void_p]
+        lib.aio_file_size.restype = ctypes.c_longlong
+        lib.aio_file_size.argtypes = [ctypes.c_char_p]
+        _LIB = lib
+    return _LIB
+
+
+class AsyncIOHandle:
+    """ref: csrc/aio/py_lib aio_handle (block_size, queue_depth, thread_count,
+    single_submit/overlap_events are implicit in the thread-pool design)."""
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 32,
+                 thread_count: int = 4, use_o_direct: bool = False):
+        self._lib = _lib()
+        self._h = self._lib.aio_handle_new(block_size, queue_depth, thread_count,
+                                           1 if use_o_direct else 0)
+        self._refs = []  # keep submitted buffers alive until wait()
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.aio_wait(h)
+            self._lib.aio_handle_free(h)
+            self._h = None
+
+    @staticmethod
+    def _check_buffer(buf: np.ndarray, writable: bool):
+        assert isinstance(buf, np.ndarray) and buf.flags.c_contiguous, \
+            "aio buffers must be C-contiguous numpy arrays"
+        if writable:
+            assert buf.flags.writeable
+
+    def async_pread(self, buffer: np.ndarray, path: str, offset: int = 0) -> None:
+        self._check_buffer(buffer, writable=True)
+        self._refs.append(buffer)
+        rc = self._lib.aio_pread(self._h, buffer.ctypes.data_as(ctypes.c_void_p),
+                                 str(path).encode(), offset, buffer.nbytes)
+        assert rc == 0, f"aio_pread submit failed: {rc}"
+
+    def async_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0) -> None:
+        self._check_buffer(buffer, writable=False)
+        self._refs.append(buffer)
+        rc = self._lib.aio_pwrite(self._h, buffer.ctypes.data_as(ctypes.c_void_p),
+                                  str(path).encode(), offset, buffer.nbytes)
+        assert rc == 0, f"aio_pwrite submit failed: {rc}"
+
+    def wait(self) -> int:
+        """Block until all submitted requests complete; returns the count.
+        Raises on the first IO error (ref: aio_handle.wait semantics)."""
+        n = self._lib.aio_wait(self._h)
+        self._refs.clear()
+        if n < 0:
+            raise OSError(-int(n), f"async IO failed: errno {-int(n)}")
+        return int(n)
+
+    def pending(self) -> int:
+        return int(self._lib.aio_pending(self._h))
+
+    # sync conveniences (ref: deepspeed_py_aio.cpp sync_pread/sync_pwrite)
+    def sync_pread(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
+        self.async_pread(buffer, path, offset)
+        return self.wait()
+
+    def sync_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
+        self.async_pwrite(buffer, path, offset)
+        return self.wait()
+
+
+def file_size(path) -> int:
+    n = _lib().aio_file_size(str(path).encode())
+    if n < 0:
+        raise OSError(-int(n), f"stat failed for {path}")
+    return int(n)

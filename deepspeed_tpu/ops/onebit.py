@@ -1,0 +1,198 @@
+"""1-bit optimizer family: OnebitAdam, OnebitLamb, ZeroOneAdam.
+
+Reference: ``deepspeed/runtime/fp16/onebit/{adam.py:14,lamb.py:15,
+zoadam.py:14}`` — communication-compressed optimizers: after a full-
+precision warmup (``freeze_step``), the variance term is frozen and the
+momentum is communicated sign-compressed with error feedback.
+
+TPU-native realisation: under GSPMD/ZeRO the cross-replica gradient mean is
+compiler-inserted and optimizer state is already partitioned, so the
+*transport* compression lives in ``runtime/comm/compressed.py``
+(compressed_allreduce / qgZ all_to_all_quant_reduce, for explicit shard_map
+pipelines over DCN).  These transforms reproduce the reference's *numerics*
+— frozen variance + error-feedback 1-bit momentum quantization — which is
+what determines convergence behaviour; jitted elementwise math takes the
+place of the fused CUDA kernels.
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .optimizer import GradientTransformation, resolve_lr, tree_zeros_like
+
+
+def _sign_compress_ef(tensor, error):
+    """Error-feedback 1-bit quantization of one tensor (the numerics of
+    ref compressed_allreduce steps 1-2, without the wire exchange)."""
+    corrected = tensor + error
+    scale = jnp.mean(jnp.abs(corrected))
+    signs = jnp.sign(corrected)
+    signs = jnp.where(signs == 0, 1.0, signs)
+    compressed = scale * signs
+    return compressed, corrected - compressed
+
+
+class OnebitAdamState(NamedTuple):
+    count: jnp.ndarray
+    exp_avg: any
+    exp_avg_sq: any
+    error: any
+
+
+def onebit_adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                freeze_step: int = 100, **_ignored) -> GradientTransformation:
+    """ref: runtime/fp16/onebit/adam.py:14 OnebitAdam."""
+    b1, b2 = betas
+
+    def init(params):
+        return OnebitAdamState(count=jnp.zeros((), jnp.int32),
+                               exp_avg=tree_zeros_like(params, jnp.float32),
+                               exp_avg_sq=tree_zeros_like(params, jnp.float32),
+                               error=tree_zeros_like(params, jnp.float32))
+
+    def update(grads, state, params):
+        count = state.count + 1
+        frozen = count > freeze_step  # compression stage
+
+        def upd(g, m, v, e, p):
+            g = g.astype(jnp.float32)
+            m_new = b1 * m + (1 - b1) * g
+            # variance frozen after warmup (ref: adam.py exp_avg_sq freeze)
+            v_new = jnp.where(frozen, v, b2 * v + (1 - b2) * g * g)
+            comp, e_comp = _sign_compress_ef(m_new, e)
+            m_used = jnp.where(frozen, comp, m_new)
+            e_new = jnp.where(frozen, e_comp, e)
+            bc1 = 1 - b1**count.astype(jnp.float32)
+            bc2 = 1 - b2**count.astype(jnp.float32)
+            step = (m_used / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if weight_decay:
+                step = step + weight_decay * p.astype(jnp.float32)
+            return -resolve_lr(lr, count) * step, m_used, v_new, e_new
+
+        flat = jax.tree.map(upd, grads, state.exp_avg, state.exp_avg_sq, state.error, params)
+        updates = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda x: isinstance(x, tuple))
+        m = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda x: isinstance(x, tuple))
+        v = jax.tree.map(lambda t: t[2], flat, is_leaf=lambda x: isinstance(x, tuple))
+        e = jax.tree.map(lambda t: t[3], flat, is_leaf=lambda x: isinstance(x, tuple))
+        return updates, OnebitAdamState(count=count, exp_avg=m, exp_avg_sq=v, error=e)
+
+    return GradientTransformation(init, update)
+
+
+class ZeroOneAdamState(NamedTuple):
+    count: jnp.ndarray
+    exp_avg: any
+    exp_avg_sq: any
+    error: any
+    var_interval: jnp.ndarray   # current variance-update interval
+    var_counter: jnp.ndarray    # steps since last variance update
+    var_updates: jnp.ndarray    # number of variance updates so far (bias corr)
+
+
+def zero_one_adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                  var_freeze_step: int = 100000, var_update_scaler: int = 16,
+                  local_step_scaler: int = 32678, local_step_clipper: int = 16,
+                  **_ignored) -> GradientTransformation:
+    """ref: runtime/fp16/onebit/zoadam.py:14 ZeroOneAdam (0/1 Adam) — the
+    variance is updated only at exponentially-spaced intervals (doubling
+    every ``var_update_scaler`` updates) until ``var_freeze_step``, and the
+    momentum is always error-feedback compressed (no warmup)."""
+    b1, b2 = betas
+
+    def init(params):
+        return ZeroOneAdamState(count=jnp.zeros((), jnp.int32),
+                                exp_avg=tree_zeros_like(params, jnp.float32),
+                                exp_avg_sq=tree_zeros_like(params, jnp.float32),
+                                error=tree_zeros_like(params, jnp.float32),
+                                var_interval=jnp.ones((), jnp.int32),
+                                var_counter=jnp.zeros((), jnp.int32),
+                                var_updates=jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params):
+        count = state.count + 1
+        frozen = count > var_freeze_step
+        var_due = jnp.logical_and(~frozen, state.var_counter + 1 >= state.var_interval)
+        new_counter = jnp.where(var_due, 0, state.var_counter + 1)
+        # interval doubles after every var_update_scaler VARIANCE UPDATES
+        # (not global steps — ref zoadam.py interval policy)
+        var_updates = state.var_updates + var_due.astype(jnp.int32)
+        grow = jnp.logical_and(var_due, (var_updates % var_update_scaler) == 0)
+        new_interval = jnp.where(grow, state.var_interval * 2, state.var_interval)
+
+        def upd(g, m, v, e, p):
+            g = g.astype(jnp.float32)
+            m_new = b1 * m + (1 - b1) * g
+            v_new = jnp.where(var_due, b2 * v + (1 - b2) * g * g, v)
+            comp, e_new = _sign_compress_ef(m_new, e)
+            bc1 = 1 - b1**count.astype(jnp.float32)
+            bc2 = 1 - b2**jnp.maximum(var_updates, 1).astype(jnp.float32)
+            step = (comp / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if weight_decay:
+                step = step + weight_decay * p.astype(jnp.float32)
+            return -resolve_lr(lr, count) * step, comp, v_new, e_new
+
+        flat = jax.tree.map(upd, grads, state.exp_avg, state.exp_avg_sq, state.error, params)
+        pick = lambda i: jax.tree.map(lambda t: t[i], flat, is_leaf=lambda x: isinstance(x, tuple))
+        return pick(0), ZeroOneAdamState(count=count, exp_avg=pick(1), exp_avg_sq=pick(2),
+                                         error=pick(3), var_interval=new_interval,
+                                         var_counter=new_counter, var_updates=var_updates)
+
+    return GradientTransformation(init, update)
+
+
+class OnebitLambState(NamedTuple):
+    count: jnp.ndarray
+    exp_avg: any
+    exp_avg_sq: any
+    error: any
+    frozen_ratio: any  # per-tensor trust ratio recorded at freeze
+
+
+def onebit_lamb(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                freeze_step: int = 100, max_coeff: float = 10.0, min_coeff: float = 0.01,
+                **_ignored) -> GradientTransformation:
+    """ref: runtime/fp16/onebit/lamb.py:15 OnebitLamb — LAMB whose layerwise
+    trust ratio is recorded at ``freeze_step`` and reused during the
+    compression stage (fresh ratios would need uncompressed norms)."""
+    b1, b2 = betas
+
+    def init(params):
+        return OnebitLambState(count=jnp.zeros((), jnp.int32),
+                               exp_avg=tree_zeros_like(params, jnp.float32),
+                               exp_avg_sq=tree_zeros_like(params, jnp.float32),
+                               error=tree_zeros_like(params, jnp.float32),
+                               frozen_ratio=jax.tree.map(lambda p: jnp.ones((), jnp.float32), params))
+
+    def update(grads, state, params):
+        count = state.count + 1
+        frozen = count > freeze_step
+
+        def upd(g, m, v, e, p, fr):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m_new = b1 * m + (1 - b1) * g
+            v_new = jnp.where(frozen, v, b2 * v + (1 - b2) * g * g)
+            comp, e_comp = _sign_compress_ef(m_new, e)
+            m_used = jnp.where(frozen, comp, m_new)
+            e_new = jnp.where(frozen, e_comp, e)
+            bc1 = 1 - b1**count.astype(jnp.float32)
+            bc2 = 1 - b2**count.astype(jnp.float32)
+            raw = (m_used / bc1) / (jnp.sqrt(v_new / bc2) + eps) + weight_decay * p32
+            w_norm = jnp.linalg.norm(p32)
+            u_norm = jnp.linalg.norm(raw)
+            live_ratio = jnp.clip(jnp.where(u_norm > 0, w_norm / u_norm, 1.0),
+                                  min_coeff, max_coeff)
+            # record the ratio while uncompressed; reuse it after freeze
+            fr_new = jnp.where(frozen, fr, live_ratio)
+            ratio = jnp.where(frozen, fr, live_ratio)
+            return -resolve_lr(lr, count) * ratio * raw, m_used, v_new, e_new, fr_new
+
+        flat = jax.tree.map(upd, grads, state.exp_avg, state.exp_avg_sq, state.error,
+                            params, state.frozen_ratio)
+        pick = lambda i: jax.tree.map(lambda t: t[i], flat, is_leaf=lambda x: isinstance(x, tuple))
+        return pick(0), OnebitLambState(count=count, exp_avg=pick(1), exp_avg_sq=pick(2),
+                                        error=pick(3), frozen_ratio=pick(4))
+
+    return GradientTransformation(init, update)

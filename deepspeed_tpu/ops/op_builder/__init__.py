@@ -1,0 +1,1 @@
+from .builder import AsyncIOBuilder, OpBuilder  # noqa: F401

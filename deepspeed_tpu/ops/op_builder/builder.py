@@ -1,0 +1,93 @@
+"""Op build system — JIT compilation of native (C++) components.
+
+Reference: ``op_builder/builder.py`` (``OpBuilder:117`` with ``sources()``,
+``include_paths()``, ``is_compatible()``, ``load()`` → prebuilt import or
+``jit_load:542`` via torch's cpp_extension).  Here the native components are
+plain C-ABI shared libraries consumed through ctypes (no torch build
+machinery): ``load()`` compiles ``sources()`` with g++ into a cached .so
+keyed by a source hash, then returns the ctypes CDLL.  Builders for Pallas/
+XLA "ops" simply return the Python module implementing them — on TPU the
+kernel "build" is XLA compilation at trace time (SURVEY §2.6 TPU note).
+"""
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+from pathlib import Path
+from typing import List, Optional
+
+from ...utils.logging import logger
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]  # deepspeed_tpu/
+DEFAULT_CACHE = os.environ.get("DS_TPU_OP_CACHE",
+                               os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu", "ops"))
+
+
+class OpBuilder:
+    """Base builder (ref: op_builder/builder.py:117 OpBuilder)."""
+
+    BUILD_VAR: Optional[str] = None  # e.g. DS_BUILD_AIO — 0 disables
+    NAME = "op"
+
+    def sources(self) -> List[str]:
+        """C++ sources relative to ``deepspeed_tpu/``."""
+        raise NotImplementedError
+
+    def include_paths(self) -> List[str]:
+        return []
+
+    def cxx_args(self) -> List[str]:
+        return ["-O3", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall"]
+
+    def is_compatible(self) -> bool:
+        if self.BUILD_VAR and os.environ.get(self.BUILD_VAR, "1") == "0":
+            return False
+        return shutil.which("g++") is not None
+
+    def absolute_sources(self) -> List[Path]:
+        return [(_REPO_ROOT / s) for s in self.sources()]
+
+    def _source_hash(self) -> str:
+        h = hashlib.sha256()
+        for src in self.absolute_sources():
+            h.update(src.read_bytes())
+        h.update(" ".join(self.cxx_args()).encode())
+        return h.hexdigest()[:16]
+
+    def so_path(self) -> Path:
+        return Path(DEFAULT_CACHE) / f"{self.NAME}_{self._source_hash()}.so"
+
+    def jit_load(self) -> Path:
+        """ref: builder.py:542 jit_load — compile into the user cache."""
+        out = self.so_path()
+        if out.exists():
+            return out
+        out.parent.mkdir(parents=True, exist_ok=True)
+        cmd = (["g++"] + self.cxx_args() +
+               [f"-I{p}" for p in self.include_paths()] +
+               [str(s) for s in self.absolute_sources()] + ["-o", str(out) + ".tmp"])
+        logger.info(f"op_builder[{self.NAME}]: {' '.join(cmd)}")
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(f"building {self.NAME} failed:\n{e.stderr}") from e
+        os.replace(str(out) + ".tmp", out)
+        return out
+
+    def load(self) -> ctypes.CDLL:
+        """Compile if needed and dlopen (ref: builder.py:523 load)."""
+        if not self.is_compatible():
+            raise RuntimeError(f"op {self.NAME} is not compatible on this system "
+                               f"(g++ missing or {self.BUILD_VAR}=0)")
+        return ctypes.CDLL(str(self.jit_load()))
+
+
+class AsyncIOBuilder(OpBuilder):
+    """ref: op_builder/async_io.py AsyncIOBuilder (BUILD_VAR DS_BUILD_AIO)."""
+    BUILD_VAR = "DS_BUILD_AIO"
+    NAME = "ds_aio"
+
+    def sources(self):
+        return ["csrc/aio/ds_aio.cpp"]

@@ -1,0 +1,88 @@
+"""Block quantization ops (int8/int4, symmetric/asymmetric).
+
+Reference: ``csrc/quantization/{quantize.cu, dequantize.cu, quant_reduce.cu,
+quantize_intX.cu}`` + ``deepspeed/ops/quantizer`` — block-quantized tensors
+for ZeRO++ communication compression (qwZ weight all-gather, qgZ gradient
+all-to-all) and weight-only inference quantization.
+
+Pure-jnp implementations; XLA fuses the scale/cast chains, and the bit
+packing (two int4 per int8 lane) lowers to the same shifts a hand kernel
+would use.  Group-wise scales over the trailing dimension of each block.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _blocked(x, block: int):
+    n = x.size
+    assert n % block == 0, f"size {n} not divisible by quant block {block}"
+    return x.reshape(n // block, block)
+
+
+def quantize_int8(x, block: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-block int8 (ref: quantize.cu symmetric path).
+    Returns (q [n/block, block] int8, scales [n/block] f32)."""
+    xb = _blocked(x.astype(jnp.float32), block)
+    absmax = jnp.max(jnp.abs(xb), axis=1)
+    scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(xb / scale[:, None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q, scale, shape) -> jnp.ndarray:
+    return (q.astype(jnp.float32) * scale[:, None]).reshape(shape)
+
+
+def quantize_int4(x, block: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-block int4, two nibbles packed per uint8
+    (ref: quantize_intX.cu).  Returns (packed [n/block, block/2] uint8,
+    scales [n/block] f32)."""
+    assert block % 2 == 0
+    xb = _blocked(x.astype(jnp.float32), block)
+    absmax = jnp.max(jnp.abs(xb), axis=1)
+    scale = jnp.where(absmax == 0, 1.0, absmax / 7.0)
+    q = jnp.clip(jnp.round(xb / scale[:, None]), -7, 7).astype(jnp.int8) + 8  # [1..15], 0 unused
+    lo = q[:, 0::2].astype(jnp.uint8)
+    hi = q[:, 1::2].astype(jnp.uint8)
+    return (lo | (hi << 4)), scale
+
+
+def dequantize_int4(packed, scale, shape) -> jnp.ndarray:
+    lo = (packed & 0xF).astype(jnp.int8) - 8
+    hi = ((packed >> 4) & 0xF).astype(jnp.int8) - 8
+    q = jnp.stack([lo, hi], axis=-1).reshape(packed.shape[0], -1)
+    return (q.astype(jnp.float32) * scale[:, None]).reshape(shape)
+
+
+def quantization_error(x, bits: int = 8, block: int = 256) -> jnp.ndarray:
+    """Roundtrip residual (used by error-feedback compression)."""
+    if bits == 8:
+        q, s = quantize_int8(x, block)
+        return x - dequantize_int8(q, s, x.shape).astype(x.dtype)
+    q, s = quantize_int4(x, block)
+    return x - dequantize_int4(q, s, x.shape).astype(x.dtype)
+
+
+# ------------------------------------------------------------- sign (1-bit)
+
+def pack_signs(x) -> jnp.ndarray:
+    """1-bit sign compression: 8 signs per uint8 (ref: csrc/xpu/packbits and
+    the compressed backend's bit packing).  Sizes not divisible by 8 are
+    padded (``unpack_signs``'s n parameter drops the slack)."""
+    flat = x.reshape(-1)
+    pad = (-flat.size) % 8
+    if pad:
+        flat = jnp.concatenate([flat, jnp.ones((pad, ), flat.dtype)])
+    bits = (flat >= 0).astype(jnp.uint8).reshape(-1, 8)
+    weights = (1 << jnp.arange(8, dtype=jnp.uint8))
+    return jnp.sum(bits * weights[None, :], axis=1, dtype=jnp.uint8)
+
+
+def unpack_signs(packed, n: int) -> jnp.ndarray:
+    """Inverse of ``pack_signs``: ±1 float32 of length n."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (packed[:, None] >> shifts[None, :]) & 1
+    return (bits.astype(jnp.float32) * 2.0 - 1.0).reshape(-1)[:n]

@@ -1,0 +1,2 @@
+from .compressed import (all_to_all_quant_reduce, compressed_allreduce,  # noqa: F401
+                         quantized_all_gather)

@@ -1,0 +1,114 @@
+"""Compressed collectives: 1-bit error-feedback allreduce and ZeRO++
+quantized reductions.
+
+Reference:
+  * ``deepspeed/runtime/comm/compressed.py:13 CompressedBackend`` /
+    ``nccl.py:16 NcclBackend`` — the error-feedback sign-compressed
+    allreduce behind OnebitAdam/OnebitLamb/ZeroOneAdam;
+  * ``runtime/comm/coalesced_collectives.py:31 all_to_all_quant_reduce``
+    (qgZ: quantized gradient all-to-all reduction) and the quantized weight
+    all-gather (qwZ) of ZeRO++.
+
+All functions are designed for use INSIDE ``shard_map`` bodies (explicit
+``jax.lax`` collectives over a named axis), which is where TPU programs
+spell out comm that GSPMD would otherwise insert at full precision.  The
+wire format is real packed bits/int8 — the ICI/DCN traffic is genuinely
+1/4–1/32 of fp32, not a simulation.
+"""
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.quantizer import (dequantize_int4, dequantize_int8, pack_signs, quantize_int4,
+                              quantize_int8, unpack_signs)
+
+
+def compressed_allreduce(x, error, axis_name: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Error-feedback 1-bit allreduce (ref: compressed.py
+    ``compressed_allreduce`` — steps 1/2 with worker error feedback).
+
+    x:     local tensor shard-identical shape on every rank
+    error: carried compression residual (same shape; init zeros)
+    Returns (averaged tensor, new error).  Wire traffic per rank:
+    n/8 bytes of signs + one f32 scale, all-gathered over the axis.
+    """
+    shape = x.shape
+    n = x.size
+    local = x.astype(jnp.float32) + error.astype(jnp.float32)
+    flat = local.reshape(-1)
+    # per-tensor scale: mean |x| of the corrected tensor (ref uses
+    # norm/sqrt(n) — mean-abs is the sign-quantization MSE optimum)
+    scale = jnp.mean(jnp.abs(flat))
+    signs = jnp.sign(flat)
+    signs = jnp.where(signs == 0, 1.0, signs)
+    compressed = scale * signs
+    new_error = (flat - compressed).reshape(shape)
+
+    packed = pack_signs(flat)                                  # uint8[n/8]
+    all_packed = jax.lax.all_gather(packed, axis_name)         # [P, n/8]
+    all_scales = jax.lax.all_gather(scale, axis_name)          # [P]
+    world = all_scales.shape[0]
+    decoded = jax.vmap(lambda p, s: unpack_signs(p, n) * s)(all_packed, all_scales)
+    avg = jnp.mean(decoded, axis=0).reshape(shape)
+    return avg.astype(x.dtype), new_error.astype(error.dtype)
+
+
+def all_to_all_quant_reduce(x, axis_name: str, bits: int = 8, block: int = 256):
+    """qgZ: quantized gradient reduce-scatter (ref: coalesced_collectives.py
+    :31 all_to_all_quant_reduce — quantize → all-to-all → dequant-reduce).
+
+    x: [n] local gradient with n divisible by the axis size.  Each rank
+    receives everyone's quantized copy of ITS output shard and reduces in
+    fp32.  Returns the rank's averaged shard [n/P].  Wire: int8 (or packed
+    int4) instead of fp32.
+    """
+    world = jax.lax.axis_size(axis_name)
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.size
+    assert n % world == 0
+    shard = n // world
+    chunks = flat.reshape(world, shard)
+    if bits == 8:
+        q, s = quantize_int8(chunks.reshape(-1), block)
+        nblocks = q.shape[0] // world
+        q = q.reshape(world, nblocks, block)
+        s = s.reshape(world, nblocks)
+    else:
+        q, s = quantize_int4(chunks.reshape(-1), block)
+        nblocks = q.shape[0] // world
+        q = q.reshape(world, nblocks, block // 2)
+        s = s.reshape(world, nblocks)
+    # all_to_all: rank r sends chunk d to rank d, receives [P, ...] copies of
+    # its own chunk index
+    q_recv = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    s_recv = jax.lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    if bits == 8:
+        deq = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, (shard, )))(q_recv, s_recv)
+    else:
+        deq = jax.vmap(lambda qq, ss: dequantize_int4(qq, ss, (shard, )))(q_recv, s_recv)
+    return jnp.mean(deq, axis=0)  # [shard] fp32
+
+
+def quantized_all_gather(shard, axis_name: str, bits: int = 8, block: int = 256):
+    """qwZ: quantized weight all-gather (ref: ZeRO++ quantized param
+    all_gather_coalesced, partition_parameters.py quantized path).
+
+    shard: this rank's parameter shard [m].  Returns the dequantized full
+    tensor [P*m] (fp32).  Wire: int8/int4 + per-block scales.
+    """
+    flat = shard.reshape(-1).astype(jnp.float32)
+    m = flat.size
+    if bits == 8:
+        q, s = quantize_int8(flat, block)
+        all_q = jax.lax.all_gather(q, axis_name)      # [P, m/block, block]
+        all_s = jax.lax.all_gather(s, axis_name)
+        deq = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, (m, )))(all_q, all_s)
+    else:
+        q, s = quantize_int4(flat, block)
+        all_q = jax.lax.all_gather(q, axis_name)
+        all_s = jax.lax.all_gather(s, axis_name)
+        deq = jax.vmap(lambda qq, ss: dequantize_int4(qq, ss, (m, )))(all_q, all_s)
+    return deq.reshape(-1)

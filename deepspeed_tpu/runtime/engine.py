@@ -38,12 +38,14 @@ from ..ops.adam import adam, adamw, fused_adam
 from ..ops.adagrad import adagrad, sgd
 from ..ops.lamb import fused_lamb
 from ..ops.lion import fused_lion
+from ..ops.onebit import onebit_adam, onebit_lamb, zero_one_adam
 from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER, TRAIN_BATCH_TIMER,
                            NoopTimer, SynchronizedWallClockTimer, ThroughputTimer)
 from .config import DeepSpeedConfig
 from .constants import (ADAGRAD_OPTIMIZER, ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER,
-                        FUSED_LAMB_OPTIMIZER, LAMB_OPTIMIZER, LION_OPTIMIZER, SGD_OPTIMIZER)
+                        FUSED_LAMB_OPTIMIZER, LAMB_OPTIMIZER, LION_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
+                        ONEBIT_LAMB_OPTIMIZER, SGD_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER)
 from .fp16.loss_scaler import DynamicLossScaler, LossScalerState, create_loss_scaler, found_inf_or_nan
 from .lr_schedules import LRSchedulerShim, get_lr_schedule
 from .zero.partition import grad_shardings as make_grad_shardings
@@ -59,6 +61,9 @@ OPTIMIZER_FACTORIES = {
     LION_OPTIMIZER: fused_lion,
     ADAGRAD_OPTIMIZER: adagrad,
     SGD_OPTIMIZER: sgd,
+    ONEBIT_ADAM_OPTIMIZER: onebit_adam,
+    ONEBIT_LAMB_OPTIMIZER: onebit_lamb,
+    ZERO_ONE_ADAM_OPTIMIZER: zero_one_adam,
 }
 
 
@@ -277,6 +282,24 @@ class DeepSpeedEngine:
         abs_state = jax.eval_shape(build_state, abs_params)
         opt_sh = self._optstate_shardings(abs_state.opt_state, param_sh, master_sh)
         repl = NamedSharding(self.mesh, P())
+        # offload_optimizer device=cpu → optimizer/master live in host memory
+        # (memory_kind pinned_host); XLA streams them through the update
+        # (ref: runtime/zero/offload_config.py + cpu_adam — same math, the
+        # host residency is a sharding property, not a different optimizer)
+        offload = self._config.zero_config.offload_optimizer
+        if offload is not None and offload.device in ("cpu", "nvme"):
+            try:
+                to_host = lambda s: s.with_memory_kind("pinned_host") \
+                    if isinstance(s, NamedSharding) else s
+                probe = NamedSharding(self.mesh, P())  # rank-agnostic probe
+                jax.jit(lambda x: x, out_shardings=to_host(probe)) \
+                    .lower(jax.ShapeDtypeStruct((1, ), jnp.float32)).compile()
+                master_sh = jax.tree.map(to_host, master_sh) if use_master else master_sh
+                opt_sh = jax.tree.map(to_host, opt_sh)
+                log_dist("offload_optimizer: optimizer states resident in host memory", ranks=[0])
+            except Exception as e:
+                logger.warning(f"offload_optimizer requested but host memory kinds are "
+                               f"unsupported on this backend; keeping states on device ({e})")
         self.state_shardings = TrainState(
             step=repl,
             params=param_sh,
@@ -298,9 +321,18 @@ class DeepSpeedEngine:
 
         def assign(subtree):
             # if subtree matches the param tree structure, use master shardings
+            # — but only for leaves whose rank fits the spec (e.g. OnebitLamb
+            # keeps per-param SCALAR trust ratios in a param-shaped tree)
             try:
                 if jax.tree.structure(subtree) == param_leaves:
-                    return master_sh if master_sh != () else param_sh
+                    sh_tree = master_sh if master_sh != () else param_sh
+
+                    def fit(aval, sh):
+                        ok = isinstance(sh, NamedSharding) and \
+                            getattr(aval, "ndim", 0) >= len(sh.spec)
+                        return sh if ok else repl
+
+                    return jax.tree.map(fit, subtree, sh_tree)
             except Exception:
                 pass
             return None
@@ -596,6 +628,62 @@ class DeepSpeedEngine:
                 ranks=[0])
 
     # ------------------------------------------------------------ checkpoints
+
+    # --------------------------------------------------------- state offload
+
+    def offload_states(self, include=None, device: str = "cpu", nvme_path=None,
+                       pin_memory: bool = True, non_blocking: bool = False):
+        """Evict optimizer state / fp32 master weights from device memory
+        (ref: runtime/zero/offload_states.py + engine.offload_states — used
+        e.g. between RLHF train and generate phases).
+
+        device='cpu'  → host numpy copies (HBM freed; ``reload_states``
+                        or the next train_batch re-uploads them).
+        device='nvme' → streamed to ``nvme_path`` via the native aio engine
+                        (ops/aio); ``reload_states`` REQUIRED before training.
+        """
+        assert self.state is not None, "no state materialized yet"
+        include = set(include or ("optimizer_states", "master_weights"))
+        self._offloaded = getattr(self, "_offloaded", {})
+
+        def take(name, tree):
+            if name not in include or tree == ():
+                return tree
+            if device == "nvme":
+                from .swap_tensor import AioSwapConfig, TensorSwapper
+                if getattr(self, "_nvme_swapper", None) is None:
+                    assert nvme_path is not None, "offload_states(device='nvme') needs nvme_path"
+                    self._nvme_swapper = TensorSwapper(nvme_path, AioSwapConfig())
+                self._nvme_swapper.swap_out(name, tree)
+                self._offloaded[name] = "nvme"
+                # zero-length host placeholders keep the pytree structure
+                return jax.tree.map(lambda x: np.empty((0, ), np.dtype(x.dtype)), tree)
+            self._offloaded[name] = "cpu"
+            return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+        new_opt = take("optimizer_states", self.state.opt_state)
+        new_master = take("master_weights", self.state.master)
+        self.state = self.state._replace(opt_state=new_opt, master=new_master)
+        log_dist(f"offload_states: {sorted(include)} → {device}", ranks=[0])
+
+    def reload_states(self, non_blocking: bool = False):
+        """Restore previously offloaded states to their device shardings
+        (ref: engine.reload_states)."""
+        offloaded = getattr(self, "_offloaded", {})
+        if not offloaded:
+            return
+
+        def put(name, tree, shardings):
+            if name not in offloaded or tree == ():
+                return tree
+            if offloaded[name] == "nvme":
+                tree = self._nvme_swapper.swap_in(name)
+            return jax.device_put(tree, shardings)
+
+        self.state = self.state._replace(
+            opt_state=put("optimizer_states", self.state.opt_state, self.state_shardings.opt_state),
+            master=put("master_weights", self.state.master, self.state_shardings.master))
+        self._offloaded = {}
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True, exclude_frozen_parameters=False):
         from ..checkpoint.engine import save_checkpoint as _save

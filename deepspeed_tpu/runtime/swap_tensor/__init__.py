@@ -1,0 +1,2 @@
+from .swapper import (AioSwapConfig, PartitionedOptimizerSwapper, SwapInHandle,  # noqa: F401
+                      TensorSwapper)

@@ -1,0 +1,59 @@
+"""Quantizer op tests (ref: tests/unit/ops/quantizer — kernel vs reference
+numeric parity)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.quantizer import (dequantize_int4, dequantize_int8, pack_signs,
+                                         quantization_error, quantize_int4, quantize_int8,
+                                         unpack_signs)
+
+
+@pytest.mark.parametrize("block", [64, 256])
+def test_int8_roundtrip_error_bound(block):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(4096, )), jnp.float32)
+    q, s = quantize_int8(x, block)
+    assert q.dtype == jnp.int8
+    back = dequantize_int8(q, s, x.shape)
+    # max error within half an int8 quantization bin per block
+    err = np.abs(np.asarray(back - x))
+    bins = np.asarray(s)[:, None] * np.ones((1, block)) * 0.5
+    assert (err <= bins.reshape(-1) + 1e-7).all()
+
+
+def test_int4_roundtrip_error_bound():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2048, )), jnp.float32)
+    q, s = quantize_int4(x, 256)
+    assert q.dtype == jnp.uint8 and q.shape == (8, 128)  # two nibbles per byte
+    back = dequantize_int4(q, s, x.shape)
+    err = np.abs(np.asarray(back - x))
+    bins = np.repeat(np.asarray(s), 256) * 0.5
+    assert (err <= bins + 1e-7).all()
+
+
+def test_zero_block_stable():
+    x = jnp.zeros((512, ), jnp.float32)
+    q, s = quantize_int8(x, 256)
+    np.testing.assert_array_equal(np.asarray(dequantize_int8(q, s, x.shape)), 0)
+
+
+def test_sign_pack_roundtrip():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(1024, )), jnp.float32)
+    packed = pack_signs(x)
+    assert packed.dtype == jnp.uint8 and packed.size == 128  # 8x compression
+    signs = unpack_signs(packed, 1024)
+    expected = np.where(np.asarray(x) >= 0, 1.0, -1.0)
+    np.testing.assert_array_equal(np.asarray(signs), expected)
+
+
+def test_quantization_error_is_residual():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(512, )), jnp.float32)
+    e = quantization_error(x, bits=8, block=256)
+    q, s = quantize_int8(x, 256)
+    np.testing.assert_allclose(np.asarray(e), np.asarray(x - dequantize_int8(q, s, x.shape)),
+                               atol=1e-7)
