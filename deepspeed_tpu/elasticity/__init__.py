@@ -1,0 +1,3 @@
+from .elasticity import (compute_elastic_config, elasticity_enabled, ensure_immutable_elastic_config,
+                         get_candidate_batch_sizes, get_valid_chips)
+from .config import (ElasticityConfig, ElasticityConfigError, ElasticityError, ElasticityIncompatibleWorldSize)

@@ -1,0 +1,73 @@
+"""Elasticity config (ref: deepspeed/elasticity/config.py).
+
+The elastic config declares the batch-size envelope the job may run in
+so the scheduler can add/remove hosts without hyperparameter drift:
+``final_batch_size = micro_batch × gas × n_chips`` must stay constant
+across every permitted chip count.
+"""
+
+ELASTICITY = "elasticity"
+ENABLED = "enabled"
+ENABLED_DEFAULT = False
+MAX_ACCEPTABLE_BATCH_SIZE = "max_train_batch_size"
+MICRO_BATCHES = "micro_batch_sizes"
+MIN_CHIPS = "min_gpus"  # key name kept for config-file parity
+MAX_CHIPS = "max_gpus"
+MIN_TIME = "min_time"
+VERSION = "version"
+PREFER_LARGER_BATCH = "prefer_larger_batch"
+IGNORE_NON_ELASTIC_BATCH_INFO = "ignore_non_elastic_batch_info"
+MODEL_PARALLEL_SIZE = "model_parallel_size"
+NUM_CHIPS_PER_NODE = "num_gpus_per_node"
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.0.0"
+
+
+class ElasticityError(Exception):
+    """Base exception for elasticity errors (ref: config.py:10)."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Configuration error (ref: config.py:16)."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """World size not in the compatible set (ref: config.py:22)."""
+
+
+class ElasticityConfig:
+    """Typed view of the ``elasticity`` config block (ref: config.py:28)."""
+
+    def __init__(self, param_dict):
+        self.enabled = param_dict.get(ENABLED, ENABLED_DEFAULT)
+        if self.enabled:
+            if MAX_ACCEPTABLE_BATCH_SIZE not in param_dict:
+                raise ElasticityConfigError(f"Elasticity config missing {MAX_ACCEPTABLE_BATCH_SIZE}")
+            if MICRO_BATCHES not in param_dict:
+                raise ElasticityConfigError(f"Elasticity config missing {MICRO_BATCHES}")
+        self.max_acceptable_batch_size = param_dict.get(MAX_ACCEPTABLE_BATCH_SIZE, 2000)
+        self.micro_batches = param_dict.get(MICRO_BATCHES, [2, 4, 6])
+        if not isinstance(self.micro_batches, list):
+            raise ElasticityConfigError(f"{MICRO_BATCHES} must be a list of ints")
+        for m in self.micro_batches:
+            if not isinstance(m, int) or m <= 0:
+                raise ElasticityConfigError(f"micro batch sizes must be positive ints, got {m}")
+        self.min_chips = param_dict.get(MIN_CHIPS, 1)
+        self.max_chips = param_dict.get(MAX_CHIPS, 10000)
+        if self.min_chips < 1 or self.max_chips < 1:
+            raise ElasticityConfigError("min/max chip counts must be >= 1")
+        if self.max_chips < self.min_chips:
+            raise ElasticityConfigError("max chip count must be >= min chip count")
+        self.model_parallel_size = param_dict.get(MODEL_PARALLEL_SIZE, 1)
+        self.num_chips_per_node = param_dict.get(NUM_CHIPS_PER_NODE, 1)
+        self.min_time = param_dict.get(MIN_TIME, 0)
+        self.version = param_dict.get(VERSION, 0.1)
+        self.prefer_larger_batch_size = param_dict.get(PREFER_LARGER_BATCH, True)
+        self.ignore_non_elastic_batch_info = param_dict.get(IGNORE_NON_ELASTIC_BATCH_INFO, False)
+
+    def repr(self):
+        return self.__dict__
+
+    def __repr__(self):
+        return str(self.__dict__)

@@ -1,0 +1,209 @@
+"""Elastic batch-size compatibility math.
+
+ref: ``deepspeed/elasticity/elasticity.py`` (``compute_elastic_config:233``,
+``_get_compatible_gpus_v01:83``, ``_get_compatible_gpus_v02:126``).
+
+The problem: choose a global batch size B ≤ max_acceptable such that for
+every chip count n in the allowed range there exist (micro ∈ micro_batches,
+gas ∈ ℕ) with micro × gas × n == B.  Then the scheduler may scale the job
+to any compatible n without changing the effective batch size.  v0.2 adds
+the constraint that n is a multiple of chips-per-node × model-parallel
+degree (whole-node, whole-model-replica scaling) — on TPU this maps to
+whole pod-slice hosts.
+"""
+
+from ..utils.logging import logger
+from .config import (ELASTICITY, LATEST_ELASTICITY_VERSION, ElasticityConfig, ElasticityConfigError, ElasticityError,
+                     ElasticityIncompatibleWorldSize)
+
+
+def get_candidate_batch_sizes(base_list, max_acceptable_batch_size):
+    """All lcm-combination batch sizes ≤ cap (ref: elasticity.py:27)."""
+    candidate_batch_size = []
+    from itertools import combinations
+    from math import lcm
+
+    for i in range(len(base_list)):
+        for comb in combinations(base_list, i + 1):
+            val = lcm(*comb)
+            while val <= max_acceptable_batch_size:
+                if val not in candidate_batch_size:
+                    candidate_batch_size.append(val)
+                val += lcm(*comb)
+    return sorted(candidate_batch_size)
+
+
+def get_valid_chips(batch_size, micro_batches, min_valid_chips, max_valid_chips):
+    """Chip counts n such that some micro divides batch_size/n
+    (ref: elasticity.py:41 get_valid_gpus)."""
+    valid_chips = []
+    for micro_batch in micro_batches:
+        if batch_size % micro_batch == 0:
+            max_chips = batch_size // micro_batch
+            for i in range(1, max_chips + 1):
+                if max_chips % i == 0:
+                    n = max_chips // i  # n chips, gas = i
+                    if min_valid_chips <= n <= max_valid_chips and n not in valid_chips:
+                        valid_chips.append(n)
+    return sorted(valid_chips)
+
+
+def get_best_candidates(candidate_batch_sizes, micro_batches, min_chips, max_chips, prefer_larger):
+    """Pick the batch size with the most compatible chip counts
+    (ref: elasticity.py:63)."""
+    max_valid_chips = 0
+    best_batch_size = None
+    final_chips = []
+    for batch_size in candidate_batch_sizes:
+        valid_chips = get_valid_chips(batch_size, micro_batches, min_chips, max_chips)
+        if len(valid_chips) > max_valid_chips or \
+                (len(valid_chips) == max_valid_chips and
+                 ((prefer_larger and batch_size > (best_batch_size or 0)) or
+                  (not prefer_larger and best_batch_size is not None and batch_size < best_batch_size))):
+            max_valid_chips = len(valid_chips)
+            best_batch_size = batch_size
+            final_chips = valid_chips
+    return best_batch_size, final_chips
+
+
+def _get_compatible_chips_v01(micro_batches, max_acceptable_batch_size, min_chips=None, max_chips=None,
+                              prefer_larger=True):
+    """ref: elasticity.py:83 _get_compatible_gpus_v01."""
+    min_chips = min_chips or 1
+    max_chips = max_chips or max_acceptable_batch_size // min(micro_batches)
+    if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
+        raise ValueError(f"All micro batches must be less than max_acceptable_batch_size "
+                         f"({max_acceptable_batch_size})")
+    candidate_batch_sizes = get_candidate_batch_sizes(micro_batches, max_acceptable_batch_size)
+    return get_best_candidates(candidate_batch_sizes, micro_batches, min_chips, max_chips, prefer_larger)
+
+
+def _get_compatible_chips_v02(micro_batches, max_acceptable_batch_size, current_num_chips, min_chips=None,
+                              max_chips=None, prefer_larger=True, num_chips_per_node=1, model_parallel_size=1):
+    """v0.2 works at NODE granularity: the unit of scaling is one host
+    (ref: elasticity.py:126 _get_compatible_gpus_v02).  Returns
+    (final_batch_size, valid_dp_world_sizes, micro_batch) where valid sizes
+    are DATA-parallel world sizes — multiples of chips_per_node / mp.
+    """
+    import math
+
+    if num_chips_per_node % model_parallel_size != 0:
+        raise ElasticityError(f"Elasticity v0.2: chips per node ({num_chips_per_node}) must be "
+                              f"divisible by model parallel size ({model_parallel_size})")
+    dp_size_per_node = num_chips_per_node // model_parallel_size
+    current_dp_size = (current_num_chips // num_chips_per_node) * dp_size_per_node or dp_size_per_node
+
+    def pick_microbatch(final_batch_size):
+        chosen = None
+        for micro_batch in micro_batches:
+            if final_batch_size // current_dp_size % micro_batch == 0:
+                if chosen is None or (prefer_larger and micro_batch > chosen):
+                    chosen = micro_batch
+        return chosen
+
+    final_batch_size, valid_node_counts = _get_compatible_chips_v01(
+        micro_batches, int(max_acceptable_batch_size / dp_size_per_node),
+        max(int((min_chips or num_chips_per_node) / num_chips_per_node), 1),
+        max(int((max_chips or current_num_chips) / num_chips_per_node), 1),
+        prefer_larger=prefer_larger)
+    final_batch_size = int(final_batch_size) * dp_size_per_node
+    valid_dp_sizes = [i * dp_size_per_node for i in valid_node_counts]
+    if current_dp_size in valid_dp_sizes:
+        return final_batch_size, valid_dp_sizes, pick_microbatch(final_batch_size)
+
+    # current topology not in the lcm-derived set: snap the batch to the
+    # largest multiple of (micro × current_dp) under the cap
+    candidate_batch_sizes = []
+    for micro_batch in micro_batches:
+        min_batch_size = micro_batch * current_dp_size
+        factor = math.floor(max_acceptable_batch_size / float(min_batch_size))
+        candidate_batch_sizes.append(factor * min_batch_size)
+    candidate = max(candidate_batch_sizes) if prefer_larger else min(candidate_batch_sizes)
+    return int(candidate), [int(current_dp_size)], pick_microbatch(candidate)
+
+
+def elasticity_enabled(ds_config: dict):
+    """ref: elasticity.py:202."""
+    if ELASTICITY not in ds_config:
+        return False
+    return ds_config[ELASTICITY].get("enabled", False)
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: dict):
+    """Cross-check the runtime config against the scheduler-frozen one in
+    DEEPSPEED_ELASTICITY_CONFIG (ref: elasticity.py:208)."""
+    import json
+    import os
+    if 'DEEPSPEED_ELASTICITY_CONFIG' not in os.environ:
+        return
+    scheduler_elastic_config_dict = json.loads(os.environ['DEEPSPEED_ELASTICITY_CONFIG'])
+    scheduler_elastic_config = ElasticityConfig(scheduler_elastic_config_dict)
+    runtime_elastic_config = ElasticityConfig(runtime_elastic_config_dict)
+    err_str = "Elastic config '{}={}' seems to have changed, but this is not supported. " \
+              "Please restart training from scratch: scheduler={}, runtime={}"
+    for field in ("max_acceptable_batch_size", "micro_batches", "version"):
+        sched, run = getattr(scheduler_elastic_config, field), getattr(runtime_elastic_config, field)
+        if sched != run:
+            raise ElasticityConfigError(err_str.format(field, run, sched, run))
+
+
+def compute_elastic_config(ds_config: dict, target_deepspeed_version: str, world_size=0, return_microbatch=False):
+    """ref: elasticity.py:233 — returns (final_batch_size, valid_chips[,
+    micro_batch]) and, when world_size>0, validates it."""
+    if not isinstance(ds_config, dict):
+        raise ValueError(f"Expected ds_config to be a dictionary, got {type(ds_config)}")
+    if ELASTICITY not in ds_config:
+        raise ElasticityConfigError(f"'{ELASTICITY}' is missing from config json")
+    elastic_config_dict = ds_config[ELASTICITY]
+    if not elastic_config_dict.get("enabled", False):
+        raise ElasticityConfigError("Elasticity is disabled, please enable it in the config")
+    elastic_config = ElasticityConfig(elastic_config_dict)
+    model_parallel_size = elastic_config.model_parallel_size
+    num_chips_per_node = elastic_config.num_chips_per_node
+
+    if float(elastic_config.version) > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(f"Elasticity version {elastic_config.version} is not supported; "
+                                    f"latest is {LATEST_ELASTICITY_VERSION}")
+
+    micro_batch = None
+    if float(elastic_config.version) == 0.1:
+        final_batch_size, valid_chips = _get_compatible_chips_v01(
+            micro_batches=elastic_config.micro_batches,
+            max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
+            min_chips=elastic_config.min_chips,
+            max_chips=elastic_config.max_chips,
+            prefer_larger=elastic_config.prefer_larger_batch_size)
+    elif float(elastic_config.version) == 0.2:
+        final_batch_size, valid_chips, micro_batch = _get_compatible_chips_v02(
+            micro_batches=elastic_config.micro_batches,
+            max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
+            current_num_chips=world_size if world_size > 0 else num_chips_per_node,
+            min_chips=elastic_config.min_chips,
+            max_chips=elastic_config.max_chips,
+            prefer_larger=elastic_config.prefer_larger_batch_size,
+            num_chips_per_node=num_chips_per_node,
+            model_parallel_size=model_parallel_size)
+    else:
+        raise NotImplementedError(f"Unable to find elastic logic for version: {elastic_config.version}")
+    final_batch_size = int(final_batch_size)
+
+    logger.info(f"Valid chip counts: {valid_chips}")
+    logger.info(f"Elastically-compatible batch size: {final_batch_size}")
+
+    if world_size > 0:
+        # v0.2's valid list is DP world sizes; v0.1's is raw chip counts
+        check = world_size // model_parallel_size if float(elastic_config.version) == 0.2 else world_size
+        if check not in valid_chips:
+            raise ElasticityIncompatibleWorldSize(
+                f"World size ({world_size}) is not valid with the current list of valid chip counts: {valid_chips}")
+        if micro_batch is None:
+            for mbsz in sorted(elastic_config.micro_batches, reverse=True):
+                if final_batch_size // check % mbsz == 0:
+                    micro_batch = mbsz
+                    break
+            assert micro_batch is not None, "Unable to find divisible micro batch size"
+        return final_batch_size, valid_chips, micro_batch
+
+    if return_microbatch:
+        return final_batch_size, valid_chips, micro_batch
+    return final_batch_size, valid_chips

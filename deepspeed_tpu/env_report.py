@@ -1,0 +1,95 @@
+"""``ds_report`` — environment / op-compatibility report.
+
+TPU-native analog of ``deepspeed/env_report.py`` (CLI ``bin/ds_report``):
+the reference prints a nvcc/torch compat matrix per op_builder; here we
+report the JAX/XLA stack, visible devices, Pallas kernel availability,
+and the native (C++) extension build status.
+"""
+
+import importlib
+import os
+import platform
+import shutil
+import subprocess
+import sys
+
+GREEN = '\033[92m'
+RED = '\033[91m'
+YELLOW = '\033[93m'
+END = '\033[0m'
+SUCCESS = f"{GREEN}[OKAY]{END}"
+WARNING = f"{YELLOW}[WARNING]{END}"
+FAIL = f'{RED}[FAIL]{END}'
+INFO = '[INFO]'
+
+color_len = len(GREEN) + len(END)
+okay = f"{GREEN}[OKAY]{END}"
+warning = f"{YELLOW}[WARNING]{END}"
+
+
+def op_report(verbose=True):
+    """Pallas/native op availability matrix (ref: env_report.py op_report)."""
+    max_dots = 23
+    print("-" * 64)
+    print("op name" + "." * (max_dots - len("op name")) + " installed .. compatible")
+    print("-" * 64)
+
+    from .ops.op_builder import ALL_OPS
+    for name, builder in sorted(ALL_OPS.items()):
+        installed = builder().is_installed()
+        compatible = builder().is_compatible()
+        dots = "." * (max_dots - len(name))
+        i_str = okay if installed else warning
+        c_str = okay if compatible else warning
+        print(f"{name}{dots} {i_str} .. {c_str}")
+    print("-" * 64)
+
+
+def debug_report():
+    import jax
+    import jaxlib
+
+    report = [
+        ("python version", sys.version.replace("\n", " ")),
+        ("platform", platform.platform()),
+        ("jax version", jax.__version__),
+        ("jaxlib version", jaxlib.__version__),
+        ("default backend", jax.default_backend()),
+        ("device count", jax.device_count()),
+        ("devices", ", ".join(str(d) for d in jax.devices()[:8])),
+    ]
+    try:
+        import flax
+        report.append(("flax version", flax.__version__))
+    except ImportError:
+        report.append(("flax version", "not installed"))
+    try:
+        import optax
+        report.append(("optax version", optax.__version__))
+    except ImportError:
+        report.append(("optax version", "not installed"))
+    try:
+        import orbax.checkpoint as ocp
+        report.append(("orbax version", getattr(ocp, "__version__", "installed")))
+    except ImportError:
+        report.append(("orbax version", "not installed"))
+    from . import __version__
+    report.append(("deepspeed_tpu version", __version__))
+    report.append(("deepspeed_tpu install path", os.path.dirname(os.path.abspath(__file__))))
+
+    print("DeepSpeed-TPU general environment info:")
+    for name, value in report:
+        print(f"{name} " + "." * (29 - len(name)), value)
+
+
+def main(args=None):
+    op_report()
+    debug_report()
+
+
+def cli_main():
+    main()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+"""Launcher constants (ref: deepspeed/launcher/constants.py)."""
+
+PDSH_LAUNCHER = 'pdsh'
+PDSH_MAX_FAN_OUT = 1024
+
+OPENMPI_LAUNCHER = 'openmpi'
+MPICH_LAUNCHER = 'mpich'
+IMPI_LAUNCHER = 'impi'
+SLURM_LAUNCHER = 'slurm'
+MVAPICH_LAUNCHER = 'mvapich'
+GCLOUD_TPU_LAUNCHER = 'gcloud'
+
+ELASTIC_TRAINING_ID_DEFAULT = "123456789"

@@ -1,0 +1,91 @@
+"""Per-node launcher — starts the worker process on one host.
+
+TPU-native analog of ``deepspeed/launcher/launch.py:133 main``.  The
+reference spawns one python per local GPU and exports
+RANK/LOCAL_RANK/WORLD_SIZE per process; under JAX a single process per
+host drives all local chips, so we spawn exactly ONE child and export
+both the JAX names (COORDINATOR_ADDRESS/PROCESS_ID/NUM_PROCESSES) and
+the reference's names (MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE/
+LOCAL_RANK) for scripts that read them.
+"""
+
+import argparse
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+
+from ..utils.logging import logger
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description="deepspeed_tpu per-node launcher")
+    parser.add_argument("--node_rank", type=int, default=0, help="rank of this node (process id)")
+    parser.add_argument("--coordinator_addr", default="127.0.0.1", type=str)
+    parser.add_argument("--coordinator_port", default=29500, type=int)
+    parser.add_argument("--world_info", default="None", type=str,
+                        help="base64-encoded dict of hostname → chip ids")
+    parser.add_argument("--module", action="store_true")
+    parser.add_argument("--no_python", action="store_true")
+    parser.add_argument("--no_local_rank", action="store_true")
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args()
+
+
+def decode_world_info(world_info_base64):
+    if world_info_base64 in (None, "None", ""):
+        return {}
+    return json.loads(base64.urlsafe_b64decode(world_info_base64))
+
+
+def build_child_env(args, world_info):
+    env = os.environ.copy()
+    num_nodes = max(len(world_info), 1)
+    env["COORDINATOR_ADDRESS"] = f"{args.coordinator_addr}:{args.coordinator_port}"
+    env["PROCESS_ID"] = str(args.node_rank)
+    env["NUM_PROCESSES"] = str(num_nodes)
+    # reference-compatible names (consumed by comm.init_distributed)
+    env["MASTER_ADDR"] = args.coordinator_addr
+    env["MASTER_PORT"] = str(args.coordinator_port)
+    env["RANK"] = str(args.node_rank)
+    env["WORLD_SIZE"] = str(num_nodes)
+    env["LOCAL_RANK"] = "0"
+    env["NODE_RANK"] = str(args.node_rank)
+    return env
+
+
+def build_child_cmd(args):
+    cmd = []
+    if not args.no_python:
+        cmd = [sys.executable, "-u"]
+        if args.module:
+            cmd.append("-m")
+    cmd.append(args.training_script)
+    cmd += args.training_script_args
+    return cmd
+
+
+def main(args=None):
+    args = args if args is not None else parse_args()
+    world_info = decode_world_info(args.world_info)
+    env = build_child_env(args, world_info)
+    cmd = build_child_cmd(args)
+    logger.info(f"launch: node_rank={args.node_rank} cmd={cmd}")
+
+    process = subprocess.Popen(cmd, env=env)
+
+    def sigkill_handler(signum, frame):
+        process.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, sigkill_handler)
+    signal.signal(signal.SIGTERM, sigkill_handler)
+    process.wait()
+    sys.exit(process.returncode)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,253 @@
+"""``dstpu`` CLI — top-level multi-node launch driver.
+
+TPU-native analog of ``deepspeed/launcher/runner.py:419 main``: parse a
+hostfile + include/exclude filters into a resource pool, pick a runner
+backend (pdsh / openmpi / slurm / gcloud-tpu), encode world info, and
+exec the per-node launcher.  Single-node short-circuits to a direct
+subprocess (the common TPU-VM case: one host, 4–8 local chips).
+"""
+
+import argparse
+import base64
+import collections
+import json
+import os
+import shutil
+import subprocess
+import sys
+from copy import deepcopy
+from typing import Dict, List, Tuple
+
+from ..utils.logging import logger
+from .constants import (GCLOUD_TPU_LAUNCHER, MPICH_LAUNCHER, OPENMPI_LAUNCHER, PDSH_LAUNCHER, SLURM_LAUNCHER)
+from .multinode_runner import GcloudTPURunner, OpenMPIRunner, PDSHRunner, SlurmRunner
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ['PYTHONPATH', 'PATH', 'JAX_PLATFORMS', 'XLA_FLAGS', 'LIBTPU_INIT_ARGS', 'TPU_NAME']
+
+
+def parse_args(args=None):
+    """ref: launcher/runner.py:48 parse_args — same flag surface where it
+    still makes sense on TPU (num_gpus → num_chips alias kept for parity)."""
+    parser = argparse.ArgumentParser(description="deepspeed_tpu launcher")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile of `hostname slots=N` lines")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help='e.g. "worker-0@worker-1:0,2"')
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help='e.g. "worker-1:0"')
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--min_elastic_nodes", type=int, default=-1)
+    parser.add_argument("--max_elastic_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_chips", dest="num_gpus", type=int, default=-1)
+    parser.add_argument("--master_port", default=29500, type=int)
+    parser.add_argument("--master_addr", default="", type=str)
+    parser.add_argument("--launcher", default=PDSH_LAUNCHER, type=str,
+                        help=f"one of {PDSH_LAUNCHER}, {OPENMPI_LAUNCHER}, {MPICH_LAUNCHER}, "
+                             f"{SLURM_LAUNCHER}, {GCLOUD_TPU_LAUNCHER}")
+    parser.add_argument("--launcher_args", default="", type=str)
+    parser.add_argument("--module", action="store_true")
+    parser.add_argument("--no_python", action="store_true")
+    parser.add_argument("--no_local_rank", action="store_true")
+    parser.add_argument("--no_ssh_check", action="store_true")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--autotuning", default="", type=str, choices=["", "tune", "run"])
+    parser.add_argument("--elastic_training", action="store_true")
+    parser.add_argument("--ssh_port", type=int, default=None)
+    parser.add_argument("--tpu_name", type=str, default=None)
+    parser.add_argument("--tpu_zone", type=str, default=None)
+    parser.add_argument("--bind_cores_to_rank", action="store_true")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path):
+    """ref: runner.py:213."""
+    if not os.path.isfile(hostfile_path):
+        logger.debug("Unable to find hostfile, will proceed with training with local resources only.")
+        return None
+    with open(hostfile_path, 'r') as fd:
+        hostfile_text = fd.readlines()
+    return _parse_hostfile(hostfile_text)
+
+
+def _parse_hostfile(hostfile_lines):
+    """ref: runner.py:226 — `hostname slots=N` per line."""
+    resource_pool = collections.OrderedDict()
+    for line in hostfile_lines:
+        line = line.strip()
+        if line == '' or line.startswith('#'):
+            continue
+        try:
+            hostname, slots = line.split()
+            _, slot_count = slots.split("=")
+            slot_count = int(slot_count)
+        except ValueError as err:
+            logger.error(f"Hostfile is not formatted correctly: {line}")
+            raise err
+        if hostname in resource_pool:
+            logger.error(f"Hostfile contains multiple entries for {hostname}")
+            raise ValueError(f"host {hostname} is already defined")
+        resource_pool[hostname] = slot_count
+    return resource_pool
+
+
+def _stable_remove_duplicates(data):
+    """ref: runner.py:258."""
+    new_list = []
+    for x in data:
+        if x not in new_list:
+            new_list.append(x)
+    return new_list
+
+
+def parse_node_config(node_config: str) -> Tuple[str, List[int]]:
+    """ref: runner.py:268 — `hostname:0,2,3`."""
+    SLOT_LIST_START = ':'
+    SLOT_SEP = ','
+    if SLOT_LIST_START in node_config:
+        hostname, slots = node_config.split(SLOT_LIST_START)
+        slot_list = [int(x) for x in slots.split(SLOT_SEP)]
+    else:
+        hostname = node_config
+        slot_list = []
+    return hostname, slot_list
+
+
+def parse_resource_filter(host_info, include_str="", exclude_str=""):
+    """ref: runner.py:293 — apply `--include`/`--exclude` to the pool."""
+    NODE_SEP = '@'
+    if include_str == "" and exclude_str == "":
+        return host_info
+    if include_str != "" and exclude_str != "":
+        raise ValueError('include_str and exclude_str are mutually exclusive.')
+
+    filtered_hosts = dict()
+    if include_str:
+        parse_str = include_str
+    else:
+        filtered_hosts = deepcopy(host_info)
+        parse_str = exclude_str
+
+    for node_config in parse_str.split(NODE_SEP):
+        hostname, slots = parse_node_config(node_config)
+        if hostname not in host_info:
+            raise ValueError(f"Hostname '{hostname}' not found in hostfile")
+        for slot in slots:
+            if slot not in range(host_info[hostname]):
+                raise ValueError(f"No slot '{slot}' specified on host '{hostname}'")
+        if include_str:
+            if len(slots) == 0:
+                filtered_hosts[hostname] = host_info[hostname]
+            else:
+                filtered_hosts[hostname] = len(_stable_remove_duplicates(slots))
+        else:
+            if len(slots) == 0:
+                del filtered_hosts[hostname]
+            else:
+                filtered_hosts[hostname] = host_info[hostname] - len(_stable_remove_duplicates(slots))
+    return filtered_hosts
+
+
+def parse_inclusion_exclusion(resource_pool, inclusion, exclusion):
+    """ref: runner.py:374."""
+    active_resources = collections.OrderedDict()
+    for hostname, slots in resource_pool.items():
+        active_resources[hostname] = slots
+    return parse_resource_filter(active_resources, include_str=inclusion, exclude_str=exclusion)
+
+
+def encode_world_info(world_info: Dict[str, int]) -> str:
+    """ref: runner.py:384."""
+    world_info_json = json.dumps(world_info).encode('utf-8')
+    return base64.urlsafe_b64encode(world_info_json).decode('utf-8')
+
+
+def run_autotuning(args, active_resources):
+    """ref: runner.py:390 — hand off to the autotuner."""
+    from ..autotuning.autotuner import Autotuner
+    tuner = Autotuner(args, active_resources)
+    logger.info("[Start] Running autotuning")
+    tuner.tune()
+    tuner.print_tuning_results()
+    logger.info("[End] Running autotuning")
+    if args.autotuning == "run":
+        tuner.run_after_tuning()
+
+
+def main(args=None):
+    """ref: runner.py:419."""
+    args = parse_args(args)
+
+    resource_pool = fetch_hostfile(args.hostfile)
+    multi_node = resource_pool is not None and len(resource_pool) > 1
+    if args.launcher == GCLOUD_TPU_LAUNCHER:
+        multi_node = True
+
+    if not multi_node and not args.force_multi:
+        # single node: run the user script directly in this environment;
+        # JAX picks up every local chip without any rendezvous
+        env = os.environ.copy()
+        cmd = []
+        if not args.no_python:
+            cmd = [sys.executable, "-u"]
+            if args.module:
+                cmd.append("-m")
+        cmd.append(args.user_script)
+        cmd += args.user_args
+        if args.autotuning != "":
+            run_autotuning(args, {'localhost': 1})
+            return
+        logger.info(f"cmd = {' '.join(cmd)}")
+        result = subprocess.Popen(cmd, env=env)
+        result.wait()
+        sys.exit(result.returncode)
+
+    active_resources = parse_inclusion_exclusion(resource_pool or {}, args.include, args.exclude)
+    if args.num_nodes > 0:
+        updated = collections.OrderedDict()
+        for count, hostname in enumerate(active_resources.keys()):
+            if count >= args.num_nodes:
+                break
+            updated[hostname] = active_resources[hostname]
+        active_resources = updated
+
+    if args.master_addr == "" and active_resources:
+        args.master_addr = list(active_resources.keys())[0]
+
+    if args.autotuning != "":
+        run_autotuning(args, active_resources)
+        return
+
+    world_info_base64 = encode_world_info(active_resources)
+
+    if args.launcher == PDSH_LAUNCHER:
+        runner = PDSHRunner(args, world_info_base64)
+    elif args.launcher == OPENMPI_LAUNCHER:
+        runner = OpenMPIRunner(args, world_info_base64, active_resources)
+    elif args.launcher == SLURM_LAUNCHER:
+        runner = SlurmRunner(args, world_info_base64, active_resources)
+    elif args.launcher == GCLOUD_TPU_LAUNCHER:
+        runner = GcloudTPURunner(args, world_info_base64)
+    else:
+        raise NotImplementedError(f"Unknown launcher {args.launcher}")
+
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher '{args.launcher}' not installed")
+    runner.validate_args()
+
+    env = os.environ.copy()
+    for var in EXPORT_ENVS:
+        if var in env:
+            runner.add_export(var, env[var])
+
+    cmd = runner.get_cmd(env, active_resources)
+    logger.info(f"cmd = {' '.join(map(str, cmd))}")
+    result = subprocess.Popen(cmd, env=env)
+    result.wait()
+    sys.exit(result.returncode)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,1 +1,131 @@
+"""Op-builder registry.
+
+ref: ``op_builder/__init__.py`` + the ~25 per-op builders (SURVEY §2.6).
+On TPU most "ops" are Pallas/XLA modules whose build happens at trace
+time; their builders exist for `ds_report` parity and return the Python
+module from ``load()``.  Native C++ builders (aio) actually compile.
+"""
+
+import importlib
+
 from .builder import AsyncIOBuilder, OpBuilder  # noqa: F401
+
+
+class PallasOpBuilder(OpBuilder):
+    """Builder whose artifact is a Python module of Pallas/XLA kernels
+    (ref: SURVEY §2.6 TPU note: builders return Pallas/XLA implementations
+    instead of nvcc-compiled modules)."""
+
+    MODULE = None  # dotted path relative to deepspeed_tpu
+
+    def sources(self):
+        return []
+
+    def is_installed(self):
+        try:
+            importlib.import_module(f"deepspeed_tpu.{self.MODULE}")
+            return True
+        except ImportError:
+            return False
+
+    def is_compatible(self):
+        if self.BUILD_VAR and __import__("os").environ.get(self.BUILD_VAR, "1") == "0":
+            return False
+        return self.is_installed()
+
+    def load(self):
+        return importlib.import_module(f"deepspeed_tpu.{self.MODULE}")
+
+
+class FusedAdamBuilder(PallasOpBuilder):
+    """ref: op_builder/fused_adam.py:11 (DS_BUILD_FUSED_ADAM)."""
+    BUILD_VAR = "DS_BUILD_FUSED_ADAM"
+    NAME = "fused_adam"
+    MODULE = "ops.adam"
+
+
+class CPUAdamBuilder(PallasOpBuilder):
+    """ref: op_builder/cpu_adam.py — host-offloaded states use the same
+    jitted update, residency is a sharding property."""
+    BUILD_VAR = "DS_BUILD_CPU_ADAM"
+    NAME = "cpu_adam"
+    MODULE = "ops.adam"
+
+
+class FusedLambBuilder(PallasOpBuilder):
+    """ref: op_builder/fused_lamb.py."""
+    BUILD_VAR = "DS_BUILD_FUSED_LAMB"
+    NAME = "fused_lamb"
+    MODULE = "ops.lamb"
+
+
+class FusedLionBuilder(PallasOpBuilder):
+    """ref: op_builder/fused_lion.py."""
+    BUILD_VAR = "DS_BUILD_FUSED_LION"
+    NAME = "fused_lion"
+    MODULE = "ops.lion"
+
+
+class CPUAdagradBuilder(PallasOpBuilder):
+    """ref: op_builder/cpu_adagrad.py."""
+    BUILD_VAR = "DS_BUILD_CPU_ADAGRAD"
+    NAME = "cpu_adagrad"
+    MODULE = "ops.adagrad"
+
+
+class QuantizerBuilder(PallasOpBuilder):
+    """ref: op_builder/quantizer.py (csrc/quantization kernels)."""
+    BUILD_VAR = "DS_BUILD_QUANTIZER"
+    NAME = "quantizer"
+    MODULE = "ops.quantizer"
+
+
+class FPQuantizerBuilder(PallasOpBuilder):
+    """ref: op_builder/fp_quantizer.py (csrc/fp_quantizer)."""
+    BUILD_VAR = "DS_BUILD_FP_QUANTIZER"
+    NAME = "fp_quantizer"
+    MODULE = "ops.fp_quantizer"
+
+
+class FlashAttnBuilder(PallasOpBuilder):
+    """Pallas flash attention (plays the role of csrc/transformer fused
+    attention, SURVEY §2.5)."""
+    BUILD_VAR = "DS_BUILD_FLASH_ATTN"
+    NAME = "flash_attn"
+    MODULE = "ops.flash_attention"
+
+
+class RaggedOpsBuilder(PallasOpBuilder):
+    """ref: op_builder/ragged_ops.py — FastGen paged/ragged decode path."""
+    BUILD_VAR = "DS_BUILD_RAGGED_OPS"
+    NAME = "ragged_ops"
+    MODULE = "ops.paged_attention"
+
+
+class SparseAttnBuilder(PallasOpBuilder):
+    """ref: op_builder/sparse_attn.py — block-sparse attention."""
+    BUILD_VAR = "DS_BUILD_SPARSE_ATTN"
+    NAME = "sparse_attn"
+    MODULE = "ops.sparse_attention"
+
+
+class RandomLTDBuilder(PallasOpBuilder):
+    """ref: op_builder/random_ltd.py — token gather/scatter for random-LTD."""
+    BUILD_VAR = "DS_BUILD_RANDOM_LTD"
+    NAME = "random_ltd"
+    MODULE = "runtime.data_pipeline.data_routing.basic_layer"
+
+
+# native C++ aio builder gains is_installed for the report
+def _aio_is_installed(self):
+    return self.so_path().exists()
+
+
+AsyncIOBuilder.is_installed = _aio_is_installed
+
+ALL_OPS = {
+    b.NAME: b
+    for b in (AsyncIOBuilder, FusedAdamBuilder, CPUAdamBuilder, FusedLambBuilder, FusedLionBuilder,
+              CPUAdagradBuilder, QuantizerBuilder, FPQuantizerBuilder, FlashAttnBuilder, RaggedOpsBuilder,
+              SparseAttnBuilder, RandomLTDBuilder)
+}

@@ -1,0 +1,105 @@
+"""Elasticity tests (mirrors reference tests/unit/elasticity/test_elastic.py
+semantics: batch-size/chip-count compatibility math, config validation)."""
+
+import pytest
+
+from deepspeed_tpu.elasticity import (ElasticityConfigError, ElasticityIncompatibleWorldSize, compute_elastic_config,
+                                      elasticity_enabled, get_candidate_batch_sizes, get_valid_chips)
+
+base_ds_config = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def test_candidate_batch_sizes():
+    candidates = get_candidate_batch_sizes([8, 12, 16, 17], 100)
+    # lcm combinations ≤ 100
+    assert 8 in candidates
+    assert 24 in candidates  # lcm(8,12)
+    assert 48 in candidates  # lcm(8,12,16)
+    assert all(c <= 100 for c in candidates)
+
+
+def test_valid_chips():
+    chips = get_valid_chips(batch_size=24, micro_batches=[8, 12], min_valid_chips=1, max_valid_chips=24)
+    # 24/8=3 → n ∈ divisors; 24/12=2
+    assert 1 in chips and 2 in chips and 3 in chips
+    assert all(1 <= n <= 24 for n in chips)
+
+
+def test_basic_10k():
+    final_batch_size, valid_chips = compute_elastic_config(ds_config=base_ds_config, target_deepspeed_version="0")
+    for n in valid_chips:
+        assert 32 <= n <= 1500
+        # some micro batch must tile exactly
+        assert any(final_batch_size % (n * mb) == 0
+                   for mb in base_ds_config["elasticity"]["micro_batch_sizes"])
+    assert final_batch_size <= 10000
+
+
+def test_world_size_valid():
+    import copy
+    ds_config = copy.deepcopy(base_ds_config)
+    final_batch_size, valid_chips = compute_elastic_config(ds_config=ds_config, target_deepspeed_version="0")
+    ws = valid_chips[0]
+    fb, vc, mb = compute_elastic_config(ds_config=ds_config, target_deepspeed_version="0", world_size=ws)
+    assert fb == final_batch_size
+    assert fb % (ws * mb) == 0
+
+
+def test_world_size_invalid():
+    import copy
+    ds_config = copy.deepcopy(base_ds_config)
+    _, valid_chips = compute_elastic_config(ds_config=ds_config, target_deepspeed_version="0")
+    bad = 31  # below min_gpus
+    assert bad not in valid_chips
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(ds_config=ds_config, target_deepspeed_version="0", world_size=bad)
+
+
+def test_disabled_raises():
+    import copy
+    ds_config = copy.deepcopy(base_ds_config)
+    ds_config["elasticity"]["enabled"] = False
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config=ds_config, target_deepspeed_version="0")
+
+
+def test_missing_fields_raise():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config={"elasticity": {"enabled": True}}, target_deepspeed_version="0")
+
+
+def test_enabled_helper():
+    assert elasticity_enabled(base_ds_config)
+    assert not elasticity_enabled({})
+
+
+def test_v02_whole_node_scaling():
+    import copy
+    ds_config = copy.deepcopy(base_ds_config)
+    ds_config["elasticity"]["version"] = 0.2
+    ds_config["elasticity"]["num_gpus_per_node"] = 4
+    ds_config["elasticity"]["min_gpus"] = 4
+    ds_config["elasticity"]["max_gpus"] = 64
+    final_batch_size, valid_chips, micro_batch = compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version="0", world_size=8)
+    assert micro_batch in ds_config["elasticity"]["micro_batch_sizes"]
+    # whole-node: every valid count is a multiple of 4
+    assert all(n % 4 == 0 for n in valid_chips)
+
+
+def test_future_version_rejected():
+    import copy
+    ds_config = copy.deepcopy(base_ds_config)
+    ds_config["elasticity"]["version"] = 0.3
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config=ds_config, target_deepspeed_version="0")

@@ -1,0 +1,154 @@
+"""Launcher command-line generation tests — no cluster needed (mirrors the
+reference strategy in tests/unit/launcher/test_multinode_runner.py: assert
+generated pdsh/mpirun/srun command lines)."""
+
+from copy import deepcopy
+
+import pytest
+
+from deepspeed_tpu.launcher import runner as ds_runner
+from deepspeed_tpu.launcher.multinode_runner import (GcloudTPURunner, OpenMPIRunner, PDSHRunner, SlurmRunner)
+
+
+@pytest.fixture
+def runner_info():
+    env = {'PATH': '/usr/bin', 'PYTHONPATH': '.'}
+    hosts = {'worker-0': 4, 'worker-1': 4}
+    world_info = 'eyJ3b3JrZXItMCI6IDR9'
+    args = ds_runner.parse_args(['--master_addr', 'worker-0', 'test_launcher.py', '--epochs', '2'])
+    return env, hosts, world_info, args
+
+
+def test_pdsh_runner(runner_info):
+    env, resource_pool, world_info, args = runner_info
+    runner = PDSHRunner(args, world_info)
+    cmd = runner.get_cmd(env, resource_pool)
+    assert cmd[0] == 'pdsh'
+    assert '-w' in cmd
+    assert 'worker-0,worker-1' in cmd
+    assert env['PDSH_RCMD_TYPE'] == 'ssh'
+    joined = ' '.join(cmd)
+    assert 'deepspeed_tpu.launcher.launch' in joined
+    assert '--node_rank=%n' in joined
+    assert '--coordinator_addr=worker-0' in joined
+    assert 'test_launcher.py' in joined
+
+
+def test_pdsh_runner_exports(runner_info):
+    env, resource_pool, world_info, args = runner_info
+    runner = PDSHRunner(args, world_info)
+    runner.add_export('XLA_FLAGS', '--xla_foo=1')
+    cmd = runner.get_cmd(env, resource_pool)
+    assert any('XLA_FLAGS' in str(c) for c in cmd)
+
+
+def test_openmpi_runner(runner_info):
+    env, resource_pool, world_info, args = runner_info
+    runner = OpenMPIRunner(args, world_info, resource_pool)
+    cmd = runner.get_cmd(env, resource_pool)
+    assert cmd[0] == 'mpirun'
+    # one JAX process per host, not per chip
+    n_idx = cmd.index('-n')
+    assert cmd[n_idx + 1] == '2'
+    assert 'test_launcher.py' in cmd
+
+
+def test_openmpi_runner_rejects_include(runner_info):
+    env, resource_pool, world_info, _ = runner_info
+    args = ds_runner.parse_args(['--include', 'worker-0', 'test_launcher.py'])
+    runner = OpenMPIRunner(args, world_info, resource_pool)
+    with pytest.raises(ValueError):
+        runner.validate_args()
+
+
+def test_slurm_runner(runner_info):
+    env, resource_pool, world_info, args = runner_info
+    runner = SlurmRunner(args, world_info, resource_pool)
+    cmd = runner.get_cmd(env, resource_pool)
+    assert cmd[0] == 'srun'
+    n_idx = cmd.index('-n')
+    assert cmd[n_idx + 1] == '2'
+    assert any(str(c).startswith('--export=ALL') for c in cmd)
+
+
+def test_gcloud_runner(runner_info):
+    env, resource_pool, world_info, _ = runner_info
+    args = ds_runner.parse_args(['--launcher', 'gcloud', '--tpu_name', 'my-pod',
+                                 '--tpu_zone', 'us-central2-b', 'train.py'])
+    runner = GcloudTPURunner(args, world_info)
+    runner.validate_args()
+    cmd = runner.get_cmd(env, resource_pool)
+    assert cmd[:6] == ['gcloud', 'compute', 'tpus', 'tpu-vm', 'ssh', 'my-pod']
+    assert '--worker=all' in cmd
+    assert '--zone=us-central2-b' in cmd
+    assert 'train.py' in cmd[-1]
+
+
+def test_gcloud_runner_needs_name(runner_info):
+    env, resource_pool, world_info, _ = runner_info
+    import os
+    os.environ.pop('TPU_NAME', None)
+    args = ds_runner.parse_args(['--launcher', 'gcloud', 'train.py'])
+    runner = GcloudTPURunner(args, world_info)
+    with pytest.raises(ValueError):
+        runner.validate_args()
+
+
+# ---------------------------------------------------------------- hostfile
+
+
+def test_parse_hostfile():
+    lines = ['worker-0 slots=4', 'worker-1 slots=8', '# comment', '']
+    pool = ds_runner._parse_hostfile(lines)
+    assert pool == {'worker-0': 4, 'worker-1': 8}
+
+
+def test_parse_hostfile_bad_line():
+    with pytest.raises(ValueError):
+        ds_runner._parse_hostfile(['worker-0 slots=4', 'worker-0 slots=2'])
+    with pytest.raises(ValueError):
+        ds_runner._parse_hostfile(['worker-0 noslots'])
+
+
+def test_include_filter():
+    pool = {'worker-0': 4, 'worker-1': 4}
+    out = ds_runner.parse_resource_filter(pool, include_str='worker-0')
+    assert out == {'worker-0': 4}
+    out = ds_runner.parse_resource_filter(pool, include_str='worker-1:0,2')
+    assert out == {'worker-1': 2}
+
+
+def test_exclude_filter():
+    pool = {'worker-0': 4, 'worker-1': 4}
+    out = ds_runner.parse_resource_filter(pool, exclude_str='worker-1')
+    assert out == {'worker-0': 4}
+    out = ds_runner.parse_resource_filter(pool, exclude_str='worker-0:1')
+    assert out['worker-0'] == 3
+
+
+def test_include_exclude_mutually_exclusive():
+    with pytest.raises(ValueError):
+        ds_runner.parse_resource_filter({'a': 1}, include_str='a', exclude_str='a')
+
+
+def test_encode_world_info_roundtrip():
+    from deepspeed_tpu.launcher.launch import decode_world_info
+    info = {'worker-0': 4, 'worker-1': 2}
+    assert decode_world_info(ds_runner.encode_world_info(info)) == info
+
+
+def test_launch_child_env():
+    from deepspeed_tpu.launcher import launch
+
+    class A:
+        node_rank = 1
+        coordinator_addr = 'worker-0'
+        coordinator_port = 29500
+
+    env = launch.build_child_env(A(), {'worker-0': 4, 'worker-1': 4})
+    assert env['COORDINATOR_ADDRESS'] == 'worker-0:29500'
+    assert env['PROCESS_ID'] == '1'
+    assert env['NUM_PROCESSES'] == '2'
+    assert env['RANK'] == '1'
+    assert env['WORLD_SIZE'] == '2'
+    assert env['LOCAL_RANK'] == '0'
