@@ -75,7 +75,12 @@ def _get_compatible_chips_v01(micro_batches, max_acceptable_batch_size, min_chip
         raise ValueError(f"All micro batches must be less than max_acceptable_batch_size "
                          f"({max_acceptable_batch_size})")
     candidate_batch_sizes = get_candidate_batch_sizes(micro_batches, max_acceptable_batch_size)
-    return get_best_candidates(candidate_batch_sizes, micro_batches, min_chips, max_chips, prefer_larger)
+    best, valid = get_best_candidates(candidate_batch_sizes, micro_batches, min_chips, max_chips, prefer_larger)
+    if best is None:
+        raise ElasticityConfigError(
+            f"No batch size <= {max_acceptable_batch_size} built from micro batches {micro_batches} "
+            f"admits any chip count in [{min_chips}, {max_chips}]; widen the range or the cap")
+    return best, valid
 
 
 def _get_compatible_chips_v02(micro_batches, max_acceptable_batch_size, current_num_chips, min_chips=None,

@@ -131,8 +131,8 @@ class OpenMPIRunner(MultiNodeRunner):
     def get_cmd(self, environment, active_resources):
         total_process_count = len(self.resource_pool)  # one JAX process per host
         mpirun_cmd = [
-            'mpirun', '-n', f'{total_process_count}', '-hostfile', f'{self.args.hostfile}', '--mca', 'btl',
-            '^openib', '--mca', 'btl_tcp_if_include', 'eth0'
+            'mpirun', '-n', f'{total_process_count}', '--map-by', 'ppr:1:node', '-hostfile',
+            f'{self.args.hostfile}', '--mca', 'btl', '^openib', '--mca', 'btl_tcp_if_include', 'eth0'
         ]
         export_cmd = []
         for k, v in self.exports.items():
@@ -169,7 +169,7 @@ class SlurmRunner(MultiNodeRunner):
         if getattr(self.args, 'comment', ''):
             srun_cmd += ['--comment', self.args.comment]
         if self.args.include != "":
-            srun_cmd.append('--include')
+            srun_cmd.append('--nodelist')
             srun_cmd.append(f'{self.args.include}')
         if self.args.exclude != "":
             srun_cmd.append('--exclude')
@@ -219,8 +219,8 @@ class GcloudTPURunner(MultiNodeRunner):
         python_exec = "python -u"
         if getattr(self.args, 'module', False):
             python_exec += " -m"
-        remote = f"{exports}cd {os.path.abspath('.')}; {python_exec} {self.user_script} " + \
-                 " ".join(self.user_arguments)
+        script_and_args = " ".join(shlex.quote(a) for a in [self.user_script] + list(self.args.user_args))
+        remote = f"{exports}cd {os.path.abspath('.')}; {python_exec} {script_and_args}"
         cmd = ['gcloud', 'compute', 'tpus', 'tpu-vm', 'ssh', self.tpu_name, '--worker=all']
         if self.tpu_zone:
             cmd += [f'--zone={self.tpu_zone}']

@@ -204,7 +204,9 @@ def main(args=None):
         result.wait()
         sys.exit(result.returncode)
 
-    active_resources = parse_inclusion_exclusion(resource_pool or {}, args.include, args.exclude)
+    if resource_pool is None:
+        resource_pool = collections.OrderedDict(localhost=1)
+    active_resources = parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
     if args.num_nodes > 0:
         updated = collections.OrderedDict()
         for count, hostname in enumerate(active_resources.keys()):

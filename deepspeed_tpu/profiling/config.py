@@ -1,0 +1,5 @@
+"""Flops-profiler config (ref: deepspeed/profiling/config.py) — the model
+lives with the other feature blocks in runtime/config.py; re-exported here
+for import-path parity."""
+
+from ..runtime.config import FlopsProfilerConfig as DeepSpeedFlopsProfilerConfig  # noqa: F401

@@ -1,0 +1,1 @@
+from .profiler import FlopsProfiler, get_model_profile, xla_cost_analysis, number_to_string, flops_to_string, params_to_string

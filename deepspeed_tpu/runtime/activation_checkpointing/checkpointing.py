@@ -1,0 +1,240 @@
+"""Activation checkpointing — remat policies over ``jax.checkpoint``.
+
+TPU-native analog of ``deepspeed/runtime/activation_checkpointing/
+checkpointing.py`` (1,150 LoC: Megatron-compatible ``checkpoint():948``,
+``CheckpointFunction:488`` with ``partition_activations:377`` /
+``gather_partitioned_activations:266``, CPU checkpointing, contiguous
+buffers, ``CudaRNGStatesTracker:124``).
+
+The mapping (SURVEY §5 "Activation checkpointing"):
+
+* ``checkpoint(fn, *args)``      → ``jax.checkpoint`` (rematerialise in bwd)
+* ``partition_activations``      → a sharding constraint on saved residuals
+  over the tensor axis: each TP rank stores 1/tp of every checkpoint, the
+  backward gather is an XLA all-gather the scheduler overlaps — same memory
+  maths as the reference's explicit partition/gather pair.
+* ``cpu_checkpointing``          → ``save_and_offload_only_these_names``
+  policy offloading named residuals to ``pinned_host`` memory.
+* contiguous_memory_optimization → no-op on TPU (XLA owns allocation; noted
+  in config for parity).
+* ``CudaRNGStatesTracker``       → ``RNGStatesTracker`` over threaded PRNG
+  keys (functional, fork-on-use; no global device RNG state exists in JAX).
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.logging import logger
+
+_CONFIG = None
+_MPU = None
+
+# names used with jax.ad_checkpoint.checkpoint_name inside model code to
+# mark offloadable/saveable residuals
+CHECKPOINT_NAME = "ds_act_ckpt"
+
+
+# --------------------------------------------------------------------- RNG
+
+
+class RNGStatesTracker:
+    """Functional analog of ``CudaRNGStatesTracker`` (ref:
+    checkpointing.py:124): named PRNG streams; ``fork(name)`` yields a fresh
+    subkey deterministically, so remat replays identical randomness (the
+    problem the reference's RNG state juggling solves — JAX solves it by
+    construction, keys being values)."""
+
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name, seed):
+        if name in self.states_:
+            raise Exception(f"rng state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def fork(self, name="model-parallel-rng"):
+        if name not in self.states_:
+            raise Exception(f"rng state {name} is not added")
+        self.states_[name], sub = jax.random.split(self.states_[name])
+        return sub
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_cuda_rng_tracker():
+    """Name kept for API parity (ref: checkpointing.py get_cuda_rng_tracker)."""
+    return _RNG_TRACKER
+
+
+def model_parallel_cuda_manual_seed(seed):
+    """Seed DP-common and TP-distinct streams (ref: checkpointing.py:
+    model_parallel_cuda_manual_seed).  On TPU the 'tp-distinct' stream is
+    folded per axis index inside the traced program via fold_in."""
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add("model-parallel-rng", seed + 2718)
+    _RNG_TRACKER.add("data-parallel-rng", seed)
+    return _RNG_TRACKER
+
+
+def model_parallel_rng_key(seed, axis_name="tensor"):
+    """Traced helper: per-TP-rank key (use inside shard_map/jit)."""
+    key = jax.random.PRNGKey(seed)
+    try:
+        idx = jax.lax.axis_index(axis_name)
+        return jax.random.fold_in(key, idx)
+    except NameError:
+        return key
+
+
+# ------------------------------------------------------------------ policies
+
+
+def _policy_from_config(cfg):
+    """Build a jax.checkpoint policy from the DS config block."""
+    pol = jax.checkpoint_policies
+    if cfg is None:
+        return None  # rematerialise everything (DeepSpeed default)
+    if getattr(cfg, "cpu_checkpointing", False):
+        # offload the marked residuals to host RAM instead of recomputing
+        return pol.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=[CHECKPOINT_NAME],
+            offload_src="device",
+            offload_dst="pinned_host")
+    if getattr(cfg, "number_checkpoints", None):
+        # keep matmul outputs; close analog of "checkpoint every N layers"
+        return pol.dots_with_no_batch_dims_saveable
+    return None
+
+
+def checkpoint_name(x, name=CHECKPOINT_NAME):
+    """Tag a residual for the offload/save policies
+    (wraps jax.ad_checkpoint.checkpoint_name)."""
+    from jax.ad_checkpoint import checkpoint_name as _cn
+    return _cn(x, name)
+
+
+# ------------------------------------------------------------------- config
+
+
+def configure(mpu_=None,
+              deepspeed_config=None,
+              partition_activations=None,
+              contiguous_checkpointing=None,
+              num_checkpoints=None,
+              checkpoint_in_cpu=None,
+              synchronize=None,
+              profile=None):
+    """ref: checkpointing.py configure — record the policy knobs."""
+    global _CONFIG, _MPU
+    from ..config import ActivationCheckpointingConfig
+
+    if deepspeed_config is not None and hasattr(deepspeed_config, "activation_checkpointing_config"):
+        _CONFIG = deepspeed_config.activation_checkpointing_config
+    else:
+        _CONFIG = ActivationCheckpointingConfig(
+            partition_activations=bool(partition_activations),
+            contiguous_memory_optimization=bool(contiguous_checkpointing),
+            cpu_checkpointing=bool(checkpoint_in_cpu),
+            number_checkpoints=num_checkpoints,
+            synchronize_checkpoint_boundary=bool(synchronize),
+            profile=bool(profile),
+        )
+    _MPU = mpu_
+    if _CONFIG.contiguous_memory_optimization:
+        logger.debug("contiguous_memory_optimization is a no-op on TPU (XLA owns allocation)")
+
+
+def is_configured():
+    """ref: checkpointing.py is_configured."""
+    return _CONFIG is not None
+
+
+def reset():
+    """ref: checkpointing.py reset."""
+    global _CONFIG
+    _CONFIG = None
+
+
+# --------------------------------------------------------------- checkpoint
+
+
+def _partition_constraint(tree):
+    """Shard saved residuals across the tensor axis (the reference's
+    partition_activations:377 splits each activation across TP ranks; here
+    the same layout is a with_sharding_constraint on the LAST dim, and the
+    bwd all-gather is compiler-inserted)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ...comm.mesh import TENSOR_AXIS, get_global_mesh, has_global_mesh
+    if not has_global_mesh():
+        return tree
+    mesh = get_global_mesh()
+    if mesh.shape.get(TENSOR_AXIS, 1) <= 1:
+        return tree
+
+    def constrain(x):
+        if getattr(x, "ndim", 0) >= 1 and x.shape[-1] % mesh.shape[TENSOR_AXIS] == 0:
+            spec = P(*([None] * (x.ndim - 1) + [TENSOR_AXIS]))
+            return jax.lax.with_sharding_constraint(x, jax.sharding.NamedSharding(mesh, spec))
+        return x
+
+    return jax.tree.map(constrain, tree)
+
+
+def checkpoint(function: Callable, *args, **kwargs) -> Any:
+    """Megatron-compatible activation checkpointing (ref:
+    checkpointing.py:948 checkpoint): runs ``function(*args)`` under remat.
+
+    Unlike the reference there is no CheckpointFunction autograd.Function —
+    ``jax.checkpoint`` handles saving/recomputing, and RNG replay is free
+    because keys are arguments.
+    """
+    cfg = _CONFIG
+    policy = _policy_from_config(cfg)
+
+    wrapped = jax.checkpoint(function, policy=policy) if policy is not None else jax.checkpoint(function)
+
+    if cfg is not None and cfg.partition_activations:
+        def with_partition(*a, **k):
+            a = _partition_constraint(a)
+            return wrapped(*a, **k)
+        return with_partition(*args, **kwargs)
+    return wrapped(*args, **kwargs)
+
+
+def checkpoint_wrapper(function: Callable) -> Callable:
+    """Decorator form: ``layer = checkpoint_wrapper(layer)``."""
+    def inner(*args, **kwargs):
+        return checkpoint(function, *args, **kwargs)
+    return inner
+
+
+def non_reentrant_checkpoint(function, *args, **kwargs):
+    """ref: checkpointing.py:704 — reentrancy is meaningless under tracing;
+    same implementation, kept for API parity."""
+    return checkpoint(function, *args, **kwargs)
+
+
+# ---------------------------------------------------- parity helper exports
+
+
+def partition_activations_in_checkpoint(partition_activation):
+    global _CONFIG
+    if _CONFIG is None:
+        configure(partition_activations=partition_activation)
+    else:
+        _CONFIG.partition_activations = partition_activation
+    logger.info(f"**************Partition Activations {partition_activation}************")

@@ -1,0 +1,4 @@
+"""Data-efficiency pipeline (ref: deepspeed/runtime/data_pipeline/):
+curriculum learning, data sampling, random-LTD token dropping."""
+
+from .curriculum_scheduler import CurriculumScheduler  # noqa: F401

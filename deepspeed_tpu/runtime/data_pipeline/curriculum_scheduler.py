@@ -1,0 +1,111 @@
+"""Curriculum-learning difficulty scheduler.
+
+ref: ``deepspeed/runtime/data_pipeline/curriculum_scheduler.py:11
+CurriculumScheduler`` — maps global step → difficulty (e.g. sequence
+length) under fixed_linear / fixed_root / fixed_discrete / custom
+schedules.  Pure host-side control logic; on TPU a difficulty change
+means new batch shapes, which triggers a cached recompile of the train
+step (engine keys compiled fns by batch shape).
+"""
+
+import math
+
+from ...utils.logging import logger
+from .constants import *  # noqa: F401,F403
+
+
+class CurriculumScheduler:
+
+    def __init__(self, config):
+        self.state = {}
+        assert CURRICULUM_LEARNING_MIN_DIFFICULTY in config, \
+            f"Curriculum learning requires the config '{CURRICULUM_LEARNING_MIN_DIFFICULTY}'"
+        assert CURRICULUM_LEARNING_MAX_DIFFICULTY in config, \
+            f"Curriculum learning requires the config '{CURRICULUM_LEARNING_MAX_DIFFICULTY}'"
+        assert CURRICULUM_LEARNING_SCHEDULE_TYPE in config, \
+            f"Curriculum learning requires the config '{CURRICULUM_LEARNING_SCHEDULE_TYPE}'"
+        self.state[CURRICULUM_LEARNING_MIN_DIFFICULTY] = config[CURRICULUM_LEARNING_MIN_DIFFICULTY]
+        self.state[CURRICULUM_LEARNING_MAX_DIFFICULTY] = config[CURRICULUM_LEARNING_MAX_DIFFICULTY]
+        self.state[CURRICULUM_LEARNING_CURRENT_DIFFICULTY] = config[CURRICULUM_LEARNING_MIN_DIFFICULTY]
+        self.state[CURRICULUM_LEARNING_SCHEDULE_TYPE] = config[CURRICULUM_LEARNING_SCHEDULE_TYPE]
+        self.first_step = True
+        self.custom_get_difficulty = None
+
+        schedule_type = config[CURRICULUM_LEARNING_SCHEDULE_TYPE]
+        schedule_config = config.get(CURRICULUM_LEARNING_SCHEDULE_CONFIG, {})
+        if schedule_type == CURRICULUM_LEARNING_SCHEDULE_FIXED_DISCRETE:
+            # {"difficulty": [1,2,3], "max_step": [5,10]}
+            assert len(schedule_config[CURRICULUM_LEARNING_SCHEDULE_DIFFICULTY]) > 0
+            assert len(schedule_config[CURRICULUM_LEARNING_SCHEDULE_MAX_STEP]) > 0
+            assert len(schedule_config[CURRICULUM_LEARNING_SCHEDULE_DIFFICULTY]) == \
+                len(schedule_config[CURRICULUM_LEARNING_SCHEDULE_MAX_STEP]) + 1
+        elif schedule_type in (CURRICULUM_LEARNING_SCHEDULE_FIXED_LINEAR, CURRICULUM_LEARNING_SCHEDULE_FIXED_ROOT):
+            assert schedule_config[CURRICULUM_LEARNING_SCHEDULE_TOTAL_STEP] > 0
+            assert schedule_config[CURRICULUM_LEARNING_SCHEDULE_DIFFICULTY_STEP] > 0
+            if schedule_config[CURRICULUM_LEARNING_SCHEDULE_DIFFICULTY_STEP] % 8 != 0:
+                logger.warning("Curriculum learning difficulty_step that is not a multiple of 8 "
+                               "hurts MXU tiling (prefer seq-len multiples of 8/128 on TPU)")
+            if schedule_type == CURRICULUM_LEARNING_SCHEDULE_FIXED_ROOT:
+                assert schedule_config[CURRICULUM_LEARNING_SCHEDULE_ROOT_DEGREE] > 0
+        elif schedule_type == CURRICULUM_LEARNING_SCHEDULE_CUSTOM:
+            pass
+        else:
+            raise RuntimeError(f"Unsupported curriculum schedule type {schedule_type}")
+        self.state[CURRICULUM_LEARNING_SCHEDULE_CONFIG] = schedule_config
+
+    def get_current_difficulty(self):
+        return self.state[CURRICULUM_LEARNING_CURRENT_DIFFICULTY]
+
+    def set_current_difficulty(self, difficulty):
+        self.state[CURRICULUM_LEARNING_CURRENT_DIFFICULTY] = difficulty
+
+    def set_custom_get_difficulty(self, schedule_function):
+        self.custom_get_difficulty = schedule_function
+
+    def get_state(self):
+        return self.state
+
+    def set_state(self, state):
+        self.state = state
+
+    def __fixed_discrete_get_difficulty(self, global_steps):
+        s_state = self.state[CURRICULUM_LEARNING_SCHEDULE_CONFIG]
+        max_steps = s_state[CURRICULUM_LEARNING_SCHEDULE_MAX_STEP]
+        difficulties = s_state[CURRICULUM_LEARNING_SCHEDULE_DIFFICULTY]
+        for i, cap in enumerate(max_steps):
+            if global_steps <= cap:
+                return difficulties[i]
+        return difficulties[-1]
+
+    def __fixed_root_get_difficulty(self, global_steps, root_degree=None):
+        s_state = self.state[CURRICULUM_LEARNING_SCHEDULE_CONFIG]
+        if root_degree is None:
+            root_degree = s_state[CURRICULUM_LEARNING_SCHEDULE_ROOT_DEGREE]
+        next_difficulty = (float(global_steps) / s_state[CURRICULUM_LEARNING_SCHEDULE_TOTAL_STEP]) ** (1.0 /
+                                                                                                       root_degree)
+        next_difficulty = math.floor(
+            next_difficulty *
+            (self.state[CURRICULUM_LEARNING_MAX_DIFFICULTY] - self.state[CURRICULUM_LEARNING_MIN_DIFFICULTY]) +
+            self.state[CURRICULUM_LEARNING_MIN_DIFFICULTY])
+        next_difficulty -= next_difficulty % s_state[CURRICULUM_LEARNING_SCHEDULE_DIFFICULTY_STEP]
+        next_difficulty = min(next_difficulty, self.state[CURRICULUM_LEARNING_MAX_DIFFICULTY])
+        next_difficulty = max(next_difficulty, self.state[CURRICULUM_LEARNING_MIN_DIFFICULTY])
+        return next_difficulty
+
+    def get_difficulty(self, global_steps):
+        stype = self.state[CURRICULUM_LEARNING_SCHEDULE_TYPE]
+        if stype == CURRICULUM_LEARNING_SCHEDULE_FIXED_DISCRETE:
+            return self.__fixed_discrete_get_difficulty(global_steps)
+        if stype == CURRICULUM_LEARNING_SCHEDULE_FIXED_LINEAR:
+            return self.__fixed_root_get_difficulty(global_steps, root_degree=1)
+        if stype == CURRICULUM_LEARNING_SCHEDULE_FIXED_ROOT:
+            return self.__fixed_root_get_difficulty(global_steps)
+        if stype == CURRICULUM_LEARNING_SCHEDULE_CUSTOM:
+            assert self.custom_get_difficulty is not None, "custom schedule needs set_custom_get_difficulty"
+            return self.custom_get_difficulty(global_steps)
+        raise RuntimeError(f"Unsupported curriculum schedule type {stype}")
+
+    def update_difficulty(self, global_steps):
+        if self.state[CURRICULUM_LEARNING_CURRENT_DIFFICULTY] < self.state[CURRICULUM_LEARNING_MAX_DIFFICULTY]:
+            self.state[CURRICULUM_LEARNING_CURRENT_DIFFICULTY] = self.get_difficulty(global_steps)
+        return self.state[CURRICULUM_LEARNING_CURRENT_DIFFICULTY]

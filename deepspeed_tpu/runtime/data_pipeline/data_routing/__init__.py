@@ -1,0 +1,2 @@
+from .basic_layer import RandomLayerTokenDrop  # noqa: F401
+from .scheduler import RandomLTDScheduler  # noqa: F401

@@ -1,0 +1,132 @@
+"""Random layerwise token dropping (random-LTD).
+
+ref: ``deepspeed/runtime/data_pipeline/data_routing/basic_layer.py:14
+RandomLayerTokenDrop`` + the CUDA token gather/scatter kernels in
+``csrc/random_ltd`` (SURVEY §2.5 maps these to plain XLA gather/sort).
+
+TPU-native design: the reserved length is STATIC per curriculum phase
+(shape-stable → one compile per phase); index sampling uses threaded PRNG
+keys via ``jax.random.permutation`` under vmap; gather/scatter lower to
+one XLA gather / scatter each — no custom kernels needed.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _sample_sorted_indices(rng, n_layers, batch, seq_len, reserved):
+    """(n_layers, batch, reserved) sorted random token indices."""
+    keys = jax.random.split(rng, n_layers * batch).reshape(n_layers, batch, 2)
+
+    def one(key):
+        perm = jax.random.permutation(key, seq_len)[:reserved]
+        return jnp.sort(perm)
+
+    return jax.vmap(jax.vmap(one))(keys)
+
+
+def gpt_sample_tokens(rng, reserved_length, seq_len, batch, n_layers, attn_mask=None):
+    """Decoder-style sampling (ref: ops/random_ltd/dropping_utils.py
+    gpt_sample_tokens): indices sorted so causal order is preserved; the
+    causal mask for the short sequence is rebuilt by the attention op from
+    positions, so no per-layer mask tensor is materialised."""
+    idx = _sample_sorted_indices(rng, n_layers, batch, seq_len, reserved_length)
+    return idx, None
+
+
+def bert_sample_tokens(rng, reserved_length, seq_len, batch, n_layers, attn_mask=None):
+    """Encoder-style sampling (ref: bert_sample_tokens): also slices the
+    padding mask to the kept tokens (n_layers, batch, reserved)."""
+    idx = _sample_sorted_indices(rng, n_layers, batch, seq_len, reserved_length)
+    part_mask = None
+    if attn_mask is not None:
+        part_mask = jax.vmap(lambda layer_idx: jnp.take_along_axis(attn_mask, layer_idx, axis=1))(idx)
+    return idx, part_mask
+
+
+def gather_tokens(x, indices, batch_first=True):
+    """Keep only the sampled tokens (ref: csrc/random_ltd gather →
+    here one XLA gather).  x: (B,S,H) or (S,B,H); indices: (B, reserved).
+    Returns (x, part) both in the input layout."""
+    xb = x if batch_first else jnp.swapaxes(x, 0, 1)
+    part = jnp.take_along_axis(xb, indices[:, :, None], axis=1)
+    if not batch_first:
+        part = jnp.swapaxes(part, 0, 1)
+    return x, part
+
+
+def scatter_tokens(full, part, indices, batch_first=True):
+    """Write processed tokens back into the full sequence (ref: ScatterTokens)."""
+    if not batch_first:
+        full = jnp.swapaxes(full, 0, 1)
+        part = jnp.swapaxes(part, 0, 1)
+    out = full.at[jnp.arange(full.shape[0])[:, None], indices].set(part)
+    if not batch_first:
+        out = jnp.swapaxes(out, 0, 1)
+    return out
+
+
+class RandomLayerTokenDrop:
+    """Functional wrapper around a transformer layer fn.
+
+    Usage:
+        ltd = RandomLayerTokenDrop(layer_fn, layer_id=i)
+        ltd.init_config(config, scheduler, i)
+        hidden = ltd(hidden, rng=key, training=True, **layer_kwargs)
+
+    ``layer_fn(hidden, **kwargs)`` may return a tensor or a tuple whose
+    first element is the hidden state (same contract as the reference).
+    """
+
+    def __init__(self, layer: Callable, layer_id: int = 0):
+        self.random_ltd_layer = layer
+        self.random_ltd_layer_id = layer_id
+        self.random_ltd_scheduler = None
+        self.mask_name = None
+        self.batch_first = True
+        self.model_type = "decoder"
+        self.random_ltd_num_layer = 1
+
+    def init_config(self, config, scheduler, random_ltd_layer_id):
+        from ..constants import (RANDOM_LTD_MODEL_MASK_NAME, RANDOM_LTD_MODEL_TYPE, RANDOM_LTD_TOTAL_LAYER_NUM)
+        self.random_ltd_scheduler = scheduler
+        self.random_ltd_layer_id = random_ltd_layer_id
+        self.mask_name = config.get(RANDOM_LTD_MODEL_MASK_NAME)
+        self.model_type = config.get(RANDOM_LTD_MODEL_TYPE, "decoder")
+        self.random_ltd_num_layer = scheduler.random_ltd_layer_num
+
+    def __call__(self, hidden_states, rng=None, training=True, **kwargs):
+        sched = self.random_ltd_scheduler
+        seq_len = hidden_states.shape[1] if self.batch_first else hidden_states.shape[0]
+        batch = hidden_states.shape[0] if self.batch_first else hidden_states.shape[1]
+        reserved = sched.get_current_seq() if sched is not None else seq_len
+
+        if not training or sched is None or reserved >= seq_len:
+            return self.random_ltd_layer(hidden_states, **kwargs)
+
+        mask = kwargs.get(self.mask_name) if self.mask_name else None
+        sampler = bert_sample_tokens if self.model_type == "encoder" else gpt_sample_tokens
+        if rng is None:
+            rng = jax.random.PRNGKey(sched.state.get("current_steps", 0))
+        # one sampling per step, shared across wrapped layers (ref stores it
+        # in scheduler state at layer 0)
+        cache_key = "_sampled_cache"
+        cached = sched.state.get(cache_key)
+        if self.random_ltd_layer_id == 0 or cached is None or cached[0] != (int(reserved), int(seq_len), int(batch)):
+            idx, part_mask = sampler(rng, int(reserved), seq_len, batch, self.random_ltd_num_layer, mask)
+            sched.state[cache_key] = ((int(reserved), int(seq_len), int(batch)), idx, part_mask)
+        else:
+            _, idx, part_mask = cached
+
+        layer_idx = idx[self.random_ltd_layer_id % idx.shape[0]]
+        full, part = gather_tokens(hidden_states, layer_idx, self.batch_first)
+        if self.mask_name and part_mask is not None:
+            kwargs[self.mask_name] = part_mask[self.random_ltd_layer_id % part_mask.shape[0]]
+
+        outputs = self.random_ltd_layer(part, **kwargs)
+        if isinstance(outputs, tuple):
+            merged = scatter_tokens(full, outputs[0], layer_idx, self.batch_first)
+            return (merged, ) + tuple(outputs[1:])
+        return scatter_tokens(full, outputs, layer_idx, self.batch_first)

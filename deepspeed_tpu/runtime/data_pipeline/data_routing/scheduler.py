@@ -1,0 +1,90 @@
+"""Random-LTD sequence-length scheduler.
+
+ref: ``deepspeed/runtime/data_pipeline/data_routing/scheduler.py``
+(BaseScheduler/RandomLTDScheduler) — grows the reserved token count from
+min_value to max_value on a fixed_linear schedule and tracks consumed
+layer-tokens.
+"""
+
+import math
+
+from ..constants import *  # noqa: F401,F403
+
+RANDOM_LTD_CONSUMED_LAYER_TOKENS = "consumed_layer_tokens"
+
+
+class BaseScheduler:
+
+    def __init__(self):
+        self.state = {}
+
+    def _fixed_root_get_value(self, global_steps, root_degree):
+        s_cfg = self.state[RANDOM_LTD_SCHEDULE_CONFIG]
+        frac = (float(global_steps) / s_cfg[RANDOM_LTD_REQUIRE_STEP]) ** (1.0 / root_degree)
+        next_seq = math.floor(frac * (self.state[RANDOM_LTD_MAX_VALUE] - self.state[RANDOM_LTD_MIN_VALUE]) +
+                              self.state[RANDOM_LTD_MIN_VALUE])
+        next_seq -= next_seq % s_cfg[RANDOM_LTD_INCREASE_STEP]
+        return min(next_seq, self.state[RANDOM_LTD_MAX_VALUE])
+
+    def get_value(self, global_steps):
+        if self.state[RANDOM_LTD_SCHEDULE_TYPE] == "fixed_linear":
+            return self._fixed_root_get_value(global_steps, 1)
+        raise RuntimeError(f"Unsupported random-LTD schedule type {self.state[RANDOM_LTD_SCHEDULE_TYPE]}")
+
+
+class RandomLTDScheduler(BaseScheduler):
+
+    def __init__(self, config):
+        super().__init__()
+        self.model_layer_num = config[RANDOM_LTD_TOTAL_LAYER_NUM]
+        self.random_ltd_layer_num = config[RANDOM_LTD_LAYER_NUM]
+        self.config_schedule = config[RANDOM_LTD_SCHEDULER]
+        self.global_batch_size = config[RANDOM_LTD_GLOBAL_BATCH_SIZE]
+        self.reset_to_init()
+        self.state[RANDOM_LTD_CONSUMED_LAYER_TOKENS] = 0
+
+    def reset_to_init(self):
+        self.state[RANDOM_LTD_MIN_VALUE] = self.config_schedule[RANDOM_LTD_MIN_VALUE]
+        self.state[RANDOM_LTD_MAX_VALUE] = self.config_schedule[RANDOM_LTD_MAX_VALUE]
+        self.state[RANDOM_LTD_CURRENT_VALUE] = self.config_schedule[RANDOM_LTD_MIN_VALUE]
+        self.state[RANDOM_LTD_SCHEDULE_CONFIG] = self.config_schedule[RANDOM_LTD_SCHEDULE_CONFIG]
+        self.state[RANDOM_LTD_SCHEDULE_TYPE] = self.config_schedule[RANDOM_LTD_SCHEDULE_TYPE]
+        self.state[RANDOM_LTD_CURR_STEP] = -1
+
+    def get_total_layer_tokens(self, train_iters):
+        for step in range(train_iters):
+            self.update_seq(step)
+        return self.state[RANDOM_LTD_CONSUMED_LAYER_TOKENS]
+
+    def get_current_seq(self):
+        return self.state[RANDOM_LTD_CURRENT_VALUE]
+
+    def set_current_seq(self, seq_length):
+        self.state[RANDOM_LTD_CURRENT_VALUE] = seq_length
+
+    def get_random_ltd_layer_num(self):
+        return self.random_ltd_layer_num
+
+    def update_seq(self, global_steps):
+        if self.state[RANDOM_LTD_CURRENT_VALUE] < self.state[RANDOM_LTD_MAX_VALUE]:
+            self.state[RANDOM_LTD_CURRENT_VALUE] = self.get_value(global_steps)
+        if global_steps != self.state[RANDOM_LTD_CURR_STEP]:
+            self.state[RANDOM_LTD_CONSUMED_LAYER_TOKENS] += self.global_batch_size * (
+                self.state[RANDOM_LTD_CURRENT_VALUE] * self.random_ltd_layer_num +
+                self.state[RANDOM_LTD_MAX_VALUE] * (self.model_layer_num - self.random_ltd_layer_num))
+            self.state[RANDOM_LTD_CURR_STEP] = global_steps
+        return self.state[RANDOM_LTD_CURRENT_VALUE]
+
+    def state_dict(self):
+        return {
+            RANDOM_LTD_CONSUMED_LAYER_TOKENS: self.state[RANDOM_LTD_CONSUMED_LAYER_TOKENS],
+            RANDOM_LTD_CURR_STEP: self.state[RANDOM_LTD_CURR_STEP],
+            RANDOM_LTD_CURRENT_VALUE: self.state[RANDOM_LTD_CURRENT_VALUE],
+            RANDOM_LTD_MIN_VALUE: self.state[RANDOM_LTD_MIN_VALUE],
+            RANDOM_LTD_MAX_VALUE: self.state[RANDOM_LTD_MAX_VALUE],
+        }
+
+    def load_state_dict(self, state_dict):
+        for k in (RANDOM_LTD_CONSUMED_LAYER_TOKENS, RANDOM_LTD_CURR_STEP, RANDOM_LTD_CURRENT_VALUE,
+                  RANDOM_LTD_MIN_VALUE, RANDOM_LTD_MAX_VALUE):
+            self.state[k] = state_dict[k]

@@ -1,0 +1,230 @@
+"""Curriculum-aware deterministic data sampler.
+
+ref: ``deepspeed/runtime/data_pipeline/data_sampling/data_sampler.py:36
+DeepSpeedDataSampler`` — yields per-rank index batches where the sample
+pool grows with curriculum difficulty.  Each configured metric has an
+``index_to_sample`` map and a difficulty schedule; at every step the
+sampler takes the intersection of samples admitted by all metrics,
+shuffles the new admissions into the pending cluster, and emits
+deterministic global batches partitioned across data-parallel ranks.
+
+Differences from the reference: single-controller JAX means ONE sampler
+instance feeds the whole job (the reference runs one per rank and slices
+by rank id; here ``get_next_global_batch`` returns the full batch and
+``__iter__`` yields this process's shard).
+"""
+
+import numpy as np
+
+from ....utils.logging import logger
+from ..constants import *  # noqa: F401,F403
+from ..curriculum_scheduler import CurriculumScheduler
+
+
+class DeepSpeedDataSampler:
+
+    def __init__(self,
+                 data_efficiency_config,
+                 one_epoch_total_samples,
+                 micro_batch_size,
+                 data_parallel_rank,
+                 data_parallel_size,
+                 data_parallel_group=None,
+                 gradient_accumulation_steps=1,
+                 global_rank=0,
+                 drop_last=True):
+        self.data_efficiency_config = data_efficiency_config
+        self.one_epoch_total_samples = one_epoch_total_samples
+        self.index_dtype = np.int64
+        self.total_samples = one_epoch_total_samples * data_efficiency_config[DATA_SAMPLING].get(
+            DATA_SAMPLING_NUM_EPOCHS, DATA_SAMPLING_NUM_EPOCHS_DEFAULT)
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_rank = data_parallel_rank
+        self.data_parallel_size = data_parallel_size
+        self.micro_batch_times_data_parallel_size = micro_batch_size * data_parallel_size
+        self.gradient_accumulation_steps = gradient_accumulation_steps
+        self.global_batch_size = self.micro_batch_times_data_parallel_size * gradient_accumulation_steps
+        self.global_rank = global_rank
+        self.drop_last = drop_last
+        self.np_rng = np.random.default_rng(data_efficiency_config.get(DATA_EFFICIENCY_SEED,
+                                                                       DATA_EFFICIENCY_SEED_DEFAULT))
+        self.state = {}
+        self.batch = []
+        self.consumed_samples = 0
+        self.curriculum_step = 0
+        self.current_difficulties = {}
+        self.data_cluster = []  # admitted-but-unconsumed sample indices
+        self.data_cluster_sizes = []
+        self.curriculum_schedulers = {}
+        self.curriculum_index_to_sample = {}
+        self.curriculum_index_to_metric = {}
+        self.custom_get_difficulty = {}
+
+        cl_cfg = data_efficiency_config[DATA_SAMPLING].get(CURRICULUM_LEARNING, {})
+        self.curriculum_learning_enabled = cl_cfg.get(CURRICULUM_LEARNING_ENABLED, False)
+        if self.curriculum_learning_enabled:
+            for metric, metric_cfg in cl_cfg[CURRICULUM_LEARNING_METRICS].items():
+                self.curriculum_schedulers[metric] = CurriculumScheduler(metric_cfg)
+                if CURRICULUM_LEARNING_SAMPLE_PATH in metric_cfg:
+                    self.curriculum_index_to_sample[metric] = np.load(
+                        metric_cfg[CURRICULUM_LEARNING_SAMPLE_PATH], allow_pickle=True)
+                if CURRICULUM_LEARNING_METRIC_PATH in metric_cfg:
+                    self.curriculum_index_to_metric[metric] = np.load(
+                        metric_cfg[CURRICULUM_LEARNING_METRIC_PATH], allow_pickle=True)
+                if metric_cfg.get(CURRICULUM_LEARNING_DIFFICULTY_TYPE) == CURRICULUM_LEARNING_PERCENTILE_BASED:
+                    assert metric in self.curriculum_index_to_metric, \
+                        f"percentile-based metric {metric} needs {CURRICULUM_LEARNING_METRIC_PATH}"
+
+    def __len__(self):
+        return self.total_samples
+
+    def set_custom_curriculum_learning_schedule(self, schedule_func_dict):
+        """ref: data_sampler.py:117."""
+        for metric, fn in schedule_func_dict.items():
+            assert metric in self.curriculum_schedulers, f"unknown curriculum metric {metric}"
+            self.curriculum_schedulers[metric].set_custom_get_difficulty(fn)
+
+    # ---------------------------------------------------------- admission
+
+    def get_sample_based_on_metric_value(self, metric, value_start, value_end):
+        """Samples whose metric value ∈ (value_start, value_end]
+        (ref: data_sampler.py:133)."""
+        metric_values = self.curriculum_index_to_metric[metric]
+        mask = (metric_values > value_start) & (metric_values <= value_end)
+        return np.nonzero(mask)[0].astype(self.index_dtype)
+
+    def get_sample_based_on_metric_percentile(self, metric, percentile_start, percentile_end):
+        """Samples in the metric's (start, end] percentile band
+        (ref: data_sampler.py:143)."""
+        metric_values = self.curriculum_index_to_metric[metric]
+        lo = np.quantile(metric_values, max(0.0, percentile_start / 100.0))
+        hi = np.quantile(metric_values, min(1.0, percentile_end / 100.0))
+        mask = (metric_values >= lo if percentile_start <= 0 else metric_values > lo) & (metric_values <= hi)
+        return np.nonzero(mask)[0].astype(self.index_dtype)
+
+    def _admitted_for(self, metric, difficulty, prev_difficulty):
+        cl_cfg = self.data_efficiency_config[DATA_SAMPLING][CURRICULUM_LEARNING]
+        metric_cfg = cl_cfg[CURRICULUM_LEARNING_METRICS][metric]
+        dtype_ = metric_cfg.get(CURRICULUM_LEARNING_DIFFICULTY_TYPE, CURRICULUM_LEARNING_VALUE_BASED)
+        if metric in self.curriculum_index_to_sample and dtype_ == CURRICULUM_LEARNING_VALUE_BASED \
+                and metric not in self.curriculum_index_to_metric:
+            # index_to_sample maps difficulty → sample ids
+            table = self.curriculum_index_to_sample[metric]
+            if isinstance(table, np.ndarray) and table.dtype == object:
+                table = table.item() if table.shape == () else table
+            out = []
+            for d in (table.keys() if isinstance(table, dict) else range(len(table))):
+                if prev_difficulty < d <= difficulty:
+                    out.append(np.asarray(table[d], self.index_dtype))
+            return np.concatenate(out) if out else np.empty((0, ), self.index_dtype)
+        if dtype_ == CURRICULUM_LEARNING_VALUE_BASED:
+            return self.get_sample_based_on_metric_value(metric, prev_difficulty, difficulty)
+        return self.get_sample_based_on_metric_percentile(metric, prev_difficulty, difficulty)
+
+    def get_new_cluster(self, previous_difficulties):
+        """Admit newly-eligible samples: intersection over metrics of each
+        metric's admission set (ref: data_sampler.py:171)."""
+        new_samples = None
+        for metric in self.curriculum_schedulers:
+            difficulty = self.current_difficulties[metric]
+            admitted = self._admitted_for(metric, difficulty, -float("inf"))
+            new_samples = admitted if new_samples is None else np.intersect1d(new_samples, admitted)
+        if new_samples is None:
+            new_samples = np.arange(self.one_epoch_total_samples, dtype=self.index_dtype)
+        # exclude already-admitted
+        already = np.concatenate(self.data_cluster) if self.data_cluster else np.empty((0, ), self.index_dtype)
+        consumed_mask = np.isin(new_samples, already, assume_unique=False)
+        fresh = new_samples[~consumed_mask] if already.size else new_samples
+        if fresh.size:
+            fresh = fresh.copy()
+            self.np_rng.shuffle(fresh)
+            self.data_cluster.append(fresh)
+            self.data_cluster_sizes.append(fresh.size)
+        logger.debug(f"curriculum step {self.curriculum_step}: admitted {fresh.size} new samples")
+
+    # ------------------------------------------------------------ batching
+
+    def get_start_end_idx(self, batch_len=None):
+        """This DP rank's slice bounds within a global micro-batch
+        (ref: data_sampler.py:122)."""
+        n = batch_len if batch_len is not None else self.micro_batch_times_data_parallel_size
+        per_rank = n // self.data_parallel_size
+        start_idx = self.data_parallel_rank * per_rank
+        return start_idx, start_idx + per_rank
+
+    def sample_from_clusters(self):
+        """Draw a global batch round-robin-proportionally from pending
+        clusters (ref: data_sampler.py:232)."""
+        need = self.global_batch_size
+        out = []
+        while need > 0 and self.data_cluster:
+            cluster = self.data_cluster[0]
+            take = min(need, cluster.size)
+            out.append(cluster[:take])
+            rest = cluster[take:]
+            if rest.size:
+                self.data_cluster[0] = rest
+            else:
+                self.data_cluster.pop(0)
+                self.data_cluster_sizes.pop(0)
+            need -= take
+        return np.concatenate(out) if out else np.empty((0, ), self.index_dtype)
+
+    def get_next_global_batch(self):
+        """ref: data_sampler.py:264."""
+        if self.curriculum_learning_enabled:
+            self.curriculum_step += 1
+            previous = dict(self.current_difficulties)
+            changed = False
+            for metric, sched in self.curriculum_schedulers.items():
+                d = sched.update_difficulty(self.curriculum_step)
+                if previous.get(metric) != d:
+                    changed = True
+                self.current_difficulties[metric] = d
+            if changed or not self.data_cluster:
+                self.get_new_cluster(previous)
+            batch = self.sample_from_clusters()
+        else:
+            start = self.consumed_samples % self.one_epoch_total_samples
+            idx = (np.arange(self.global_batch_size, dtype=self.index_dtype) + start) % self.one_epoch_total_samples
+            batch = idx
+        self.consumed_samples += batch.size
+        return batch
+
+    def __iter__(self):
+        while self.consumed_samples <= self.total_samples:
+            batch = self.get_next_global_batch()
+            if batch.size == 0:
+                return
+            # yield per-micro-batch slices for this DP rank
+            for i in range(self.gradient_accumulation_steps):
+                micro = batch[i * self.micro_batch_times_data_parallel_size:(i + 1) *
+                              self.micro_batch_times_data_parallel_size]
+                if micro.size < self.micro_batch_times_data_parallel_size and self.drop_last:
+                    return
+                start_idx, end_idx = self.get_start_end_idx(micro.size)
+                yield micro[start_idx:end_idx].tolist()
+
+    # ---------------------------------------------------------- state io
+
+    def state_dict(self):
+        """ref: data_sampler.py:316."""
+        return {
+            CURRICULUM_LEARNING_BATCH: [c.tolist() for c in self.data_cluster],
+            CURRICULUM_LEARNING_CONSUMED_SAMPLES: self.consumed_samples,
+            CURRICULUM_LEARNING_STEP: self.curriculum_step,
+            CURRICULUM_LEARNING_CURRENT_DIFFICULTIES: dict(self.current_difficulties),
+            CURRICULUM_LEARNING_NP_RNG_STATE: self.np_rng.bit_generator.state,
+        }
+
+    def load_state_dict(self, state_dict):
+        """ref: data_sampler.py:327."""
+        self.data_cluster = [np.asarray(c, self.index_dtype) for c in state_dict[CURRICULUM_LEARNING_BATCH]]
+        self.data_cluster_sizes = [c.size for c in self.data_cluster]
+        self.consumed_samples = state_dict[CURRICULUM_LEARNING_CONSUMED_SAMPLES]
+        self.curriculum_step = state_dict[CURRICULUM_LEARNING_STEP]
+        self.current_difficulties = dict(state_dict[CURRICULUM_LEARNING_CURRENT_DIFFICULTIES])
+        self.np_rng.bit_generator.state = state_dict[CURRICULUM_LEARNING_NP_RNG_STATE]
+        for metric, sched in self.curriculum_schedulers.items():
+            if metric in self.current_difficulties:
+                sched.set_current_difficulty(self.current_difficulties[metric])

@@ -1,0 +1,141 @@
+"""Memory-mapped indexed dataset.
+
+ref: ``deepspeed/runtime/data_pipeline/data_sampling/indexed_dataset.py:369
+MMapIndexedDataset`` — variable-length token sequences stored contiguously
+with an index of (offset, length) per sample, read zero-copy via mmap.
+
+Own on-disk format (NOT the Megatron .bin/.idx layout):
+
+``<path>.bin``   raw sample payloads, concatenated
+``<path>.idx``   header: magic ``DSTPUIDX``, version u32, dtype-code u32,
+                 count u64; then lengths  u32[count], then byte offsets
+                 u64[count].
+
+Reads return numpy views into the mmap (no copy) — feeding a host→device
+transfer directly.  Suits TPU input pipelines: the loader slices fixed
+shapes from the mmap and the engine's jit cache keys on shape.
+"""
+
+import os
+import struct
+from functools import lru_cache
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+
+_DTYPES = {
+    1: np.uint8,
+    2: np.int8,
+    3: np.int16,
+    4: np.int32,
+    5: np.int64,
+    6: np.float32,
+    7: np.float64,
+    8: np.uint16,
+}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix_path):
+    return prefix_path + ".bin"
+
+
+def index_file_path(prefix_path):
+    return prefix_path + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Append samples then ``finalize`` (ref: indexed_dataset.py
+    MMapIndexedDatasetBuilder)."""
+
+    def __init__(self, out_file, dtype=np.int32):
+        self._path = out_file
+        self._data_file = open(data_file_path(out_file), "wb")
+        self._dtype = np.dtype(dtype)
+        self._lengths = []
+        self._offsets = []
+        self._pos = 0
+
+    def add_item(self, tokens):
+        arr = np.asarray(tokens, dtype=self._dtype)
+        self._offsets.append(self._pos)
+        self._lengths.append(arr.size)
+        b = arr.tobytes(order="C")
+        self._data_file.write(b)
+        self._pos += len(b)
+
+    def add_doc(self, tokens, doc_ids=None):
+        self.add_item(tokens)
+
+    def merge_file_(self, another_file):
+        other = MMapIndexedDataset(another_file)
+        for i in range(len(other)):
+            self.add_item(other[i])
+
+    def finalize(self, index_file=None):
+        self._data_file.close()
+        path = index_file or index_file_path(self._path)
+        with open(path, "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<II", _VERSION, _DTYPE_CODES[self._dtype]))
+            f.write(struct.pack("<Q", len(self._lengths)))
+            f.write(np.asarray(self._lengths, np.uint32).tobytes())
+            f.write(np.asarray(self._offsets, np.uint64).tobytes())
+
+
+class MMapIndexedDataset:
+    """Zero-copy reader (ref: indexed_dataset.py:369)."""
+
+    def __init__(self, path, skip_warmup=True):
+        self._path = path
+        with open(index_file_path(path), "rb") as f:
+            magic = f.read(len(_MAGIC))
+            assert magic == _MAGIC, f"bad index magic in {path}: {magic}"
+            version, dtype_code = struct.unpack("<II", f.read(8))
+            assert version == _VERSION
+            (count, ) = struct.unpack("<Q", f.read(8))
+            self._dtype = np.dtype(_DTYPES[dtype_code])
+            self._lengths = np.frombuffer(f.read(4 * count), np.uint32)
+            self._offsets = np.frombuffer(f.read(8 * count), np.uint64)
+        self._bin = np.memmap(data_file_path(path), mode="r", dtype=np.uint8)
+
+    def __len__(self):
+        return len(self._lengths)
+
+    @property
+    def sizes(self):
+        return self._lengths
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @lru_cache(maxsize=8)
+    def __getstate__(self):
+        return self._path
+
+    def __setstate__(self, path):
+        self.__init__(path)
+
+    def get(self, idx, offset=0, length=None):
+        n = int(self._lengths[idx]) - offset
+        if length is not None:
+            n = min(n, length)
+        start = int(self._offsets[idx]) + offset * self._dtype.itemsize
+        nbytes = n * self._dtype.itemsize
+        return np.frombuffer(self._bin[start:start + nbytes], dtype=self._dtype)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return [self.get(i) for i in range(*idx.indices(len(self)))]
+        return self.get(idx)
+
+    @property
+    def supports_prefetch(self):
+        return False
+
+    @staticmethod
+    def exists(path):
+        return os.path.exists(index_file_path(path)) and os.path.exists(data_file_path(path))

@@ -157,6 +157,14 @@ class DeepSpeedEngine:
                                           steps_per_output=config.steps_per_print)
         self.monitor = self._build_monitor()
 
+        # ---- flops profiler (ref: engine.py:300-304 construction,
+        # :2411-2424 step trigger)
+        self.flops_profiler = None
+        if config.flops_profiler_config.enabled:
+            from ..profiling.flops_profiler import FlopsProfiler
+            self.flops_profiler = FlopsProfiler(model=self.module, ds_engine=self,
+                                                recompute_fwd_factor=config.flops_profiler_config.recompute_fwd_factor)
+
         # ---- state (lazy until first batch unless params given)
         self.state: Optional[TrainState] = None
         self.state_shardings = None
@@ -520,11 +528,24 @@ class DeepSpeedEngine:
             micro = [next(data_iter) for _ in range(self.gas)]
             batch = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *micro) if self.gas > 1 else micro[0]
         self._ensure_ready(batch)
+        prof_cfg = self._config.flops_profiler_config
+        profiling_now = (self.flops_profiler is not None and self.global_steps == prof_cfg.profile_step)
+        if profiling_now:
+            self.flops_profiler.start_profile(example_batch=batch)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         self.state, metrics = self._train_step_fn(self.state, batch)
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
+        if profiling_now:
+            jax.block_until_ready(metrics.loss)
+            self.flops_profiler.stop_profile()
+            self.flops_profiler.print_model_profile(profile_step=self.global_steps,
+                                                    module_depth=prof_cfg.module_depth,
+                                                    top_modules=prof_cfg.top_modules,
+                                                    detailed=prof_cfg.detailed,
+                                                    output_file=prof_cfg.output_file)
+            self.flops_profiler.end_profile()
         self.global_steps += 1
         self.global_samples += self._config.train_batch_size
         self._write_monitor(metrics)

@@ -1,0 +1,80 @@
+"""Flops profiler tests (mirrors reference
+tests/unit/profiling/flops_profiler/test_flops_profiler.py: assert measured
+flops within tolerance of the analytic count)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from deepspeed_tpu.profiling.flops_profiler import (FlopsProfiler, flops_to_string, get_model_profile,
+                                                    number_to_string, params_to_string, xla_cost_analysis)
+
+
+def within_range(val, target, tolerance=0.1):
+    if target == 0:
+        return val == 0
+    return abs(val - target) / target < tolerance
+
+
+class TinyMLP(nn.Module):
+    hidden: int = 64
+    out: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.hidden)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.out)(x)
+
+
+def test_xla_cost_analysis_matmul():
+    a = jnp.ones((128, 256), jnp.float32)
+    b = jnp.ones((256, 512), jnp.float32)
+    ca = xla_cost_analysis(lambda x, y: x @ y, a, b)
+    # 2*M*N*K flops
+    assert within_range(ca.get("flops", 0), 2 * 128 * 256 * 512, tolerance=0.05)
+
+
+def test_get_model_profile_mlp():
+    batch, din = 8, 16
+    model = TinyMLP()
+    x = jnp.ones((batch, din), jnp.float32)
+    flops, macs, params = get_model_profile(model, args=(x, ), print_profile=False, as_string=False)
+    expected_params = (din * 64 + 64) + (64 * 32 + 32)
+    assert params == expected_params
+    expected_flops = 2 * batch * (din * 64 + 64 * 32)
+    assert within_range(flops, expected_flops, tolerance=0.25)  # + bias/relu
+    assert macs == flops // 2
+
+
+def test_get_model_profile_strings():
+    model = TinyMLP()
+    x = jnp.ones((4, 16), jnp.float32)
+    flops, macs, params = get_model_profile(model, args=(x, ), print_profile=False, as_string=True)
+    assert "FLOPS" in flops and "MACs" in macs
+
+
+def test_profiler_with_engine(capsys):
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.llama import LlamaForCausalLM
+
+    from tests.unit.simple_model import TINY, base_config, random_batch
+
+    model = LlamaForCausalLM(TINY)
+    config = base_config(flops_profiler={"enabled": True, "profile_step": 1})
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+    assert engine.flops_profiler is not None
+    for _ in range(3):
+        engine.train_batch(batch=random_batch(8, 16))
+    assert engine.flops_profiler.get_total_flops() > 0
+    assert engine.flops_profiler.get_total_params() > 0
+    assert engine.flops_profiler.get_total_duration() > 0
+    assert "Flops Profiler" in capsys.readouterr().out
+
+
+def test_number_formatting():
+    assert number_to_string(1.5e12).startswith("1.50 T")
+    assert flops_to_string(2.0e9) == "2.00 GFLOPS"
+    assert params_to_string(125e6) == "125.00 M"

@@ -1,0 +1,118 @@
+"""Activation-checkpointing tests (mirrors reference
+tests/unit/runtime/activation_checkpointing/test_activation_checkpointing.py:
+checkpointed fwd/bwd must match the uncheckpointed reference numerically)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ckpt
+
+
+@pytest.fixture(autouse=True)
+def _reset_cfg():
+    yield
+    ckpt.reset()
+
+
+def _mlp(w1, w2, x):
+    h = jnp.tanh(x @ w1)
+    return jnp.sum((h @ w2) ** 2)
+
+
+def _inputs(seed=0):
+    rng = np.random.default_rng(seed)
+    w1 = jnp.asarray(rng.normal(size=(16, 32)), jnp.float32)
+    w2 = jnp.asarray(rng.normal(size=(32, 8)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)
+    return w1, w2, x
+
+
+def test_checkpoint_matches_reference_fwd():
+    w1, w2, x = _inputs()
+    ref = _mlp(w1, w2, x)
+    out = ckpt.checkpoint(_mlp, w1, w2, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_checkpoint_matches_reference_bwd():
+    w1, w2, x = _inputs()
+    ref_grads = jax.grad(_mlp, argnums=(0, 1))(w1, w2, x)
+
+    def ckpt_loss(w1, w2, x):
+        return ckpt.checkpoint(_mlp, w1, w2, x)
+
+    grads = jax.grad(ckpt_loss, argnums=(0, 1))(w1, w2, x)
+    for g, r in zip(grads, ref_grads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=1e-5)
+
+
+def test_checkpoint_inside_jit():
+    w1, w2, x = _inputs()
+
+    @jax.jit
+    def step(w1, w2, x):
+        return jax.grad(lambda a, b: ckpt.checkpoint(_mlp, a, b, x), argnums=0)(w1, w2)
+
+    g = step(w1, w2, x)
+    assert g.shape == w1.shape and bool(jnp.isfinite(g).all())
+
+
+def test_configure_and_flags():
+    assert not ckpt.is_configured()
+    ckpt.configure(partition_activations=True, checkpoint_in_cpu=False, num_checkpoints=2)
+    assert ckpt.is_configured()
+    ckpt.reset()
+    assert not ckpt.is_configured()
+
+
+def test_partition_activations_numerics():
+    # with a TP mesh active, partitioned checkpointing must not change values
+    from deepspeed_tpu.comm.mesh import MeshSpec, create_mesh, set_global_mesh
+    mesh = create_mesh(MeshSpec(tensor=2, data=-1))
+    set_global_mesh(mesh)
+    ckpt.configure(partition_activations=True)
+    w1, w2, x = _inputs()
+    ref = _mlp(w1, w2, x)
+    with mesh:
+        out = jax.jit(lambda a, b, c: ckpt.checkpoint(_mlp, a, b, c))(w1, w2, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_cpu_checkpointing_policy_numerics():
+    ckpt.configure(checkpoint_in_cpu=True)
+
+    def fn(w1, w2, x):
+        h = ckpt.checkpoint_name(jnp.tanh(x @ w1))
+        return jnp.sum((h @ w2) ** 2)
+
+    w1, w2, x = _inputs()
+    ref_g = jax.grad(fn)(w1, w2, x)
+    g = jax.grad(lambda a: ckpt.checkpoint(fn, a, w2, x))(w1)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(ref_g), rtol=1e-5)
+
+
+def test_rng_tracker_fork_deterministic():
+    tracker = ckpt.model_parallel_cuda_manual_seed(1234)
+    k1 = tracker.fork()
+    k2 = tracker.fork()
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+    tracker2 = ckpt.model_parallel_cuda_manual_seed(1234)
+    k1b = tracker2.fork()
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(k1b))
+
+
+def test_rng_tracker_duplicate_add_raises():
+    tracker = ckpt.RNGStatesTracker()
+    tracker.add("s", 0)
+    with pytest.raises(Exception):
+        tracker.add("s", 1)
+    with pytest.raises(Exception):
+        tracker.fork("missing")
+
+
+def test_checkpoint_wrapper():
+    w1, w2, x = _inputs()
+    wrapped = ckpt.checkpoint_wrapper(_mlp)
+    np.testing.assert_allclose(np.asarray(wrapped(w1, w2, x)), np.asarray(_mlp(w1, w2, x)), rtol=1e-6)

@@ -1,0 +1,276 @@
+"""Data-efficiency pipeline tests (mirrors reference
+tests/unit/runtime/test_data_efficiency.py semantics)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.data_pipeline.data_routing.basic_layer import (RandomLayerTokenDrop, gather_tokens,
+                                                                          gpt_sample_tokens, scatter_tokens)
+from deepspeed_tpu.runtime.data_pipeline.data_routing.scheduler import RandomLTDScheduler
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_sampler import DeepSpeedDataSampler
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.indexed_dataset import (MMapIndexedDataset,
+                                                                               MMapIndexedDatasetBuilder)
+
+
+# ------------------------------------------------------- curriculum schedule
+
+
+def test_fixed_linear_schedule():
+    sched = CurriculumScheduler({
+        "min_difficulty": 8,
+        "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 10, "difficulty_step": 8},
+    })
+    d0 = sched.update_difficulty(0)
+    assert d0 == 8
+    d_mid = sched.update_difficulty(5)
+    assert 8 <= d_mid <= 64 and d_mid % 8 == 0
+    d_end = sched.update_difficulty(10)
+    assert d_end == 64
+    # monotone
+    assert d0 <= d_mid <= d_end
+
+
+def test_fixed_root_schedule():
+    sched = CurriculumScheduler({
+        "min_difficulty": 2,
+        "max_difficulty": 100,
+        "schedule_type": "fixed_root",
+        "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 2, "root_degree": 2},
+    })
+    assert sched.update_difficulty(100) == 100
+    # sqrt schedule grows faster early
+    assert sched.get_difficulty(25) >= 2 + (100 - 2) // 4 - 2
+
+
+def test_fixed_discrete_schedule():
+    sched = CurriculumScheduler({
+        "min_difficulty": 1,
+        "max_difficulty": 3,
+        "schedule_type": "fixed_discrete",
+        "schedule_config": {"difficulty": [1, 2, 3], "max_step": [5, 10]},
+    })
+    assert sched.get_difficulty(3) == 1
+    assert sched.get_difficulty(7) == 2
+    assert sched.get_difficulty(100) == 3
+
+
+def test_custom_schedule():
+    sched = CurriculumScheduler({
+        "min_difficulty": 1,
+        "max_difficulty": 10,
+        "schedule_type": "custom",
+    })
+    sched.set_custom_get_difficulty(lambda step: min(10, step))
+    assert sched.get_difficulty(4) == 4
+
+
+def test_state_roundtrip():
+    sched = CurriculumScheduler({
+        "min_difficulty": 8,
+        "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 10, "difficulty_step": 8},
+    })
+    sched.update_difficulty(5)
+    state = sched.get_state()
+    sched2 = CurriculumScheduler({
+        "min_difficulty": 8,
+        "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 10, "difficulty_step": 8},
+    })
+    sched2.set_state(state)
+    assert sched2.get_current_difficulty() == sched.get_current_difficulty()
+
+
+# --------------------------------------------------------- indexed dataset
+
+
+def test_mmap_indexed_dataset_roundtrip(tmp_path):
+    path = str(tmp_path / "ds")
+    builder = MMapIndexedDatasetBuilder(path, dtype=np.int32)
+    samples = [np.arange(n, dtype=np.int32) for n in (3, 7, 1, 12)]
+    for s in samples:
+        builder.add_item(s)
+    builder.finalize()
+
+    assert MMapIndexedDataset.exists(path)
+    ds = MMapIndexedDataset(path)
+    assert len(ds) == 4
+    for i, s in enumerate(samples):
+        np.testing.assert_array_equal(ds[i], s)
+    np.testing.assert_array_equal(ds.sizes, [3, 7, 1, 12])
+    # partial read
+    np.testing.assert_array_equal(ds.get(3, offset=2, length=4), np.arange(2, 6, dtype=np.int32))
+
+
+def test_mmap_indexed_dataset_dtypes(tmp_path):
+    path = str(tmp_path / "ds16")
+    builder = MMapIndexedDatasetBuilder(path, dtype=np.uint16)
+    builder.add_item([1, 2, 65535])
+    builder.finalize()
+    ds = MMapIndexedDataset(path)
+    assert ds.dtype == np.uint16
+    np.testing.assert_array_equal(ds[0], np.asarray([1, 2, 65535], np.uint16))
+
+
+# ------------------------------------------------------------ data sampler
+
+
+def _sampler_config(enabled_curriculum, tmp_path=None, n=64):
+    cfg = {
+        "seed": 42,
+        "data_sampling": {
+            "enabled": True,
+            "num_epochs": 2,
+        },
+    }
+    if enabled_curriculum:
+        metric_path = str(tmp_path / "metric.npy")
+        np.save(metric_path, np.arange(n))  # difficulty == index
+        cfg["data_sampling"]["curriculum_learning"] = {
+            "enabled": True,
+            "curriculum_metrics": {
+                "seqlen": {
+                    "index_to_metric_path": metric_path,
+                    "difficulty_type": "value",
+                    "min_difficulty": 8,
+                    "max_difficulty": n,
+                    "schedule_type": "fixed_linear",
+                    "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 8},
+                }
+            },
+        }
+    return cfg
+
+
+def test_sampler_no_curriculum():
+    sampler = DeepSpeedDataSampler(_sampler_config(False), one_epoch_total_samples=64, micro_batch_size=4,
+                                   data_parallel_rank=0, data_parallel_size=2, gradient_accumulation_steps=1)
+    it = iter(sampler)
+    b0 = next(it)
+    assert len(b0) == 4  # micro_batch per rank
+    # deterministic sequential coverage
+    b1 = next(it)
+    assert b0 != b1
+
+
+def test_sampler_rank_slicing():
+    s0 = DeepSpeedDataSampler(_sampler_config(False), 64, 4, data_parallel_rank=0, data_parallel_size=2)
+    s1 = DeepSpeedDataSampler(_sampler_config(False), 64, 4, data_parallel_rank=1, data_parallel_size=2)
+    b0, b1 = next(iter(s0)), next(iter(s1))
+    assert set(b0).isdisjoint(set(b1))
+
+
+def test_sampler_curriculum_admission(tmp_path):
+    cfg = _sampler_config(True, tmp_path, n=64)
+    sampler = DeepSpeedDataSampler(cfg, one_epoch_total_samples=64, micro_batch_size=4,
+                                   data_parallel_rank=0, data_parallel_size=1)
+    batch1 = sampler.get_next_global_batch()
+    # at first step only easy samples (metric ≤ current difficulty) admitted
+    d = sampler.current_difficulties["seqlen"]
+    assert all(v <= d for v in batch1)
+    # difficulty grows
+    for _ in range(5):
+        sampler.get_next_global_batch()
+    assert sampler.current_difficulties["seqlen"] == 64
+
+
+def test_sampler_state_roundtrip(tmp_path):
+    cfg = _sampler_config(True, tmp_path)
+    sampler = DeepSpeedDataSampler(cfg, 64, 4, 0, 1)
+    sampler.get_next_global_batch()
+    sampler.get_next_global_batch()
+    state = sampler.state_dict()
+
+    sampler2 = DeepSpeedDataSampler(cfg, 64, 4, 0, 1)
+    sampler2.load_state_dict(state)
+    assert sampler2.consumed_samples == sampler.consumed_samples
+    assert sampler2.curriculum_step == sampler.curriculum_step
+    np.testing.assert_array_equal(sampler.get_next_global_batch(), sampler2.get_next_global_batch())
+
+
+# ------------------------------------------------------------- random-LTD
+
+
+def _ltd_config(min_v=4, max_v=16):
+    return {
+        "random_ltd_layer_num": 2,
+        "random_ltd_schedule": {
+            "min_value": min_v,
+            "max_value": max_v,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"require_steps": 10, "seq_per_step": 2},
+        },
+        "global_batch_size": 8,
+    }
+
+
+def test_ltd_scheduler_growth():
+    cfg = _ltd_config()
+    sched = RandomLTDScheduler({
+        "total_layer_num": 4,
+        "random_ltd_layer_num": 2,
+        "random_ltd_schedule": cfg["random_ltd_schedule"],
+        "global_batch_size": 8,
+    })
+    assert sched.get_current_seq() == 4
+    sched.update_seq(10)
+    assert sched.get_current_seq() == 16
+    assert sched.state_dict()["consumed_layer_tokens"] > 0
+
+
+def test_gather_scatter_roundtrip():
+    import jax
+    import jax.numpy as jnp
+    rng = jax.random.PRNGKey(0)
+    x = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+    idx, _ = gpt_sample_tokens(rng, 5, 8, 2, 1)
+    assert idx.shape == (1, 2, 5)
+    # sorted per row
+    assert bool((jnp.diff(idx[0], axis=-1) > 0).all())
+    full, part = gather_tokens(x, idx[0])
+    assert part.shape == (2, 5, 4)
+    merged = scatter_tokens(full, part * 0 + 7.0, idx[0])
+    # positions in idx got 7, others unchanged
+    for b in range(2):
+        for s in range(8):
+            expect = 7.0 if s in np.asarray(idx[0][b]) else float(x[b, s, 0])
+            assert float(merged[b, s, 0]) == expect
+
+
+def test_random_layer_token_drop_wrapper():
+    import jax
+    import jax.numpy as jnp
+
+    calls = []
+
+    def layer(h):
+        calls.append(h.shape)
+        return h * 2.0
+
+    sched = RandomLTDScheduler({
+        "total_layer_num": 2,
+        "random_ltd_layer_num": 1,
+        "random_ltd_schedule": {
+            "min_value": 4,
+            "max_value": 8,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"require_steps": 10, "seq_per_step": 2},
+        },
+        "global_batch_size": 8,
+    })
+    wrapper = RandomLayerTokenDrop(layer, layer_id=0)
+    wrapper.random_ltd_scheduler = sched
+    wrapper.random_ltd_num_layer = 1
+    x = jnp.ones((2, 8, 4), jnp.float32)
+    out = wrapper(x, rng=jax.random.PRNGKey(0), training=True)
+    assert out.shape == x.shape
+    assert calls[0] == (2, 4, 4)  # layer saw only reserved tokens
+    # eval mode: no dropping
+    out_eval = wrapper(x, training=False)
+    assert calls[-1] == (2, 8, 4)
+    np.testing.assert_allclose(np.asarray(out_eval), 2.0)
