@@ -1,0 +1,9 @@
+"""Checkpointing: sharded save/load (orbax), universal atom-layout
+interchange (ref: deepspeed/checkpoint/), fp32 consolidation (ref:
+deepspeed/utils/zero_to_fp32.py)."""
+
+from .engine import load_checkpoint, save_checkpoint
+from .ds_to_universal import convert_to_universal, load_universal_atoms
+from .universal import load_universal_checkpoint
+from .zero_to_fp32 import (convert_zero_checkpoint_to_fp32_state_dict, get_fp32_state_dict_from_zero_checkpoint,
+                           load_state_dict_from_zero_checkpoint)

@@ -1,0 +1,179 @@
+"""Universal Checkpoint (UCP) converter.
+
+TPU-native analog of ``deepspeed/checkpoint/ds_to_universal.py``
+(ref: ds_to_universal.py:112 extract_zero_shards, :232 merge_tp_slices).
+
+The reference has to run a multi-pass offline job because its shard files
+(`zero_pp_rank_X_mp_rank_XX_optim_states.pt`) bake the (TP, PP, DP) topology
+into flattened 1-D partitions: extracting a parameter means slicing every
+rank's flat buffer and re-gluing TP slices with pattern-specific cat axes.
+Orbax checkpoints store each parameter as a GLOBAL logical array, so the
+"universal" form here is simply one directory per parameter holding its fp32
+weight + optimizer moments as host numpy files — the same "atom" layout the
+reference produces (`<param>/fp32.pt`, `<param>/exp_avg.pt`, ...), written as
+``.npy``.
+
+Why keep the converter at all (instead of "orbax does it"): the atom layout
+is the reference's *interchange format* — it decouples a checkpoint from
+mesh/stage/dtype/optimizer-partitioning so that a differently-configured run
+(or another framework) can consume it, and it is browsable/editable with
+nothing but numpy.
+
+CLI:  python -m deepspeed_tpu.checkpoint.ds_to_universal \
+          --input_folder ckpts --output_folder ckpts_universal [--tag ...]
+"""
+
+import argparse
+import json
+import os
+import shutil
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+
+# atom file names (same vocabulary as the reference's universal checkpoint)
+FP32_WEIGHT = "fp32"
+EXP_AVG = "exp_avg"
+EXP_AVG_SQ = "exp_avg_sq"
+STEP = "step"
+
+_MOMENT_NAMES = {
+    # optax-style state field → atom name
+    "mu": EXP_AVG,
+    "nu": EXP_AVG_SQ,
+    "m": EXP_AVG,
+    "v": EXP_AVG_SQ,
+    "exp_avg": EXP_AVG,
+    "exp_avg_sq": EXP_AVG_SQ,
+    "momentum": EXP_AVG,
+    "accumulator": EXP_AVG_SQ,  # adagrad
+    "trace": EXP_AVG,
+}
+
+
+def _flatten_with_names(tree, prefix=()) -> Dict[str, np.ndarray]:
+    """Flax param dict → {'layers.0.attention.q.kernel': ndarray}."""
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_with_names(v, prefix + (str(k), )))
+    elif tree is None or (isinstance(tree, (tuple, list)) and len(tree) == 0):
+        pass
+    else:
+        out[".".join(prefix)] = np.asarray(tree)
+    return out
+
+
+def _find_moment_trees(opt_state, param_template: Dict[str, np.ndarray]):
+    """Walk the optimizer state; any dict subtree whose flattened key-set
+    matches the param tree is a per-param moment tree.  NamedTuple fields
+    provide the moment names (mu/nu → exp_avg/exp_avg_sq)."""
+    found = {}  # atom_name -> {param_name: ndarray}
+    pset = set(param_template)
+
+    def visit(node, name_hint):
+        if hasattr(node, "_fields"):
+            for f in node._fields:
+                visit(getattr(node, f), f)
+            return
+        if isinstance(node, (tuple, list)):
+            for x in node:
+                visit(x, name_hint)
+            return
+        if isinstance(node, dict):
+            flat = _flatten_with_names(node)
+            if set(flat) == pset and name_hint in _MOMENT_NAMES:
+                found.setdefault(_MOMENT_NAMES[name_hint], flat)
+                return
+            for k, v in node.items():
+                visit(v, k)
+            return
+
+    visit(opt_state, "")
+    return found
+
+
+def convert_to_universal(input_folder: str,
+                         output_folder: str,
+                         tag: Optional[str] = None) -> str:
+    """Read a deepspeed_tpu checkpoint and write the universal atom layout:
+
+        <output_folder>/<tag>/zero/<param_name>/{fp32,exp_avg,exp_avg_sq}.npy
+        <output_folder>/<tag>/universal_meta.json
+        <output_folder>/latest_universal
+    """
+    import orbax.checkpoint as ocp
+
+    input_folder = os.path.abspath(input_folder)
+    if tag is None:
+        with open(os.path.join(input_folder, "latest")) as f:
+            tag = f.read().strip()
+    src = os.path.join(input_folder, str(tag))
+
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore(os.path.join(src, "state"))
+
+    # master (fp32) weights if present, else params upcast
+    master = state.get("master")
+    weights = _flatten_with_names(master if master is not None else state["params"])
+    weights = {k: v.astype(np.float32) for k, v in weights.items()}
+    moments = _find_moment_trees(state.get("opt_state"), weights)
+
+    dst = os.path.join(os.path.abspath(output_folder), str(tag))
+    zero_dir = os.path.join(dst, "zero")
+    if os.path.exists(zero_dir):
+        shutil.rmtree(zero_dir)
+    os.makedirs(zero_dir, exist_ok=True)
+
+    for pname, w in weights.items():
+        pdir = os.path.join(zero_dir, pname)
+        os.makedirs(pdir, exist_ok=True)
+        np.save(os.path.join(pdir, f"{FP32_WEIGHT}.npy"), w)
+        for atom, tree in moments.items():
+            np.save(os.path.join(pdir, f"{atom}.npy"), np.asarray(tree[pname], np.float32))
+
+    meta = {
+        "tag": str(tag),
+        "step": int(np.asarray(state.get("step", 0))),
+        "param_names": sorted(weights),
+        "atoms": [FP32_WEIGHT] + sorted(moments),
+        "source": src,
+    }
+    src_meta = os.path.join(src, "meta.json")
+    if os.path.exists(src_meta):
+        with open(src_meta) as f:
+            meta["source_meta"] = json.load(f)
+    with open(os.path.join(dst, "universal_meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    with open(os.path.join(os.path.abspath(output_folder), "latest_universal"), "w") as f:
+        f.write(str(tag))
+    logger.info(f"universal checkpoint written: {dst} ({len(weights)} params, atoms={meta['atoms']})")
+    return dst
+
+
+def load_universal_atoms(universal_dir: str) -> Dict[str, Dict[str, np.ndarray]]:
+    """{'param_name': {'fp32': ndarray, 'exp_avg': ..., 'exp_avg_sq': ...}}"""
+    zero_dir = os.path.join(universal_dir, "zero")
+    out = {}
+    for root, _dirs, files in os.walk(zero_dir):
+        npys = [f for f in files if f.endswith(".npy")]
+        if not npys:
+            continue
+        pname = os.path.relpath(root, zero_dir).replace(os.sep, ".")
+        out[pname] = {os.path.splitext(f)[0]: np.load(os.path.join(root, f)) for f in npys}
+    return out
+
+
+def main(args=None):
+    p = argparse.ArgumentParser(description="Convert deepspeed_tpu checkpoint to universal atom layout")
+    p.add_argument("--input_folder", required=True)
+    p.add_argument("--output_folder", required=True)
+    p.add_argument("--tag", default=None)
+    a = p.parse_args(args)
+    convert_to_universal(a.input_folder, a.output_folder, tag=a.tag)
+
+
+if __name__ == "__main__":
+    main()

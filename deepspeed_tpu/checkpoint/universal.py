@@ -1,0 +1,115 @@
+"""Load a universal (atom-layout) checkpoint into a live engine.
+
+Analog of the reference's ``load_universal_checkpoint`` path
+(ref: runtime/engine.py:958, checkpoint/universal_checkpoint.py
+load_hp_checkpoint_state) which maps per-parameter atom files onto each
+rank's local flat fragments via ``utils/tensor_fragment.py``.  Here the
+mapping is: atom (global fp32 ndarray) → `jax.device_put` under the engine's
+current sharding — any mesh/stage/dtype target works, which is the entire
+point of the universal format.
+"""
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..utils.logging import log_dist
+from .ds_to_universal import EXP_AVG, EXP_AVG_SQ, FP32_WEIGHT, _MOMENT_NAMES, load_universal_atoms
+
+
+def _rebuild_tree(template, flat, prefix=(), cast_like=True):
+    if isinstance(template, dict):
+        return {k: _rebuild_tree(v, flat, prefix + (str(k), ), cast_like) for k, v in template.items()}
+    name = ".".join(prefix)
+    val = flat[name]
+    if cast_like:
+        val = np.asarray(val, template.dtype)
+    return val
+
+
+def _replace_moment_trees(opt_state, param_template, atoms, step=None):
+    """Return opt_state with per-param moment subtrees replaced from atoms
+    and scalar step/count fields set to the checkpoint's step (so e.g. Adam
+    bias correction resumes at the right t, not at 1)."""
+    pset = set(param_template)
+
+    def moment_flat(atom_name):
+        return {p: atoms[p][atom_name] for p in atoms if atom_name in atoms[p]}
+
+    def visit(node, name_hint):
+        if hasattr(node, "_fields"):
+            return type(node)(*[visit(getattr(node, f), f) for f in node._fields])
+        if isinstance(node, tuple):
+            return tuple(visit(x, name_hint) for x in node)
+        if isinstance(node, list):
+            return [visit(x, name_hint) for x in node]
+        if isinstance(node, dict):
+            from .ds_to_universal import _flatten_with_names
+            flat = _flatten_with_names(node)
+            if set(flat) == pset and name_hint in _MOMENT_NAMES:
+                wanted = _MOMENT_NAMES[name_hint]
+                source = moment_flat(wanted)
+                if source and set(source) != pset:
+                    missing = sorted(pset - set(source))[:5]
+                    raise ValueError(
+                        f"universal checkpoint '{wanted}' atoms do not cover the engine's "
+                        f"parameters (missing e.g. {missing}); refusing a partial optimizer "
+                        f"restore — pass load_optimizer_states=False to load weights only")
+                if source:
+                    return _rebuild_tree(node, source)
+            return {k: visit(v, k) for k, v in node.items()}
+        if step is not None and name_hint in ("step", "count") and np.ndim(node) == 0:
+            return np.asarray(step, getattr(node, "dtype", np.int32))
+        return node
+
+    return visit(opt_state, "")
+
+
+def load_universal_checkpoint(engine, universal_dir: str, tag: Optional[str] = None,
+                              load_optimizer_states: bool = True):
+    import os
+    universal_dir = os.path.abspath(universal_dir)
+    if os.path.isdir(os.path.join(universal_dir, "zero")):
+        path = universal_dir
+    else:
+        if tag is None:
+            with open(os.path.join(universal_dir, "latest_universal")) as f:
+                tag = f.read().strip()
+        path = os.path.join(universal_dir, str(tag))
+
+    atoms = load_universal_atoms(path)
+    assert engine.state is not None, "materialize engine state first (run a batch or pass params)"
+    import json
+    step = None
+    meta_path = os.path.join(path, "universal_meta.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            step = json.load(f).get("step")
+
+    fp32_flat = {p: a[FP32_WEIGHT] for p, a in atoms.items()}
+    use_master = engine.state.master != ()
+
+    # params in compute dtype
+    host_params = jax.tree.map(lambda x: np.asarray(x), engine.state.params)
+    new_params = _rebuild_tree(host_params, fp32_flat)
+    placed_params = jax.device_put(new_params, engine.state_shardings.params)
+
+    new_master = ()
+    if use_master:
+        host_master = jax.tree.map(lambda x: np.asarray(x), engine.state.master)
+        new_master = jax.device_put(_rebuild_tree(host_master, fp32_flat), engine.state_shardings.master)
+
+    new_opt = engine.state.opt_state
+    if load_optimizer_states:
+        host_opt = jax.tree.map(lambda x: np.asarray(x), engine.state.opt_state)
+        template = fp32_flat  # key set
+        new_opt = _replace_moment_trees(host_opt, template, atoms, step=step)
+        new_opt = jax.device_put(new_opt, engine.state_shardings.opt_state)
+
+    engine.state = engine.state._replace(params=placed_params, master=new_master, opt_state=new_opt)
+    if step is not None:
+        engine.state = engine.state._replace(
+            step=jax.device_put(np.asarray(step, np.int32), engine.state_shardings.step))
+    log_dist(f"loaded universal checkpoint from {path} ({len(atoms)} params)", ranks=[0])
+    return engine

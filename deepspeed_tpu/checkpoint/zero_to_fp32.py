@@ -1,0 +1,93 @@
+"""Consolidate a (possibly sharded) checkpoint into a single fp32 state dict.
+
+TPU-native analog of ``deepspeed/utils/zero_to_fp32.py`` (ref:
+get_fp32_state_dict_from_zero_checkpoint / convert_zero_checkpoint_to_fp32_state_dict).
+The reference stitches per-rank flat ZeRO partitions back into full tensors;
+orbax already stores global arrays, so consolidation is a host-side restore +
+fp32 upcast of the master (or param) tree.
+
+Also usable as a CLI:
+    python -m deepspeed_tpu.checkpoint.zero_to_fp32 <ckpt_dir> <out_file.npz> [--tag t]
+"""
+
+import argparse
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+from .ds_to_universal import _flatten_with_names
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
+                                             tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Return {'dotted.param.name': fp32 ndarray} from the saved master
+    (fp32) weights, falling back to the compute-dtype params upcast."""
+    import orbax.checkpoint as ocp
+
+    checkpoint_dir = os.path.abspath(checkpoint_dir)
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, "latest")
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = os.path.join(checkpoint_dir, str(tag), "state")
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore(path)
+    src = state.get("master") or state["params"]
+    flat = _flatten_with_names(src)
+    return {k: np.asarray(v, np.float32) for k, v in flat.items()}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str,
+                                               output_file: str,
+                                               tag: Optional[str] = None) -> str:
+    """Write the consolidated fp32 state dict to ``output_file``:
+    ``.npz`` (numpy archive) or ``.pt`` (torch.save, loadable by torch users
+    migrating from the reference)."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=tag)
+    if output_file.endswith(".pt") or output_file.endswith(".bin"):
+        import torch
+        torch.save({k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()}, output_file)
+    else:
+        np.savez(output_file, **sd)
+        if not output_file.endswith(".npz"):
+            output_file += ".npz"
+    logger.info(f"consolidated fp32 state dict: {output_file} ({len(sd)} tensors)")
+    return output_file
+
+
+def load_state_dict_from_zero_checkpoint(engine, checkpoint_dir: str, tag: Optional[str] = None):
+    """Load the consolidated fp32 weights into a live engine (ref:
+    zero_to_fp32.load_state_dict_from_zero_checkpoint)."""
+    import jax
+
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=tag)
+
+    def rebuild(tree, prefix=()):
+        if isinstance(tree, dict):
+            return {k: rebuild(v, prefix + (str(k), )) for k, v in tree.items()}
+        name = ".".join(prefix)
+        return sd[name]
+
+    assert engine.state is not None, "materialize engine state first"
+    new_params = rebuild(engine.state.params)
+    cast = jax.tree.map(lambda x, p: np.asarray(x, p.dtype), new_params, engine.state.params)
+    placed = jax.device_put(cast, engine.state_shardings.params)
+    use_master = engine.state.master != ()
+    new_master = jax.device_put(new_params, engine.state_shardings.master) if use_master else ()
+    engine.state = engine.state._replace(params=placed, master=new_master)
+    return engine
+
+
+def main(args=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("--tag", default=None)
+    a = p.parse_args(args)
+    convert_zero_checkpoint_to_fp32_state_dict(a.checkpoint_dir, a.output_file, tag=a.tag)
+
+
+if __name__ == "__main__":
+    main()

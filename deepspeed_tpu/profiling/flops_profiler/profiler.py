@@ -144,9 +144,11 @@ class FlopsProfiler:
             ca = self._engine_cost()
         if ca is None and self.model is not None and self._example_batch is not None:
             model = self.model
+            # init OUTSIDE the analyzed fn: parameter init flops must not
+            # count toward the forward-pass cost
+            variables = model.init(jax.random.PRNGKey(0), self._example_batch)
 
             def apply_fn(batch):
-                variables = model.init(jax.random.PRNGKey(0), batch)
                 return model.apply(variables, batch)
 
             try:

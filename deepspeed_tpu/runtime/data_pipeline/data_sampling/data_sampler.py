@@ -55,6 +55,10 @@ class DeepSpeedDataSampler:
         self.current_difficulties = {}
         self.data_cluster = []  # admitted-but-unconsumed sample indices
         self.data_cluster_sizes = []
+        # every sample id ever admitted (consumed or pending) — admission must
+        # not re-admit consumed ids when difficulty grows, and epoch wrap-around
+        # re-draws from exactly this pool
+        self._ever_admitted = np.zeros(one_epoch_total_samples, dtype=bool)
         self.curriculum_schedulers = {}
         self.curriculum_index_to_sample = {}
         self.curriculum_index_to_metric = {}
@@ -121,9 +125,10 @@ class DeepSpeedDataSampler:
             return self.get_sample_based_on_metric_value(metric, prev_difficulty, difficulty)
         return self.get_sample_based_on_metric_percentile(metric, prev_difficulty, difficulty)
 
-    def get_new_cluster(self, previous_difficulties):
+    def get_new_cluster(self):
         """Admit newly-eligible samples: intersection over metrics of each
-        metric's admission set (ref: data_sampler.py:171)."""
+        metric's admission set, minus everything already admitted (pending OR
+        consumed) (ref: data_sampler.py:171)."""
         new_samples = None
         for metric in self.curriculum_schedulers:
             difficulty = self.current_difficulties[metric]
@@ -131,12 +136,10 @@ class DeepSpeedDataSampler:
             new_samples = admitted if new_samples is None else np.intersect1d(new_samples, admitted)
         if new_samples is None:
             new_samples = np.arange(self.one_epoch_total_samples, dtype=self.index_dtype)
-        # exclude already-admitted
-        already = np.concatenate(self.data_cluster) if self.data_cluster else np.empty((0, ), self.index_dtype)
-        consumed_mask = np.isin(new_samples, already, assume_unique=False)
-        fresh = new_samples[~consumed_mask] if already.size else new_samples
+        fresh = new_samples[~self._ever_admitted[new_samples]]
         if fresh.size:
             fresh = fresh.copy()
+            self._ever_admitted[fresh] = True
             self.np_rng.shuffle(fresh)
             self.data_cluster.append(fresh)
             self.data_cluster_sizes.append(fresh.size)
@@ -155,7 +158,9 @@ class DeepSpeedDataSampler:
     def sample_from_clusters(self):
         """Draw a global batch round-robin-proportionally from pending
         clusters (ref: data_sampler.py:232)."""
-        need = self.global_batch_size
+        return self.sample_from_clusters_n(self.global_batch_size)
+
+    def sample_from_clusters_n(self, need):
         out = []
         while need > 0 and self.data_cluster:
             cluster = self.data_cluster[0]
@@ -182,8 +187,22 @@ class DeepSpeedDataSampler:
                     changed = True
                 self.current_difficulties[metric] = d
             if changed or not self.data_cluster:
-                self.get_new_cluster(previous)
+                self.get_new_cluster()
             batch = self.sample_from_clusters()
+            # epoch wrap-around: when the admitted pool can't fill a global
+            # batch, re-draw (reshuffled) from the pool of already-admitted
+            # samples — the curriculum restricts WHICH samples are eligible,
+            # never the batch size (ref: data_sampler.py epoch reshuffle)
+            while batch.size < self.global_batch_size:
+                pool = np.nonzero(self._ever_admitted)[0].astype(self.index_dtype)
+                if pool.size == 0:
+                    break
+                refill = pool.copy()
+                self.np_rng.shuffle(refill)
+                self.data_cluster.append(refill)
+                self.data_cluster_sizes.append(refill.size)
+                more = self.sample_from_clusters_n(self.global_batch_size - batch.size)
+                batch = np.concatenate([batch, more])
         else:
             start = self.consumed_samples % self.one_epoch_total_samples
             idx = (np.arange(self.global_batch_size, dtype=self.index_dtype) + start) % self.one_epoch_total_samples
@@ -215,6 +234,7 @@ class DeepSpeedDataSampler:
             CURRICULUM_LEARNING_STEP: self.curriculum_step,
             CURRICULUM_LEARNING_CURRENT_DIFFICULTIES: dict(self.current_difficulties),
             CURRICULUM_LEARNING_NP_RNG_STATE: self.np_rng.bit_generator.state,
+            "ever_admitted": np.nonzero(self._ever_admitted)[0].tolist(),
         }
 
     def load_state_dict(self, state_dict):
@@ -225,6 +245,8 @@ class DeepSpeedDataSampler:
         self.curriculum_step = state_dict[CURRICULUM_LEARNING_STEP]
         self.current_difficulties = dict(state_dict[CURRICULUM_LEARNING_CURRENT_DIFFICULTIES])
         self.np_rng.bit_generator.state = state_dict[CURRICULUM_LEARNING_NP_RNG_STATE]
+        self._ever_admitted = np.zeros(self.one_epoch_total_samples, dtype=bool)
+        self._ever_admitted[np.asarray(state_dict.get("ever_admitted", []), dtype=np.int64)] = True
         for metric, sched in self.curriculum_schedulers.items():
             if metric in self.current_difficulties:
                 sched.set_current_difficulty(self.current_difficulties[metric])

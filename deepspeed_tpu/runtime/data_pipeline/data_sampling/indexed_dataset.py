@@ -18,7 +18,7 @@ shapes from the mmap and the engine's jit cache keys on shape.
 
 import os
 import struct
-from functools import lru_cache
+
 
 import numpy as np
 
@@ -112,7 +112,6 @@ class MMapIndexedDataset:
     def dtype(self):
         return self._dtype
 
-    @lru_cache(maxsize=8)
     def __getstate__(self):
         return self._path
 
