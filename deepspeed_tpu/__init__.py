@@ -59,7 +59,15 @@ def initialize(args=None,
     ds_config = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(config, mpu=mpu)
     from .runtime.pipe.engine import PipelineEngine
     from .runtime.pipe.module import PipelineModule
-    engine_cls = PipelineEngine if isinstance(model, PipelineModule) else DeepSpeedEngine
+    if isinstance(model, PipelineModule):
+        engine_cls = PipelineEngine
+    elif ds_config.hybrid_engine.enabled:
+        # RLHF train+generate engine (ref: deepspeed/__init__.py:119 picks
+        # DeepSpeedHybridEngine when config.hybrid_engine.enabled)
+        from .runtime.hybrid_engine import DeepSpeedHybridEngine
+        engine_cls = DeepSpeedHybridEngine
+    else:
+        engine_cls = DeepSpeedEngine
     engine = engine_cls(model=model,
                         config=ds_config,
                         optimizer=optimizer,

@@ -1,0 +1,8 @@
+"""Compression: QAT (weight/activation quantization), pruning (sparse/row/
+head/channel), layer reduction.  ref: deepspeed/compression/."""
+
+from .basic_layer import LinearLayerCompress, QuantAct
+from .compress import (build_compression_fn, init_compression, redundancy_clean, student_initialization)
+from .scheduler import CompressionScheduler
+from .utils import (asym_quantize, binary_quantize, channel_mask_l1, sparse_mask_l1, row_mask_l1, head_mask_l1,
+                    stochastic_round_quantize, sym_quantize, ternary_quantize, topk_mask)

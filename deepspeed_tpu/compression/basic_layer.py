@@ -1,0 +1,69 @@
+"""Compression-aware layers for use inside flax models.
+
+ref: deepspeed/compression/basic_layer.py (QuantAct:17,
+LinearLayer_Compress:121, Embedding_Compress:65).  Weight-side compression
+is functional (compress.build_compression_fn) — these modules cover the
+in-forward pieces: activation quantization with running-range calibration
+and a compress-ready Linear that quantizes activations around the matmul.
+"""
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .utils import asym_quantize, sym_quantize
+
+
+class QuantAct(nn.Module):
+    """Activation quantize-dequantize with momentum range calibration
+    (ref: basic_layer.py:17 QuantAct; ``x_min_max`` running stats).
+
+    State lives in the ``batch_stats`` collection; pass
+    ``deterministic=True`` (eval) to use the frozen range.
+    """
+    num_bits: int = 8
+    act_range_momentum: float = 0.95
+    quantization_type: str = "symmetric"  # symmetric | asymmetric
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = False):
+        rng_min = self.variable("batch_stats", "x_min", lambda: jnp.zeros((), jnp.float32))
+        rng_max = self.variable("batch_stats", "x_max", lambda: jnp.zeros((), jnp.float32))
+        if not deterministic:
+            x_min = jnp.minimum(0.0, x.min()).astype(jnp.float32)
+            x_max = jnp.maximum(0.0, x.max()).astype(jnp.float32)
+            init = (rng_min.value == 0.0) & (rng_max.value == 0.0)
+            m = self.act_range_momentum
+            new_min = jnp.where(init, x_min, rng_min.value * m + x_min * (1 - m))
+            new_max = jnp.where(init, x_max, rng_max.value * m + x_max * (1 - m))
+            if not self.is_initializing():
+                rng_min.value = new_min
+                rng_max.value = new_max
+        else:
+            new_min, new_max = rng_min.value, rng_max.value
+
+        # quantize against the calibrated range: shift+scale into the range,
+        # fixed-levels round, then back (STE inside sym/asym_quantize)
+        if self.quantization_type == "symmetric":
+            bound = jnp.maximum(jnp.abs(new_min), jnp.abs(new_max)) + 1e-12
+            xc = jnp.clip(x, -bound, bound)
+            return sym_quantize(xc, self.num_bits, num_groups=1)
+        xc = jnp.clip(x, new_min, new_max + 1e-12)
+        return asym_quantize(xc, self.num_bits, num_groups=1)
+
+
+class LinearLayerCompress(nn.Module):
+    """Dense with optional activation quantization before/after
+    (ref: basic_layer.py:121 LinearLayer_Compress.forward — weight-side
+    quant/pruning is applied by the engine's compression transform)."""
+    features: int
+    use_bias: bool = True
+    act_quant_bits: Optional[int] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = False):
+        if self.act_quant_bits is not None:
+            x = QuantAct(num_bits=self.act_quant_bits, name="quant_act")(x, deterministic)
+        return nn.Dense(self.features, use_bias=self.use_bias, dtype=self.dtype, name="linear")(x)

@@ -1,0 +1,251 @@
+"""Compression-aware training (QAT, pruning, layer reduction).
+
+TPU-native analog of ``deepspeed/compression/compress.py``
+(init_compression:100, redundancy_clean:148, student_initialization:192)
+and ``basic_layer.py`` (LinearLayer_Compress etc.).
+
+The reference rewrites nn.Modules in place (LinearLayer_Compress wraps each
+targeted Linear and mutates weights in forward, driven by
+compression_scheduler ticking per step).  Functionally in JAX:
+
+    fn = build_compression_fn(compression_dict, abs_params)
+    compressed_params = fn(params, step)        # inside the jitted loss
+
+``fn`` applies, per matched parameter leaf, quantize-dequantize with a
+straight-through estimator and/or magnitude pruning masks.  The schedule
+(enable at ``schedule_offset``, bit decay every doubling
+``quantization_period`` — ref: runtime/quantize.py:136 where
+``q_period <<= 1`` each precision drop) is computed from the traced ``step``
+so no recompilation happens when the schedule advances.
+
+``redundancy_clean`` bakes the masks/quantization permanently into the param
+tree (the reference's fix_*_helpers), for export after compression training.
+"""
+
+import re
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import logger
+from .constants import *  # noqa: F401,F403
+from .utils import (asym_quantize, channel_mask_l1, head_mask_l1, row_mask_l1, sparse_mask_l1, ste,
+                    stochastic_round_quantize, sym_quantize)
+
+
+def _match(path: str, patterns: List[str]) -> bool:
+    for pat in patterns:
+        if pat == "*" or pat in path or re.search(pat, path):
+            return True
+    return False
+
+
+def _param_paths(tree, prefix=()):
+    out = []
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.extend(_param_paths(v, prefix + (str(k), )))
+    else:
+        out.append(".".join(prefix))
+    return out
+
+
+def _groups(method_block) -> List[Tuple[dict, List[str]]]:
+    out = []
+    for _name, g in (method_block.get(DIFFERENT_GROUPS) or {}).items():
+        out.append((g.get(DIFFERENT_GROUPS_PARAMETERS, {}), g.get(DIFFERENT_GROUPS_MODULE_SCOPE, ["*"])))
+    return out
+
+
+def _bits_at(step, offset, start_bits, target_bits, period):
+    """Traced bit schedule: start_bits until offset, then halve every
+    doubling period until target_bits (ref: runtime/quantize.py:134-139)."""
+    s = jnp.maximum(0.0, step.astype(jnp.float32) - offset)
+    k = jnp.floor(jnp.log2(s / max(period, 1) + 1.0))
+    bits = jnp.maximum(float(target_bits), jnp.floor(start_bits * jnp.exp2(-k)))
+    return jnp.where(step >= offset, bits, float(start_bits))
+
+
+class CompressionSpec:
+    """Parsed compression_training dict → per-technique match lists."""
+
+    def __init__(self, compression_dict: Dict[str, Any]):
+        self.raw = compression_dict or {}
+
+    def technique(self, name):
+        blk = self.raw.get(name) or {}
+        shared = blk.get(SHARED_PARAMETERS) or {}
+        if not shared.get(TECHNIQUE_ENABLED, False):
+            return None
+        return shared, _groups(blk)
+
+    @property
+    def any_enabled(self):
+        return any(self.technique(t) is not None
+                   for t in (WEIGHT_QUANTIZATION, SPARSE_PRUNING, ROW_PRUNING, HEAD_PRUNING, CHANNEL_PRUNING))
+
+
+def build_compression_fn(compression_dict: Dict[str, Any], abs_params) -> Any:
+    """Return ``fn(params, step) -> params`` applying all enabled weight
+    techniques, or None if nothing is enabled.  Activation quantization is
+    separate (`QuantAct` module below) since it lives in model forward."""
+    spec = CompressionSpec(compression_dict)
+    if not spec.any_enabled:
+        return None
+    paths = _param_paths(abs_params)
+
+    wq = spec.technique(WEIGHT_QUANTIZATION)
+    sp = spec.technique(SPARSE_PRUNING)
+    rp = spec.technique(ROW_PRUNING)
+    hp = spec.technique(HEAD_PRUNING)
+    cp = spec.technique(CHANNEL_PRUNING)
+
+    # resolve per-path actions once (host side)
+    actions = {}  # path -> list of (kind, cfg)
+    for path in paths:
+        acts = []
+        leaf_name = path.rsplit(".", 1)[-1]
+        is_weight = leaf_name in ("kernel", "embedding", "weight") or leaf_name.endswith("kernel")
+        if not is_weight:
+            continue
+        if wq:
+            shared, groups = wq
+            if shared.get(WQ_QUANTIZE_IN_FORWARD, True):
+                for params_cfg, mods in groups:
+                    if _match(path, mods):
+                        acts.append(("wq", {
+                            "offset": shared.get(TECHNIQUE_SCHEDULE_OFFSET, 0),
+                            "type": shared.get(WQ_QUANTIZATION_TYPE, "symmetric"),
+                            "rounding": shared.get(WQ_ROUNDING, "nearest"),
+                            "groups": shared.get(WQ_GROUPS, 1),
+                            "start": params_cfg.get(WQ_START_BITS, 8),
+                            "target": params_cfg.get(WQ_TARGET_BITS, 8),
+                            "period": params_cfg.get(WQ_PERIOD, 1),
+                        }))
+                        break
+        for kind, tech in (("sp", sp), ("rp", rp), ("cp", cp)):
+            if tech:
+                shared, groups = tech
+                method = shared.get(PRUNE_METHOD, "l1")
+                if method not in ("l1", "topk"):
+                    raise ValueError(f"pruning method {method} not supported")
+                if method == "topk":
+                    logger.warning("topk (learnable-score) pruning approximated by l1 magnitude on TPU")
+                for params_cfg, mods in groups:
+                    if _match(path, mods):
+                        acts.append((kind, {
+                            "offset": shared.get(TECHNIQUE_SCHEDULE_OFFSET, 0),
+                            "ratio": 1.0 - params_cfg.get(PRUNE_DENSE_RATIO, 1.0),
+                        }))
+                        break
+        if hp:
+            shared, groups = hp
+            for params_cfg, mods in groups:
+                if _match(path, mods):
+                    acts.append(("hp", {
+                        "offset": shared.get(TECHNIQUE_SCHEDULE_OFFSET, 0),
+                        "ratio": 1.0 - params_cfg.get(PRUNE_DENSE_RATIO, 1.0),
+                        "num_heads": shared.get(HP_NUM_HEADS, 1),
+                    }))
+                    break
+        if acts:
+            actions[path] = acts
+
+    if not actions:
+        return None
+    logger.info(f"compression: {len(actions)} parameters matched "
+                f"({[t for t in ('wq', 'sp', 'rp', 'hp', 'cp') if any(k == t for a in actions.values() for k, _ in a)]})")
+
+    def apply_leaf(path, w, step):
+        for kind, cfg in actions.get(path, ()):
+            on = step >= cfg["offset"]
+            if kind == "wq":
+                bits = _bits_at(step, cfg["offset"], cfg["start"], cfg["target"], cfg["period"])
+                if cfg.get("rounding") == "stochastic":
+                    # per-step, per-param key derived from the traced step
+                    import zlib
+                    rng = jax.random.fold_in(jax.random.PRNGKey(zlib.crc32(path.encode()) & 0x7FFFFFFF), step)
+                    wq_ = stochastic_round_quantize(w, bits, cfg["groups"], rng)
+                else:
+                    qfn = sym_quantize if cfg["type"] == "symmetric" else asym_quantize
+                    wq_ = qfn(w, bits, num_groups=cfg["groups"])
+                w = jnp.where(on, wq_, w)
+            elif kind == "sp":
+                w = jnp.where(on, w * jax.lax.stop_gradient(sparse_mask_l1(w, cfg["ratio"])), w)
+            elif kind == "rp":
+                w = jnp.where(on, w * jax.lax.stop_gradient(row_mask_l1(w, cfg["ratio"])), w)
+            elif kind == "cp":
+                w = jnp.where(on, w * jax.lax.stop_gradient(channel_mask_l1(w, cfg["ratio"])), w)
+            elif kind == "hp":
+                if w.ndim == 2:
+                    m = head_mask_l1(w, cfg["ratio"], cfg["num_heads"])
+                    w = jnp.where(on, w * jax.lax.stop_gradient(m), w)
+        return w
+
+    def fn(params, step):
+        def walk(tree, prefix=()):
+            if isinstance(tree, dict):
+                return {k: walk(v, prefix + (str(k), )) for k, v in tree.items()}
+            path = ".".join(prefix)
+            return apply_leaf(path, tree, step) if path in actions else tree
+
+        return walk(params)
+
+    return fn
+
+
+# ----------------------------------------------------------- public parity API
+
+
+def init_compression(model_or_engine, deepspeed_config, teacher_model=None, mpu=None):
+    """Attach compression to a live engine (ref: compress.py:100).  For raw
+    flax models just validates the config; the engine picks the transform up
+    from its DeepSpeedConfig at step-build time."""
+    from ..runtime.engine import DeepSpeedEngine
+    if isinstance(model_or_engine, DeepSpeedEngine):
+        eng = model_or_engine
+        eng.enable_compression()
+        return eng
+    return model_or_engine
+
+
+def redundancy_clean(params, compression_dict: Dict[str, Any], final_step: int = 10**9):
+    """Bake masks/quantization into the weights permanently
+    (ref: compress.py:148 redundancy_clean → fix_compression)."""
+    fn = build_compression_fn(compression_dict, jax.eval_shape(lambda: params))
+    if fn is None:
+        return params
+    return jax.jit(fn)(params, jnp.asarray(final_step, jnp.int32))
+
+
+def student_initialization(student_params, teacher_params, deepspeed_config):
+    """Layer-reduction init: copy chosen teacher layers into the student
+    (ref: compress.py:192; config keys layer_reduction.*).
+
+    Works on scan-stacked layer params (leading layer axis, our models) by
+    gathering ``teacher_layer`` indices, and copies ``other_module_name``
+    subtrees verbatim.
+    """
+    from .constants import LR_MODULE_NAME_PREFIX, LR_OTHER_MODULE_NAME, LR_TEACHER_LAYER
+    cfg = deepspeed_config if isinstance(deepspeed_config, dict) else {}
+    lr = (cfg.get("compression_training") or {}).get(LAYER_REDUCTION) or cfg.get(LAYER_REDUCTION) or {}
+    teacher_layer = lr.get(LR_TEACHER_LAYER)
+    assert teacher_layer is not None, "layer_reduction.teacher_layer required"
+    prefix = lr.get(LR_MODULE_NAME_PREFIX, "")
+    other = lr.get(LR_OTHER_MODULE_NAME, [])
+    idx = np.asarray(teacher_layer, np.int32)
+
+    def walk(stu, tea, prefix_path=""):
+        if isinstance(stu, dict):
+            return {k: walk(v, tea[k], f"{prefix_path}.{k}".strip(".")) for k, v in stu.items()}
+        in_layers = prefix == "" or prefix in prefix_path
+        if in_layers and hasattr(tea, "shape") and tea.ndim >= 1 and tea.shape[0] >= idx.size \
+                and stu.shape[0] == idx.size and stu.shape[1:] == tea.shape[1:]:
+            return jnp.take(tea, idx, axis=0)  # stacked-layer gather
+        if stu.shape == tea.shape and (in_layers or _match(prefix_path, other) or not other):
+            return tea
+        return stu
+
+    return walk(student_params, teacher_params)

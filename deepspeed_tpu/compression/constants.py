@@ -1,0 +1,46 @@
+"""Compression config keys (ref: deepspeed/compression/constants.py)."""
+
+WEIGHT_QUANTIZATION = "weight_quantization"
+ACTIVATION_QUANTIZATION = "activation_quantization"
+SPARSE_PRUNING = "sparse_pruning"
+ROW_PRUNING = "row_pruning"
+HEAD_PRUNING = "head_pruning"
+CHANNEL_PRUNING = "channel_pruning"
+LAYER_REDUCTION = "layer_reduction"
+
+SHARED_PARAMETERS = "shared_parameters"
+DIFFERENT_GROUPS = "different_groups"
+
+TECHNIQUE_ENABLED = "enabled"
+TECHNIQUE_SCHEDULE_OFFSET = "schedule_offset"
+TECHNIQUE_SCHEDULE_OFFSET_END = "schedule_offset_end"
+
+DIFFERENT_GROUPS_PARAMETERS = "params"
+DIFFERENT_GROUPS_MODULE_SCOPE = "modules"
+DIFFERENT_GROUPS_RELATED_MODULE_SCOPE = "related_modules"
+
+# weight quantization shared
+WQ_QUANTIZE_IN_FORWARD = "quantize_weight_in_forward"
+WQ_QUANTIZATION_TYPE = "quantization_type"   # symmetric | asymmetric
+WQ_ROUNDING = "rounding"                     # nearest | stochastic
+WQ_GROUPS = "quantize_groups"
+# weight quantization per-group params
+WQ_START_BITS = "start_bits"
+WQ_TARGET_BITS = "target_bits"
+WQ_PERIOD = "quantization_period"
+
+# activation quantization per-group params
+AQ_BITS = "bits"
+AQ_TYPE = "quantization_type"
+AQ_RANGE_CALIBRATION = "range_calibration"   # dynamic | static
+
+# pruning per-group params
+PRUNE_DENSE_RATIO = "dense_ratio"
+PRUNE_METHOD = "method"                      # l1 | topk (l1 supported)
+HP_NUM_HEADS = "num_heads"
+
+# layer reduction
+LR_KEEP_NUMBER_LAYER = "keep_number_layer"
+LR_MODULE_NAME_PREFIX = "module_name_prefix"
+LR_TEACHER_LAYER = "teacher_layer"
+LR_OTHER_MODULE_NAME = "other_module_name"

@@ -1,0 +1,36 @@
+"""Compression scheduler — host-side progress reporting.
+
+ref: deepspeed/compression/scheduler.py (compression_scheduler).  In the
+reference this object mutates layer flags every step; here the schedule is
+compiled into the train step (compress._bits_at / offset gates on the traced
+step), so the scheduler only mirrors what the compiled schedule is doing —
+for logging and for tests asserting schedule math.
+"""
+
+from .compress import CompressionSpec
+from .constants import *  # noqa: F401,F403
+
+
+class CompressionScheduler:
+
+    def __init__(self, compression_dict):
+        self.spec = CompressionSpec(compression_dict)
+        self.training_steps = 0
+
+    def step(self, n: int = 1):
+        self.training_steps += n
+
+    def bits_now(self, start_bits, target_bits, period, offset=0):
+        """Python mirror of compress._bits_at for verification."""
+        import math
+        s = max(0, self.training_steps - offset)
+        k = int(math.floor(math.log2(s / max(period, 1) + 1.0)))
+        bits = max(target_bits, start_bits // (2**k))
+        return bits if self.training_steps >= offset else start_bits
+
+    def enabled(self, technique):
+        t = self.spec.technique(technique)
+        if t is None:
+            return False
+        shared, _ = t
+        return self.training_steps >= shared.get(TECHNIQUE_SCHEDULE_OFFSET, 0)

@@ -1,0 +1,126 @@
+"""Compression primitives: straight-through quantizers and binarizers.
+
+ref: deepspeed/compression/utils.py (TopKBinarizer, SymQuantizer,
+AsymQuantizer, TernaryQuantizer, BinaryQuantizer).  All are implemented as
+pure jnp functions whose backward is the straight-through estimator (STE):
+``x + stop_gradient(f(x) - x)`` — the JAX spelling of the reference's
+``torch.autograd.Function`` with identity backward.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def ste(x, fx):
+    """Straight-through: forward value fx, gradient of x."""
+    return x + jax.lax.stop_gradient(fx - x)
+
+
+def _group_reshape(x, num_groups):
+    flat = x.reshape(num_groups, -1)
+    return flat
+
+
+def sym_quantize(x, num_bits, num_groups: int = 1):
+    """Symmetric uniform quantize-dequantize with STE
+    (ref: utils.py SymQuantizer.forward).  num_bits may be a traced scalar
+    (the schedule decays bits during training)."""
+    shape = x.shape
+    g = _group_reshape(x, num_groups)
+    q_range = jnp.exp2(jnp.asarray(num_bits, jnp.float32)) - 1.0  # 2^b - 1
+    amax = jnp.max(jnp.abs(g), axis=1, keepdims=True) + 1e-12
+    scale = 2.0 * amax / q_range
+    q = jnp.clip(jnp.round(g / scale), -(q_range + 1) / 2, (q_range - 1) / 2) * scale
+    return ste(x, q.reshape(shape))
+
+
+def asym_quantize(x, num_bits, num_groups: int = 1):
+    """Asymmetric (min/max) quantize-dequantize with STE
+    (ref: utils.py AsymQuantizer.forward)."""
+    shape = x.shape
+    g = _group_reshape(x, num_groups)
+    q_range = jnp.exp2(jnp.asarray(num_bits, jnp.float32)) - 1.0
+    mn = jnp.min(g, axis=1, keepdims=True)
+    mx = jnp.max(g, axis=1, keepdims=True)
+    scale = (mx - mn + 1e-12) / q_range
+    q = (jnp.round((g - mn) / scale)) * scale + mn
+    return ste(x, q.reshape(shape))
+
+
+def ternary_quantize(x, num_groups: int = 1):
+    """{-a, 0, +a} per group (ref: utils.py TernaryQuantizer)."""
+    shape = x.shape
+    g = _group_reshape(x, num_groups)
+    thres = 0.7 * jnp.mean(jnp.abs(g), axis=1, keepdims=True)
+    pos = (g > thres).astype(x.dtype)
+    neg = (g < -thres).astype(x.dtype)
+    mask = (jnp.abs(g) > thres).astype(x.dtype)
+    alpha = jnp.sum(jnp.abs(g * mask), axis=1, keepdims=True) / (jnp.sum(mask, axis=1, keepdims=True) + 1e-12)
+    q = alpha * (pos - neg)
+    return ste(x, q.reshape(shape))
+
+
+def binary_quantize(x, num_groups: int = 1):
+    """{-a, +a} per group (ref: utils.py BinaryQuantizer)."""
+    shape = x.shape
+    g = _group_reshape(x, num_groups)
+    alpha = jnp.mean(jnp.abs(g), axis=1, keepdims=True)
+    q = alpha * jnp.sign(g)
+    return ste(x, q.reshape(shape))
+
+
+def stochastic_round_quantize(x, num_bits, num_groups: int, rng):
+    """Symmetric quantization with stochastic rounding (ref: config
+    ``rounding: stochastic``)."""
+    shape = x.shape
+    g = _group_reshape(x, num_groups)
+    q_range = jnp.exp2(jnp.asarray(num_bits, jnp.float32)) - 1.0
+    amax = jnp.max(jnp.abs(g), axis=1, keepdims=True) + 1e-12
+    scale = 2.0 * amax / q_range
+    noise = jax.random.uniform(rng, g.shape) - 0.5
+    q = jnp.clip(jnp.floor(g / scale + 0.5 + noise), -(q_range + 1) / 2, (q_range - 1) / 2) * scale
+    return ste(x, q.reshape(shape))
+
+
+def topk_mask(scores, ratio):
+    """Binary mask keeping the top (1-ratio) fraction by score
+    (ref: utils.py TopKBinarizer: keeps top ``1 - ratio``).  STE against
+    scores when they require grad."""
+    flat = scores.reshape(-1)
+    k = jnp.maximum(1, jnp.round((1.0 - ratio) * flat.size)).astype(jnp.int32)
+    thresh = jnp.sort(flat)[flat.size - k]
+    return (scores >= thresh).astype(scores.dtype)
+
+
+def sparse_mask_l1(w, ratio):
+    """Element mask from |w| (ref: basic_layer.enable_sparse_pruning 'l1')."""
+    return topk_mask(jnp.abs(w), ratio)
+
+
+def row_mask_l1(w, ratio):
+    """Output-dim mask from per-row L1 norm.  Kernel layout is
+    (in, out) — flax Dense — so 'row pruning' (output neurons, ref
+    basic_layer.enable_row_pruning computes norm over dim=1 of torch's
+    (out, in) weight) masks columns of the flax kernel."""
+    norms = jnp.sum(jnp.abs(w), axis=0)
+    return topk_mask(norms, ratio)[None, :]
+
+
+def channel_mask_l1(w, ratio):
+    """Input-dim (channel) mask from per-input-row L1 norm — flax kernel
+    layout (in, out), so channel pruning masks rows (ref:
+    basic_layer.Conv2dLayer_Compress channel pruning semantics)."""
+    norms = jnp.sum(jnp.abs(w), axis=1)
+    return topk_mask(norms, ratio)[:, None]
+
+
+def head_mask_l1(w_o, ratio, num_heads):
+    """Head mask from the attention-output projection's per-head norm
+    (ref: basic_layer head pruning applies to the O matrix; the reference
+    only implements learnable-topk, we score by L1 like row pruning).
+    w_o layout (in=heads*dim, out)."""
+    in_dim = w_o.shape[0]
+    per_head = w_o.reshape(num_heads, in_dim // num_heads, -1)
+    norms = jnp.sum(jnp.abs(per_head), axis=(1, 2))
+    mask = topk_mask(norms, ratio)  # [H]
+    return jnp.repeat(mask, in_dim // num_heads)[:, None]  # [in, 1]

@@ -1,0 +1,9 @@
+"""Optimized linear layers: LoRA + fp8/intX weight quantization.
+
+ref: deepspeed/linear/ (OptimizedLinear:18, LoRAOptimizedLinear:76,
+quantization.py QuantizedParameter).
+"""
+
+from .config import LoRAConfig, QuantizationConfig
+from .optimized_linear import (LoRAOptimizedLinear, OptimizedLinear, fuse_lora, lora_trainable_mask, unfuse_lora)
+from .quantization import QuantizedLinear, QuantizedParameter, dequantize, quantize

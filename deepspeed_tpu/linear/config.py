@@ -1,0 +1,46 @@
+"""Configs for OptimizedLinear / LoRA / quantization.
+
+ref: deepspeed/linear/config.py (LoRAConfig, QuantizationConfig).
+"""
+
+from dataclasses import dataclass, field
+from typing import List
+
+import jax.numpy as jnp
+
+
+@dataclass
+class LoRAConfig:
+    """ref: linear/config.py LoRAConfig.
+
+    lora_r: adapter rank.  lora_alpha: scaling (effective scale alpha/r).
+    base_weight_sharding: the reference shards the frozen base weight over
+    this many ranks and all-gathers per forward; here base weights carry the
+    ZeRO logical axes and GSPMD does the same thing declaratively — the flag
+    toggles that annotation.  offload/offload_ratio: keep frozen base on
+    host memory (streamed in by XLA).  target_mods: module-name suffixes to
+    wrap when converting a model.
+    """
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1
+    offload: bool = False
+    offload_ratio: float = 0.0
+    delay_lora_init: bool = False
+    target_mods: List[str] = field(
+        default_factory=lambda: ["q_proj", "k_proj", "v_proj", "o_proj", "gate_proj", "up_proj", "down_proj"])
+
+
+@dataclass
+class QuantizationConfig:
+    """ref: linear/config.py QuantizationConfig.
+
+    q_bits ∈ {8, 6, 4}; 8 stores jnp.float8_e4m3fn (native TPU fp8) unless
+    q_dtype overrides to int8; 6/4 store block-scaled ints (the reference's
+    fp_quantizer analog — csrc/fp_quantizer).  group_size: elements per
+    scaling group.
+    """
+    q_bits: int = 8
+    mantissa_bits: int = 3
+    group_size: int = 512
+    q_dtype: object = jnp.float8_e4m3fn

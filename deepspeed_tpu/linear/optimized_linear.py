@@ -1,0 +1,149 @@
+"""OptimizedLinear / LoRAOptimizedLinear.
+
+ref: deepspeed/linear/optimized_linear.py (OptimizedLinear dispatching to
+nn.Linear / QuantizedLinear / LoRAOptimizedLinear).
+
+TPU-native differences:
+* base_weight_sharding: the reference manually shards the frozen base weight
+  1-D across ranks and all-gathers in forward (optimized_linear.py:all_gather
+  in forward); here the base kernel carries the ZeRO logical axes
+  ("embed"-style names resolved by module_inject/tp_rules) so GSPMD inserts
+  the same all-gather — enabled whenever lora_config.base_weight_sharding>1.
+* freezing: torch sets requires_grad=False; JAX freezing is an optimizer
+  mask — `lora_trainable_mask(params)` marks lora_* leaves trainable and
+  everything else frozen, consumable by any optimizer's mask arg or
+  optax.masked.
+* fuse/unfuse (used by the RLHF hybrid engine for fast generation,
+  ref: runtime/hybrid_engine.py fuse_lora_weight): pure functions over the
+  param tree.
+"""
+
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from .config import LoRAConfig, QuantizationConfig
+from .quantization import QuantizedLinear, dequantize, quantize
+
+
+def _zero_sharded(init):
+    # logical ZeRO axes on the base weight: tp_rules maps "embed"/"mlp"
+    # logical names onto (data, expert, seq)/tensor mesh axes per zero stage
+    return nn.with_logical_partitioning(init, ("embed", "mlp"))
+
+
+class LoRAOptimizedLinear(nn.Module):
+    """y = x @ W_base(frozen)  +  (alpha/r) * x @ A @ B
+    (ref: optimized_linear.py:LoRAOptimizedLinear.forward)."""
+    output_dim: int
+    bias: bool = False
+    lora_config: Optional[LoRAConfig] = None
+    quantization_config: Optional[QuantizationConfig] = None
+    dtype: Any = jnp.bfloat16
+    kernel_init: Any = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.lora_config or LoRAConfig()
+        assert not self.bias, "bias=True unsupported by LoRAOptimizedLinear (parity with reference)"
+        in_dim = x.shape[-1]
+        r = cfg.lora_r
+        scaling = cfg.lora_alpha / r
+
+        base_init = _zero_sharded(self.kernel_init) if cfg.base_weight_sharding > 1 else self.kernel_init
+        if self.quantization_config is not None:
+            qcfg = self.quantization_config
+
+            def init_q(rng):
+                return quantize(self.kernel_init(rng, (in_dim, self.output_dim), jnp.float32), qcfg)
+
+            rng = self.make_rng("params") if self.has_rng("params") else jax.random.PRNGKey(0)
+            q0, s0 = init_q(rng)
+            qw = self.variable("quant", "base_kernel_q", lambda: q0)
+            sc = self.variable("quant", "base_kernel_scale", lambda: s0)
+            base_w = dequantize(qw.value, sc.value, (in_dim, self.output_dim), self.dtype)
+        else:
+            base_w = self.param("base_kernel", base_init, (in_dim, self.output_dim), jnp.float32)
+            base_w = base_w.astype(self.dtype)
+
+        # kaiming-uniform A, zeros B — standard LoRA init so the adapter
+        # starts as identity (ref: optimized_linear.py init_lora)
+        bound = math.sqrt(6.0 / in_dim)
+        a_init = lambda rng, shape, dtype=jnp.float32: jax.random.uniform(rng, shape, dtype, -bound, bound)
+        lora_a = self.param("lora_a", a_init, (in_dim, r))
+        lora_b = self.param("lora_b", nn.initializers.zeros_init(), (r, self.output_dim), jnp.float32)
+
+        y = x.astype(self.dtype) @ base_w
+        adapter = (x.astype(self.dtype) @ lora_a.astype(self.dtype)) @ lora_b.astype(self.dtype)
+        return y + scaling * adapter
+
+
+class OptimizedLinear(nn.Module):
+    """Dispatching façade (ref: optimized_linear.py:OptimizedLinear.__new__):
+    no configs → plain Dense; lora_config → LoRAOptimizedLinear (quantized
+    base if quantization_config too); only quantization_config →
+    QuantizedLinear."""
+    output_dim: int
+    bias: bool = False
+    lora_config: Optional[LoRAConfig] = None
+    quantization_config: Optional[QuantizationConfig] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        if self.lora_config is None and self.quantization_config is None:
+            return nn.Dense(self.output_dim, use_bias=self.bias, dtype=self.dtype, name="linear")(x)
+        if self.lora_config is not None:
+            return LoRAOptimizedLinear(output_dim=self.output_dim, bias=self.bias,
+                                       lora_config=self.lora_config,
+                                       quantization_config=self.quantization_config,
+                                       dtype=self.dtype, name="lora_linear")(x)
+        return QuantizedLinear(output_dim=self.output_dim, bias=self.bias,
+                               quantization_config=self.quantization_config,
+                               dtype=self.dtype, name="quant_linear")(x)
+
+
+# ----------------------------------------------------------------- utilities
+
+
+def lora_trainable_mask(params) -> Any:
+    """Pytree of bools: True for lora_a/lora_b leaves (trainable), False for
+    everything else (frozen base) — feed to an optimizer mask (the JAX analog
+    of requires_grad=False on base weights)."""
+
+    def walk(tree, path=()):
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k, )) for k, v in tree.items()}
+        return any(p.startswith("lora_") for p in path)
+
+    return walk(params)
+
+
+def fuse_lora(params, lora_config: Optional[LoRAConfig] = None):
+    """Fold each adapter into its base kernel:  W ← W + (alpha/r)(A−bound)B
+    (ref: hybrid_engine fuse_lora_weight → _fuse_lora).  Returns a new tree;
+    `unfuse_lora` reverses it exactly."""
+    return _fuse(params, lora_config or LoRAConfig(), sign=+1.0)
+
+
+def unfuse_lora(params, lora_config: Optional[LoRAConfig] = None):
+    """ref: hybrid_engine unfuse_lora_weight."""
+    return _fuse(params, lora_config or LoRAConfig(), sign=-1.0)
+
+
+def _fuse(params, cfg, sign):
+    def walk(tree):
+        if not isinstance(tree, dict):
+            return tree
+        if "base_kernel" in tree and "lora_a" in tree and "lora_b" in tree:
+            w, a, b = tree["base_kernel"], tree["lora_a"], tree["lora_b"]
+            scaling = cfg.lora_alpha / cfg.lora_r
+            delta = a @ b * scaling
+            return {**tree, "base_kernel": w + sign * delta.astype(w.dtype)}
+        return {k: walk(v) for k, v in tree.items()}
+
+    return walk(params)

@@ -1,0 +1,108 @@
+"""Weight quantization for OptimizedLinear: fp8 (native TPU dtype) and
+block-scaled int4/int6/int8.
+
+ref: deepspeed/linear/quantization.py (QuantizedParameter, QuantizedLinear)
+and csrc/fp_quantizer/ — the reference packs fp6/fp8/fp12 on CUDA; on TPU
+fp8 is a hardware dtype (jnp.float8_e4m3fn), and sub-8-bit formats are
+block-scaled integers produced/consumed by jit-fused quant/dequant (XLA
+fuses the dequant into the consuming matmul, so memory stays quantized).
+"""
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from .config import QuantizationConfig
+
+F8_MAX = 448.0  # float8_e4m3fn finite max
+
+
+def _group(x: jnp.ndarray, group_size: int) -> Tuple[jnp.ndarray, int]:
+    flat = x.reshape(-1)
+    pad = (-flat.size) % group_size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(-1, group_size), pad
+
+
+def quantize(x: jnp.ndarray, cfg: QuantizationConfig) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """→ (q, scales). q has cfg.q_dtype (fp8) or int8 storage for q_bits<8."""
+    g, _pad = _group(x.astype(jnp.float32), cfg.group_size)
+    amax = jnp.max(jnp.abs(g), axis=1, keepdims=True) + 1e-12
+    if cfg.q_bits >= 8 and cfg.q_dtype == jnp.float8_e4m3fn:
+        scale = amax / F8_MAX
+        q = (g / scale).astype(jnp.float8_e4m3fn)
+        return q, scale.astype(jnp.float32)
+    qmax = float(2**(cfg.q_bits - 1) - 1)
+    scale = amax / qmax
+    q = jnp.clip(jnp.round(g / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize(q: jnp.ndarray, scale: jnp.ndarray, shape, dtype=jnp.bfloat16) -> jnp.ndarray:
+    flat = (q.astype(jnp.float32) * scale).reshape(-1)
+    n = int(np.prod(shape))
+    return flat[:n].reshape(shape).astype(dtype)
+
+
+@dataclass
+class QuantizedParameter:
+    """A quantized weight + its scales; `.dequantized()` yields the compute
+    tensor (ref: linear/quantization.py:QuantizedParameter, whose .data
+    round-trips through the fp_quantizer kernels)."""
+    q: jnp.ndarray
+    scale: jnp.ndarray
+    shape: tuple
+    dtype: object = jnp.bfloat16
+    quantization_config: Optional[QuantizationConfig] = None
+
+    @classmethod
+    def from_tensor(cls, x, cfg: Optional[QuantizationConfig] = None, dtype=jnp.bfloat16):
+        cfg = cfg or QuantizationConfig()
+        q, s = quantize(jnp.asarray(x), cfg)
+        return cls(q=q, scale=s, shape=tuple(np.shape(x)), dtype=dtype, quantization_config=cfg)
+
+    def dequantized(self):
+        return dequantize(self.q, self.scale, self.shape, self.dtype)
+
+    @property
+    def nbytes(self):
+        return self.q.size * self.q.dtype.itemsize + self.scale.size * 4
+
+
+class QuantizedLinear(nn.Module):
+    """Linear whose weight is stored quantized and dequantized on the fly
+    inside the matmul (ref: linear/quantization.py:QuantizedLinear).
+
+    The quantized payload lives in the ``quant`` variable collection, the
+    scales alongside it; no full-precision copy exists after init.
+    """
+    output_dim: int
+    bias: bool = False
+    quantization_config: Optional[QuantizationConfig] = None
+    dtype: object = jnp.bfloat16
+    kernel_init: object = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.quantization_config or QuantizationConfig()
+        in_dim = x.shape[-1]
+
+        def init_q(rng):
+            w = self.kernel_init(rng, (in_dim, self.output_dim), jnp.float32)
+            return quantize(w, cfg)
+
+        rng = self.make_rng("params") if self.has_rng("params") else jax.random.PRNGKey(0)
+        q_init, s_init = init_q(rng)
+        qw = self.variable("quant", "kernel_q", lambda: q_init)
+        sc = self.variable("quant", "kernel_scale", lambda: s_init)
+        w = dequantize(qw.value, sc.value, (in_dim, self.output_dim), self.dtype)
+        y = x.astype(self.dtype) @ w
+        if self.bias:
+            b = self.param("bias", nn.initializers.zeros_init(), (self.output_dim, ), self.dtype)
+            y = y + b
+        return y

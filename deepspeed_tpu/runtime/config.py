@@ -289,6 +289,16 @@ class TensorParallelConfig(DeepSpeedConfigModel):
     tp_grain_size: int = 1
 
 
+class HybridEngineConfig(DeepSpeedConfigModel):
+    """RLHF train+generate engine block (ref: runtime/config.py:548)."""
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
 class PipelineConfig(DeepSpeedConfigModel):
     """Pipeline engine knobs (ref: runtime/pipe/module.py + engine)."""
     stages: int = Field(1, ge=1)
@@ -400,6 +410,7 @@ class DeepSpeedConfig:
             comet=CometConfig(**pd.get(COMET, {})),
         )
         self.checkpoint_config = CheckpointConfig(**pd.get(CHECKPOINT, {}))
+        self.hybrid_engine = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.aio_config = AIOConfig(**pd.get(AIO, {}))
         self.elasticity_config = ElasticityConfig(**pd.get(ELASTICITY, {}))
         self.compression_config = CompressionConfig(**pd.get(COMPRESSION_TRAINING, {}))

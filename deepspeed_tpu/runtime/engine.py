@@ -165,6 +165,11 @@ class DeepSpeedEngine:
             self.flops_profiler = FlopsProfiler(model=self.module, ds_engine=self,
                                                 recompute_fwd_factor=config.flops_profiler_config.recompute_fwd_factor)
 
+        # ---- compression-aware training (ref: compression/compress.py
+        # init_compression; applied as a param transform inside the loss)
+        self._compression_fn = None
+        self._compression_requested = bool(config._param_dict.get("compression_training"))
+
         # ---- state (lazy until first batch unless params given)
         self.state: Optional[TrainState] = None
         self.state_shardings = None
@@ -375,10 +380,26 @@ class DeepSpeedEngine:
 
         return jax.tree.map(one, batch)
 
-    def _microbatch_loss(self, params, mb):
+    def _microbatch_loss(self, params, mb, step=None):
+        if self._compression_fn is not None and step is not None:
+            params = self._compression_fn(params, step)
         args, kwargs = self.model_inputs_fn(mb)
         outputs = self.module.apply({"params": params}, *args, **kwargs)
         return self.loss_fn(outputs, mb)
+
+    def enable_compression(self):
+        """Build the compression transform from config (ref:
+        compression/compress.py:100 init_compression)."""
+        self._compression_requested = True
+        self._step_key = None  # force step rebuild
+        if self.state is not None:
+            self._build_compression()
+
+    def _build_compression(self):
+        from ..compression.compress import build_compression_fn
+        comp_dict = self._config._param_dict.get("compression_training", {})
+        abs_params = jax.eval_shape(lambda: self.state.params)
+        self._compression_fn = build_compression_fn(comp_dict, abs_params)
 
     def _grads_for_batch(self, state, batch):
         """Accumulated (summed) scaled grads + mean loss over the GAS axis.
@@ -391,7 +412,7 @@ class DeepSpeedEngine:
         scale = state.scaler.cur_scale
 
         def scaled_loss(p, mb):
-            loss = self._microbatch_loss(p, mb)
+            loss = self._microbatch_loss(p, mb, step=state.step)
             return (loss * scale).astype(jnp.float32), loss
 
         grad_fn = jax.grad(scaled_loss, has_aux=True)
@@ -485,7 +506,7 @@ class DeepSpeedEngine:
             scale = state.scaler.cur_scale
 
             def scaled_loss(p, mb):
-                loss = self._microbatch_loss(p, mb)
+                loss = self._microbatch_loss(p, mb, step=state.step)
                 return (loss * scale).astype(jnp.float32), loss
 
             grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params, b)
@@ -508,6 +529,9 @@ class DeepSpeedEngine:
     def _ensure_ready(self, batch):
         if self.state is None:
             self._materialize_state(batch=batch)
+        if self._compression_requested and self._compression_fn is None:
+            self._build_compression()
+            self._compression_requested = False
         # compiled fns are keyed by batch structure: a malformed batch fails
         # cleanly without poisoning the cache, and changing batch shapes
         # (e.g. curriculum seq-len growth) triggers a fresh compile
@@ -555,7 +579,7 @@ class DeepSpeedEngine:
     def _build_eval_fn(self):
         if self._eval_fn is None:
             def eval_loss(state, b):
-                return self._microbatch_loss(state.params, b)
+                return self._microbatch_loss(state.params, b, step=state.step)
             self._eval_fn = jax.jit(eval_loss, in_shardings=(self.state_shardings, self._batch_shardings))
         return self._eval_fn
 

@@ -1,0 +1,185 @@
+"""Compression tests (analog of reference tests/unit/compression/
+test_compression.py — quantizer math, pruning masks, QAT training, layer
+reduction)."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.compression import (CompressionScheduler, QuantAct, build_compression_fn, redundancy_clean,
+                                       row_mask_l1, sparse_mask_l1, student_initialization, sym_quantize,
+                                       asym_quantize, ternary_quantize, binary_quantize, topk_mask)
+from deepspeed_tpu.models.llama import LlamaForCausalLM
+
+from simple_model import TINY, base_config, random_batch
+
+
+# ---------------------------------------------------------------- primitives
+
+
+def test_sym_quantize_levels_and_ste():
+    x = jnp.linspace(-1, 1, 64).reshape(1, -1)
+    q = sym_quantize(x, 4, num_groups=1)
+    assert len(np.unique(np.asarray(q).round(6))) <= 16
+    # STE: gradient passes through unchanged
+    g = jax.grad(lambda t: sym_quantize(t, 4).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+def test_asym_quantize_range():
+    x = jax.random.uniform(jax.random.PRNGKey(0), (4, 64), minval=2.0, maxval=3.0)
+    q = asym_quantize(x, 8, num_groups=4)
+    assert float(jnp.abs(q - x).max()) < (3.0 - 2.0) / 255 + 1e-5
+
+
+def test_ternary_binary():
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 128))
+    t = ternary_quantize(x)
+    assert len(np.unique(np.asarray(t[0]).round(6))) <= 3
+    b = binary_quantize(x)
+    assert len(np.unique(np.abs(np.asarray(b[0])).round(6))) == 1
+
+
+def test_topk_and_masks():
+    w = jnp.asarray(np.arange(100, dtype=np.float32).reshape(10, 10))
+    m = topk_mask(w, ratio=0.7)  # keep top 30%
+    assert int(m.sum()) == 30
+    sm = sparse_mask_l1(w, 0.5)
+    assert int(sm.sum()) == 50
+    rm = row_mask_l1(w, 0.5)
+    assert rm.shape == (1, 10) and int(rm.sum()) == 5
+
+
+# ------------------------------------------------------------- transform
+
+
+WQ_CONFIG = {
+    "weight_quantization": {
+        "shared_parameters": {"enabled": True, "quantize_weight_in_forward": True,
+                              "quantization_type": "symmetric", "quantize_groups": 1,
+                              "schedule_offset": 0},
+        "different_groups": {"wq1": {"params": {"start_bits": 8, "target_bits": 4,
+                                                "quantization_period": 10},
+                                     "modules": ["*"]}},
+    },
+}
+
+
+def test_build_compression_fn_quantizes():
+    params = {"layer": {"kernel": jax.random.normal(jax.random.PRNGKey(0), (16, 16)),
+                        "bias": jnp.zeros((16, ))}}
+    fn = build_compression_fn(WQ_CONFIG, jax.eval_shape(lambda: params))
+    out = fn(params, jnp.asarray(0, jnp.int32))
+    # kernel quantized at 8 bits, bias untouched
+    assert not np.allclose(np.asarray(out["layer"]["kernel"]), np.asarray(params["layer"]["kernel"]))
+    np.testing.assert_array_equal(np.asarray(out["layer"]["bias"]), 0.0)
+    # late step → 4 bits → coarser
+    out4 = fn(params, jnp.asarray(1000, jnp.int32))
+    n8 = len(np.unique(np.asarray(out["layer"]["kernel"])))
+    n4 = len(np.unique(np.asarray(out4["layer"]["kernel"])))
+    assert n4 < n8
+
+
+def test_pruning_transform_and_redundancy_clean():
+    cfg = {
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 5, "method": "l1"},
+            "different_groups": {"sp1": {"params": {"dense_ratio": 0.5}, "modules": ["*"]}},
+        },
+    }
+    params = {"l": {"kernel": jax.random.normal(jax.random.PRNGKey(2), (8, 8))}}
+    fn = build_compression_fn(cfg, jax.eval_shape(lambda: params))
+    before = fn(params, jnp.asarray(0, jnp.int32))  # before offset: untouched
+    np.testing.assert_array_equal(np.asarray(before["l"]["kernel"]), np.asarray(params["l"]["kernel"]))
+    after = fn(params, jnp.asarray(5, jnp.int32))
+    assert (np.asarray(after["l"]["kernel"]) == 0).sum() == 32  # half pruned
+
+    cleaned = redundancy_clean(params, cfg)
+    assert (np.asarray(cleaned["l"]["kernel"]) == 0).sum() == 32
+
+
+def test_channel_pruning_nonsquare():
+    cfg = {
+        "channel_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0, "method": "l1"},
+            "different_groups": {"cp1": {"params": {"dense_ratio": 0.5}, "modules": ["*"]}},
+        },
+    }
+    params = {"l": {"kernel": jax.random.normal(jax.random.PRNGKey(4), (8, 16))}}
+    fn = build_compression_fn(cfg, jax.eval_shape(lambda: params))
+    out = np.asarray(fn(params, jnp.asarray(0, jnp.int32))["l"]["kernel"])
+    zero_rows = (out == 0).all(axis=1).sum()  # input-channel rows pruned
+    assert zero_rows == 4
+
+
+def test_stochastic_rounding_path():
+    cfg = {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "quantize_weight_in_forward": True,
+                                  "quantization_type": "symmetric", "rounding": "stochastic",
+                                  "quantize_groups": 1, "schedule_offset": 0},
+            "different_groups": {"wq1": {"params": {"start_bits": 4, "target_bits": 4,
+                                                    "quantization_period": 10}, "modules": ["*"]}},
+        },
+    }
+    params = {"l": {"kernel": jax.random.normal(jax.random.PRNGKey(5), (16, 16))}}
+    fn = jax.jit(build_compression_fn(cfg, jax.eval_shape(lambda: params)))
+    a = np.asarray(fn(params, jnp.asarray(1, jnp.int32))["l"]["kernel"])
+    b = np.asarray(fn(params, jnp.asarray(2, jnp.int32))["l"]["kernel"])
+    assert not np.array_equal(a, b)  # noise differs per step
+    a2 = np.asarray(fn(params, jnp.asarray(1, jnp.int32))["l"]["kernel"])
+    np.testing.assert_array_equal(a, a2)  # but deterministic per step
+
+
+def test_scheduler_bits_mirror():
+    s = CompressionScheduler(WQ_CONFIG)
+    assert s.bits_now(8, 4, period=10) == 8
+    s.step(10)
+    assert s.bits_now(8, 4, period=10) == 4  # 8 // 2
+    s.training_steps = 10**6
+    assert s.bits_now(8, 4, period=10) == 4  # floored at target
+
+
+def test_quant_act_calibration():
+    qa = QuantAct(num_bits=8)
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 32))
+    v = qa.init(jax.random.PRNGKey(0), x)
+    y, mut = qa.apply(v, x, mutable=["batch_stats"])
+    assert float(jnp.abs(y - x).max()) < 0.05
+    assert float(mut["batch_stats"]["x_max"]) > 0
+
+
+# ------------------------------------------------------------ engine QAT
+
+
+def test_engine_trains_with_compression():
+    cfg = base_config(**{"compression_training": WQ_CONFIG})
+    engine, _, _, _ = ds.initialize(model=LlamaForCausalLM(TINY), config=cfg)
+    batch = random_batch()
+    l0 = float(engine.train_batch(batch=batch))
+    for _ in range(10):
+        l1 = float(engine.train_batch(batch=batch))
+    assert l1 < l0  # QAT still learns
+    assert engine._compression_fn is not None
+
+
+# -------------------------------------------------------- layer reduction
+
+
+def test_student_initialization_stacked_layers():
+    tea = {"model": {"layers": {"kernel": jnp.arange(40, dtype=jnp.float32).reshape(4, 10)}},
+           "head": {"kernel": jnp.ones((10, ))}}
+    stu = {"model": {"layers": {"kernel": jnp.zeros((2, 10))}},
+           "head": {"kernel": jnp.zeros((10, ))}}
+    cfg = {"compression_training": {"layer_reduction": {
+        "enabled": True, "keep_number_layer": 2, "module_name_prefix": "model.layers",
+        "teacher_layer": [1, 3], "other_module_name": ["head"]}}}
+    out = student_initialization(stu, tea, cfg)
+    np.testing.assert_array_equal(np.asarray(out["model"]["layers"]["kernel"]),
+                                  np.asarray(tea["model"]["layers"]["kernel"])[[1, 3]])
+    np.testing.assert_array_equal(np.asarray(out["head"]["kernel"]), 1.0)

@@ -1,0 +1,101 @@
+"""OptimizedLinear / LoRA / quantization tests (analog of the reference's
+tests/unit/linear/test_linear.py + test_quant_param.py)."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.linear import (LoRAConfig, LoRAOptimizedLinear, OptimizedLinear, QuantizationConfig,
+                                  QuantizedLinear, QuantizedParameter, fuse_lora, lora_trainable_mask,
+                                  quantize, dequantize, unfuse_lora)
+
+
+def test_plain_dispatch():
+    m = OptimizedLinear(output_dim=32)
+    x = jnp.ones((4, 16), jnp.bfloat16)
+    v = m.init(jax.random.PRNGKey(0), x)
+    assert "linear" in v["params"]
+    assert m.apply(v, x).shape == (4, 32)
+
+
+@pytest.mark.parametrize("q_bits", [8, 6, 4])
+def test_quantize_roundtrip(q_bits):
+    cfg = QuantizationConfig(q_bits=q_bits, group_size=64)
+    if q_bits < 8:
+        cfg.q_dtype = jnp.int8
+    x = jax.random.normal(jax.random.PRNGKey(0), (128, 64), jnp.float32)
+    q, s = quantize(x, cfg)
+    back = dequantize(q, s, x.shape, jnp.float32)
+    err = float(jnp.abs(back - x).max() / jnp.abs(x).max())
+    tol = {8: 0.05, 6: 0.08, 4: 0.2}[q_bits]
+    assert err < tol, f"{q_bits}-bit roundtrip error {err}"
+
+
+def test_quantized_param_bytes():
+    x = jax.random.normal(jax.random.PRNGKey(1), (256, 256))
+    qp = QuantizedParameter.from_tensor(x, QuantizationConfig(q_bits=8, group_size=256))
+    assert qp.nbytes < x.size * 2  # less than bf16 copy
+    d = qp.dequantized()
+    assert d.shape == x.shape and d.dtype == jnp.bfloat16
+
+
+def test_quantized_linear_close_to_dense():
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, 64), jnp.float32)
+    m = QuantizedLinear(output_dim=32, quantization_config=QuantizationConfig(group_size=64))
+    v = m.init(jax.random.PRNGKey(3), x)
+    assert "quant" in v  # no fp copy of the weight exists
+    y = m.apply(v, x)
+    assert y.shape == (8, 32) and jnp.isfinite(y).all()
+
+
+def test_lora_starts_as_identity_and_trains():
+    cfg = LoRAConfig(lora_r=4, lora_alpha=8)
+    m = LoRAOptimizedLinear(output_dim=32, lora_config=cfg, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(4), (8, 16), jnp.float32)
+    v = m.init(jax.random.PRNGKey(5), x)
+    # B=0 → adapter contributes nothing at init
+    base_only = x @ v["params"]["base_kernel"]
+    np.testing.assert_allclose(np.asarray(m.apply(v, x)), np.asarray(base_only), rtol=1e-5)
+
+    mask = lora_trainable_mask(v["params"])
+    assert mask["lora_a"] and mask["lora_b"] and not mask["base_kernel"]
+
+    def loss(p):
+        return (m.apply({"params": p}, x)**2).mean()
+
+    g = jax.grad(loss)(v["params"])
+    assert float(jnp.abs(g["lora_a"]).sum()) >= 0  # lora_b grad nonzero, lora_a zero at init (B=0)
+    assert float(jnp.abs(g["lora_b"]).sum()) > 0
+
+
+def test_fuse_unfuse_roundtrip():
+    cfg = LoRAConfig(lora_r=4, lora_alpha=8)
+    m = LoRAOptimizedLinear(output_dim=32, lora_config=cfg, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(6), (8, 16), jnp.float32)
+    v = m.init(jax.random.PRNGKey(7), x)
+    p = v["params"]
+    p = {**p, "lora_b": jax.random.normal(jax.random.PRNGKey(8), p["lora_b"].shape) * 0.1}
+
+    fused = fuse_lora(p, cfg)
+    # fused base alone == full lora forward
+    y_lora = np.asarray(m.apply({"params": p}, x))
+    y_fused = np.asarray(x @ fused["base_kernel"])
+    np.testing.assert_allclose(y_fused, y_lora, rtol=1e-4, atol=1e-5)
+
+    back = unfuse_lora(fused, cfg)
+    np.testing.assert_allclose(np.asarray(back["base_kernel"]), np.asarray(p["base_kernel"]), atol=1e-5)
+
+
+def test_quantized_lora_base():
+    cfg = LoRAConfig(lora_r=4)
+    qcfg = QuantizationConfig(q_bits=8, group_size=64)
+    m = LoRAOptimizedLinear(output_dim=32, lora_config=cfg, quantization_config=qcfg)
+    x = jax.random.normal(jax.random.PRNGKey(9), (4, 64), jnp.float32)
+    v = m.init(jax.random.PRNGKey(10), x)
+    assert "base_kernel_q" in v["quant"]
+    assert "base_kernel" not in v["params"]  # no fp base weight
+    assert m.apply(v, x).shape == (4, 32)
