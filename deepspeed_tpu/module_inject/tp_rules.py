@@ -78,11 +78,12 @@ def logical_to_sharding(logical_spec_tree, mesh: Mesh, rules: Rules):
     return jax.tree.map(convert, logical_spec_tree, is_leaf=lambda x: isinstance(x, P))
 
 
-def param_shardings(abs_boxed_variables, mesh: Mesh, zero_stage: int):
+def param_shardings(abs_boxed_variables, mesh: Mesh, zero_stage: int, fsdp_axes: Sequence[str] = ZERO_AXES):
     """NamedShardings for a flax variables pytree carrying ``nn.Partitioned``
     metadata (from nn.with_logical_partitioning).  Returns a tree with the
     UNBOXED structure (P leaves where boxes were), suitable as jit
-    out_shardings for an init that applies ``nn.meta.unbox``."""
+    out_shardings for an init that applies ``nn.meta.unbox``.
+    ``fsdp_axes`` restricts the ZeRO-3 partition group (MiCS/hpZ)."""
     logical = nn.get_partition_spec(abs_boxed_variables)
-    rules = make_logical_rules(zero_stage, mesh)
+    rules = make_logical_rules(zero_stage, mesh, fsdp_axes=fsdp_axes)
     return logical_to_sharding(logical, mesh, rules)

@@ -1,0 +1,7 @@
+"""Block-sparse attention (ref: deepspeed/ops/sparse_attention/)."""
+
+from .sparse_attention_utils import extend_position_embedding, pad_to_block_size, unpad_sequence_output
+from .sparse_self_attention import SparseSelfAttention, sparse_attention
+from .sparsity_config import (BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+                              FixedSparsityConfig, LocalSlidingWindowSparsityConfig, SparsityConfig,
+                              VariableSparsityConfig, make_sparsity_config)

@@ -1,0 +1,48 @@
+"""Helpers for integrating sparse attention into models.
+
+ref: deepspeed/ops/sparse_attention/sparse_attention_utils.py
+(SparseAttentionUtils: pad_to_block_size, unpad_sequence_output,
+extend_position_embedding, update_tokenizer_model_max_length,
+replace_model_self_attention_with_sparse_self_attention).
+"""
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def pad_to_block_size(block: int, input_ids, attention_mask=None, token_type_ids=None,
+                      position_ids=None, inputs_embeds=None, pad_token_id: int = 0):
+    """Right-pad sequence tensors to a multiple of the block size
+    (ref: sparse_attention_utils.py pad_to_block_size).  Returns
+    (pad_len, padded tensors…) — mirror the reference's tuple contract."""
+    ref = input_ids if input_ids is not None else inputs_embeds
+    seq_len = ref.shape[1]
+    pad_len = (-seq_len) % block
+
+    def pad(x, value=0):
+        if x is None or pad_len == 0:
+            return x
+        cfg = [(0, 0), (0, pad_len)] + [(0, 0)] * (x.ndim - 2)
+        return jnp.pad(x, cfg, constant_values=value)
+
+    return (pad_len, pad(input_ids, pad_token_id), pad(attention_mask), pad(token_type_ids),
+            pad(position_ids), pad(inputs_embeds))
+
+
+def unpad_sequence_output(pad_len: int, sequence_output):
+    """ref: sparse_attention_utils.py unpad_sequence_output."""
+    if pad_len == 0:
+        return sequence_output
+    return sequence_output[:, :-pad_len]
+
+
+def extend_position_embedding(pos_embedding: jnp.ndarray, max_position: int):
+    """Tile learned position embeddings to a longer context
+    (ref: sparse_attention_utils.py extend_position_embedding)."""
+    cur = pos_embedding.shape[0]
+    if max_position <= cur:
+        return pos_embedding[:max_position]
+    reps = int(np.ceil(max_position / cur))
+    return jnp.tile(pos_embedding, (reps, 1))[:max_position]

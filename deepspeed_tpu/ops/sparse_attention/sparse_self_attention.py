@@ -1,0 +1,124 @@
+"""Block-sparse self-attention kernel (gather-based, XLA/MXU-friendly).
+
+ref: deepspeed/ops/sparse_attention/sparse_self_attention.py +
+matmul.py/softmax.py (Triton block-sparse sdd/dsd matmuls).  The Triton
+design materializes only nonzero blocks of QK^T.  The TPU-native analog:
+for each (head, query-block-row) we GATHER the active key/value blocks
+given by the static layout, run a dense [block × L·block] attention on the
+gathered slab, and scatter nothing back (output is dense).  Compute and
+memory scale with the number of active blocks L, not sequence length —
+the same asymptotics as the Triton kernels, but expressed as static gathers
++ batched matmuls that XLA tiles onto the MXU.
+
+All index maps are static numpy derived from the layout, so jit sees fixed
+shapes; per-head layouts with different occupancy are padded to the max
+row occupancy L_max (padded blocks are masked to -inf before softmax).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sparsity_config import SparsityConfig
+
+
+def _row_gather_maps(layout: np.ndarray):
+    """layout [H, nb, nb] → cols [H, nb, L] int32 (active col-block ids,
+    padded with 0), valid [H, nb, L] bool."""
+    H, nb, _ = layout.shape
+    occ = layout.sum(-1).max()
+    L = max(int(occ), 1)
+    cols = np.zeros((H, nb, L), np.int32)
+    valid = np.zeros((H, nb, L), bool)
+    for h in range(H):
+        for r in range(nb):
+            c = np.nonzero(layout[h, r])[0]
+            cols[h, r, :c.size] = c
+            valid[h, r, :c.size] = True
+    return cols, valid
+
+
+def sparse_attention(q, k, v, layout: np.ndarray, block: int, causal: bool = False,
+                     scale: Optional[float] = None, key_padding_mask=None):
+    """q,k,v: [B, H, S, D] → [B, H, S, D] attending only where layout=1.
+
+    ``layout``: static [H, nb, nb] 0/1 (nb = S/block).  ``causal`` applies
+    token-level causality *within* the admitted blocks (the layout itself
+    should already be lower-triangular for unidirectional configs).
+    """
+    B, H, S, D = q.shape
+    nb = S // block
+    assert layout.shape == (H, nb, nb), f"layout {layout.shape} != {(H, nb, nb)}"
+    cols, valid = _row_gather_maps(layout)
+    L = cols.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(D)
+
+    cols_j = jnp.asarray(cols)            # [H, nb, L]
+    valid_j = jnp.asarray(valid)
+
+    qb = q.reshape(B, H, nb, block, D)
+    kb = k.reshape(B, H, nb, block, D)
+    vb = v.reshape(B, H, nb, block, D)
+
+    # gather active key/value blocks per (h, row): [B, H, nb, L, block, D]
+    def gather_blocks(x):
+        # x: [B, H, nb, block, D]; take along block axis with cols [H, nb, L]
+        idx = cols_j[None, :, :, :, None, None]
+        idx = jnp.broadcast_to(idx, (B, H, nb, L, block, D))
+        xe = x[:, :, None]  # [B, H, 1, nb, block, D]
+        xe = jnp.broadcast_to(xe, (B, H, nb, nb, block, D))
+        return jnp.take_along_axis(xe, idx, axis=3)
+
+    kg = gather_blocks(kb).reshape(B, H, nb, L * block, D)
+    vg = gather_blocks(vb).reshape(B, H, nb, L * block, D)
+
+    scores = jnp.einsum("bhrqd,bhrkd->bhrqk", qb, kg) * scale  # [B,H,nb,block,L*block]
+
+    # mask: padded blocks, optional causal within gathered keys, padding mask
+    neg = jnp.finfo(scores.dtype).min
+    block_ok = jnp.repeat(valid_j, block, axis=-1)  # [H, nb, L*block]
+    mask = block_ok[None, :, :, None, :]
+    if causal:
+        q_pos = (jnp.arange(nb)[:, None] * block + jnp.arange(block)[None, :])  # [nb, block]
+        k_pos = (cols_j[..., :, None] * block + jnp.arange(block)[None, None, None, :])  # [H,nb,L,block]
+        k_pos = k_pos.reshape(H, nb, L * block)
+        mask = mask & (q_pos[None, None, :, :, None] >= k_pos[:, :, None, :][None])
+    if key_padding_mask is not None:
+        kp = jnp.asarray(key_padding_mask, bool)  # [B, S] True = keep
+        kpb = kp.reshape(B, 1, nb, block)
+        kpg = jnp.take_along_axis(jnp.broadcast_to(kpb[:, :, None], (B, 1, nb, nb, block)),
+                                  cols_j[None, :, :, :, None], axis=3)
+        mask = mask & kpg.reshape(B, H, nb, 1, L * block)
+    scores = jnp.where(mask, scores, neg)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    # rows with zero admitted keys (fully masked) produce nan-free zeros
+    probs = jnp.where(mask.any(-1, keepdims=True), probs, 0.0)
+    out = jnp.einsum("bhrqk,bhrkd->bhrqd", probs, vg)
+    return out.reshape(B, H, S, D)
+
+
+class SparseSelfAttention:
+    """Callable wrapper bound to a SparsityConfig (ref:
+    sparse_self_attention.py:SparseSelfAttention — torch module; here a
+    layout cache + functional apply)."""
+
+    def __init__(self, sparsity_config: SparsityConfig, key_padding_mask_mode="add",
+                 attn_mask_mode="mul"):
+        self.sparsity_config = sparsity_config
+        self._layouts = {}
+
+    def get_layout(self, seq_len):
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = np.asarray(self.sparsity_config.make_layout(seq_len))
+        return self._layouts[seq_len]
+
+    def __call__(self, query, key, value, key_padding_mask=None, causal=None):
+        S = query.shape[2]
+        layout = self.get_layout(S)
+        causal = (self.sparsity_config.attention == "unidirectional") \
+            if causal is None and hasattr(self.sparsity_config, "attention") else bool(causal)
+        return sparse_attention(query, key, value, layout, self.sparsity_config.block,
+                                causal=causal, key_padding_mask=key_padding_mask)

@@ -1,0 +1,3 @@
+"""Domino TP comm-overlap transformer (ref: deepspeed/runtime/domino/)."""
+
+from .transformer import DominoTransformer, DominoTransformerLayer

@@ -1,0 +1,129 @@
+"""Domino: tensor-parallel transformer with compute/communication overlap.
+
+ref: runtime/domino/transformer.py:411 DominoTransformer +
+domino/async_linear.py:47 DominoAsyncColumnParallelLinear.  The reference
+splits each batch into µ-batches and launches the TP allreduce of µ-batch i
+asynchronously while computing µ-batch i+1, hiding TP communication behind
+compute.
+
+TPU-native: the layer processes µ-batch chunks as independent dataflow
+chains inside one jitted program.  Each chain's row-parallel matmul ends in
+a GSPMD-inserted allreduce, and since chain i+1's matmuls have no data
+dependency on chain i's allreduce, XLA's latency-hiding scheduler overlaps
+them — the async-handle choreography becomes a property of the schedule.
+The µ-batch count (ref: tag_micro_batches) controls the overlap depth.
+
+Layer structure matches the reference (Megatron block): LN → col-parallel
+QKV → attention → row-parallel proj [+allreduce] → residual → LN →
+col-parallel MLP-in → gelu → row-parallel MLP-out [+allreduce] → residual.
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ...comm.mesh import TENSOR_AXIS
+
+# logical axis vocabulary shared with the model zoo (module_inject/tp_rules)
+EMBED = "embed"
+HEADS = "heads"
+HEAD_DIM = "head_dim"
+MLP = "mlp"
+
+
+def _logical(init, names):
+    return nn.with_logical_partitioning(init, names)
+
+
+class DominoTransformerLayer(nn.Module):
+    """One TP transformer block over µ-batch chunks
+    (ref: transformer.py:DominoTransformerLayer.forward)."""
+    hidden_size: int
+    num_attention_heads: int
+    ffn_hidden_size: int
+    micro_batches: int = 2  # ref: Domino's µ-batch split degree
+    causal: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        H = self.num_attention_heads
+        D = self.hidden_size // H
+        dt = self.dtype
+
+        ln1_scale = self.param("input_layernorm", _logical(nn.initializers.ones_init(), (EMBED, )),
+                               (self.hidden_size, ), jnp.float32)
+        ln2_scale = self.param("post_attention_layernorm", _logical(nn.initializers.ones_init(), (EMBED, )),
+                               (self.hidden_size, ), jnp.float32)
+        wqkv = self.param("qkv", _logical(nn.initializers.lecun_normal(), (EMBED, HEADS, HEAD_DIM)),
+                          (self.hidden_size, H, 3 * D), jnp.float32)
+        wo = self.param("dense", _logical(nn.initializers.lecun_normal(), (HEADS, HEAD_DIM, EMBED)),
+                        (H, D, self.hidden_size), jnp.float32)
+        w1 = self.param("mlp_h_to_4h", _logical(nn.initializers.lecun_normal(), (EMBED, MLP)),
+                        (self.hidden_size, self.ffn_hidden_size), jnp.float32)
+        w2 = self.param("mlp_4h_to_h", _logical(nn.initializers.lecun_normal(), (MLP, EMBED)),
+                        (self.ffn_hidden_size, self.hidden_size), jnp.float32)
+
+        def ln(v, scale):
+            m = jnp.mean(v.astype(jnp.float32), -1, keepdims=True)
+            var = jnp.var(v.astype(jnp.float32), -1, keepdims=True)
+            return ((v - m) * jax.lax.rsqrt(var + 1e-5) * scale).astype(dt)
+
+        def one_chunk(xc):
+            # attention: col-parallel QKV (sharded over heads), row-parallel out
+            h = ln(xc, ln1_scale)
+            qkv = jnp.einsum("bse,ehd->bshd", h, wqkv.astype(dt))
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            scores = jnp.einsum("bshd,bthd->bhst", q, k) / np.sqrt(D)
+            if self.causal:
+                S = xc.shape[1]
+                mask = jnp.tril(jnp.ones((S, S), bool))
+                scores = jnp.where(mask[None, None], scores, jnp.finfo(scores.dtype).min)
+            probs = jax.nn.softmax(scores, -1)
+            ctx = jnp.einsum("bhst,bthd->bshd", probs, v)
+            # row-parallel projection: contraction over the TP-sharded head
+            # axis ⇒ GSPMD inserts the TP allreduce here (the async_linear
+            # allreduce in the reference)
+            attn_out = jnp.einsum("bshd,hde->bse", ctx, wo.astype(dt))
+            xc = xc + attn_out
+            # MLP col→row parallel; second matmul again ends in TP allreduce
+            h2 = ln(xc, ln2_scale)
+            inter = jax.nn.gelu(jnp.einsum("bse,ef->bsf", h2, w1.astype(dt)))
+            mlp_out = jnp.einsum("bsf,fe->bse", inter, w2.astype(dt))
+            return xc + mlp_out
+
+        B = x.shape[0]
+        n = min(self.micro_batches, B)
+        if n <= 1 or B % n != 0:
+            return one_chunk(x)
+        # independent µ-batch chains: XLA overlaps chunk i's trailing
+        # allreduce with chunk i+1's matmuls (Domino's async pipeline)
+        chunks = jnp.split(x, n, axis=0)
+        outs = [one_chunk(c) for c in chunks]
+        return jnp.concatenate(outs, axis=0)
+
+
+class DominoTransformer(nn.Module):
+    """Stack of Domino layers (ref: transformer.py:411 DominoTransformer)."""
+    num_layers: int
+    hidden_size: int
+    num_attention_heads: int
+    ffn_hidden_size: int
+    micro_batches: int = 2
+    causal: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.num_layers):
+            x = DominoTransformerLayer(hidden_size=self.hidden_size,
+                                       num_attention_heads=self.num_attention_heads,
+                                       ffn_hidden_size=self.ffn_hidden_size,
+                                       micro_batches=self.micro_batches,
+                                       causal=self.causal,
+                                       dtype=self.dtype,
+                                       name=f"layer_{i}")(x)
+        return x

@@ -1,0 +1,86 @@
+"""Power-iteration curvature (Hessian top-eigenvalue) estimation.
+
+ref: runtime/eigenvalue.py (Eigenvalue.compute_eigenvalue — per-block power
+iteration using double backward; consumed by the quantizer's eigenvalue-
+aware schedule, engine config ``eigenvalue:{enabled,...}``).
+
+JAX-native: Hessian-vector products via forward-over-reverse
+(jvp of grad) — one jit'd HVP per iteration, no graph retention tricks.
+"""
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import logger
+
+
+class Eigenvalue:
+
+    def __init__(self, verbose: bool = False, max_iter: int = 100, tol: float = 1e-2,
+                 stability: float = 1e-6, gas_boundary_resolution: int = 1,
+                 layer_name: str = "", layer_num: int = 0):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+        self.layer_name = layer_name
+        self.layer_num = layer_num
+
+    def _normalize(self, tree):
+        norm = jnp.sqrt(sum(jnp.vdot(x, x).real for x in jax.tree.leaves(tree)))
+        return jax.tree.map(lambda x: x / (norm + self.stability), tree), norm
+
+    def compute_eigenvalue(self, loss_fn: Callable, params, rng=None,
+                           block_filter: Optional[Callable[[str], bool]] = None) -> Dict[str, float]:
+        """Top Hessian eigenvalue per top-level param block.
+
+        ``loss_fn(params) -> scalar``.  Returns {block_name: eigenvalue}
+        (ref: eigenvalue.py compute_eigenvalue returning per-layer values,
+        post-processed so zero/failed estimates get the max seen — same
+        convention here).
+        """
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        grad_fn = jax.grad(loss_fn)
+
+        @jax.jit
+        def hvp(p, v):
+            return jax.jvp(grad_fn, (p, ), (v, ))[1]
+
+        results = {}
+        blocks = list(params.keys()) if isinstance(params, dict) else [None]
+        for name in blocks:
+            if block_filter is not None and name is not None and not block_filter(str(name)):
+                continue
+            sub = params[name] if name is not None else params
+            k = jax.random.fold_in(rng, hash(str(name)) & 0x7FFF)
+            v = jax.tree.map(lambda x: jax.random.normal(jax.random.fold_in(k, 0), x.shape, x.dtype)
+                             if jnp.issubdtype(x.dtype, jnp.floating) else jnp.zeros_like(x), sub)
+            v, _ = self._normalize(v)
+            eig = 0.0
+            for i in range(self.max_iter):
+                # embed the block vector into a full-tree tangent
+                full_v = jax.tree.map(jnp.zeros_like, params)
+                if name is not None:
+                    full_v = {**full_v, name: v}
+                else:
+                    full_v = v
+                hv_full = hvp(params, full_v)
+                hv = hv_full[name] if name is not None else hv_full
+                v_new, norm = self._normalize(hv)
+                new_eig = float(norm)
+                if abs(new_eig - eig) < self.tol * max(abs(eig), 1e-12):
+                    eig = new_eig
+                    break
+                eig, v = new_eig, v_new
+            results[str(name)] = eig
+            if self.verbose:
+                logger.info(f"eigenvalue[{name}] = {eig:.4e} ({i + 1} iters)")
+
+        # replace zero/failed estimates with the max (ref: eigenvalue.py
+        # post-process "set to max of other layers")
+        mx = max(results.values(), default=0.0)
+        return {k: (val if val > 0 else mx) for k, val in results.items()}

@@ -244,6 +244,11 @@ class DeepSpeedEngine:
         from flax import linen as nn
 
         from ..module_inject.tp_rules import param_shardings as make_param_shardings
+        from .zero.mics import resolve_partition_axes
+
+        # MiCS / ZeRO++ hpZ: restrict which DP mesh axes the ZeRO partition
+        # uses (ref: runtime/zero/mics.py, partition_parameters.py hpZ)
+        param_axes, state_axes = resolve_partition_axes(self.mesh, self._config.zero_config, self.zero_stage)
 
         if params is None:
             args, kwargs = self.model_inputs_fn(batch)
@@ -254,7 +259,7 @@ class DeepSpeedEngine:
                 return self.module.init(rng, *abs_args, **abs_kwargs)
 
             abs_boxed = jax.eval_shape(boxed_init, self.init_rng)
-            var_shardings = make_param_shardings(abs_boxed, self.mesh, self.zero_stage)
+            var_shardings = make_param_shardings(abs_boxed, self.mesh, self.zero_stage, fsdp_axes=param_axes)
 
             def unboxed_init(rng):
                 return nn.meta.unbox(boxed_init(rng))
@@ -265,7 +270,7 @@ class DeepSpeedEngine:
             variables = params if isinstance(params, dict) and "params" in params else {"params": params}
             variables = nn.meta.unbox(variables)
             abs_vars = jax.eval_shape(lambda: variables)
-            var_shardings = make_param_shardings(abs_vars, self.mesh, self.zero_stage)
+            var_shardings = make_param_shardings(abs_vars, self.mesh, self.zero_stage, fsdp_axes=param_axes)
             variables = jax.device_put(variables, var_shardings)
 
         raw_params = variables["params"]
@@ -277,8 +282,10 @@ class DeepSpeedEngine:
                        if jnp.issubdtype(x.dtype, jnp.floating) else x)
 
         abs_params = jax.eval_shape(lambda: raw_params)
-        master_sh = master_and_optstate_shardings(param_sh, abs_params, self.mesh, self.zero_stage)
-        self._grad_shardings = make_grad_shardings(param_sh, abs_params, self.mesh, self.zero_stage)
+        master_sh = master_and_optstate_shardings(param_sh, abs_params, self.mesh, self.zero_stage,
+                                                  zero_axes=state_axes)
+        self._grad_shardings = make_grad_shardings(param_sh, abs_params, self.mesh, self.zero_stage,
+                                                   zero_axes=state_axes)
 
         @partial(jax.jit, out_shardings=None)
         def build_state(p):

@@ -1,0 +1,55 @@
+"""Sparse gradient container.
+
+ref: runtime/sparse_tensor.py (SparseTensor — index/value form of sparse
+embedding grads, reduced via ``sparse_allreduce_no_retain``
+engine.py:2683).  JAX-native: jax.experimental.sparse.BCOO is the
+index/value form; the allreduce analog concatenates every rank's (index,
+value) pairs — here expressed as an all_gather of both arrays inside
+shard_map, or densification when the consumer needs it.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SparseTensor:
+    """ref: runtime/sparse_tensor.py:SparseTensor."""
+
+    def __init__(self, dense_tensor=None, indices=None, values=None, dense_size=None):
+        if dense_tensor is not None:
+            rows = jnp.any(dense_tensor != 0, axis=tuple(range(1, dense_tensor.ndim)))
+            self.indices = jnp.nonzero(rows, size=None)[0]
+            self.values = dense_tensor[self.indices]
+            self.dense_size = dense_tensor.shape
+        else:
+            self.indices = indices
+            self.values = values
+            self.dense_size = tuple(dense_size)
+
+    @staticmethod
+    def type():
+        return "deepspeed_tpu.runtime.sparse_tensor.SparseTensor"
+
+    def to_coo_tensor(self):
+        from jax.experimental import sparse as jsparse
+        idx = self.indices[:, None].astype(jnp.int32)
+        return jsparse.BCOO((self.values, idx), shape=self.dense_size)
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_size, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def sparse_size(self) -> Tuple[int, int]:
+        import numpy as np
+        return int(self.values.size + self.indices.size), int(np.prod(self.dense_size))
+
+
+def sparse_allreduce(st: SparseTensor, axis_name: str) -> SparseTensor:
+    """Concatenate (indices, values) across an axis inside shard_map /
+    pmap — the reference's NCCL allgather of indices+values
+    (engine.py:2719 sparse_allreduce)."""
+    idx = jax.lax.all_gather(st.indices, axis_name, tiled=True)
+    vals = jax.lax.all_gather(st.values, axis_name, tiled=True)
+    return SparseTensor(indices=idx, values=vals, dense_size=st.dense_size)

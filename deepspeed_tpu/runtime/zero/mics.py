@@ -1,0 +1,97 @@
+"""MiCS (Minimize Communication Scale) + hpZ hierarchical partitioning.
+
+ref: runtime/zero/mics.py (MiCS_Optimizer, MiCS_Init) and
+partition_parameters.py:1673 _partition_param_sec (ZeRO++ hpZ).
+
+Both features answer the same question — "shard over how many ranks?" —
+because all-gathering a ZeRO-3 param from every rank crosses slow links.
+* MiCS: shard params+grads+optimizer within a sub-group of ``shard_size``
+  ranks, replicate across sub-groups; all-gathers stay inside the group.
+* hpZ (ZeRO++): keep optimizer/grad sharding global, but hold a SECONDARY
+  param partition within the node so backward all-gathers are intra-node.
+
+On a TPU mesh this maps to *which mesh axes the ZeRO sharding uses*.  Mesh
+axes are ordered outer→inner with inner axes ICI-adjacent (comm/mesh.py), so
+a sub-group of size N = the product of the innermost DP axes: sharding over
+those axes makes GSPMD emit all-gathers that ride ICI, replication across
+the remaining outer axes (DCN in multi-pod) — exactly the MiCS/hpZ
+communication pattern, with zero bookkeeping.
+"""
+
+from typing import Tuple
+
+from jax.sharding import Mesh
+
+from ...comm.mesh import ZERO_AXES
+from ...utils.logging import log_dist
+
+
+def mics_zero_axes(mesh: Mesh, shard_size: int, zero_axes=ZERO_AXES) -> Tuple[str, ...]:
+    """Innermost subset of the active ZeRO axes whose product equals
+    ``shard_size`` (the MiCS sub-group / hpZ secondary-partition size)."""
+    active = [a for a in zero_axes if mesh.shape.get(a, 1) > 1]
+    total = 1
+    for a in active:
+        total *= mesh.shape[a]
+    if shard_size >= total:
+        return tuple(active)
+    chosen = []
+    acc = 1
+    for a in reversed(active):  # innermost first
+        if acc == shard_size:
+            break
+        acc *= mesh.shape[a]
+        chosen.append(a)
+    if acc != shard_size:
+        raise ValueError(
+            f"mics/hpz shard size {shard_size} must equal the product of innermost "
+            f"data-parallel mesh axes; available suffix products from {dict(mesh.shape)}: "
+            f"{_suffix_products(mesh, active)}")
+    return tuple(reversed(chosen))
+
+
+def _suffix_products(mesh, active):
+    out, acc = [], 1
+    for a in reversed(active):
+        acc *= mesh.shape[a]
+        out.append(acc)
+    return out
+
+
+def resolve_partition_axes(mesh: Mesh, zero_config, zero_stage: int):
+    """(param_axes, state_axes) for the configured stage + MiCS/hpZ knobs.
+
+    * mics_shard_size>0 (ref: mics.py MiCS_Init(shard_size)): everything
+      shards within the sub-group.
+    * zero_hpz_partition_size>1 (ref: DeepSpeedZeroConfig.zero_hpz_partition_size):
+      params use the secondary (intra-node) partition; optimizer/grads stay
+      on the full DP axes.
+    """
+    param_axes = state_axes = ZERO_AXES
+    mics = getattr(zero_config, "mics_shard_size", -1) or -1
+    hpz = getattr(zero_config, "zero_hpz_partition_size", 1) or 1
+    if zero_stage == 3 and mics > 0:
+        param_axes = state_axes = mics_zero_axes(mesh, mics)
+        log_dist(f"MiCS: sharding over axes {param_axes} (shard_size={mics})", ranks=[0])
+    elif zero_stage == 3 and hpz > 1:
+        param_axes = mics_zero_axes(mesh, hpz)
+        log_dist(f"ZeRO++ hpZ: secondary param partition over {param_axes} "
+                 f"(partition_size={hpz})", ranks=[0])
+    return param_axes, state_axes
+
+
+class MiCS_Init:
+    """API-parity context manager (ref: mics.py MiCS_Init).  Partitioned
+    construction on TPU happens via jit out_shardings at first use; this
+    context simply carries the config for symmetry with the reference."""
+
+    def __init__(self, module=None, data_parallel_group=None, mem_efficient_linear=True,
+                 remote_device=None, pin_memory=False, config_dict_or_path=None,
+                 config=None, enabled=True, dtype=None, mpu=None):
+        self.enabled = enabled
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -47,14 +47,34 @@ def zero_shard_spec(spec: Optional[P], shape: Tuple[int, ...], mesh: Mesh,
     axes = tuple(a for a in zero_axes if mesh.shape.get(a, 1) > 1)
     if not axes:
         return spec if spec is not None else P()
-    zsize = axis_size(mesh, *axes)
     entries = list(_spec_tuple(spec, len(shape)))
-    # skip if some dim already carries a zero axis
-    flat = []
-    for e in entries:
-        flat.extend(e if isinstance(e, tuple) else (e, ))
-    if any(a in flat for a in axes):
+
+    # if a dim already carries SOME of the zero axes (e.g. hpZ params sharded
+    # over the intra-node subgroup only), extend that dim with the missing
+    # axes so optimizer state/grads shard over the FULL group
+    # (ref: hpZ — secondary param partition, primary optimizer partition)
+    for d, e in enumerate(entries):
+        cur = tuple(e) if isinstance(e, tuple) else ((e, ) if e is not None else ())
+        present = [a for a in cur if a in axes]
+        if not present:
+            continue
+        missing = tuple(a for a in axes if a not in cur)
+        if not missing:
+            return P(*entries)
+        full = cur + missing
+        total = int(np.prod([mesh.shape.get(a, 1) for a in full]))
+        if shape[d] % total == 0:
+            entries[d] = full
+            return P(*entries)
+        # can't extend this dim; try placing the missing axes on another dim
+        msize = int(np.prod([mesh.shape.get(a, 1) for a in missing]))
+        for d2, dim in enumerate(shape):
+            if entries[d2] is None and dim % msize == 0 and dim >= msize:
+                entries[d2] = missing if len(missing) > 1 else missing[0]
+                return P(*entries)
         return P(*entries)
+
+    zsize = axis_size(mesh, *axes)
     for d, dim in enumerate(shape):
         if entries[d] is None and dim % zsize == 0 and dim >= zsize:
             entries[d] = axes if len(axes) > 1 else axes[0]
@@ -62,35 +82,36 @@ def zero_shard_spec(spec: Optional[P], shape: Tuple[int, ...], mesh: Mesh,
     return P(*entries)
 
 
-def _shard_like(shardings_tree, shapes_tree, mesh, add_zero: bool):
+def _shard_like(shardings_tree, shapes_tree, mesh, add_zero: bool, zero_axes=ZERO_AXES):
     def convert(sh, shape_struct):
         spec = sh.spec if isinstance(sh, NamedSharding) else sh
         shape = shape_struct.shape if hasattr(shape_struct, "shape") else tuple(shape_struct)
         if add_zero:
-            spec = zero_shard_spec(spec, shape, mesh)
+            spec = zero_shard_spec(spec, shape, mesh, zero_axes=zero_axes)
         return NamedSharding(mesh, spec)
 
     return jax.tree.map(convert, shardings_tree, shapes_tree)
 
 
-def master_and_optstate_shardings(param_shardings, param_shapes, mesh: Mesh, stage: int):
+def master_and_optstate_shardings(param_shardings, param_shapes, mesh: Mesh, stage: int, zero_axes=ZERO_AXES):
     """Sharding for fp32 master weights and per-param optimizer moments.
 
     stage >= 1: shard over DP axes (ref: stage_1_and_2.py partitioned fp32
     groups); stage 3: params already DP-sharded so this is a no-op add.
+    ``zero_axes`` restricts the partition group (MiCS, see zero/mics.py).
     """
     add_zero = stage >= 1
-    return _shard_like(param_shardings, param_shapes, mesh, add_zero)
+    return _shard_like(param_shardings, param_shapes, mesh, add_zero, zero_axes)
 
 
-def grad_shardings(param_shardings, param_shapes, mesh: Mesh, stage: int):
+def grad_shardings(param_shardings, param_shapes, mesh: Mesh, stage: int, zero_axes=ZERO_AXES):
     """Sharding constraint applied to gradients inside the compiled step.
 
     stage <= 1: grads replicated over DP (plain allreduce); stage >= 2:
     grads land reduce-scattered onto the optimizer partitioning.
     """
     add_zero = stage >= 2
-    return _shard_like(param_shardings, param_shapes, mesh, add_zero)
+    return _shard_like(param_shardings, param_shapes, mesh, add_zero, zero_axes)
 
 
 def estimate_partitioned_bytes(param_shapes, shardings, dtype_bytes=4):

@@ -1,0 +1,72 @@
+"""TiledLinear — split a large linear into tiles to bound live memory.
+
+ref: runtime/zero/tiling.py (TiledLinear / TiledLinearReturnBias): splits a
+Linear into in_splits × out_splits sub-linears so ZeRO-3 gathers one tile at
+a time instead of the whole weight.  TPU-native: tiles are the leading axes
+of ONE stacked param [in_splits, out_splits, in/i, out/o]; the contraction
+runs as a lax.scan over input tiles, so XLA keeps at most one gathered
+tile slab live at a time (remat-friendly), and each tile matmul is still a
+dense MXU op.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class TiledLinear(nn.Module):
+    """y = x @ W + b computed tile-by-tile (ref: tiling.py TiledLinear)."""
+    features: int
+    in_splits: int = 1
+    out_splits: int = 1
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        in_dim = x.shape[-1]
+        assert in_dim % self.in_splits == 0, f"in_dim {in_dim} % in_splits {self.in_splits}"
+        assert self.features % self.out_splits == 0, f"features {self.features} % out_splits {self.out_splits}"
+        ti, to = in_dim // self.in_splits, self.features // self.out_splits
+
+        # one stacked param; per-(i,j) tiles initialized independently like
+        # the reference's sub-linears (fan-in of a tile, matching its copy)
+        def init(rng, shape, dtype):
+            rngs = jax.random.split(rng, self.in_splits * self.out_splits)
+            tiles = [self.kernel_init(r, (ti, to), dtype) for r in rngs]
+            return jnp.stack(tiles).reshape(self.in_splits, self.out_splits, ti, to)
+
+        w = self.param("kernel", init, (self.in_splits, self.out_splits, ti, to), self.dtype)
+
+        xt = x.reshape(x.shape[:-1] + (self.in_splits, ti))
+
+        def body(acc, i):
+            # one input tile against all its output tiles: [*, ti] @ [O, ti, to]
+            xi = jnp.take(xt, i, axis=-2)
+            wi = jax.lax.dynamic_index_in_dim(w, i, axis=0, keepdims=False)  # [O, ti, to]
+            contrib = jnp.einsum("...i,oij->...oj", xi, wi)
+            return acc + contrib, None
+
+        acc0 = jnp.zeros(x.shape[:-1] + (self.out_splits, to), self.dtype)
+        acc, _ = jax.lax.scan(body, acc0, jnp.arange(self.in_splits))
+        y = acc.reshape(x.shape[:-1] + (self.features, ))
+        if self.use_bias:
+            b = self.param("bias", self.bias_init, (self.features, ), self.dtype)
+            y = y + b
+        return y
+
+
+def copy_params_from_dense(tiled_params, dense_kernel, dense_bias=None):
+    """Load a dense (in, out) kernel into the tiled layout (ref:
+    tiling.py TiledLinear.copy_params_from)."""
+    in_splits, out_splits, ti, to = tiled_params["kernel"].shape
+    w = jnp.asarray(dense_kernel).reshape(in_splits, ti, out_splits, to).transpose(0, 2, 1, 3)
+    out = dict(tiled_params)
+    out["kernel"] = w.astype(tiled_params["kernel"].dtype)
+    if dense_bias is not None and "bias" in tiled_params:
+        out["bias"] = jnp.asarray(dense_bias).astype(tiled_params["bias"].dtype)
+    return out
