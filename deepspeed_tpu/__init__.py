@@ -12,6 +12,7 @@ __version__ = "0.1.0"
 
 from . import comm  # noqa: F401
 from .comm.comm import init_distributed  # noqa: F401
+from .runtime import zero  # noqa: F401  (ds.zero.Init / GatheredParameters parity)
 from .runtime.config import DeepSpeedConfig  # noqa: F401
 from .runtime.engine import DeepSpeedEngine  # noqa: F401
 from .runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader  # noqa: F401
@@ -105,3 +106,21 @@ def init_inference(model=None, config=None, **kwargs):
     """ref: deepspeed/__init__.py:291 — build an inference engine."""
     from .inference.engine import InferenceEngine
     return InferenceEngine(model=model, config=config or {}, **kwargs)
+
+
+def tp_model_init(model=None, tp_size: int = 1, dtype=None, config=None):
+    """ref: deepspeed/__init__.py:369 tp_model_init — prepare a model for
+    tensor-parallel training.  Returns (model, TpTrainingManager); pass the
+    manager's shardings (or just set tensor_parallel.autotp_size in the
+    engine config — the engine's logical-rules path covers flax models with
+    logical axis names; the manager covers converted HF trees)."""
+    from .runtime.tensor_parallel import TpTrainingManager, TPTrainingConfig
+    if isinstance(config, TPTrainingConfig):
+        cfg = config
+    elif isinstance(config, dict):
+        cfg = TPTrainingConfig(**{**config, "autotp_size": config.get("autotp_size", tp_size)})
+    elif config is None:
+        cfg = TPTrainingConfig(autotp_size=tp_size)
+    else:
+        raise TypeError(f"config must be TPTrainingConfig or dict, got {type(config)}")
+    return model, TpTrainingManager(model=model, tp_size=tp_size, dtype=dtype, config=cfg)

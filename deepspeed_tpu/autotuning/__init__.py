@@ -1,0 +1,4 @@
+"""Autotuning (ref: deepspeed/autotuning/ — Autotuner:42, tuner/, scheduler)."""
+
+from .autotuner import Autotuner, ResourceManager
+from .tuner import BaseTuner, CostModel, GridSearchTuner, ModelBasedTuner, RandomTuner

@@ -1,0 +1,167 @@
+"""Autotuner: search ZeRO stage / micro-batch / config space by measuring
+short compiled runs.
+
+ref: deepspeed/autotuning/autotuner.py:42 Autotuner + scheduler.py
+ResourceManager.  The reference launches whole multi-node training jobs per
+experiment and parses metric files back.  Single-controller JAX removes the
+process choreography: each experiment builds an engine IN-PROCESS, runs a
+few measured steps on the live mesh, and tears down — compile errors and
+OOMs surface as failed experiments (metric None), exactly like the
+reference's failed launches.
+
+Model info profiling (ref: autotuner.py _generate_experiments using
+activation-memory measurements + param counts) uses jax.eval_shape — no
+device memory is spent sizing the model.
+"""
+
+import itertools
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+from .constants import *  # noqa: F401,F403
+from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
+
+TUNERS = {
+    AUTOTUNING_TUNER_GRIDSEARCH: GridSearchTuner,
+    AUTOTUNING_TUNER_RANDOM: RandomTuner,
+    AUTOTUNING_TUNER_MODELBASED: ModelBasedTuner,
+}
+
+
+def _deep_merge(base: dict, over: dict) -> dict:
+    out = dict(base)
+    for k, v in over.items():
+        out[k] = _deep_merge(out[k], v) if isinstance(v, dict) and isinstance(out.get(k), dict) else v
+    return out
+
+
+class ResourceManager:
+    """Runs experiments and returns metric values (ref:
+    autotuning/scheduler.py ResourceManager.schedule_experiments/run)."""
+
+    def __init__(self, model_factory: Callable[[], Any], batch_fn: Callable[[int], dict],
+                 metric: str = AUTOTUNING_METRIC_THROUGHPUT, steps: int = 3, warmup: int = 1,
+                 mesh=None, loss_fn=None):
+        self.model_factory = model_factory
+        self.batch_fn = batch_fn
+        self.metric = metric
+        self.steps = steps
+        self.warmup = warmup
+        self.mesh = mesh
+        self.loss_fn = loss_fn
+        self.history: List[Dict] = []
+
+    def run_experiment(self, exp_config: dict) -> Optional[float]:
+        import deepspeed_tpu as ds
+        try:
+            engine, _, _, _ = ds.initialize(model=self.model_factory(), config=dict(exp_config),
+                                            mesh=self.mesh, loss_fn=self.loss_fn)
+            micro = exp_config.get("train_micro_batch_size_per_gpu")
+            global_batch = exp_config.get("train_batch_size") or engine.train_batch_size()
+            batch = self.batch_fn(global_batch)
+            for _ in range(self.warmup):
+                loss = engine.train_batch(batch=batch)
+            float(loss)  # sync
+            t0 = time.time()
+            for _ in range(self.steps):
+                loss = engine.train_batch(batch=batch)
+            float(loss)
+            dt = (time.time() - t0) / self.steps
+            n_tokens = int(np.prod(np.shape(batch["input_ids"])))
+            if self.metric == AUTOTUNING_METRIC_LATENCY:
+                val = -dt
+            else:  # throughput (tokens/s); FLOPS metric is proportional
+                val = n_tokens / dt
+            return float(val)
+        except Exception as e:
+            logger.warning(f"experiment failed ({type(e).__name__}: {e}); recording as infeasible")
+            return None
+
+    def run(self, exps: List[dict]) -> List[Optional[float]]:
+        out = []
+        for e in exps:
+            val = self.run_experiment(e)
+            self.history.append({"config": e, self.metric: val})
+            logger.info(f"autotuning exp zero={e.get('zero_optimization', {}).get('stage')} "
+                        f"mbs={e.get('train_micro_batch_size_per_gpu')} -> {val}")
+            out.append(val)
+        return out
+
+
+class Autotuner:
+    """ref: autotuner.py:42 — orchestrates space generation + tuner + report."""
+
+    def __init__(self, base_config: dict, model_factory, batch_fn, mesh=None, loss_fn=None,
+                 tuning_space: Optional[Dict[str, List]] = None):
+        self.base_config = dict(base_config)
+        at = dict(self.base_config.pop(AUTOTUNING, {}) or {})
+        self.metric = at.get(AUTOTUNING_METRIC, AUTOTUNING_METRIC_THROUGHPUT)
+        self.tuner_type = at.get(AUTOTUNING_TUNER_TYPE, AUTOTUNING_TUNER_MODELBASED)
+        self.early_stopping = at.get(AUTOTUNING_TUNER_EARLY_STOPPING)
+        self.num_trials = at.get(AUTOTUNING_TUNER_NUM_TRIALS, 50)
+        self.results_dir = at.get(AUTOTUNING_RESULTS_DIR, "autotuning_results")
+        self.max_train_batch_size = at.get(AUTOTUNING_MAX_TRAIN_BATCH_SIZE)
+        self.start_profile_step = at.get(AUTOTUNING_START_PROFILE_STEP, 1)
+        self.end_profile_step = at.get(AUTOTUNING_END_PROFILE_STEP, 4)
+        self.rm = ResourceManager(model_factory, batch_fn, metric=self.metric, mesh=mesh, loss_fn=loss_fn,
+                                  steps=max(1, self.end_profile_step - self.start_profile_step),
+                                  warmup=self.start_profile_step)
+        self.tuning_space = tuning_space
+        self.best_config = None
+        self.best_metric_val = None
+
+    def model_info(self, model, example_batch) -> Dict[str, Any]:
+        """Param count + per-dtype bytes via eval_shape (ref: autotuner
+        model_info profiling path engine.py:2041-2060)."""
+        import jax
+        import jax.numpy as jnp
+
+        ids = jnp.asarray(example_batch["input_ids"])
+        abs_vars = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), ids))
+        n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(abs_vars))
+        return {"num_params": n_params, "approx_bytes_fp32": 4 * n_params}
+
+    def _generate_experiments(self) -> List[dict]:
+        space = self.tuning_space or {
+            "zero_stage": DEFAULT_TUNING_SPACE_ZERO["zero_optimization"]["stage"],
+            "micro_batch": DEFAULT_MICRO_BATCH_SIZES,
+        }
+        import jax
+        zs = space.get("zero_stage", [0])
+        mbs = space.get("micro_batch", [None])
+        world = jax.device_count()
+        exps = []
+        for stage, mb in itertools.product(zs, mbs):
+            cfg = _deep_merge(self.base_config, {"zero_optimization": {"stage": stage}})
+            if mb is not None:
+                # mb is the GLOBAL micro-batch; config takes per-device micro
+                # and the triad gb = micro_per_dev * gas * world must hold
+                gb = self.base_config.get("train_batch_size")
+                if self.max_train_batch_size and mb > self.max_train_batch_size:
+                    continue
+                if gb is None or gb % mb != 0 or mb % world != 0:
+                    continue
+                cfg = _deep_merge(cfg, {"train_micro_batch_size_per_gpu": mb // world,
+                                        "gradient_accumulation_steps": gb // mb})
+            exps.append(cfg)
+        return exps
+
+    def tune(self) -> dict:
+        exps = self._generate_experiments()
+        logger.info(f"autotuning: {len(exps)} experiments, tuner={self.tuner_type}, metric={self.metric}")
+        tuner_cls = TUNERS[self.tuner_type]
+        tuner = tuner_cls(exps, self.rm, metric=self.metric)
+        best, val = tuner.tune(sample_size=1, n_trials=self.num_trials, early_stopping=self.early_stopping)
+        self.best_config, self.best_metric_val = best, val
+        os.makedirs(self.results_dir, exist_ok=True)
+        with open(os.path.join(self.results_dir, "summary.json"), "w") as f:
+            json.dump({"best_config": best, "metric": self.metric, "value": val,
+                       "history": self.rm.history}, f, indent=2, default=str)
+        logger.info(f"autotuning best: {val} with zero_stage="
+                    f"{(best or {}).get('zero_optimization', {}).get('stage')}")
+        return best

@@ -1,0 +1,27 @@
+"""Autotuning config keys (ref: deepspeed/autotuning/constants.py)."""
+
+AUTOTUNING = "autotuning"
+AUTOTUNING_ENABLED = "enabled"
+AUTOTUNING_FAST = "fast"
+AUTOTUNING_METRIC = "metric"
+AUTOTUNING_METRIC_THROUGHPUT = "throughput"
+AUTOTUNING_METRIC_LATENCY = "latency"
+AUTOTUNING_METRIC_FLOPS = "flops"
+AUTOTUNING_START_PROFILE_STEP = "start_profile_step"
+AUTOTUNING_END_PROFILE_STEP = "end_profile_step"
+AUTOTUNING_MAX_TRAIN_BATCH_SIZE = "max_train_batch_size"
+AUTOTUNING_MP_SIZE = "mp_size"
+AUTOTUNING_TUNER_TYPE = "tuner_type"
+AUTOTUNING_TUNER_GRIDSEARCH = "gridsearch"
+AUTOTUNING_TUNER_RANDOM = "random"
+AUTOTUNING_TUNER_MODELBASED = "model_based"
+AUTOTUNING_TUNER_EARLY_STOPPING = "tuner_early_stopping"
+AUTOTUNING_TUNER_NUM_TRIALS = "tuner_num_trials"
+AUTOTUNING_RESULTS_DIR = "results_dir"
+AUTOTUNING_EXPS_DIR = "exps_dir"
+AUTOTUNING_OVERWRITE = "overwrite"
+
+DEFAULT_TUNING_SPACE_ZERO = {
+    "zero_optimization": {"stage": [0, 1, 2, 3]},
+}
+DEFAULT_MICRO_BATCH_SIZES = [1, 2, 4, 8, 16]

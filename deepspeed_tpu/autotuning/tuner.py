@@ -1,0 +1,151 @@
+"""Tuners: grid / random / model-based search over experiment configs.
+
+ref: deepspeed/autotuning/tuner/{base_tuner.py:13 BaseTuner,
+index_based_tuner.py:11 RandomTuner, :27 GridSearchTuner,
+model_based_tuner.py:19 ModelBasedTuner, cost_model.py XGBoostCostModel}.
+
+The model-based tuner's XGBoost surrogate is replaced by a
+nearest-neighbour + running-mean predictor over one-hot encoded configs
+(numpy only — the image has no xgboost; the estimator only has to RANK a
+handful of configs, not extrapolate).
+"""
+
+import random as _random
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+
+
+class BaseTuner:
+    """ref: base_tuner.py:13."""
+
+    def __init__(self, exps: List[dict], resource_manager, metric: str = "throughput"):
+        self.all_exps = list(exps)
+        self.rm = resource_manager
+        self.metric = metric
+        self.best_exp = None
+        self.best_metric_val = -float("inf")
+
+    def has_next(self):
+        return len(self.all_exps) > 0
+
+    def next_batch(self, sample_size: int) -> List[dict]:
+        raise NotImplementedError
+
+    def update(self, exps: List[dict], results: List[Optional[float]]):
+        pass
+
+    def tune(self, sample_size: int = 1, n_trials: int = 1000, early_stopping: Optional[int] = None):
+        """ref: base_tuner.py:38 — run batches until exhausted/early stop."""
+        i = 0
+        stale = 0
+        while i < n_trials and self.has_next():
+            batch = self.next_batch(sample_size)
+            results = self.rm.run(batch)
+            improved = False
+            for exp, val in zip(batch, results):
+                if val is not None and val > self.best_metric_val:
+                    self.best_exp, self.best_metric_val = exp, val
+                    improved = True
+            self.update(batch, results)
+            i += len(batch)
+            stale = 0 if improved else stale + len(batch)
+            if early_stopping and stale >= early_stopping:
+                logger.info(f"early stopping after {stale} non-improving trials")
+                break
+        return self.best_exp, self.best_metric_val
+
+
+class GridSearchTuner(BaseTuner):
+    """In-order exhaustive sweep (ref: index_based_tuner.py:27)."""
+
+    def next_batch(self, sample_size):
+        batch, self.all_exps = self.all_exps[:sample_size], self.all_exps[sample_size:]
+        return batch
+
+
+class RandomTuner(BaseTuner):
+    """Random order sweep (ref: index_based_tuner.py:11)."""
+
+    def __init__(self, exps, resource_manager, metric="throughput", seed: int = 0):
+        super().__init__(exps, resource_manager, metric)
+        self._rng = _random.Random(seed)
+
+    def next_batch(self, sample_size):
+        n = min(sample_size, len(self.all_exps))
+        batch = self._rng.sample(self.all_exps, n)
+        for b in batch:
+            self.all_exps.remove(b)
+        return batch
+
+
+def _featurize(exp: dict, keys: List[str]) -> np.ndarray:
+    def get(d, dotted):
+        for p in dotted.split("."):
+            d = d.get(p, {}) if isinstance(d, dict) else {}
+        return d if not isinstance(d, dict) else 0
+
+    return np.asarray([float(get(exp, k) or 0) for k in keys], np.float64)
+
+
+class CostModel:
+    """k-NN surrogate over measured configs (ref: tuner/cost_model.py
+    XGBoostCostModel.fit/predict)."""
+
+    def __init__(self, feature_keys: List[str], k: int = 3):
+        self.keys = feature_keys
+        self.k = k
+        self.X: List[np.ndarray] = []
+        self.y: List[float] = []
+
+    def fit(self, exps: List[dict], vals: List[float]):
+        for e, v in zip(exps, vals):
+            if v is not None:
+                self.X.append(_featurize(e, self.keys))
+                self.y.append(v)
+
+    def predict(self, exps: List[dict]) -> np.ndarray:
+        if not self.X:
+            return np.zeros(len(exps))
+        X = np.stack(self.X)
+        y = np.asarray(self.y)
+        scale = X.std(0) + 1e-9
+        out = []
+        for e in exps:
+            f = _featurize(e, self.keys)
+            d = np.linalg.norm((X - f) / scale, axis=1)
+            idx = np.argsort(d)[:self.k]
+            w = 1.0 / (d[idx] + 1e-6)
+            out.append(float((y[idx] * w).sum() / w.sum()))
+        return np.asarray(out)
+
+
+class ModelBasedTuner(BaseTuner):
+    """Explore a seed batch, then greedily run the configs the surrogate
+    ranks best (ref: model_based_tuner.py:19)."""
+
+    def __init__(self, exps, resource_manager, metric="throughput", feature_keys=None, seed_trials: int = 2):
+        super().__init__(exps, resource_manager, metric)
+        self.feature_keys = feature_keys or ["train_micro_batch_size_per_gpu",
+                                             "gradient_accumulation_steps",
+                                             "zero_optimization.stage"]
+        self.model = CostModel(self.feature_keys)
+        self.seed_trials = seed_trials
+        self._trials = 0
+
+    def next_batch(self, sample_size):
+        if self._trials < self.seed_trials or not self.model.X:
+            batch, self.all_exps = self.all_exps[:sample_size], self.all_exps[sample_size:]
+        else:
+            preds = self.model.predict(self.all_exps)
+            order = np.argsort(-preds)[:sample_size]
+            batch = [self.all_exps[i] for i in order]
+            for b in batch:
+                self.all_exps.remove(b)
+        self._trials += len(batch)
+        return batch
+
+    def update(self, exps, results):
+        self.model.fit(exps, results)

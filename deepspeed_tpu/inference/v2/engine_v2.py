@@ -55,7 +55,15 @@ class InferenceEngineV2:
         self.econfig = engine_config or RaggedInferenceEngineConfig()
         kvcfg = self.econfig.kv
         self.model = LlamaForCausalLMWithCache(cfg, page_size=kvcfg.page_size)
-        self.params = params
+        # weight-only-quantized checkpoints: int8 stays in HBM, dequant is
+        # traced into the step program (ref: inference/quantization kernels)
+        from ..quantization import QuantizedParams
+        if isinstance(params, QuantizedParams):
+            self._qparams = params
+            self.params = {"params": params.tree}
+        else:
+            self._qparams = None
+            self.params = params
         self.kv = BlockedKVCache(kvcfg.num_pages, kvcfg.page_size, kvcfg.max_pages_per_seq)
         self.state = StateManager(self.kv, max_batch=self.econfig.scheduler.max_seqs)
         self.scheduler = SplitFuseScheduler(self.econfig.scheduler)
@@ -85,6 +93,8 @@ class InferenceEngineV2:
             logger.info(f"InferenceEngineV2: compiling step program batch={batch} chunk={chunk}")
 
             def step(params, cache, tokens, start_pos, block_tables, chunk_lens, rng):
+                if self._qparams is not None:
+                    params = {"params": self._qparams.dequantize(params["params"])}
                 logits, cache = self.model.apply(params, tokens, start_pos, block_tables, cache,
                                                  chunk_lens)
                 # logits of each row's LAST real token

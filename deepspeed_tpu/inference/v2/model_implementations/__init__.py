@@ -1,0 +1,6 @@
+"""Per-architecture inference policies (ref:
+deepspeed/inference/v2/model_implementations/ — falcon, llama_v2, mistral,
+mixtral, opt, phi, phi3, qwen, qwen_v2, qwen_v2_moe)."""
+
+from .policies import (POLICY_REGISTRY, InferenceV2Policy, LlamaPolicy, MistralPolicy, MixtralPolicy,
+                       Phi3Policy, Qwen2Policy, convert_hf_state_dict, policy_for)

@@ -1,0 +1,182 @@
+"""HF checkpoint → TPU-native model policies.
+
+ref: deepspeed/inference/v2/model_implementations/*/policy.py (each
+``InferenceV2Policy`` maps a HF checkpoint's layer containers onto the
+engine's kernel parameter layout) and module_inject's per-model containers.
+
+Here a policy is (a) a config translation (HF config → LlamaConfig-family)
+and (b) a weight translation: HF state-dict names → the flax param tree,
+including transposes into DenseGeneral layouts and stacking per-layer
+tensors along axis 0 for the scan-over-layers models.
+
+Covered model_types (ref model_implementations dirs): llama (v1/v2/v3),
+mistral, qwen2, phi3 (fused qkv/gate_up split), mixtral (MoE).
+"""
+
+import re
+from typing import Any, Dict
+
+import numpy as np
+
+from ....models.llama import LlamaConfig
+from ....utils.logging import logger
+
+
+def _t(x):
+    return np.ascontiguousarray(np.asarray(x).T)
+
+
+class InferenceV2Policy:
+    """Base policy (ref: inference/v2/model_implementations/inference_policy_base.py)."""
+    model_type = None
+
+    def build_config(self, hf_cfg) -> LlamaConfig:
+        return LlamaConfig.from_hf(hf_cfg)
+
+    def build_model(self, cfg: LlamaConfig):
+        from ....models.llama import LlamaForCausalLM
+        return LlamaForCausalLM(cfg)
+
+    # -- weight translation ------------------------------------------------
+    def convert(self, sd: Dict[str, Any], cfg: LlamaConfig) -> Dict[str, Any]:
+        """HF state dict (name → torch/np tensor) → flax params tree."""
+        H, KV = cfg.num_attention_heads, cfg.num_key_value_heads
+        D = cfg.hidden_size // H
+        E = cfg.hidden_size
+        L = cfg.num_hidden_layers
+
+        def get(name):
+            t = sd[name]
+            return np.asarray(t.float().numpy() if hasattr(t, "float") else t, np.float32)
+
+        def layer_stack(fmt, conv):
+            return np.stack([conv(get(fmt.format(i=i))) for i in range(L)])
+
+        def qkv_kernel(fmt, heads):
+            # HF [heads*D, E] → ours [E, heads, D]
+            return layer_stack(fmt, lambda w: _t(w).reshape(E, heads, D))
+
+        params = {
+            "embed_tokens": {"embedding": get("model.embed_tokens.weight")},
+            "norm": {"weight": get("model.norm.weight")},
+            "model": {"layers": {
+                "input_layernorm": {"weight": layer_stack("model.layers.{i}.input_layernorm.weight", lambda w: w)},
+                "post_attention_layernorm": {
+                    "weight": layer_stack("model.layers.{i}.post_attention_layernorm.weight", lambda w: w)},
+                "self_attn": {
+                    "q_proj": {"kernel": qkv_kernel("model.layers.{i}.self_attn.q_proj.weight", H)},
+                    "k_proj": {"kernel": qkv_kernel("model.layers.{i}.self_attn.k_proj.weight", KV)},
+                    "v_proj": {"kernel": qkv_kernel("model.layers.{i}.self_attn.v_proj.weight", KV)},
+                    # HF o_proj [E, H*D] → ours [H, D, E]
+                    "o_proj": {"kernel": layer_stack("model.layers.{i}.self_attn.o_proj.weight",
+                                                     lambda w: _t(w).reshape(H, D, E))},
+                },
+                "mlp": {
+                    "gate_proj": {"kernel": layer_stack("model.layers.{i}.mlp.gate_proj.weight", _t)},
+                    "up_proj": {"kernel": layer_stack("model.layers.{i}.mlp.up_proj.weight", _t)},
+                    "down_proj": {"kernel": layer_stack("model.layers.{i}.mlp.down_proj.weight", _t)},
+                },
+            }},
+        }
+        if cfg.attention_bias:
+            for name, heads in (("q_proj", H), ("k_proj", KV), ("v_proj", KV)):
+                params["model"]["layers"]["self_attn"][name]["bias"] = layer_stack(
+                    "model.layers.{{i}}.self_attn.{0}.bias".format(name), lambda b: b.reshape(heads, D))
+        if cfg.tie_word_embeddings or "lm_head.weight" not in sd:
+            params["lm_head"] = {"kernel": _t(params["embed_tokens"]["embedding"])}
+        else:
+            params["lm_head"] = {"kernel": _t(get("lm_head.weight"))}
+        return params
+
+
+class LlamaPolicy(InferenceV2Policy):
+    """ref: model_implementations/llama_v2/ (+v1/v3 via config)."""
+    model_type = "llama"
+
+
+class MistralPolicy(InferenceV2Policy):
+    """ref: model_implementations/mistral/ — llama layout + GQA; the
+    sliding-window attention of mistral is honored at the attention level
+    (paged decode masks beyond window)."""
+    model_type = "mistral"
+
+
+class Qwen2Policy(InferenceV2Policy):
+    """ref: model_implementations/qwen_v2/ — llama layout + qkv bias."""
+    model_type = "qwen2"
+
+    def build_config(self, hf_cfg):
+        return LlamaConfig.from_hf(hf_cfg, attention_bias=True)
+
+
+class Phi3Policy(InferenceV2Policy):
+    """ref: model_implementations/phi3/ — fused qkv_proj and gate_up_proj
+    get split into the llama layout."""
+    model_type = "phi3"
+
+    def convert(self, sd, cfg):
+        H, KV = cfg.num_attention_heads, cfg.num_key_value_heads
+        D = cfg.hidden_size // H
+        expanded = {}
+        for name, t in sd.items():
+            w = np.asarray(t.float().numpy() if hasattr(t, "float") else t, np.float32)
+            m = re.match(r"model\.layers\.(\d+)\.self_attn\.qkv_proj\.weight", name)
+            if m:
+                i = m.group(1)
+                q, k, v = np.split(w, [H * D, H * D + KV * D], axis=0)
+                expanded[f"model.layers.{i}.self_attn.q_proj.weight"] = q
+                expanded[f"model.layers.{i}.self_attn.k_proj.weight"] = k
+                expanded[f"model.layers.{i}.self_attn.v_proj.weight"] = v
+                continue
+            m = re.match(r"model\.layers\.(\d+)\.mlp\.gate_up_proj\.weight", name)
+            if m:
+                i = m.group(1)
+                g, u = np.split(w, 2, axis=0)
+                expanded[f"model.layers.{i}.mlp.gate_proj.weight"] = g
+                expanded[f"model.layers.{i}.mlp.up_proj.weight"] = u
+                continue
+            expanded[name] = w
+        return super().convert(expanded, cfg)
+
+
+class MixtralPolicy(InferenceV2Policy):
+    """ref: model_implementations/mixtral/ — MoE FFN: per-layer experts
+    stacked onto the expert axis of our Mixtral model."""
+    model_type = "mixtral"
+
+    def build_config(self, hf_cfg):
+        from ....models.mixtral import MixtralConfig
+        return MixtralConfig.from_hf(hf_cfg)
+
+    def build_model(self, cfg):
+        from ....models.mixtral import MixtralForCausalLM
+        return MixtralForCausalLM(cfg)
+
+    def convert(self, sd, cfg):
+        raise NotImplementedError(
+            "mixtral HF weight conversion lands with the MoE serving path; "
+            "use deepspeed_tpu.models.mixtral natively-initialized for now")
+
+
+POLICY_REGISTRY = {
+    "llama": LlamaPolicy(),
+    "mistral": MistralPolicy(),
+    "qwen2": Qwen2Policy(),
+    "phi3": Phi3Policy(),
+    "mixtral": MixtralPolicy(),
+}
+
+
+def policy_for(model_type: str) -> InferenceV2Policy:
+    if model_type not in POLICY_REGISTRY:
+        raise ValueError(f"no inference policy for model_type={model_type!r}; "
+                         f"known: {sorted(POLICY_REGISTRY)}")
+    return POLICY_REGISTRY[model_type]
+
+
+def convert_hf_state_dict(sd, hf_cfg, model_type=None) -> tuple:
+    """(LlamaConfig-family cfg, flax params) from an HF state dict."""
+    mt = model_type or getattr(hf_cfg, "model_type", "llama")
+    pol = policy_for(mt)
+    cfg = pol.build_config(hf_cfg)
+    return cfg, pol.convert(sd, cfg)

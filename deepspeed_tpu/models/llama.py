@@ -56,6 +56,7 @@ class LlamaConfig:
     remat: bool = True
     remat_policy: str = "nothing_saveable"
     attention_impl: str = "reference"  # reference | flash | ulysses
+    attention_bias: bool = False  # qkv bias (Qwen2-style checkpoints)
 
     @staticmethod
     def from_hf(hf_cfg, **overrides):
@@ -71,6 +72,7 @@ class LlamaConfig:
             rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
             rms_norm_eps=getattr(hf_cfg, "rms_norm_eps", 1e-5),
             tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
+            attention_bias=getattr(hf_cfg, "attention_bias", False),
         )
         fields.update(overrides)
         return LlamaConfig(**fields)
@@ -170,7 +172,8 @@ class LlamaAttention(nn.Module):
     def __call__(self, x, positions, segment_ids=None):
         cfg = self.cfg
         head_dim = cfg.hidden_size // cfg.num_attention_heads
-        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        dense = partial(nn.DenseGeneral, use_bias=cfg.attention_bias, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype)
         q = dense(features=(cfg.num_attention_heads, head_dim),
                   kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, HEADS, HEAD_DIM)),
                   name="q_proj")(x)

@@ -62,17 +62,20 @@ def sparse_attention(q, k, v, layout: np.ndarray, block: int, causal: bool = Fal
     kb = k.reshape(B, H, nb, block, D)
     vb = v.reshape(B, H, nb, block, D)
 
-    # gather active key/value blocks per (h, row): [B, H, nb, L, block, D]
+    # gather active key/value blocks per (h, row) WITHOUT any nb×nb temp:
+    # per head, one XLA gather of [B, nb, L, block, D] — working set scales
+    # with L (active blocks), which is the whole point of block sparsity
     def gather_blocks(x):
-        # x: [B, H, nb, block, D]; take along block axis with cols [H, nb, L]
-        idx = cols_j[None, :, :, :, None, None]
-        idx = jnp.broadcast_to(idx, (B, H, nb, L, block, D))
-        xe = x[:, :, None]  # [B, H, 1, nb, block, D]
-        xe = jnp.broadcast_to(xe, (B, H, nb, nb, block, D))
-        return jnp.take_along_axis(xe, idx, axis=3)
+        # x: [B, H, nb, block, D] → [B, H, nb, L*block, D]
+        def per_head(xh, colsh):
+            # xh [B, nb, block, D], colsh [nb, L] → [B, nb, L, block, D]
+            return jnp.take(xh, colsh, axis=1)
 
-    kg = gather_blocks(kb).reshape(B, H, nb, L * block, D)
-    vg = gather_blocks(vb).reshape(B, H, nb, L * block, D)
+        g = jax.vmap(per_head, in_axes=(1, 0), out_axes=1)(x, cols_j)
+        return g.reshape(B, H, nb, L * block, D)
+
+    kg = gather_blocks(kb)
+    vg = gather_blocks(vb)
 
     scores = jnp.einsum("bhrqd,bhrkd->bhrqk", qb, kg) * scale  # [B,H,nb,block,L*block]
 
@@ -86,11 +89,10 @@ def sparse_attention(q, k, v, layout: np.ndarray, block: int, causal: bool = Fal
         k_pos = k_pos.reshape(H, nb, L * block)
         mask = mask & (q_pos[None, None, :, :, None] >= k_pos[:, :, None, :][None])
     if key_padding_mask is not None:
-        kp = jnp.asarray(key_padding_mask, bool)  # [B, S] True = keep
-        kpb = kp.reshape(B, 1, nb, block)
-        kpg = jnp.take_along_axis(jnp.broadcast_to(kpb[:, :, None], (B, 1, nb, nb, block)),
-                                  cols_j[None, :, :, :, None], axis=3)
-        mask = mask & kpg.reshape(B, H, nb, 1, L * block)
+        kp = jnp.asarray(key_padding_mask, bool).reshape(B, nb, block)  # True = keep
+        # per head: gather the key-block mask rows for each query row
+        kpg = jax.vmap(lambda colsh: jnp.take(kp, colsh, axis=1), out_axes=1)(cols_j)
+        mask = mask & kpg.reshape(B, H, nb, L * block)[:, :, :, None, :]
     scores = jnp.where(mask, scores, neg)
 
     probs = jax.nn.softmax(scores, axis=-1)

@@ -105,15 +105,11 @@ class FixedSparsityConfig(SparsityConfig):
         starts = list(range(first, end, self.num_local_blocks))
         if end < nb:  # short tail window
             starts.append(min(end + first, nb - self.num_global_blocks))
-        rows = np.arange(nb)[:, None]
         for i in starts:
             sl = slice(i, i + self.num_global_blocks)
-            if self.attention == "bidirectional":
-                layout[h, :, sl] = 1
-            else:
-                layout[h, i:, sl] = 1  # only rows at/after the global block
-                # respect causality within the vertical stripe
-                layout[h, :, sl] = np.where(rows >= i, layout[h, :, sl], 0)
+            # vertical global stripe; the final np.tril in make_layout
+            # enforces causality for unidirectional attention
+            layout[h, :, sl] = 1
             if self.horizontal_global_attention:
                 layout[h, sl, :] = 1
         return layout

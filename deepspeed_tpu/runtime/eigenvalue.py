@@ -44,21 +44,28 @@ class Eigenvalue:
         convention here).
         """
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        grad_fn = jax.grad(loss_fn)
-
-        @jax.jit
-        def hvp(p, v):
-            return jax.jvp(grad_fn, (p, ), (v, ))[1]
+        # cache the compiled HVP per loss_fn: compute_eigenvalue runs at
+        # every gas boundary and must not recompile the double backward
+        cache = getattr(self, "_hvp_cache", None)
+        if cache is None:
+            cache = self._hvp_cache = {}
+        hvp = cache.get(loss_fn)
+        if hvp is None:
+            grad_fn = jax.grad(loss_fn)
+            hvp = cache[loss_fn] = jax.jit(lambda p, v: jax.jvp(grad_fn, (p, ), (v, ))[1])
 
         results = {}
         blocks = list(params.keys()) if isinstance(params, dict) else [None]
-        for name in blocks:
+        for bi, name in enumerate(blocks):
             if block_filter is not None and name is not None and not block_filter(str(name)):
                 continue
             sub = params[name] if name is not None else params
-            k = jax.random.fold_in(rng, hash(str(name)) & 0x7FFF)
-            v = jax.tree.map(lambda x: jax.random.normal(jax.random.fold_in(k, 0), x.shape, x.dtype)
-                             if jnp.issubdtype(x.dtype, jnp.floating) else jnp.zeros_like(x), sub)
+            k = jax.random.fold_in(rng, bi)  # deterministic across processes
+            leaves, treedef = jax.tree.flatten(sub)
+            v_leaves = [jax.random.normal(jax.random.fold_in(k, li), x.shape, x.dtype)
+                        if jnp.issubdtype(x.dtype, jnp.floating) else jnp.zeros_like(x)
+                        for li, x in enumerate(leaves)]
+            v = jax.tree.unflatten(treedef, v_leaves)
             v, _ = self._normalize(v)
             eig = 0.0
             for i in range(self.max_iter):

@@ -17,11 +17,22 @@ import jax.numpy as jnp
 class SparseTensor:
     """ref: runtime/sparse_tensor.py:SparseTensor."""
 
-    def __init__(self, dense_tensor=None, indices=None, values=None, dense_size=None):
+    def __init__(self, dense_tensor=None, indices=None, values=None, dense_size=None,
+                 max_nnz: Optional[int] = None):
+        """``max_nnz`` gives the static nonzero-row capacity needed to build
+        a SparseTensor inside jit/shard_map (dynamic nnz is untraceable);
+        padded slots carry zero values so to_dense/allreduce stay exact."""
         if dense_tensor is not None:
             rows = jnp.any(dense_tensor != 0, axis=tuple(range(1, dense_tensor.ndim)))
-            self.indices = jnp.nonzero(rows, size=None)[0]
-            self.values = dense_tensor[self.indices]
+            if max_nnz is not None:
+                idx = jnp.nonzero(rows, size=max_nnz, fill_value=0)[0]
+                vals = dense_tensor[idx]
+                valid = jnp.arange(max_nnz) < jnp.sum(rows)
+                vals = vals * valid.reshape((max_nnz, ) + (1, ) * (dense_tensor.ndim - 1)).astype(vals.dtype)
+                self.indices, self.values = idx, vals
+            else:
+                self.indices = jnp.nonzero(rows)[0]
+                self.values = dense_tensor[self.indices]
             self.dense_size = dense_tensor.shape
         else:
             self.indices = indices

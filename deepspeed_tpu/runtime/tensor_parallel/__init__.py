@@ -1,0 +1,3 @@
+"""Tensor-parallel training manager (ref: deepspeed/runtime/tensor_parallel/)."""
+
+from .tp_manager import TpTrainingManager, TPTrainingConfig

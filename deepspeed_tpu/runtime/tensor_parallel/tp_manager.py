@@ -1,0 +1,88 @@
+"""AutoTP *training* manager.
+
+ref: runtime/tensor_parallel/tp_manager.py:12 TpTrainingManager +
+tensor_parallel/config.py:38 TPTrainingConfig, engine hook
+engine.py:431 _configure_tensor_parallel.
+
+The reference walks a torch module, slices Linear weights across TP ranks
+and wraps rows/cols with allreduce layers so an HF model *trains* tensor-
+parallel.  Here the same outcome is a sharding plan: given the flax params
+tree, the manager classifies each kernel as column-parallel (output dim
+sharded), row-parallel (input dim sharded, GSPMD inserts the allreduce) or
+replicated, by the module-name heuristics AutoTP uses
+(ref: module_inject/auto_tp.py:193 tp_parser — attention out-proj and MLP
+down-proj are row-parallel, everything else wide is column-parallel).
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...comm.mesh import TENSOR_AXIS
+from ...utils.logging import log_dist
+
+# module-name suffixes that are ROW-parallel (contraction dim sharded →
+# forward ends in the TP allreduce) — the reference's auto_tp "allreduce
+# linears" list
+ROW_PARALLEL_PATTERNS = ("o_proj", "out_proj", "down_proj", "dense_4h_to_h", "attention.dense", "fc2", "wo")
+
+
+@dataclass
+class TPTrainingConfig:
+    """ref: tensor_parallel/config.py:38."""
+    autotp_size: int = 1
+    tensor_parallel: Optional[Dict] = None
+    injection_policy_tuple: Optional[Tuple] = None
+    keep_module_on_host: bool = False
+    tp_grain_size: int = 1
+
+
+class TpTrainingManager:
+    """ref: tp_manager.py:12 — builds and applies the TP sharding plan."""
+
+    def __init__(self, model=None, tp_size: int = 1, dtype=None, config: Optional[TPTrainingConfig] = None):
+        self.module = model
+        self.tp_size = config.autotp_size if config and config.autotp_size > 1 else tp_size
+        self.config = config or TPTrainingConfig(autotp_size=self.tp_size)
+
+    def plan(self, abs_params, mesh: Mesh) -> Dict[str, P]:
+        """path → PartitionSpec for every kernel leaf."""
+        tp = mesh.shape.get(TENSOR_AXIS, 1)
+        out: Dict[str, P] = {}
+
+        def walk(tree, prefix=()):
+            if isinstance(tree, dict):
+                for k, v in tree.items():
+                    walk(v, prefix + (str(k), ))
+                return
+            path = ".".join(prefix)
+            shape = tree.shape if hasattr(tree, "shape") else ()
+            if tp <= 1 or len(shape) < 2:
+                out[path] = P()
+            elif any(p in path for p in ROW_PARALLEL_PATTERNS):
+                # row-parallel: shard the contraction (first) dim
+                out[path] = P(TENSOR_AXIS) if shape[0] % tp == 0 else P()
+            else:
+                # column-parallel: shard the output (last) dim
+                spec = [None] * len(shape)
+                if shape[-1] % tp == 0:
+                    spec[-1] = TENSOR_AXIS
+                out[path] = P(*spec)
+
+        walk(abs_params)
+        n_row = sum(1 for s in out.values() if s and s[0] == TENSOR_AXIS)
+        log_dist(f"TpTrainingManager: tp={tp}, {n_row} row-parallel / "
+                 f"{len(out) - n_row} col-or-replicated params", ranks=[0])
+        return out
+
+    def shardings(self, abs_params, mesh: Mesh):
+        import jax
+        plan = self.plan(abs_params, mesh)
+
+        def to_sh(tree, prefix=()):
+            if isinstance(tree, dict):
+                return {k: to_sh(v, prefix + (str(k), )) for k, v in tree.items()}
+            return NamedSharding(mesh, plan[".".join(prefix)])
+
+        return to_sh(abs_params)

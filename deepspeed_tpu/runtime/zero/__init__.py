@@ -1,6 +1,7 @@
 """ZeRO as sharding policies (ref: deepspeed/runtime/zero/)."""
 
 from .mics import MiCS_Init, mics_zero_axes, resolve_partition_axes
+from .partition_parameters import GatheredParameters, Init
 from .partition import (estimate_partitioned_bytes, grad_shardings, master_and_optstate_shardings,
                         zero_shard_spec)
 from .tiling import TiledLinear, copy_params_from_dense

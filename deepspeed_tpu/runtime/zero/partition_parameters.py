@@ -1,0 +1,123 @@
+"""zero.Init / GatheredParameters — API-parity param-partitioning contexts.
+
+ref: runtime/zero/partition_parameters.py (Init:825 — patches module
+construction so params materialize pre-partitioned; GatheredParameters:2120
+— temporarily all-gathers partitioned params for host-side access).
+
+On TPU the heavy machinery is unnecessary: the engine initializes params
+directly INTO their partitioned layout (jit with out_shardings,
+engine._materialize_state), so ``Init`` is a thin context that records
+construction-time intent.  ``GatheredParameters`` has a real job though:
+user code (checkpoint surgery, stats, weight tying checks) sometimes needs
+the full array of a ZeRO-3-sharded param on host — that is a device_get of
+the global logical array, with optional write-back on exit (the reference's
+``modifier_rank`` semantics).
+"""
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from ...utils.logging import logger
+
+
+class Init:
+    """ref: partition_parameters.py:825.  Accepts the reference's kwargs for
+    drop-in compatibility; partitioned materialization happens at
+    engine-init (see engine.py _materialize_state docstring)."""
+
+    def __init__(self, module=None, data_parallel_group=None, mem_efficient_linear: bool = True,
+                 remote_device: Optional[str] = None, pin_memory: bool = False,
+                 config_dict_or_path=None, config=None, enabled: bool = True,
+                 dtype=None, mpu=None, zero_param_parallel_group=None,
+                 zero_quantized_weights: bool = False, zero_quantized_nontrainable_weights: bool = False,
+                 sequence_data_parallel_group=None, param_swapper=None):
+        self.enabled = enabled
+        if enabled:
+            logger.debug("zero.Init: params will materialize directly into their "
+                         "partitioned layout at engine init")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class GatheredParameters:
+    """ref: partition_parameters.py:2120.
+
+    with GatheredParameters(engine, ["model.layers"], modifier_rank=0) as g:
+        full = g["model.layers.mlp.down_proj.kernel"]   # host numpy
+        g["model.layers.mlp.down_proj.kernel"] = full * 2   # written back
+
+    Pass an engine (gathers from/writes back to engine.state.params) or a
+    raw param tree (read-only gather).
+    """
+
+    def __init__(self, params_or_engine, names=None, modifier_rank: Optional[int] = None,
+                 fwd_module=None, enabled: bool = True):
+        self.enabled = enabled
+        self._engine = None
+        if hasattr(params_or_engine, "state") and hasattr(params_or_engine, "state_shardings"):
+            self._engine = params_or_engine
+            self._tree = params_or_engine.state.params
+        else:
+            self._tree = params_or_engine
+        self.names = names
+        self.modifier_rank = modifier_rank
+        self._gathered = {}
+        self._dirty = set()
+
+    def _flatten(self):
+        out = {}
+
+        def walk(t, p=()):
+            if isinstance(t, dict):
+                for k, v in t.items():
+                    walk(v, p + (str(k), ))
+            else:
+                out[".".join(p)] = t
+
+        walk(self._tree)
+        return out
+
+    def __enter__(self):
+        if not self.enabled:
+            return self
+        flat = self._flatten()
+        wanted = flat if self.names is None else \
+            {k: v for k, v in flat.items() if any(k.startswith(n) or n in k for n in self.names)}
+        # device_get of the GLOBAL logical array = the all-gather
+        self._gathered = {k: np.asarray(jax.device_get(v)) for k, v in wanted.items()}
+        return self
+
+    def __getitem__(self, name):
+        return self._gathered[name]
+
+    def keys(self):
+        return self._gathered.keys()
+
+    def __setitem__(self, name, value):
+        assert self.modifier_rank is not None, \
+            "writes require modifier_rank (parity with the reference's contract)"
+        self._gathered[name] = np.asarray(value)
+        self._dirty.add(name)
+
+    def __exit__(self, *exc):
+        if self._dirty and self._engine is not None:
+            def walk(t, sh, p=()):
+                if isinstance(t, dict):
+                    return {k: walk(v, sh[k], p + (str(k), )) for k, v in t.items()}
+                name = ".".join(p)
+                if name in self._dirty:
+                    return jax.device_put(self._gathered[name].astype(t.dtype), sh)
+                return t
+
+            new = walk(self._engine.state.params, self._engine.state_shardings.params)
+            self._engine.state = self._engine.state._replace(params=new)
+        elif self._dirty:
+            logger.warning("GatheredParameters writes dropped: constructed from a raw tree, "
+                           "pass the engine to persist modifications")
+        return False

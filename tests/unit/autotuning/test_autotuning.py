@@ -1,0 +1,81 @@
+"""Autotuning tests (analog of reference tests/unit/autotuning/test_autotuning.py)."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import (Autotuner, CostModel, GridSearchTuner, ModelBasedTuner, RandomTuner,
+                                      ResourceManager)
+from deepspeed_tpu.models.llama import LlamaForCausalLM
+
+from simple_model import TINY, base_config, random_batch
+
+
+class FakeRM:
+    """Metric = -|mbs - 4| - stage (best: mbs=4, stage=0)."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def run(self, exps):
+        self.calls += len(exps)
+        out = []
+        for e in exps:
+            mbs = e.get("train_micro_batch_size_per_gpu", 1)
+            st = e.get("zero_optimization", {}).get("stage", 0)
+            out.append(-abs(mbs - 4) - st)
+        return out
+
+
+def space():
+    return [{"train_micro_batch_size_per_gpu": m, "gradient_accumulation_steps": 1,
+             "zero_optimization": {"stage": s}} for m in (1, 2, 4, 8) for s in (0, 2)]
+
+
+@pytest.mark.parametrize("cls", [GridSearchTuner, RandomTuner, ModelBasedTuner])
+def test_tuners_find_best(cls):
+    rm = FakeRM()
+    tuner = cls(space(), rm)
+    best, val = tuner.tune(sample_size=2, n_trials=100)
+    assert val == 0
+    assert best["train_micro_batch_size_per_gpu"] == 4
+    assert best["zero_optimization"]["stage"] == 0
+
+
+def test_early_stopping_limits_trials():
+    rm = FakeRM()
+    tuner = GridSearchTuner(space(), rm)
+    tuner.tune(sample_size=1, n_trials=100, early_stopping=2)
+    assert rm.calls < 8
+
+
+def test_cost_model_ranks():
+    cm = CostModel(["train_micro_batch_size_per_gpu", "zero_optimization.stage"])
+    exps = space()
+    vals = [-abs(e["train_micro_batch_size_per_gpu"] - 4) - e["zero_optimization"]["stage"] for e in exps]
+    cm.fit(exps, vals)
+    preds = cm.predict(exps)
+    assert np.argmax(preds) == np.argmax(vals)
+
+
+def test_autotuner_end_to_end(tmp_path):
+    cfg = base_config()
+    cfg["autotuning"] = {"enabled": True, "tuner_type": "gridsearch",
+                         "results_dir": str(tmp_path / "res"), "tuner_num_trials": 4}
+    at = Autotuner(cfg, model_factory=lambda: LlamaForCausalLM(TINY),
+                   batch_fn=lambda gb: random_batch(batch_size=gb),
+                   tuning_space={"zero_stage": [0, 2], "micro_batch": [8]})
+    info = at.model_info(LlamaForCausalLM(TINY), random_batch())
+    assert info["num_params"] > 0
+    best = at.tune()
+    assert best is not None
+    assert (tmp_path / "res" / "summary.json").exists()
+    assert at.best_metric_val > 0  # tokens/s
+
+
+def test_failed_experiment_is_infeasible():
+    rm = ResourceManager(model_factory=lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+                         batch_fn=lambda gb: random_batch())
+    assert rm.run([{"train_batch_size": 8}]) == [None]

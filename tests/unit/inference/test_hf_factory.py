@@ -1,0 +1,113 @@
+"""HF checkpoint → engine factory tests (analog of reference
+tests/unit/inference/v2/model_implementations + test_inference.py's HF
+parity sweep, run against locally-saved tiny random checkpoints)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.quantization import quantize_inference_params
+from deepspeed_tpu.inference.v2.engine_factory import build_hf_engine
+from deepspeed_tpu.inference.v2.model_implementations import convert_hf_state_dict, policy_for
+
+
+def _tiny_hf_llama(tmp_path, cls_name="llama"):
+    import torch
+    torch.manual_seed(0)
+    if cls_name == "llama":
+        from transformers import LlamaConfig as HFConfig, LlamaForCausalLM as HFModel
+        cfg = HFConfig(vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+                       num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+                       rope_theta=10000.0, tie_word_embeddings=False)
+    elif cls_name == "qwen2":
+        from transformers import Qwen2Config as HFConfig, Qwen2ForCausalLM as HFModel
+        cfg = HFConfig(vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+                       num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+                       rope_theta=10000.0, tie_word_embeddings=False)
+    model = HFModel(cfg)
+    d = tmp_path / cls_name
+    model.save_pretrained(d)
+    return model, cfg, str(d)
+
+
+@pytest.mark.parametrize("arch", ["llama", "qwen2"])
+def test_hf_logits_parity(arch, tmp_path):
+    """Converted weights reproduce the HF model's logits."""
+    import torch
+    hf_model, hf_cfg, path = _tiny_hf_llama(tmp_path, arch)
+
+    from transformers import AutoConfig
+    from deepspeed_tpu.inference.v2.engine_factory import _load_state_dict
+    sd = _load_state_dict(path)
+    cfg, params = convert_hf_state_dict(sd, AutoConfig.from_pretrained(path, local_files_only=True))
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32, "scan_layers": True, "remat": False})
+
+    from deepspeed_tpu.models.llama import LlamaForCausalLM
+    ours = LlamaForCausalLM(cfg)
+    ids = np.array([[5, 9, 2, 7, 1, 3]], np.int32)
+    got = np.asarray(ours.apply({"params": params}, jnp.asarray(ids)))
+
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_build_hf_engine_generates(tmp_path):
+    _, _, path = _tiny_hf_llama(tmp_path, "llama")
+    eng = build_hf_engine(path)
+    outs = eng.generate([[5, 9, 2], [7, 1, 3, 4]], max_new_tokens=4)
+    assert len(outs) == 2 and all(len(o) == 4 for o in outs)
+
+
+def test_phi3_policy_splits_fused():
+    H, KV, E, L, V = 4, 2, 32, 2, 64
+    D = E // H
+    rng = np.random.default_rng(0)
+    sd = {"model.embed_tokens.weight": rng.normal(size=(V, E)).astype(np.float32),
+          "model.norm.weight": np.ones(E, np.float32),
+          "lm_head.weight": rng.normal(size=(V, E)).astype(np.float32)}
+    for i in range(L):
+        p = f"model.layers.{i}"
+        sd[f"{p}.input_layernorm.weight"] = np.ones(E, np.float32)
+        sd[f"{p}.post_attention_layernorm.weight"] = np.ones(E, np.float32)
+        sd[f"{p}.self_attn.qkv_proj.weight"] = rng.normal(size=((H + 2 * KV) * D, E)).astype(np.float32)
+        sd[f"{p}.self_attn.o_proj.weight"] = rng.normal(size=(E, H * D)).astype(np.float32)
+        sd[f"{p}.mlp.gate_up_proj.weight"] = rng.normal(size=(2 * 96, E)).astype(np.float32)
+        sd[f"{p}.mlp.down_proj.weight"] = rng.normal(size=(E, 96)).astype(np.float32)
+
+    class FakeCfg:
+        model_type = "phi3"
+        vocab_size, hidden_size, intermediate_size = V, E, 96
+        num_hidden_layers, num_attention_heads, num_key_value_heads = L, H, KV
+        max_position_embeddings, rope_theta, rms_norm_eps = 64, 1e4, 1e-5
+        tie_word_embeddings = False
+
+    cfg, params = convert_hf_state_dict(sd, FakeCfg())
+    assert params["model"]["layers"]["self_attn"]["q_proj"]["kernel"].shape == (L, E, H, D)
+    assert params["model"]["layers"]["mlp"]["gate_proj"]["kernel"].shape == (L, E, 96)
+    # converted params drive a forward pass
+    from deepspeed_tpu.models.llama import LlamaForCausalLM
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32, "remat": False})
+    out = LlamaForCausalLM(cfg).apply({"params": params}, jnp.ones((1, 4), jnp.int32))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_unknown_model_type_raises():
+    with pytest.raises(ValueError, match="no inference policy"):
+        policy_for("made_up_arch")
+
+
+def test_weight_only_quantized_engine(tmp_path):
+    _, _, path = _tiny_hf_llama(tmp_path, "llama")
+    eng_fp = build_hf_engine(path)
+    eng_q = build_hf_engine(path, quantization_mode="int8")
+    assert eng_q._qparams is not None
+    # int8 payload is smaller than the fp32 weights
+    n_fp = sum(l.size * 4 for l in jax.tree.leaves(eng_fp.params))
+    assert eng_q._qparams.nbytes < 0.5 * n_fp
+    out_fp = eng_fp.generate([[5, 9, 2, 7]], max_new_tokens=8)[0]
+    out_q = eng_q.generate([[5, 9, 2, 7]], max_new_tokens=8)[0]
+    # random tiny model: quantization may flip late tokens; prefix agrees
+    assert out_fp[:2] == out_q[:2]
