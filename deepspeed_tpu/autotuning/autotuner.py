@@ -64,7 +64,7 @@ class ResourceManager:
             micro = exp_config.get("train_micro_batch_size_per_gpu")
             global_batch = exp_config.get("train_batch_size") or engine.train_batch_size()
             batch = self.batch_fn(global_batch)
-            for _ in range(self.warmup):
+            for _ in range(max(1, self.warmup)):  # ≥1: compile must not land in the timed loop
                 loss = engine.train_batch(batch=batch)
             float(loss)  # sync
             t0 = time.time()

@@ -46,8 +46,16 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None, save_latest=T
         "scaler": engine.state.scaler._asdict(),
         "skipped_steps": engine.state.skipped_steps,
     }
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.join(path, "state"), state_dict, force=True)
+    # pluggable engine (ref: runtime/checkpoint_engine/ + nebula async):
+    # "nebula": {"enabled": true} or checkpoint.checkpoint_engine "async" →
+    # the save streams in the background (singleton checkpointer); training
+    # continues immediately and the write is fenced at the next save/load
+    from ..runtime.checkpoint_engine import make_checkpoint_engine
+    pd = engine._config._param_dict
+    kind = "async" if pd.get("nebula", {}).get("enabled", False) else \
+        pd.get("checkpoint", {}).get("checkpoint_engine", "orbax")
+    ck = make_checkpoint_engine(kind)
+    ck.save(state_dict, os.path.join(path, "state"))
 
     meta = {
         "tag": str(tag),
@@ -68,6 +76,8 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None, save_latest=T
 
 
 def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True, load_module_only=False):
+    from ..runtime.checkpoint_engine import wait_for_pending_saves
+    wait_for_pending_saves()  # fence any in-flight async (nebula-style) save
     load_dir = os.path.abspath(load_dir)
     if tag is None:
         latest = os.path.join(load_dir, "latest")

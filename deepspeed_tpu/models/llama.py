@@ -222,11 +222,15 @@ class LlamaBlock(nn.Module):
     scanned: bool = False
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(self, x, positions, segment_ids=None, pld_scale=None):
         cfg = self.cfg
-        h = x + LlamaAttention(cfg, name="self_attn")(
+        # progressive layer drop: the whole block's residual contribution is
+        # gated by pld_scale = keep_mask/keep_prob (ref: PLD paper eq. 6 and
+        # runtime/progressive_layer_drop.py pld_layer_mask)
+        s = 1.0 if pld_scale is None else pld_scale.astype(cfg.dtype)
+        h = x + s * LlamaAttention(cfg, name="self_attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="input_layernorm")(x), positions, segment_ids)
-        out = h + LlamaMLP(cfg, name="mlp")(
+        out = h + s * LlamaMLP(cfg, name="mlp")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="post_attention_layernorm")(h))
         if self.scanned:
             return out, None
@@ -237,7 +241,7 @@ class ScannedBlocks(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(self, x, positions, segment_ids=None, pld_scale=None):
         cfg = self.cfg
         block_cls = LlamaBlock
         if cfg.remat:
@@ -247,21 +251,25 @@ class ScannedBlocks(nn.Module):
             blocks = nn.scan(block_cls,
                              variable_axes={"params": 0},
                              split_rngs={"params": True},
-                             in_axes=(nn.broadcast, nn.broadcast),
+                             in_axes=(nn.broadcast, nn.broadcast, 0),
                              length=cfg.num_hidden_layers,
                              metadata_params={nn.PARTITION_NAME: LAYERS})
-            x, _ = blocks(cfg, scanned=True, name="layers")(x, positions, segment_ids)
+            if pld_scale is None:
+                pld_scale = jnp.ones((cfg.num_hidden_layers, ), jnp.float32)
+            x, _ = blocks(cfg, scanned=True, name="layers")(x, positions, segment_ids, pld_scale)
             return x
         for i in range(cfg.num_hidden_layers):
-            x = block_cls(cfg, name=f"layers_{i}")(x, positions, segment_ids)
+            s_i = None if pld_scale is None else pld_scale[i]
+            x = block_cls(cfg, name=f"layers_{i}")(x, positions, segment_ids, s_i)
         return x
 
 
 class LlamaForCausalLM(nn.Module):
     cfg: LlamaConfig
+    supports_pld = True  # engine passes pld_scale when PLD is configured
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, segment_ids=None):
+    def __call__(self, input_ids, positions=None, segment_ids=None, pld_scale=None):
         cfg = self.cfg
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
@@ -272,7 +280,7 @@ class LlamaForCausalLM(nn.Module):
                          embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
                          name="embed_tokens")
         x = embed(input_ids)
-        x = ScannedBlocks(cfg, name="model")(x, positions, segment_ids)
+        x = ScannedBlocks(cfg, name="model")(x, positions, segment_ids, pld_scale)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="norm")(x)
         if cfg.tie_word_embeddings:
             logits = embed.attend(x)

@@ -111,7 +111,8 @@ class LlamaAttentionCache(nn.Module):
         cfg = self.cfg
         head_dim = cfg.hidden_size // cfg.num_attention_heads
         from functools import partial
-        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        dense = partial(nn.DenseGeneral, use_bias=cfg.attention_bias, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype)
         q = dense(features=(cfg.num_attention_heads, head_dim),
                   kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, HEADS, HEAD_DIM)),
                   name="q_proj")(x)

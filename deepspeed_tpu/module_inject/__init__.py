@@ -1,0 +1,5 @@
+"""Module injection: TP sharding rules + HF model replacement policies
+(ref: deepspeed/module_inject/)."""
+
+from .replace_module import replace_module, replace_transformer_layer
+from .tp_rules import make_logical_rules, logical_to_sharding, param_shardings

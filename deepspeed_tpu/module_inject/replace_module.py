@@ -1,0 +1,52 @@
+"""Module replacement façade — HF model → TPU-native engine modules.
+
+ref: deepspeed/module_inject/replace_module.py (replace_transformer_layer:183,
+replace_module:619) + per-model containers (module_inject/containers/).
+
+The reference mutates a live torch model, swapping each transformer layer
+for a fused CUDA container and slicing weights for TP.  The TPU analog is a
+whole-model translation: pick the per-arch policy
+(inference/v2/model_implementations/policies.py — the "containers"), convert
+the checkpoint into the flax param layout, and return the TPU model +
+params; TP slicing is a sharding plan (module_inject/tp_rules.py or
+runtime/tensor_parallel) instead of in-place weight surgery.
+"""
+
+from typing import Any, Optional, Tuple
+
+from ..utils.logging import logger
+
+
+def replace_transformer_layer(orig_layer_impl=None, model=None, checkpoint_dict=None,
+                              config=None, model_config=None):
+    """ref: replace_module.py:183.  Torch-module surgery has no TPU analog;
+    use ``replace_module(path_or_model)`` to obtain the TPU-native model."""
+    raise NotImplementedError(
+        "kernel-injection into live torch modules is CUDA-specific; use "
+        "deepspeed_tpu.module_inject.replace_module(model_or_path) or "
+        "inference.v2.engine_factory.build_hf_engine for the TPU path")
+
+
+def replace_module(model_or_path, policy=None, dtype=None) -> Tuple[Any, Any]:
+    """(tpu_model, params) for a local HF checkpoint path or a loaded HF
+    torch model (ref: replace_module.py:619 — returns the policy-replaced
+    model)."""
+    from ..inference.v2.model_implementations import convert_hf_state_dict
+
+    if isinstance(model_or_path, str):
+        from transformers import AutoConfig
+        from ..inference.v2.engine_factory import _load_state_dict
+        hf_cfg = AutoConfig.from_pretrained(model_or_path, local_files_only=True)
+        sd = _load_state_dict(model_or_path)
+    else:
+        hf_cfg = model_or_path.config
+        sd = model_or_path.state_dict()
+
+    cfg, params = convert_hf_state_dict(sd, hf_cfg)
+    if dtype is not None:
+        cfg = cfg.__class__(**{**cfg.__dict__, "dtype": dtype})
+    from ..inference.v2.model_implementations import policy_for
+    pol = policy if policy is not None else policy_for(getattr(hf_cfg, "model_type", "llama"))
+    model = pol.build_model(cfg)
+    logger.info(f"replace_module: {getattr(hf_cfg, 'model_type', '?')} → {type(model).__name__}")
+    return model, {"params": params}

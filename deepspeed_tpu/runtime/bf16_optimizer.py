@@ -1,0 +1,47 @@
+"""BF16_Optimizer — bf16 params with fp32 master + fp32 grad accumulation.
+
+ref: runtime/bf16_optimizer.py:35 BF16_Optimizer (bf16 model weights, fp32
+flat master partitions in ZeRO-1 layout, fp32 gradient accumulation).
+
+The engine implements exactly this when ``bf16.enabled`` (TrainState.master
+fp32 + zero-stage sharding of master/moments).  The standalone transform
+here is FP16_Optimizer minus the loss scaler — bf16's range makes scaling
+unnecessary (the reference likewise has no scaler on the bf16 path).
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.optimizer import GradientTransformation
+
+
+class BF16OptimizerState(NamedTuple):
+    inner: Any
+    master: Any  # fp32
+
+
+class BF16_Optimizer:
+    """Duck-typed (init, update) like the engine's client-optimizer contract."""
+
+    def __init__(self, inner: GradientTransformation, clip_grad: float = 0.0):
+        self.inner = inner
+        self.clip_grad = clip_grad
+        self.init = self._init
+        self.update = self._update
+
+    def _init(self, params):
+        master = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+        return BF16OptimizerState(inner=self.inner.init(master), master=master)
+
+    def _update(self, grads, state: BF16OptimizerState, params=None):
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        if self.clip_grad and self.clip_grad > 0:
+            from ..ops.optimizer import clip_by_global_norm
+            grads, _ = clip_by_global_norm(grads, self.clip_grad)
+        updates, new_inner = self.inner.update(grads, state.inner, state.master)
+        new_master = jax.tree.map(lambda m, u: m + u, state.master, updates)
+        deltas = jax.tree.map(lambda m, p: m.astype(p.dtype) - p, new_master, params) \
+            if params is not None else jax.tree.map(lambda m: m.astype(jnp.bfloat16), new_master)
+        return deltas, BF16OptimizerState(inner=new_inner, master=new_master)

@@ -1,0 +1,112 @@
+"""Pluggable checkpoint engines.
+
+ref: runtime/checkpoint_engine/{checkpoint_engine.py CheckpointEngine ABC,
+torch_checkpoint_engine.py TorchCheckpointEngine,
+nebula_checkpoint_engine.py NebulaCheckpointEngine} + deepspeed/nebula/.
+
+* OrbaxCheckpointEngine — synchronous sharded save/restore (the
+  TorchCheckpointEngine analog; resharding-on-restore included).
+* AsyncCheckpointEngine — orbax AsyncCheckpointer: save returns while the
+  write streams in the background (the Nebula tiered/async service's role;
+  ``commit()`` waits for durability like Nebula's commit).
+"""
+
+import os
+from typing import Any, Optional
+
+from ..utils.logging import log_dist, logger
+
+
+class CheckpointEngine:
+    """ref: checkpoint_engine.py CheckpointEngine ABC."""
+
+    def __init__(self, config_params=None):
+        self.config = config_params
+
+    def create(self, tag):
+        log_dist(f"checkpoint tag {tag}", ranks=[0])
+
+    def save(self, state_dict, path: str):
+        raise NotImplementedError
+
+    def load(self, path: str, target=None, map_location=None):
+        raise NotImplementedError
+
+    def commit(self, tag):
+        return True
+
+    def makedirs(self, path, exist_ok=True):
+        os.makedirs(path, exist_ok=exist_ok)
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """Synchronous orbax save/restore (ref: torch_checkpoint_engine.py)."""
+
+    def save(self, state_dict, path: str):
+        import orbax.checkpoint as ocp
+        with ocp.StandardCheckpointer() as c:
+            c.save(path, state_dict, force=True)
+        return path
+
+    def load(self, path: str, target=None, map_location=None):
+        import orbax.checkpoint as ocp
+        with ocp.StandardCheckpointer() as c:
+            return c.restore(path, target) if target is not None else c.restore(path)
+
+
+class AsyncCheckpointEngine(CheckpointEngine):
+    """Async background save (ref: nebula_checkpoint_engine.py — Nebula's
+    async/tiered persistence; commit() == Nebula commit barrier)."""
+
+    def __init__(self, config_params=None):
+        super().__init__(config_params)
+        self._ckptr = None
+
+    def _ensure(self):
+        if self._ckptr is None:
+            import orbax.checkpoint as ocp
+            self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        return self._ckptr
+
+    def save(self, state_dict, path: str):
+        import orbax.checkpoint as ocp
+        self._ensure().save(path, args=ocp.args.StandardSave(state_dict), force=True)
+        return path  # returns immediately; write streams in background
+
+    def load(self, path: str, target=None, map_location=None):
+        import orbax.checkpoint as ocp
+        c = self._ensure()
+        c.wait_until_finished()
+        return c.restore(path, args=ocp.args.StandardRestore(target)) if target is not None \
+            else c.restore(path)
+
+    def commit(self, tag):
+        if self._ckptr is not None:
+            self._ckptr.wait_until_finished()
+        log_dist(f"async checkpoint {tag} committed", ranks=[0])
+        return True
+
+
+_ASYNC_SINGLETON: Optional[AsyncCheckpointEngine] = None
+
+
+def make_checkpoint_engine(name: Optional[str] = None, config_params=None) -> CheckpointEngine:
+    """'orbax'/'torch' → sync; 'async'/'nebula' → async.  The async engine is
+    a process-wide singleton: orbax's AsyncCheckpointer owns a background
+    thread pool, and successive saves must serialize through one instance
+    (a fresh checkpointer per save would leak threads and lose the pending-
+    write barrier)."""
+    global _ASYNC_SINGLETON
+    name = (name or "orbax").lower()
+    if name in ("async", "nebula"):
+        if _ASYNC_SINGLETON is None:
+            _ASYNC_SINGLETON = AsyncCheckpointEngine(config_params)
+        return _ASYNC_SINGLETON
+    return OrbaxCheckpointEngine(config_params)
+
+
+def wait_for_pending_saves():
+    """Barrier for any in-flight async save (call before restoring or at
+    process exit — the Nebula commit fence)."""
+    if _ASYNC_SINGLETON is not None:
+        _ASYNC_SINGLETON.commit("pending")

@@ -170,6 +170,15 @@ class DeepSpeedEngine:
         self._compression_fn = None
         self._compression_requested = bool(config._param_dict.get("compression_training"))
 
+        # ---- progressive layer drop (ref: engine.py progressive_layer_drop
+        # config + runtime/progressive_layer_drop.py)
+        self.progressive_layer_drop = None
+        pld_cfg = config._param_dict.get("progressive_layer_drop", {})
+        if pld_cfg.get("enabled", False):
+            from .progressive_layer_drop import ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(theta=pld_cfg.get("theta", 0.5),
+                                                               gamma=pld_cfg.get("gamma", 0.001))
+
         # ---- state (lazy until first batch unless params given)
         self.state: Optional[TrainState] = None
         self.state_shardings = None
@@ -387,10 +396,22 @@ class DeepSpeedEngine:
 
         return jax.tree.map(one, batch)
 
-    def _microbatch_loss(self, params, mb, step=None):
+    def _microbatch_loss(self, params, mb, step=None, training=False):
         if self._compression_fn is not None and step is not None:
             params = self._compression_fn(params, step)
         args, kwargs = self.model_inputs_fn(mb)
+        if training and step is not None and self.progressive_layer_drop is not None \
+                and getattr(self.module, "supports_pld", False):
+            # traced PLD schedule: theta(t) = (1-p)·e^{-γt} + p, per-layer
+            # keep mask drawn from a step-derived key (ref:
+            # runtime/progressive_layer_drop.py; one compiled program, the
+            # schedule advances via the step input)
+            from .progressive_layer_drop import pld_layer_mask
+            pld = self.progressive_layer_drop
+            theta = (1.0 - pld.theta) * jnp.exp(-pld.gamma * step.astype(jnp.float32)) + pld.theta
+            rng = jax.random.fold_in(jax.random.PRNGKey(17), step)
+            mask, inv = pld_layer_mask(rng, self.module.cfg.num_hidden_layers, theta)
+            kwargs["pld_scale"] = mask * inv
         outputs = self.module.apply({"params": params}, *args, **kwargs)
         return self.loss_fn(outputs, mb)
 
@@ -419,7 +440,7 @@ class DeepSpeedEngine:
         scale = state.scaler.cur_scale
 
         def scaled_loss(p, mb):
-            loss = self._microbatch_loss(p, mb, step=state.step)
+            loss = self._microbatch_loss(p, mb, step=state.step, training=True)
             return (loss * scale).astype(jnp.float32), loss
 
         grad_fn = jax.grad(scaled_loss, has_aux=True)
@@ -449,15 +470,24 @@ class DeepSpeedEngine:
 
     def _apply_grads(self, state: TrainState, grads, loss):
         """Unscale, constrain sharding, clip, update, recast — with on-device
-        overflow skip (ref: stage3.py:2082 step + loss-scaler adjust)."""
+        overflow skip (ref: stage3.py:2082 step + loss-scaler adjust).
+
+        bf16/fp32 fast path: with a static unity scaler there is nothing to
+        unscale and no overflow-skip (ref: bf16_optimizer.py has no scaler),
+        so the finite-check reduction and the 3× whole-tree ``where`` passes
+        are elided from the compiled step entirely.
+        """
         cfg = self._config
-        inv = 1.0 / (state.scaler.cur_scale * self.gas)
-        grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+        from .fp16.loss_scaler import StaticLossScaler
+        static_unity = isinstance(self.loss_scaler, StaticLossScaler) and \
+            float(self.loss_scaler.init_scale) == 1.0
+        inv = (1.0 / self.gas) if static_unity else 1.0 / (state.scaler.cur_scale * self.gas)
         if cfg.gradient_predivide_factor != 1.0:
-            grads = jax.tree.map(lambda g: g / cfg.gradient_predivide_factor, grads)
+            inv = inv / cfg.gradient_predivide_factor
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
         grads = jax.lax.with_sharding_constraint(grads, self._grad_shardings)
 
-        found_inf = found_inf_or_nan(grads)
+        found_inf = jnp.asarray(False) if static_unity else found_inf_or_nan(grads)
         grad_norm = opt_lib.global_norm(grads)
         if cfg.gradient_clipping and cfg.gradient_clipping > 0:
             clip_scale = jnp.minimum(1.0, cfg.gradient_clipping / (grad_norm + 1e-6))
@@ -468,12 +498,13 @@ class DeepSpeedEngine:
         updates, new_opt_state = self.opt.update(grads, state.opt_state, master)
         new_master = opt_lib.apply_updates(master, updates)
 
-        # skip the update entirely on overflow (ref: fused_optimizer.py overflow path)
-        def pick(new, old):
-            return jax.tree.map(lambda n, o: jnp.where(found_inf, o, n), new, old)
+        if not static_unity:
+            # skip the update entirely on overflow (ref: fused_optimizer.py)
+            def pick(new, old):
+                return jax.tree.map(lambda n, o: jnp.where(found_inf, o, n), new, old)
 
-        new_master = pick(new_master, master)
-        new_opt_state = pick(new_opt_state, state.opt_state)
+            new_master = pick(new_master, master)
+            new_opt_state = pick(new_opt_state, state.opt_state)
         new_params = jax.tree.map(lambda m: m.astype(self.compute_dtype), new_master) if use_master else new_master
         new_scaler = self.loss_scaler.update(state.scaler, found_inf)
         lr_val = jnp.asarray(self.lr_schedule(state.step + 1), jnp.float32)
@@ -513,7 +544,7 @@ class DeepSpeedEngine:
             scale = state.scaler.cur_scale
 
             def scaled_loss(p, mb):
-                loss = self._microbatch_loss(p, mb, step=state.step)
+                loss = self._microbatch_loss(p, mb, step=state.step, training=True)
                 return (loss * scale).astype(jnp.float32), loss
 
             grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params, b)
@@ -579,6 +610,8 @@ class DeepSpeedEngine:
             self.flops_profiler.end_profile()
         self.global_steps += 1
         self.global_samples += self._config.train_batch_size
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
         self._write_monitor(metrics)
         self._maybe_print(metrics)
         return metrics.loss

@@ -1,0 +1,84 @@
+"""FP16_Optimizer — fp32-master mixed precision as a gradient transform.
+
+ref: runtime/fp16/fused_optimizer.py:33 FP16_Optimizer (and
+unfused_optimizer.py:24 FP16_UnfusedOptimizer — the fused/unfused split is a
+CUDA kernel detail with no TPU analog; both map here).
+
+The DeepSpeedEngine implements this logic inline in its compiled step
+(scaled loss → unscale → overflow-skip → fp32 master update → recast,
+engine.py _apply_grads).  This class packages the same math as a standalone
+optax-style GradientTransformation for client code that builds its own
+training loops: state = (inner_state, master fp32 params, loss-scaler
+state); update consumes SCALED fp16/bf16 grads and emits parameter DELTAS
+in compute dtype, skipping on overflow exactly like the reference's
+``overflow`` path.
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.optimizer import GradientTransformation
+from .loss_scaler import DynamicLossScaler, LossScalerState, create_loss_scaler, found_inf_or_nan
+
+
+class FP16OptimizerState(NamedTuple):
+    inner: Any
+    master: Any          # fp32 copies of params
+    scaler: LossScalerState
+    skipped: jnp.ndarray
+
+
+class FP16_Optimizer:
+    """Wrap ``inner`` with loss scaling + fp32 master weights.  Duck-typed
+    to the optax-style (init, update) contract the engine accepts for
+    client optimizers."""
+
+    def __init__(self, inner: GradientTransformation, fp16_config=None, compute_dtype=jnp.float16,
+                 clip_grad: float = 0.0):
+        self.inner = inner
+        self.scaler = create_loss_scaler(fp16_config, compute_dtype)
+        self.clip_grad = clip_grad
+        self.compute_dtype = compute_dtype
+        self.init = self._init
+        self.update = self._update
+
+    def _init(self, params):
+        master = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+        return FP16OptimizerState(inner=self.inner.init(master), master=master,
+                                  scaler=self.scaler.init_state(),
+                                  skipped=jnp.zeros((), jnp.int32))
+
+    def _update(self, scaled_grads, state: FP16OptimizerState, params=None):
+        inv = 1.0 / state.scaler.cur_scale
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, scaled_grads)
+        found_inf = found_inf_or_nan(grads)
+        if self.clip_grad and self.clip_grad > 0:
+            from ...ops.optimizer import clip_by_global_norm
+            grads, _ = clip_by_global_norm(grads, self.clip_grad)
+        updates, new_inner = self.inner.update(grads, state.inner, state.master)
+        new_master = jax.tree.map(lambda m, u: m + u, state.master, updates)
+
+        def pick(new, old):
+            return jax.tree.map(lambda n, o: jnp.where(found_inf, o, n), new, old)
+
+        new_master = pick(new_master, state.master)
+        new_inner = pick(new_inner, state.inner)
+        # emit deltas in compute dtype: new_param - old_param
+        deltas = jax.tree.map(lambda m, p: (m.astype(self.compute_dtype) - p), new_master, params) \
+            if params is not None else jax.tree.map(lambda m: m.astype(self.compute_dtype), new_master)
+        new_state = FP16OptimizerState(inner=new_inner, master=new_master,
+                                       scaler=self.scaler.update(state.scaler, found_inf),
+                                       skipped=state.skipped + found_inf.astype(jnp.int32))
+        return deltas, new_state
+
+    @property
+    def loss_scale(self):
+        """ref: fused_optimizer.py loss_scale property (static value needs
+        the live state — read state.scaler.cur_scale instead)."""
+        return None
+
+
+# the reference's unfused variant differs only in CUDA kernel choice
+FP16_UnfusedOptimizer = FP16_Optimizer

@@ -1,0 +1,54 @@
+"""Progressive Layer Dropping (PLD).
+
+ref: runtime/progressive_layer_drop.py (theta schedule
+theta(t) = (1-p)·exp(-gamma·t) + p) + engine hook (config key
+``progressive_layer_drop``; the reference's models read pld_theta from
+``get_state()`` and stochastically skip transformer blocks).
+
+TPU-native model integration: ``pld_layer_mask(rng, num_layers, theta)``
+draws the per-layer keep mask with the PLD depth-scaled keep probability
+(deeper layers drop more often, per the paper), shaped for the
+scan-over-layers models: multiply each block's residual branch by
+mask[layer]/keep_prob inside the scan body — static shapes, one compiled
+program for all steps, theta enters as a traced scalar.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    """ref: progressive_layer_drop.py:10."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})", ranks=[0])
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step: int):
+        self.current_theta = (1.0 - self.theta) * float(np.exp(-self.gamma * global_step)) + self.theta
+        return self.current_theta
+
+
+def pld_layer_mask(rng, num_layers: int, theta, dtype=jnp.float32):
+    """(mask[L], inv_keep[L]) — keep mask and 1/keep_prob scaling.
+
+    Layer l keeps with probability 1 - (l+1)/L · (1-theta): identity at
+    theta=1, linear depth scaling as theta decays (PLD eq. 6).  Multiply a
+    block's residual delta by mask[l]*inv_keep[l] to apply.
+    """
+    depth = (jnp.arange(num_layers, dtype=jnp.float32) + 1.0) / num_layers
+    keep_p = 1.0 - depth * (1.0 - jnp.asarray(theta, jnp.float32))
+    mask = jax.random.bernoulli(rng, keep_p).astype(dtype)
+    return mask, (1.0 / keep_p).astype(dtype)

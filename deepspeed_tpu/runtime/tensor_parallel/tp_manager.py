@@ -51,6 +51,13 @@ class TpTrainingManager:
         tp = mesh.shape.get(TENSOR_AXIS, 1)
         out: Dict[str, P] = {}
 
+        import re
+
+        def is_row(path):
+            # word-boundary match on dotted segments ('wo' must not hit
+            # 'word_embeddings')
+            return any(re.search(rf"(^|\.){re.escape(p)}(\.|$)", path) for p in ROW_PARALLEL_PATTERNS)
+
         def walk(tree, prefix=()):
             if isinstance(tree, dict):
                 for k, v in tree.items():
@@ -58,15 +65,28 @@ class TpTrainingManager:
                 return
             path = ".".join(prefix)
             shape = tree.shape if hasattr(tree, "shape") else ()
-            if tp <= 1 or len(shape) < 2:
+            # scan-over-layers trees stack a leading layer axis — never
+            # shard it (converted HF trees: q_proj [L,E,H,D], o_proj [L,H,D,E])
+            stacked = "layers" in prefix and len(shape) >= 3
+            base = 1 if stacked else 0
+            eff = shape[base:]
+            if tp <= 1 or len(eff) < 2:
                 out[path] = P()
-            elif any(p in path for p in ROW_PARALLEL_PATTERNS):
-                # row-parallel: shard the contraction (first) dim
-                out[path] = P(TENSOR_AXIS) if shape[0] % tp == 0 else P()
-            else:
-                # column-parallel: shard the output (last) dim
+            elif is_row(path):
+                # row-parallel: shard the first contraction dim (heads for
+                # [H, D, E]-style attention-out kernels)
                 spec = [None] * len(shape)
-                if shape[-1] % tp == 0:
+                if eff[0] % tp == 0:
+                    spec[base] = TENSOR_AXIS
+                out[path] = P(*spec)
+            else:
+                # column-parallel: shard the output heads dim for
+                # [E, H, D]-style kernels, else the last dim
+                spec = [None] * len(shape)
+                tgt = base + 1 if len(eff) >= 3 else len(shape) - 1
+                if shape[tgt] % tp == 0:
+                    spec[tgt] = TENSOR_AXIS
+                elif shape[-1] % tp == 0:
                     spec[-1] = TENSOR_AXIS
                 out[path] = P(*spec)
 

@@ -94,6 +94,30 @@ def test_phi3_policy_splits_fused():
     assert np.isfinite(np.asarray(out)).all()
 
 
+def test_qwen2_bias_reaches_cache_model(tmp_path):
+    """The paged-decode model must honor attention_bias — qwen2 greedy
+    decode through the v2 engine must match HF's next token (fp32 engine:
+    tiny random models have near-tied logits in bf16)."""
+    import torch
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_factory import _load_state_dict
+    from transformers import AutoConfig
+
+    hf_model, hf_cfg, path = _tiny_hf_llama(tmp_path, "qwen2")
+    sd = _load_state_dict(path)
+    cfg, params = convert_hf_state_dict(sd, AutoConfig.from_pretrained(path, local_files_only=True))
+    assert cfg.attention_bias
+    assert "bias" in params["model"]["layers"]["self_attn"]["q_proj"]
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32, "remat": False})
+    eng = InferenceEngineV2(cfg, {"params": params},
+                            RaggedInferenceEngineConfig(kv_dtype=jnp.float32))
+    prompt = [5, 9, 2, 7]
+    out = eng.generate([prompt], max_new_tokens=1)[0]
+    with torch.no_grad():
+        logits = hf_model(torch.tensor([prompt])).logits[0, -1]
+    assert out[0] == int(logits.argmax())
+
+
 def test_unknown_model_type_raises():
     with pytest.raises(ValueError, match="no inference policy"):
         policy_for("made_up_arch")

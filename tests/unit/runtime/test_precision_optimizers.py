@@ -1,0 +1,114 @@
+"""FP16_Optimizer / BF16_Optimizer / PLD / checkpoint-engine tests
+(analogs of reference tests/unit/runtime/half_precision/test_fp16.py,
+test_bf16.py and runtime PLD coverage)."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.llama import LlamaForCausalLM
+from deepspeed_tpu.ops.adam import fused_adam
+from deepspeed_tpu.runtime.bf16_optimizer import BF16_Optimizer
+from deepspeed_tpu.runtime.fp16.fused_optimizer import FP16_Optimizer
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop, pld_layer_mask
+
+from simple_model import TINY, base_config, random_batch
+
+
+def test_fp16_optimizer_standalone():
+    from deepspeed_tpu.runtime.config import FP16Config
+    params = {"w": jnp.ones((4, ), jnp.float16)}
+    opt = FP16_Optimizer(fused_adam(lr=0.1), compute_dtype=jnp.float16,
+                         fp16_config=FP16Config(enabled=True, initial_scale_power=8, hysteresis=1))
+    state = opt.init(params)
+    scale = state.scaler.cur_scale
+    grads = jax.tree.map(lambda p: jnp.full_like(p, 1.0) * scale, params)  # pre-scaled
+    deltas, state = opt.update(grads, state, params)
+    new_params = jax.tree.map(lambda p, d: p + d, params, deltas)
+    assert float(new_params["w"][0]) < 1.0  # moved downhill
+    assert int(state.skipped) == 0
+
+    # overflow grads → step skipped, scale halves
+    bad = jax.tree.map(lambda p: jnp.full_like(p, np.inf), params)
+    deltas2, state2 = opt.update(bad, state, new_params)
+    np.testing.assert_allclose(np.asarray(deltas2["w"], np.float32), 0.0)
+    assert int(state2.skipped) == 1
+    assert float(state2.scaler.cur_scale) < float(state.scaler.cur_scale)
+
+
+def test_bf16_optimizer_standalone():
+    params = {"w": jnp.ones((4, ), jnp.bfloat16)}
+    opt = BF16_Optimizer(fused_adam(lr=0.1))
+    state = opt.init(params)
+    assert state.master["w"].dtype == jnp.float32
+    grads = {"w": jnp.ones((4, ), jnp.bfloat16)}
+    deltas, state = opt.update(grads, state, params)
+    assert float((params["w"] + deltas["w"])[0]) < 1.0
+
+
+def test_pld_schedule_and_mask():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    pld.update_state(0)
+    assert abs(pld.get_theta() - 1.0) < 1e-6
+    pld.update_state(10**6)
+    assert abs(pld.get_theta() - 0.5) < 1e-3  # decays to theta
+    assert pld.get_state()["progressive_layer_drop"]
+
+    mask, inv = pld_layer_mask(jax.random.PRNGKey(0), 8, theta=1.0)
+    np.testing.assert_array_equal(np.asarray(mask), 1.0)  # theta=1: keep all
+    masks = [np.asarray(pld_layer_mask(jax.random.PRNGKey(s), 8, 0.3)[0]) for s in range(50)]
+    rate = np.stack(masks).mean(0)
+    assert rate[0] > rate[-1]  # deeper layers drop more
+
+
+def test_engine_pld_hook():
+    cfg = base_config(**{"progressive_layer_drop": {"enabled": True, "theta": 0.6, "gamma": 0.1}})
+    engine, _, _, _ = ds.initialize(model=LlamaForCausalLM(TINY), config=cfg)
+    assert engine.progressive_layer_drop is not None
+    for _ in range(3):
+        engine.train_batch(batch=random_batch())
+    assert engine.progressive_layer_drop.get_theta() < 1.0
+
+
+def test_pld_actually_drops_layers():
+    """pld_scale gates block residuals: zeroing every layer must reduce the
+    model to embeddings+norm+head (layer params contribute nothing)."""
+    import jax.numpy as jnp
+    model = LlamaForCausalLM(TINY)
+    ids = jnp.ones((2, 8), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), ids)
+    full = model.apply(v, ids)
+    keep = model.apply(v, ids, pld_scale=jnp.ones((TINY.num_hidden_layers, )))
+    drop = model.apply(v, ids, pld_scale=jnp.zeros((TINY.num_hidden_layers, )))
+    np.testing.assert_allclose(np.asarray(full), np.asarray(keep), rtol=1e-6)
+    assert np.abs(np.asarray(full) - np.asarray(drop)).max() > 1e-3
+
+
+def test_async_checkpoint_engine(tmp_path):
+    from deepspeed_tpu.runtime.checkpoint_engine import AsyncCheckpointEngine, make_checkpoint_engine
+    eng = make_checkpoint_engine("nebula")
+    assert isinstance(eng, AsyncCheckpointEngine)
+    tree = {"a": jnp.arange(8.0), "b": {"c": jnp.ones((2, 2))}}
+    eng.save(tree, str(tmp_path / "st"))
+    assert eng.commit("t")  # waits for durability
+    back = eng.load(str(tmp_path / "st"))
+    np.testing.assert_array_equal(np.asarray(back["a"]), np.arange(8.0))
+
+
+def test_nebula_config_checkpoint_roundtrip(tmp_path):
+    cfg = base_config()
+    cfg["nebula"] = {"enabled": True}
+    engine, _, _, _ = ds.initialize(model=LlamaForCausalLM(TINY), config=cfg)
+    batch = random_batch()
+    engine.train_batch(batch=batch)
+    loss = float(engine.eval_batch(batch=batch))
+    engine.save_checkpoint(tmp_path, tag="async1")
+    fresh, _, _, _ = ds.initialize(model=LlamaForCausalLM(TINY), config=cfg)
+    fresh.train_batch(batch=random_batch(seed=9))
+    fresh.load_checkpoint(tmp_path, tag="async1")
+    assert abs(float(fresh.eval_batch(batch=batch)) - loss) < 1e-5

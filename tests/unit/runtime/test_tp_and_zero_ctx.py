@@ -36,6 +36,26 @@ def test_tp_manager_plan():
     assert sh["mlp"]["up_proj"]["kernel"].spec[-1] == "tensor"
 
 
+def test_tp_manager_stacked_hf_tree():
+    """Converted HF trees carry a leading layer axis that must never be
+    sharded; heads are the TP dim."""
+    mesh = create_mesh(MeshSpec(data=2, tensor=4), devices=jax.devices()[:8])
+    L, E, H, D = 2, 32, 8, 4
+    abs_params = {"model": {"layers": {"self_attn": {
+        "q_proj": {"kernel": jax.ShapeDtypeStruct((L, E, H, D), jnp.float32)},
+        "o_proj": {"kernel": jax.ShapeDtypeStruct((L, H, D, E), jnp.float32)},
+    }}},
+        "word_embeddings": {"kernel": jax.ShapeDtypeStruct((256, E), jnp.float32)}}
+    plan = TpTrainingManager(tp_size=4).plan(abs_params, mesh)
+    q = plan["model.layers.self_attn.q_proj.kernel"]
+    o = plan["model.layers.self_attn.o_proj.kernel"]
+    assert q[0] is None and q[2] == "tensor"      # layer axis untouched, heads sharded
+    assert o[0] is None and o[1] == "tensor"      # row-parallel over heads
+    # 'wo' pattern must not hit 'word_embeddings' (word-boundary match)
+    we = plan["word_embeddings.kernel"]
+    assert we[0] is None
+
+
 def test_tp_model_init_api():
     model, mgr = ds.tp_model_init(model=LlamaForCausalLM(TINY), tp_size=2)
     assert isinstance(mgr, TpTrainingManager) and mgr.tp_size == 2
