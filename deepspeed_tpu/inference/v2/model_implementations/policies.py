@@ -10,7 +10,10 @@ including transposes into DenseGeneral layouts and stacking per-layer
 tensors along axis 0 for the scan-over-layers models.
 
 Covered model_types (ref model_implementations dirs): llama (v1/v2/v3),
-mistral, qwen2, phi3 (fused qkv/gate_up split), mixtral (MoE).
+mistral, qwen2, phi3 (fused qkv/gate_up split), mixtral (MoE), opt
+(learned positions / ReLU / biases).  llama-family configs additionally
+serve through the FastGen-v2 paged engine; opt/mixtral serve via
+module_inject.replace_module + init_inference/hybrid generate.
 """
 
 import re
@@ -139,6 +142,67 @@ class Phi3Policy(InferenceV2Policy):
         return super().convert(expanded, cfg)
 
 
+class OPTPolicy(InferenceV2Policy):
+    """ref: model_implementations/opt/ — learned positions, pre-LN, ReLU MLP,
+    qkv/out/fc biases; maps onto models/opt.py."""
+    model_type = "opt"
+
+    def build_config(self, hf_cfg):
+        from ....models.opt import OPTConfig
+        return OPTConfig.from_hf(hf_cfg)
+
+    def build_model(self, cfg):
+        from ....models.opt import OPTForCausalLM
+        return OPTForCausalLM(cfg)
+
+    def convert(self, sd, cfg):
+        H = cfg.num_attention_heads
+        D = cfg.hidden_size // H
+        E = cfg.hidden_size
+        L = cfg.num_hidden_layers
+
+        def get(name):
+            t = sd[name]
+            return np.asarray(t.float().numpy() if hasattr(t, "float") else t, np.float32)
+
+        def stack(fmt, conv=lambda w: w):
+            return np.stack([conv(get(fmt.format(i=i))) for i in range(L)])
+
+        def ln(prefix):
+            return {"scale": stack(prefix + ".weight"), "bias": stack(prefix + ".bias")}
+
+        def proj(name):
+            return {"kernel": stack(f"model.decoder.layers.{{i}}.self_attn.{name}.weight",
+                                    lambda w: _t(w).reshape(E, H, D)),
+                    "bias": stack(f"model.decoder.layers.{{i}}.self_attn.{name}.bias",
+                                  lambda b: b.reshape(H, D))}
+
+        params = {
+            "embed_tokens": {"embedding": get("model.decoder.embed_tokens.weight")},
+            "embed_positions": {"embedding": get("model.decoder.embed_positions.weight")},
+            "final_layer_norm": {"scale": get("model.decoder.final_layer_norm.weight"),
+                                 "bias": get("model.decoder.final_layer_norm.bias")},
+            "layers": {
+                "self_attn_layer_norm": ln("model.decoder.layers.{i}.self_attn_layer_norm"),
+                "final_layer_norm": ln("model.decoder.layers.{i}.final_layer_norm"),
+                "self_attn": {
+                    "q_proj": proj("q_proj"), "k_proj": proj("k_proj"), "v_proj": proj("v_proj"),
+                    "out_proj": {"kernel": stack("model.decoder.layers.{i}.self_attn.out_proj.weight",
+                                                 lambda w: _t(w).reshape(H, D, E)),
+                                 "bias": stack("model.decoder.layers.{i}.self_attn.out_proj.bias")},
+                },
+                "fc1": {"kernel": stack("model.decoder.layers.{i}.fc1.weight", _t),
+                        "bias": stack("model.decoder.layers.{i}.fc1.bias")},
+                "fc2": {"kernel": stack("model.decoder.layers.{i}.fc2.weight", _t),
+                        "bias": stack("model.decoder.layers.{i}.fc2.bias")},
+            },
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"kernel": _t(get("lm_head.weight"))} if "lm_head.weight" in sd \
+                else {"kernel": _t(params["embed_tokens"]["embedding"])}
+        return params
+
+
 class MixtralPolicy(InferenceV2Policy):
     """ref: model_implementations/mixtral/ — MoE FFN: per-layer experts
     stacked onto the expert axis of our Mixtral model."""
@@ -153,9 +217,51 @@ class MixtralPolicy(InferenceV2Policy):
         return MixtralForCausalLM(cfg)
 
     def convert(self, sd, cfg):
-        raise NotImplementedError(
-            "mixtral HF weight conversion lands with the MoE serving path; "
-            "use deepspeed_tpu.models.mixtral natively-initialized for now")
+        H, KV = cfg.num_attention_heads, cfg.num_key_value_heads
+        D = cfg.hidden_size // H
+        E = cfg.hidden_size
+        L = cfg.num_hidden_layers
+        NE = cfg.num_local_experts
+
+        def get(name):
+            t = sd[name]
+            return np.asarray(t.float().numpy() if hasattr(t, "float") else t, np.float32)
+
+        def stack(fmt, conv=lambda w: w):
+            return np.stack([conv(get(fmt.format(i=i))) for i in range(L)])
+
+        def experts(w_name):
+            # [L, NE, ...] from model.layers.{i}.block_sparse_moe.experts.{e}.{w1,w2,w3}
+            return np.stack([
+                np.stack([_t(get(f"model.layers.{i}.block_sparse_moe.experts.{e}.{w_name}.weight"))
+                          for e in range(NE)]) for i in range(L)])
+
+        params = {
+            "embed_tokens": {"embedding": get("model.embed_tokens.weight")},
+            "norm": {"weight": get("model.norm.weight")},
+            "layers": {
+                "input_layernorm": {"weight": stack("model.layers.{i}.input_layernorm.weight")},
+                "post_attention_layernorm": {"weight": stack("model.layers.{i}.post_attention_layernorm.weight")},
+                "self_attn": {
+                    "q_proj": {"kernel": stack("model.layers.{i}.self_attn.q_proj.weight",
+                                               lambda w: _t(w).reshape(E, H, D))},
+                    "k_proj": {"kernel": stack("model.layers.{i}.self_attn.k_proj.weight",
+                                               lambda w: _t(w).reshape(E, KV, D))},
+                    "v_proj": {"kernel": stack("model.layers.{i}.self_attn.v_proj.weight",
+                                               lambda w: _t(w).reshape(E, KV, D))},
+                    "o_proj": {"kernel": stack("model.layers.{i}.self_attn.o_proj.weight",
+                                               lambda w: _t(w).reshape(H, D, E))},
+                },
+                "block_sparse_moe": {
+                    "gate": {"kernel": stack("model.layers.{i}.block_sparse_moe.gate.weight", _t)},
+                    # HF w1=gate, w3=up, w2=down; ours w_* in (in, out) layout
+                    "experts": {"w_gate": experts("w1"), "w_up": experts("w3"), "w_down": experts("w2")},
+                },
+            },
+        }
+        params["lm_head"] = {"kernel": _t(get("lm_head.weight"))} if "lm_head.weight" in sd \
+            else {"kernel": _t(params["embed_tokens"]["embedding"])}
+        return params
 
 
 POLICY_REGISTRY = {
@@ -164,6 +270,7 @@ POLICY_REGISTRY = {
     "qwen2": Qwen2Policy(),
     "phi3": Phi3Policy(),
     "mixtral": MixtralPolicy(),
+    "opt": OPTPolicy(),
 }
 
 

@@ -1,0 +1,154 @@
+"""OPT family — decoder-only with learned positions and ReLU MLP.
+
+ref: deepspeed/inference/v2/model_implementations/opt/ (+ module_inject
+containers/opt.py) — the reference serves OPT through its kernel containers;
+here it is a first-class flax model sharing the logical-axis vocabulary of
+models/llama.py so every parallelism axis (ZeRO/TP/SP) applies unchanged.
+
+Architecture (HF OPTForCausalLM): token embed + learned position embed
+(offset 2), pre-LN decoder blocks (LayerNorm with bias), standard MHA with
+qkv+out biases, ReLU MLP (fc1/fc2 with bias), final LN, tied or separate
+lm head.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from .llama import EMBED, HEADS, HEAD_DIM, KV_HEADS, LAYERS, MLP, VOCAB, _logical, get_attention_impl
+
+
+@dataclass(frozen=True)
+class OPTConfig:
+    vocab_size: int = 50272
+    hidden_size: int = 768
+    ffn_dim: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 2048
+    do_layer_norm_before: bool = True
+    tie_word_embeddings: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+    attention_impl: str = "reference"
+
+    @staticmethod
+    def from_hf(hf_cfg, **overrides):
+        proj = getattr(hf_cfg, "word_embed_proj_dim", None)
+        if proj not in (None, hf_cfg.hidden_size):
+            raise NotImplementedError(
+                f"OPT checkpoints with projected embeddings (word_embed_proj_dim={proj} != "
+                f"hidden_size={hf_cfg.hidden_size}, e.g. opt-350m) are not supported")
+        if not getattr(hf_cfg, "do_layer_norm_before", True):
+            raise NotImplementedError("post-LN OPT variants (do_layer_norm_before=False, "
+                                      "e.g. opt-350m) are not supported")
+        fields = dict(vocab_size=hf_cfg.vocab_size,
+                      hidden_size=hf_cfg.hidden_size,
+                      ffn_dim=hf_cfg.ffn_dim,
+                      num_hidden_layers=hf_cfg.num_hidden_layers,
+                      num_attention_heads=hf_cfg.num_attention_heads,
+                      max_position_embeddings=hf_cfg.max_position_embeddings,
+                      do_layer_norm_before=getattr(hf_cfg, "do_layer_norm_before", True),
+                      tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", True))
+        fields.update(overrides)
+        return OPTConfig(**fields)
+
+
+class OPTAttention(nn.Module):
+    cfg: OPTConfig
+
+    @nn.compact
+    def __call__(self, x, segment_ids=None):
+        cfg = self.cfg
+        H = cfg.num_attention_heads
+        D = cfg.hidden_size // H
+        dense = partial(nn.DenseGeneral, use_bias=True, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        q = dense(features=(H, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, HEADS, HEAD_DIM)),
+                  name="q_proj")(x)
+        k = dense(features=(H, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
+                  name="k_proj")(x)
+        v = dense(features=(H, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
+                  name="v_proj")(x)
+        attn_fn = get_attention_impl(cfg.attention_impl)
+        out = attn_fn(q, k, v, causal=True, segment_ids=segment_ids)
+        return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=True,
+                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                               kernel_init=_logical(nn.initializers.lecun_normal(), (HEADS, HEAD_DIM, EMBED)),
+                               name="out_proj")(out)
+
+
+class OPTBlock(nn.Module):
+    cfg: OPTConfig
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, x, segment_ids=None):
+        cfg = self.cfg
+        ln = partial(nn.LayerNorm, epsilon=1e-5, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        h = x
+        a_in = ln(name="self_attn_layer_norm")(h) if cfg.do_layer_norm_before else h
+        a = OPTAttention(cfg, name="self_attn")(a_in, segment_ids)
+        h = h + a
+        if not cfg.do_layer_norm_before:
+            h = ln(name="self_attn_layer_norm")(h)
+        m_in = ln(name="final_layer_norm")(h) if cfg.do_layer_norm_before else h
+        m = nn.Dense(cfg.ffn_dim, use_bias=True, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, MLP)), name="fc1")(m_in)
+        m = jax.nn.relu(m)
+        m = nn.Dense(cfg.hidden_size, use_bias=True, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=_logical(nn.initializers.lecun_normal(), (MLP, EMBED)), name="fc2")(m)
+        out = h + m
+        if not cfg.do_layer_norm_before:
+            out = ln(name="final_layer_norm")(out)
+        if self.scanned:
+            return out, None
+        return out
+
+
+class OPTForCausalLM(nn.Module):
+    cfg: OPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                         name="embed_tokens")
+        # HF OPT offsets learned positions by 2 (padding convention)
+        pos_embed = nn.Embed(cfg.max_position_embeddings + 2, cfg.hidden_size, dtype=cfg.dtype,
+                             param_dtype=cfg.param_dtype,
+                             embedding_init=nn.initializers.normal(0.02),
+                             name="embed_positions")
+        x = embed(input_ids) + pos_embed(positions + 2)
+
+        block_cls = OPTBlock
+        if cfg.remat:
+            block_cls = nn.remat(OPTBlock, prevent_cse=not cfg.scan_layers)
+        if cfg.scan_layers:
+            blocks = nn.scan(block_cls, variable_axes={"params": 0}, split_rngs={"params": True},
+                             in_axes=(nn.broadcast, ), length=cfg.num_hidden_layers,
+                             metadata_params={nn.PARTITION_NAME: LAYERS})
+            x, _ = blocks(cfg, scanned=True, name="layers")(x, segment_ids)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                x = block_cls(cfg, name=f"layers_{i}")(x, segment_ids)
+
+        if cfg.do_layer_norm_before:  # HF: final LN exists only for pre-LN OPT
+            x = nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                             name="final_layer_norm")(x)
+        if cfg.tie_word_embeddings:
+            return embed.attend(x)
+        return nn.DenseGeneral(features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                               param_dtype=cfg.param_dtype,
+                               kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, VOCAB)),
+                               name="lm_head")(x)

@@ -56,14 +56,18 @@ def compressed_allreduce(x, error, axis_name: str) -> Tuple[jnp.ndarray, jnp.nda
     return avg.astype(x.dtype), new_error.astype(error.dtype)
 
 
-def all_to_all_quant_reduce(x, axis_name: str, bits: int = 8, block: int = 256):
+def all_to_all_quant_reduce(x, axis_name: str, bits: int = 8, block: int = 256,
+                            return_local_dequant: bool = False):
     """qgZ: quantized gradient reduce-scatter (ref: coalesced_collectives.py
     :31 all_to_all_quant_reduce — quantize → all-to-all → dequant-reduce).
 
     x: [n] local gradient with n divisible by the axis size.  Each rank
     receives everyone's quantized copy of ITS output shard and reduces in
     fp32.  Returns the rank's averaged shard [n/P].  Wire: int8 (or packed
-    int4) instead of fp32.
+    int4) instead of fp32.  ``return_local_dequant`` additionally returns
+    the dequantized copy of THIS rank's full input exactly as the wire
+    carried it (the LoCo error-feedback residual source — computed here so
+    the codec exists in exactly one place).
     """
     world = jax.lax.axis_size(axis_name)
     flat = x.reshape(-1).astype(jnp.float32)
@@ -73,11 +77,13 @@ def all_to_all_quant_reduce(x, axis_name: str, bits: int = 8, block: int = 256):
     chunks = flat.reshape(world, shard)
     if bits == 8:
         q, s = quantize_int8(chunks.reshape(-1), block)
+        local_deq = dequantize_int8(q, s, (n, )) if return_local_dequant else None
         nblocks = q.shape[0] // world
         q = q.reshape(world, nblocks, block)
         s = s.reshape(world, nblocks)
     else:
         q, s = quantize_int4(chunks.reshape(-1), block)
+        local_deq = dequantize_int4(q, s, (n, )) if return_local_dequant else None
         nblocks = q.shape[0] // world
         q = q.reshape(world, nblocks, block // 2)
         s = s.reshape(world, nblocks)
@@ -89,7 +95,10 @@ def all_to_all_quant_reduce(x, axis_name: str, bits: int = 8, block: int = 256):
         deq = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, (shard, )))(q_recv, s_recv)
     else:
         deq = jax.vmap(lambda qq, ss: dequantize_int4(qq, ss, (shard, )))(q_recv, s_recv)
-    return jnp.mean(deq, axis=0)  # [shard] fp32
+    reduced = jnp.mean(deq, axis=0)  # [shard] fp32
+    if return_local_dequant:
+        return reduced, local_deq
+    return reduced
 
 
 def quantized_all_gather(shard, axis_name: str, bits: int = 8, block: int = 256):
@@ -112,3 +121,21 @@ def quantized_all_gather(shard, axis_name: str, bits: int = 8, block: int = 256)
         all_s = jax.lax.all_gather(s, axis_name)
         deq = jax.vmap(lambda qq, ss: dequantize_int4(qq, ss, (m, )))(all_q, all_s)
     return deq.reshape(-1)
+
+
+def loco_all_to_all_quant_reduce(x, error, axis_name: str, bits: int = 8, block: int = 256,
+                                 err_beta: float = 0.8):
+    """LoCo-qgZ: quantized gradient reduction WITH local error feedback
+    (ref: coalesced_collectives.py:81 all_to_all_loco_quant_reduce — the
+    LoCo variant folds the previous round's quantization error back into
+    the gradient before quantizing, removing the bias of plain qgZ).
+
+    x: [n] local grad; error: [n] running error state (same shape).
+    Returns (reduced_shard [n/P] fp32, new_error [n]).
+    """
+    flat = x.reshape(-1).astype(jnp.float32)
+    fed = flat + err_beta * error.reshape(-1).astype(jnp.float32)
+    reduced, deq = all_to_all_quant_reduce(fed, axis_name, bits=bits, block=block,
+                                           return_local_dequant=True)
+    new_error = (fed - deq).reshape(x.shape)
+    return reduced, new_error.astype(error.dtype)

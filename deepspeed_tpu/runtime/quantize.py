@@ -1,0 +1,86 @@
+"""MoQ — Mixture-of-Quantization training quantizer.
+
+ref: runtime/quantize.py (Quantizer.quantize — gradual bit reduction with a
+mixed-fp16 blend ratio, optionally scheduled by per-layer Hessian
+eigenvalues; engine hook engine.py:1532 _configure_quantization).
+
+Functional port: ``MoQQuantizer.apply(params, step, eigenvalues=None)``
+quantize-dequantizes weight leaves at the current bit-width with an
+fp16-mix ratio that decays from 1→0 (``quantize_real_ratio`` in the
+reference), so early training sees mostly-full-precision weights.  When
+eigenvalues are provided (runtime/eigenvalue.py), layers with larger
+curvature keep higher precision longer — the reference's
+eigenvalue-adjusted period.
+"""
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..compression.utils import asym_quantize, sym_quantize
+from ..utils.logging import logger
+
+
+class MoQQuantizer:
+
+    def __init__(self, q_groups: int = 1, q_mixed_fp16: bool = False, q_change_ratio: float = 0.01,
+                 q_type: int = 0, q_rounding: int = 0, q_verbose: bool = False,
+                 q_eigenvalue: bool = False, start_bits: int = 16, target_bits: int = 8,
+                 period: int = 100):
+        self.q_groups = q_groups
+        self.q_mixed_fp16 = q_mixed_fp16
+        self.q_change_ratio = q_change_ratio
+        self.q_type = q_type  # 0: symmetric, 1: asymmetric
+        self.q_rounding = q_rounding
+        self.q_eigenvalue = q_eigenvalue
+        self.start_bits = start_bits
+        self.target_bits = target_bits
+        self.period = period
+        if q_verbose:
+            logger.info(f"MoQ: {start_bits}→{target_bits} bits, period={period}, "
+                        f"mixed_fp16={q_mixed_fp16}, eigenvalue={q_eigenvalue}")
+
+    def bits_at(self, step, scale: float = 1.0):
+        """Halve from start→target every doubling period (ref:
+        quantize.py:136 q_period <<= 1).  ``scale`` stretches the period for
+        high-curvature layers (eigenvalue scheduling)."""
+        s = jnp.maximum(0.0, step.astype(jnp.float32))
+        p = jnp.maximum(1.0, self.period * scale)
+        k = jnp.floor(jnp.log2(s / p + 1.0))
+        return jnp.maximum(float(self.target_bits), jnp.floor(self.start_bits * jnp.exp2(-k)))
+
+    def mix_ratio(self, step):
+        """quantize_real_ratio: fp16-blend weight decaying 1→0
+        (ref: quantize.py update_fp16_ratio)."""
+        if not self.q_mixed_fp16:
+            return jnp.asarray(0.0, jnp.float32)
+        return jnp.clip(1.0 - self.q_change_ratio * step.astype(jnp.float32), 0.0, 1.0)
+
+    def apply(self, params, step, eigenvalues: Optional[Dict[str, float]] = None):
+        """Quantize-dequantize every ≥2-D float leaf (STE inside)."""
+        step = jnp.asarray(step)
+        mix = self.mix_ratio(step)
+        eigs = eigenvalues or {}
+        max_eig = max(eigs.values(), default=1.0) or 1.0
+
+        def walk(tree, path=()):
+            if isinstance(tree, dict):
+                return {k: walk(v, path + (str(k), )) for k, v in tree.items()}
+            if not hasattr(tree, "ndim") or tree.ndim < 2 or not jnp.issubdtype(tree.dtype, jnp.floating):
+                return tree
+            scale = 1.0
+            if self.q_eigenvalue and eigs:
+                block = path[0] if path else ""
+                # higher curvature → longer period → later quantization
+                scale = 1.0 + eigs.get(str(block), 0.0) / max_eig
+            bits = self.bits_at(step, scale)
+            qfn = sym_quantize if self.q_type == 0 else asym_quantize
+            q = qfn(tree, bits, num_groups=self.q_groups)
+            return (mix * tree + (1.0 - mix) * q).astype(tree.dtype)
+
+        return walk(params)
+
+
+# API-parity alias (ref: runtime/quantize.py class Quantizer)
+Quantizer = MoQQuantizer
