@@ -1,0 +1,8 @@
+"""Accelerator abstraction (ref: deepspeed/accelerator/).
+
+``get_accelerator()`` auto-detects TPU vs CPU (env override DS_ACCELERATOR,
+ref: real_accelerator.py:51).
+"""
+
+from .abstract_accelerator import DeepSpeedAccelerator
+from .real_accelerator import get_accelerator, set_accelerator

@@ -11,9 +11,10 @@ tensors along axis 0 for the scan-over-layers models.
 
 Covered model_types (ref model_implementations dirs): llama (v1/v2/v3),
 mistral, qwen2, phi3 (fused qkv/gate_up split), mixtral (MoE), opt
-(learned positions / ReLU / biases).  llama-family configs additionally
-serve through the FastGen-v2 paged engine; opt/mixtral serve via
-module_inject.replace_module + init_inference/hybrid generate.
+(learned positions / ReLU / biases), falcon (fused qkv, parallel
+residual).  llama-family configs additionally serve through the FastGen-v2
+paged engine; opt/mixtral/falcon serve via module_inject.replace_module +
+init_inference/hybrid generate.
 """
 
 import re
@@ -27,6 +28,22 @@ from ....utils.logging import logger
 
 def _t(x):
     return np.ascontiguousarray(np.asarray(x).T)
+
+
+def _get(sd, name):
+    """Checkpoint tensor → fp32 numpy (torch or numpy input)."""
+    t = sd[name]
+    return np.asarray(t.float().numpy() if hasattr(t, "float") else t, np.float32)
+
+
+def _stack(sd, fmt, L, conv=lambda w: w):
+    """Stack per-layer tensors along axis 0 for the scan-over-layers models."""
+    return np.stack([conv(_get(sd, fmt.format(i=i))) for i in range(L)])
+
+
+def _tied_lm_head(sd, embedding):
+    return {"kernel": _t(_get(sd, "lm_head.weight"))} if "lm_head.weight" in sd \
+        else {"kernel": _t(embedding)}
 
 
 class InferenceV2Policy:
@@ -48,9 +65,7 @@ class InferenceV2Policy:
         E = cfg.hidden_size
         L = cfg.num_hidden_layers
 
-        def get(name):
-            t = sd[name]
-            return np.asarray(t.float().numpy() if hasattr(t, "float") else t, np.float32)
+        get = lambda name: _get(sd, name)
 
         def layer_stack(fmt, conv):
             return np.stack([conv(get(fmt.format(i=i))) for i in range(L)])
@@ -161,12 +176,9 @@ class OPTPolicy(InferenceV2Policy):
         E = cfg.hidden_size
         L = cfg.num_hidden_layers
 
-        def get(name):
-            t = sd[name]
-            return np.asarray(t.float().numpy() if hasattr(t, "float") else t, np.float32)
+        get = lambda name: _get(sd, name)
 
-        def stack(fmt, conv=lambda w: w):
-            return np.stack([conv(get(fmt.format(i=i))) for i in range(L)])
+        stack = lambda fmt, conv=(lambda w: w): _stack(sd, fmt, L, conv)
 
         def ln(prefix):
             return {"scale": stack(prefix + ".weight"), "bias": stack(prefix + ".bias")}
@@ -198,8 +210,7 @@ class OPTPolicy(InferenceV2Policy):
             },
         }
         if not cfg.tie_word_embeddings:
-            params["lm_head"] = {"kernel": _t(get("lm_head.weight"))} if "lm_head.weight" in sd \
-                else {"kernel": _t(params["embed_tokens"]["embedding"])}
+            params["lm_head"] = _tied_lm_head(sd, params["embed_tokens"]["embedding"])
         return params
 
 
@@ -223,12 +234,9 @@ class MixtralPolicy(InferenceV2Policy):
         L = cfg.num_hidden_layers
         NE = cfg.num_local_experts
 
-        def get(name):
-            t = sd[name]
-            return np.asarray(t.float().numpy() if hasattr(t, "float") else t, np.float32)
+        get = lambda name: _get(sd, name)
 
-        def stack(fmt, conv=lambda w: w):
-            return np.stack([conv(get(fmt.format(i=i))) for i in range(L)])
+        stack = lambda fmt, conv=(lambda w: w): _stack(sd, fmt, L, conv)
 
         def experts(w_name):
             # [L, NE, ...] from model.layers.{i}.block_sparse_moe.experts.{e}.{w1,w2,w3}
@@ -259,8 +267,86 @@ class MixtralPolicy(InferenceV2Policy):
                 },
             },
         }
-        params["lm_head"] = {"kernel": _t(get("lm_head.weight"))} if "lm_head.weight" in sd \
-            else {"kernel": _t(params["embed_tokens"]["embedding"])}
+        params["lm_head"] = _tied_lm_head(sd, params["embed_tokens"]["embedding"])
+        return params
+
+
+class FalconPolicy(InferenceV2Policy):
+    """ref: model_implementations/falcon/ — fused query_key_value split into
+    q/k/v for both the 7b (MQA, H q-heads then 1 k then 1 v) and
+    new_decoder_architecture (per-KV-group [q_per_kv, k, v]) layouts."""
+    model_type = "falcon"
+
+    def build_config(self, hf_cfg):
+        from ....models.falcon import FalconConfig
+        return FalconConfig.from_hf(hf_cfg)
+
+    def build_model(self, cfg):
+        from ....models.falcon import FalconForCausalLM
+        return FalconForCausalLM(cfg)
+
+    def convert(self, sd, cfg):
+        H, KV = cfg.num_attention_heads, cfg.num_kv_heads
+        D = cfg.hidden_size // H
+        E = cfg.hidden_size
+        L = cfg.num_hidden_layers
+
+        get = lambda name: _get(sd, name)
+
+        stack = lambda fmt, conv=(lambda w: w): _stack(sd, fmt, L, conv)
+
+        def split_qkv(w):
+            # w: [(rows), E] fused
+            if cfg.new_decoder_architecture:
+                qpk = H // KV
+                g = w.reshape(KV, qpk + 2, D, E)
+                q = g[:, :qpk].reshape(KV * qpk, D, E)      # == [H, D, E]
+                k = g[:, qpk].reshape(KV, D, E)
+                v = g[:, qpk + 1].reshape(KV, D, E)
+            elif KV == 1:  # 7b MQA: H q rows, then k, then v
+                g = w.reshape(H + 2, D, E)
+                q, k, v = g[:H], g[H:H + 1], g[H + 1:]
+            else:  # classic MHA: per-head interleave [H, 3, D]
+                g = w.reshape(H, 3, D, E)
+                q, k, v = g[:, 0], g[:, 1], g[:, 2]
+            # [heads, D, E] → ours (E, heads, D)
+            to_ours = lambda t: np.ascontiguousarray(np.transpose(t, (2, 0, 1)))
+            return to_ours(q), to_ours(k), to_ours(v)
+
+        qs, ks, vs = [], [], []
+        for i in range(L):
+            q, k, v = split_qkv(get(f"transformer.h.{i}.self_attention.query_key_value.weight"))
+            qs.append(q); ks.append(k); vs.append(v)
+
+        ln_blocks = {}
+        if cfg.new_decoder_architecture and cfg.num_ln_in_parallel_attn == 2:
+            for name in ("ln_attn", "ln_mlp"):
+                ln_blocks[name] = {"scale": stack(f"transformer.h.{{i}}.{name}.weight"),
+                                   "bias": stack(f"transformer.h.{{i}}.{name}.bias")}
+        else:
+            # falcon-7b AND falcon-11B-style (num_ln_in_parallel_attn=1)
+            ln_blocks["input_layernorm"] = {
+                "scale": stack("transformer.h.{i}.input_layernorm.weight"),
+                "bias": stack("transformer.h.{i}.input_layernorm.bias")}
+
+        params = {
+            "word_embeddings": {"embedding": get("transformer.word_embeddings.weight")},
+            "ln_f": {"scale": get("transformer.ln_f.weight"), "bias": get("transformer.ln_f.bias")},
+            "h": {
+                **ln_blocks,
+                "self_attention": {
+                    "q_proj": {"kernel": np.stack(qs)},
+                    "k_proj": {"kernel": np.stack(ks)},
+                    "v_proj": {"kernel": np.stack(vs)},
+                    "dense": {"kernel": stack("transformer.h.{i}.self_attention.dense.weight",
+                                              lambda w: _t(w).reshape(H, D, E))},
+                },
+                "dense_h_to_4h": {"kernel": stack("transformer.h.{i}.mlp.dense_h_to_4h.weight", _t)},
+                "dense_4h_to_h": {"kernel": stack("transformer.h.{i}.mlp.dense_4h_to_h.weight", _t)},
+            },
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = _tied_lm_head(sd, params["word_embeddings"]["embedding"])
         return params
 
 
@@ -271,6 +357,7 @@ POLICY_REGISTRY = {
     "phi3": Phi3Policy(),
     "mixtral": MixtralPolicy(),
     "opt": OPTPolicy(),
+    "falcon": FalconPolicy(),
 }
 
 

@@ -35,6 +35,8 @@ class BertConfig:
     max_position_embeddings: int = 512
     type_vocab_size: int = 2
     layer_norm_eps: float = 1e-12
+    pre_layer_norm: bool = False  # post-LN (original BERT) by default;
+    # pre-LN variant used by ops/transformer's stochastic/pre_layer_norm mode
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     scan_layers: bool = True
@@ -114,15 +116,22 @@ class BertLayer(nn.Module):
     @nn.compact
     def __call__(self, x, attention_mask=None):
         cfg = self.cfg
-        attn = BertSelfAttention(cfg, name="attention")(x, attention_mask)
-        x = _ln(cfg, "attention_output_ln")(x + attn)
+        if cfg.pre_layer_norm:
+            attn = BertSelfAttention(cfg, name="attention")(
+                _ln(cfg, "attention_output_ln")(x), attention_mask)
+            x = x + attn
+            mlp_in = _ln(cfg, "output_ln")(x)
+        else:
+            attn = BertSelfAttention(cfg, name="attention")(x, attention_mask)
+            x = _ln(cfg, "attention_output_ln")(x + attn)
+            mlp_in = x
         h = nn.DenseGeneral(features=cfg.intermediate_size,
                             use_bias=True,
                             dtype=cfg.dtype,
                             param_dtype=cfg.param_dtype,
                             kernel_init=_logical(nn.initializers.normal(0.02), (EMBED, MLP)),
                             bias_init=_logical(nn.initializers.zeros_init(), (MLP, )),
-                            name="intermediate")(x)
+                            name="intermediate")(mlp_in)
         h = nn.gelu(h, approximate=False)
         h = nn.DenseGeneral(features=cfg.hidden_size,
                             use_bias=True,
@@ -131,7 +140,7 @@ class BertLayer(nn.Module):
                             kernel_init=_logical(nn.initializers.normal(0.02), (MLP, EMBED)),
                             bias_init=_logical(nn.initializers.zeros_init(), (EMBED, )),
                             name="output")(h)
-        out = _ln(cfg, "output_ln")(x + h)
+        out = (x + h) if cfg.pre_layer_norm else _ln(cfg, "output_ln")(x + h)
         if self.scanned:
             return out, None
         return out

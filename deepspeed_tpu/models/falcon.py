@@ -1,0 +1,165 @@
+"""Falcon family — parallel attention/MLP decoder with MQA/GQA.
+
+ref: deepspeed/inference/v2/model_implementations/falcon/ (+ the falcon
+containers in module_inject).  Covers both layouts:
+  * falcon-7b style: multi_query=True (1 KV head), parallel_attn=True,
+    ONE input_layernorm shared by attention and MLP;
+  * new_decoder_architecture (falcon-40b/180b): grouped KV heads with
+    separate ln_attn / ln_mlp.
+
+Blocks are parallel-residual: x + attn(ln(x)) + mlp(ln'(x)) — on TPU this
+is a scheduling gift: the attention and MLP chains have no data dependency,
+so XLA overlaps their matmuls (and their TP collectives) natively.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from .llama import (EMBED, HEADS, HEAD_DIM, KV_HEADS, LAYERS, MLP, VOCAB, _logical, apply_rope,
+                    get_attention_impl, rotary_embedding)
+
+
+@dataclass(frozen=True)
+class FalconConfig:
+    vocab_size: int = 65024
+    hidden_size: int = 4544
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 71
+    num_kv_heads: int = 1
+    new_decoder_architecture: bool = False
+    parallel_attn: bool = True
+    num_ln_in_parallel_attn: int = 2  # new-arch: 2 = ln_attn+ln_mlp; 1 = shared (falcon-11B)
+    bias: bool = False
+    layer_norm_epsilon: float = 1e-5
+    rope_theta: float = 10000.0
+    max_position_embeddings: int = 2048
+    tie_word_embeddings: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+    attention_impl: str = "reference"
+
+    @staticmethod
+    def from_hf(hf_cfg, **overrides):
+        new_arch = getattr(hf_cfg, "new_decoder_architecture", False)
+        if new_arch:
+            kv = getattr(hf_cfg, "num_kv_heads", hf_cfg.num_attention_heads)
+        else:
+            kv = 1 if getattr(hf_cfg, "multi_query", True) else hf_cfg.num_attention_heads
+        if getattr(hf_cfg, "alibi", False):
+            raise NotImplementedError("alibi falcon variants not supported (rotary only)")
+        if not getattr(hf_cfg, "parallel_attn", True):
+            raise NotImplementedError("sequential-residual falcon (parallel_attn=False, falcon-rw) "
+                                      "not supported")
+        if getattr(hf_cfg, "bias", False):
+            raise NotImplementedError("bias=True falcon variants (falcon-rw) not supported")
+        fields = dict(vocab_size=hf_cfg.vocab_size,
+                      hidden_size=hf_cfg.hidden_size,
+                      num_hidden_layers=hf_cfg.num_hidden_layers,
+                      num_attention_heads=hf_cfg.num_attention_heads,
+                      num_kv_heads=kv,
+                      new_decoder_architecture=new_arch,
+                      num_ln_in_parallel_attn=getattr(hf_cfg, "num_ln_in_parallel_attn", None) or 2,
+                      parallel_attn=getattr(hf_cfg, "parallel_attn", True),
+                      bias=getattr(hf_cfg, "bias", False),
+                      layer_norm_epsilon=getattr(hf_cfg, "layer_norm_epsilon", 1e-5),
+                      rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+                      tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", True))
+        fields.update(overrides)
+        return FalconConfig(**fields)
+
+
+class FalconAttention(nn.Module):
+    cfg: FalconConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        H, KV = cfg.num_attention_heads, cfg.num_kv_heads
+        D = cfg.hidden_size // H
+        dense = partial(nn.DenseGeneral, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        q = dense(features=(H, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, HEADS, HEAD_DIM)),
+                  name="q_proj")(x)
+        k = dense(features=(KV, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
+                  name="k_proj")(x)
+        v = dense(features=(KV, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
+                  name="v_proj")(x)
+        cos, sin = rotary_embedding(positions, D, cfg.rope_theta)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        out = get_attention_impl(cfg.attention_impl)(q, k, v, causal=True, segment_ids=segment_ids)
+        return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=cfg.bias,
+                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                               kernel_init=_logical(nn.initializers.lecun_normal(), (HEADS, HEAD_DIM, EMBED)),
+                               name="dense")(out)
+
+
+class FalconBlock(nn.Module):
+    cfg: FalconConfig
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        ln = partial(nn.LayerNorm, epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+        if cfg.new_decoder_architecture and cfg.num_ln_in_parallel_attn == 2:
+            attn_in = ln(name="ln_attn")(x)
+            mlp_in = ln(name="ln_mlp")(x)
+        else:
+            # falcon-7b and falcon-11B (num_ln_in_parallel_attn=1): one LN
+            # feeds both parallel branches
+            attn_in = ln(name="input_layernorm")(x)
+            mlp_in = attn_in
+        attn_out = FalconAttention(cfg, name="self_attention")(attn_in, positions, segment_ids)
+        h = nn.Dense(cfg.hidden_size * 4, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, MLP)),
+                     name="dense_h_to_4h")(mlp_in)
+        mlp_out = nn.Dense(cfg.hidden_size, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                           kernel_init=_logical(nn.initializers.lecun_normal(), (MLP, EMBED)),
+                           name="dense_4h_to_h")(jax.nn.gelu(h, approximate=False))
+        out = x + attn_out + mlp_out  # parallel residual
+        if self.scanned:
+            return out, None
+        return out
+
+
+class FalconForCausalLM(nn.Module):
+    cfg: FalconConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                         name="word_embeddings")
+        x = embed(input_ids)
+        block_cls = FalconBlock
+        if cfg.remat:
+            block_cls = nn.remat(FalconBlock, prevent_cse=not cfg.scan_layers)
+        if cfg.scan_layers:
+            blocks = nn.scan(block_cls, variable_axes={"params": 0}, split_rngs={"params": True},
+                             in_axes=(nn.broadcast, nn.broadcast), length=cfg.num_hidden_layers,
+                             metadata_params={nn.PARTITION_NAME: LAYERS})
+            x, _ = blocks(cfg, scanned=True, name="h")(x, positions, segment_ids)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                x = block_cls(cfg, name=f"h_{i}")(x, positions, segment_ids)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         name="ln_f")(x)
+        if cfg.tie_word_embeddings:
+            return embed.attend(x)
+        return nn.DenseGeneral(features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                               param_dtype=cfg.param_dtype,
+                               kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, VOCAB)),
+                               name="lm_head")(x)

@@ -1,0 +1,62 @@
+"""Legacy fused transformer layer — API parity.
+
+ref: deepspeed/ops/transformer/transformer.py (DeepSpeedTransformerLayer /
+DeepSpeedTransformerConfig backed by csrc/transformer/*.cu — the original
+fused BERT-training kernels: fused QKV GEMM + softmax + dropout + layernorm).
+
+On TPU the fusion IS the compiler's job: one jitted BertLayer produces the
+same fused schedule XLA-side (gelu/bias/dropout folded into the GEMM
+epilogues), so this module is a thin parity shim over models/bert.BertLayer
+keeping the reference's constructor surface for code being migrated.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ...models.bert import BertConfig, BertLayer
+
+
+@dataclass
+class DeepSpeedTransformerConfig:
+    """ref: ops/transformer/transformer.py DeepSpeedTransformerConfig."""
+    batch_size: int = -1
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    heads: int = 12
+    attn_dropout_ratio: float = 0.1
+    hidden_dropout_ratio: float = 0.1
+    num_hidden_layers: int = 12
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    local_rank: int = -1
+    seed: int = -1
+    fp16: bool = False
+    pre_layer_norm: bool = True
+    normalize_invertible: bool = False     # memory trick subsumed by remat
+    gelu_checkpoint: bool = False          # ditto
+    adjust_init_range: bool = True
+    attn_dropout_checkpoint: bool = False
+    stochastic_mode: bool = False
+    return_tuple: bool = False
+    training: bool = True
+
+    def to_bert_config(self) -> BertConfig:
+        # dropout ratios accepted for parity; BertLayer is deterministic
+        # (dropout under jit is a model concern, not a kernel concern here)
+        return BertConfig(hidden_size=self.hidden_size,
+                          intermediate_size=self.intermediate_size,
+                          num_attention_heads=self.heads,
+                          num_hidden_layers=self.num_hidden_layers,
+                          layer_norm_eps=self.layer_norm_eps,
+                          pre_layer_norm=self.pre_layer_norm,
+                          dtype=jnp.float16 if self.fp16 else jnp.float32)
+
+
+def DeepSpeedTransformerLayer(config: DeepSpeedTransformerConfig, initial_weights=None,
+                              initial_biases=None):
+    """ref: transformer.py DeepSpeedTransformerLayer(config) — returns the
+    layer module; weights initialize on first apply (initial_weights/biases
+    accepted for signature parity; load via flax params instead)."""
+    return BertLayer(config.to_bert_config())

@@ -217,3 +217,31 @@ def test_v2_engine_rejects_non_llama_family(tmp_path):
             num_attention_heads=4, max_position_embeddings=64, word_embed_proj_dim=64)).save_pretrained(d)
     with pytest.raises(NotImplementedError, match="replace_module"):
         build_hf_engine(str(d))
+
+
+@pytest.mark.parametrize("new_arch,kv", [(False, 1), (True, 2)])
+def test_falcon_logits_parity(new_arch, kv, tmp_path):
+    """Falcon conversion (fused qkv split, parallel residual) matches HF."""
+    import torch
+    from transformers import FalconConfig as HFC, FalconForCausalLM as HFM
+    torch.manual_seed(0)
+    hf_cfg = HFC(vocab_size=128, hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+                 new_decoder_architecture=new_arch, multi_query=(kv == 1), num_kv_heads=kv,
+                 parallel_attn=True, bias=False, alibi=False, hidden_dropout=0.0,
+                 attention_dropout=0.0, tie_word_embeddings=True)
+    hf_model = HFM(hf_cfg).eval()
+    d = tmp_path / f"falcon{int(new_arch)}"
+    hf_model.save_pretrained(d)
+
+    from transformers import AutoConfig
+    from deepspeed_tpu.inference.v2.engine_factory import _load_state_dict
+    sd = _load_state_dict(str(d))
+    cfg, params = convert_hf_state_dict(sd, AutoConfig.from_pretrained(str(d), local_files_only=True))
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32, "remat": False})
+
+    from deepspeed_tpu.models.falcon import FalconForCausalLM
+    ids = np.array([[5, 9, 2, 7, 1, 3]], np.int32)
+    got = np.asarray(FalconForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids)))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
