@@ -66,9 +66,7 @@ class InferenceV2Policy:
         L = cfg.num_hidden_layers
 
         get = lambda name: _get(sd, name)
-
-        def layer_stack(fmt, conv):
-            return np.stack([conv(get(fmt.format(i=i))) for i in range(L)])
+        layer_stack = lambda fmt, conv: _stack(sd, fmt, L, conv)
 
         def qkv_kernel(fmt, heads):
             # HF [heads*D, E] → ours [E, heads, D]
@@ -271,6 +269,58 @@ class MixtralPolicy(InferenceV2Policy):
         return params
 
 
+class PhiPolicy(InferenceV2Policy):
+    """ref: model_implementations/phi/ — parallel block, partial rotary,
+    biases everywhere incl. lm_head; maps onto models/phi.py."""
+    model_type = "phi"
+
+    def build_config(self, hf_cfg):
+        from ....models.phi import PhiConfig
+        return PhiConfig.from_hf(hf_cfg)
+
+    def build_model(self, cfg):
+        from ....models.phi import PhiForCausalLM
+        return PhiForCausalLM(cfg)
+
+    def convert(self, sd, cfg):
+        H, KV = cfg.num_attention_heads, cfg.num_key_value_heads
+        D = cfg.hidden_size // H
+        E = cfg.hidden_size
+        L = cfg.num_hidden_layers
+        get = lambda name: _get(sd, name)
+        stack = lambda fmt, conv=(lambda w: w): _stack(sd, fmt, L, conv)
+
+        def proj(name, heads):
+            return {"kernel": stack(f"model.layers.{{i}}.self_attn.{name}.weight",
+                                    lambda w: _t(w).reshape(E, heads, D)),
+                    "bias": stack(f"model.layers.{{i}}.self_attn.{name}.bias",
+                                  lambda b: b.reshape(heads, D))}
+
+        params = {
+            "embed_tokens": {"embedding": get("model.embed_tokens.weight")},
+            "final_layernorm": {"scale": get("model.final_layernorm.weight"),
+                                "bias": get("model.final_layernorm.bias")},
+            "lm_head": {"kernel": _t(get("lm_head.weight")), "bias": get("lm_head.bias")},
+            "layers": {
+                "input_layernorm": {"scale": stack("model.layers.{i}.input_layernorm.weight"),
+                                    "bias": stack("model.layers.{i}.input_layernorm.bias")},
+                "self_attn": {
+                    "q_proj": proj("q_proj", H),
+                    "k_proj": proj("k_proj", KV),
+                    "v_proj": proj("v_proj", KV),
+                    "dense": {"kernel": stack("model.layers.{i}.self_attn.dense.weight",
+                                              lambda w: _t(w).reshape(H, D, E)),
+                              "bias": stack("model.layers.{i}.self_attn.dense.bias")},
+                },
+                "fc1": {"kernel": stack("model.layers.{i}.mlp.fc1.weight", _t),
+                        "bias": stack("model.layers.{i}.mlp.fc1.bias")},
+                "fc2": {"kernel": stack("model.layers.{i}.mlp.fc2.weight", _t),
+                        "bias": stack("model.layers.{i}.mlp.fc2.bias")},
+            },
+        }
+        return params
+
+
 class FalconPolicy(InferenceV2Policy):
     """ref: model_implementations/falcon/ — fused query_key_value split into
     q/k/v for both the 7b (MQA, H q-heads then 1 k then 1 v) and
@@ -319,7 +369,7 @@ class FalconPolicy(InferenceV2Policy):
             qs.append(q); ks.append(k); vs.append(v)
 
         ln_blocks = {}
-        if cfg.new_decoder_architecture and cfg.num_ln_in_parallel_attn == 2:
+        if cfg.num_ln_in_parallel_attn == 2:  # HF keys purely on this flag
             for name in ("ln_attn", "ln_mlp"):
                 ln_blocks[name] = {"scale": stack(f"transformer.h.{{i}}.{name}.weight"),
                                    "bias": stack(f"transformer.h.{{i}}.{name}.bias")}
@@ -358,6 +408,7 @@ POLICY_REGISTRY = {
     "mixtral": MixtralPolicy(),
     "opt": OPTPolicy(),
     "falcon": FalconPolicy(),
+    "phi": PhiPolicy(),
 }
 
 

@@ -66,7 +66,9 @@ class FalconConfig:
                       num_attention_heads=hf_cfg.num_attention_heads,
                       num_kv_heads=kv,
                       new_decoder_architecture=new_arch,
-                      num_ln_in_parallel_attn=getattr(hf_cfg, "num_ln_in_parallel_attn", None) or 2,
+                      # HF: None resolves to 2 only for the new decoder arch
+                      num_ln_in_parallel_attn=(getattr(hf_cfg, "num_ln_in_parallel_attn", None)
+                                               or (2 if new_arch else 1)),
                       parallel_attn=getattr(hf_cfg, "parallel_attn", True),
                       bias=getattr(hf_cfg, "bias", False),
                       layer_norm_epsilon=getattr(hf_cfg, "layer_norm_epsilon", 1e-5),
@@ -110,7 +112,7 @@ class FalconBlock(nn.Module):
         cfg = self.cfg
         ln = partial(nn.LayerNorm, epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype)
-        if cfg.new_decoder_architecture and cfg.num_ln_in_parallel_attn == 2:
+        if cfg.num_ln_in_parallel_attn == 2:  # HF keys purely on this flag
             attn_in = ln(name="ln_attn")(x)
             mlp_in = ln(name="ln_mlp")(x)
         else:

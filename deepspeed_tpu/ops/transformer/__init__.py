@@ -44,7 +44,10 @@ class DeepSpeedTransformerConfig:
 
     def to_bert_config(self) -> BertConfig:
         # dropout ratios accepted for parity; BertLayer is deterministic
-        # (dropout under jit is a model concern, not a kernel concern here)
+        # (dropout under jit is a model concern, not a kernel concern here).
+        # initializer_range/adjust_init_range likewise accepted but unused:
+        # BertLayer initializes at normal(0.02); load trained weights via
+        # flax params when exact init parity matters
         return BertConfig(hidden_size=self.hidden_size,
                           intermediate_size=self.intermediate_size,
                           num_attention_heads=self.heads,

@@ -315,20 +315,37 @@ class DeepSpeedEngine:
         # (memory_kind pinned_host); XLA streams them through the update
         # (ref: runtime/zero/offload_config.py + cpu_adam — same math, the
         # host residency is a sharding property, not a different optimizer)
-        offload = self._config.zero_config.offload_optimizer
-        if offload is not None and offload.device in ("cpu", "nvme"):
+        def try_host_offload(name, *sharding_trees):
+            """Move shardings to host memory kind if the backend supports it
+            (one probe-compile); returns the trees (possibly unchanged)."""
             try:
                 to_host = lambda s: s.with_memory_kind("pinned_host") \
                     if isinstance(s, NamedSharding) else s
                 probe = NamedSharding(self.mesh, P())  # rank-agnostic probe
                 jax.jit(lambda x: x, out_shardings=to_host(probe)) \
                     .lower(jax.ShapeDtypeStruct((1, ), jnp.float32)).compile()
-                master_sh = jax.tree.map(to_host, master_sh) if use_master else master_sh
-                opt_sh = jax.tree.map(to_host, opt_sh)
-                log_dist("offload_optimizer: optimizer states resident in host memory", ranks=[0])
+                out = tuple(jax.tree.map(to_host, t) for t in sharding_trees)
+                log_dist(f"{name}: resident in host memory (streamed through HBM)", ranks=[0])
+                return out
             except Exception as e:
-                logger.warning(f"offload_optimizer requested but host memory kinds are "
-                               f"unsupported on this backend; keeping states on device ({e})")
+                logger.warning(f"{name} requested but host memory kinds are unsupported "
+                               f"on this backend; keeping on device ({e})")
+                return sharding_trees
+
+        offload = self._config.zero_config.offload_optimizer
+        if offload is not None and offload.device in ("cpu", "nvme"):
+            if use_master:
+                master_sh, opt_sh = try_host_offload("offload_optimizer", master_sh, opt_sh)
+            else:
+                (opt_sh, ) = try_host_offload("offload_optimizer", opt_sh)
+        # offload_param (ZeRO-Infinity): compute-dtype params themselves live
+        # in host memory and stream through HBM per use — with scan-over-
+        # layers XLA prefetches one layer's slab at a time (the analog of the
+        # reference's AsyncPartitionedParameterSwapper double buffering,
+        # ref: runtime/zero/partition_parameters.py remote_device="cpu")
+        p_offload = self._config.zero_config.offload_param
+        if p_offload is not None and getattr(p_offload, "device", None) in ("cpu", "nvme"):
+            (param_sh, ) = try_host_offload("offload_param", param_sh)
         self.state_shardings = TrainState(
             step=repl,
             params=param_sh,

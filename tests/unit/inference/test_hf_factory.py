@@ -219,16 +219,18 @@ def test_v2_engine_rejects_non_llama_family(tmp_path):
         build_hf_engine(str(d))
 
 
-@pytest.mark.parametrize("new_arch,kv", [(False, 1), (True, 2)])
-def test_falcon_logits_parity(new_arch, kv, tmp_path):
-    """Falcon conversion (fused qkv split, parallel residual) matches HF."""
+@pytest.mark.parametrize("new_arch,kv,num_ln", [(False, 1, None), (True, 2, 2), (True, 2, 1)])
+def test_falcon_logits_parity(new_arch, kv, num_ln, tmp_path):
+    """Falcon conversion (fused qkv split, parallel residual) matches HF —
+    incl. the falcon-11B single-shared-LN new-arch layout (num_ln=1)."""
     import torch
     from transformers import FalconConfig as HFC, FalconForCausalLM as HFM
     torch.manual_seed(0)
     hf_cfg = HFC(vocab_size=128, hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
                  new_decoder_architecture=new_arch, multi_query=(kv == 1), num_kv_heads=kv,
                  parallel_attn=True, bias=False, alibi=False, hidden_dropout=0.0,
-                 attention_dropout=0.0, tie_word_embeddings=True)
+                 attention_dropout=0.0, tie_word_embeddings=True,
+                 num_ln_in_parallel_attn=num_ln)
     hf_model = HFM(hf_cfg).eval()
     d = tmp_path / f"falcon{int(new_arch)}"
     hf_model.save_pretrained(d)
@@ -242,6 +244,34 @@ def test_falcon_logits_parity(new_arch, kv, tmp_path):
     from deepspeed_tpu.models.falcon import FalconForCausalLM
     ids = np.array([[5, 9, 2, 7, 1, 3]], np.int32)
     got = np.asarray(FalconForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids)))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_phi_logits_parity(tmp_path):
+    """Phi-2-style conversion (parallel block, partial rotary, biased head)
+    matches HF."""
+    import torch
+    from transformers import PhiConfig as HFC, PhiForCausalLM as HFM
+    torch.manual_seed(0)
+    hf_cfg = HFC(vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+                 num_attention_heads=4, num_key_value_heads=4, partial_rotary_factor=0.5,
+                 max_position_embeddings=64, rope_theta=1e4, hidden_dropout=0.0,
+                 attention_dropout=0.0, resid_pdrop=0.0, embd_pdrop=0.0)
+    hf_model = HFM(hf_cfg).eval()
+    d = tmp_path / "phi"
+    hf_model.save_pretrained(d)
+
+    from transformers import AutoConfig
+    from deepspeed_tpu.inference.v2.engine_factory import _load_state_dict
+    sd = _load_state_dict(str(d))
+    cfg, params = convert_hf_state_dict(sd, AutoConfig.from_pretrained(str(d), local_files_only=True))
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32, "remat": False})
+
+    from deepspeed_tpu.models.phi import PhiForCausalLM
+    ids = np.array([[5, 9, 2, 7, 1, 3]], np.int32)
+    got = np.asarray(PhiForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids)))
     with torch.no_grad():
         want = hf_model(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)

@@ -57,3 +57,15 @@ def test_offload_optimizer_config_accepted():
     b = _batch()
     losses = [float(eng.train_batch(batch=b)) for _ in range(3)]
     assert losses[-1] < losses[0]
+
+
+def test_offload_param_graceful():
+    """offload_param config: host memory kinds on TPU, graceful device
+    fallback elsewhere (ref: zero offload_param / ZeRO-Infinity)."""
+    cfg = {"train_batch_size": 8, "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "steps_per_print": 0,
+           "zero_optimization": {"stage": 3, "offload_param": {"device": "cpu", "pin_memory": True}}}
+    engine, _, _, _ = ds.initialize(model=LlamaForCausalLM(CFG), config=cfg)
+    ids = np.random.default_rng(0).integers(0, 64, (8, 16), dtype=np.int32)
+    loss = float(engine.train_batch(batch={"input_ids": ids, "labels": ids}))
+    assert np.isfinite(loss)
