@@ -12,8 +12,9 @@ tensors along axis 0 for the scan-over-layers models.
 Covered model_types (ref model_implementations dirs): llama (v1/v2/v3),
 mistral, qwen2, phi3 (fused qkv/gate_up split), mixtral (MoE), opt
 (learned positions / ReLU / biases), falcon (fused qkv, parallel
-residual).  llama-family configs additionally serve through the FastGen-v2
-paged engine; opt/mixtral/falcon serve via module_inject.replace_module +
+residual), phi (parallel block, partial rotary), qwen2_moe (top-k experts
++ shared expert).  llama-family configs additionally serve through the
+FastGen-v2 paged engine; the rest serve via module_inject.replace_module +
 init_inference/hybrid generate.
 """
 
@@ -44,6 +45,15 @@ def _stack(sd, fmt, L, conv=lambda w: w):
 def _tied_lm_head(sd, embedding):
     return {"kernel": _t(_get(sd, "lm_head.weight"))} if "lm_head.weight" in sd \
         else {"kernel": _t(embedding)}
+
+
+def _proj(sd, L, E, D, fmt, heads, bias: bool):
+    """Attention projection: HF [heads*D, E](+bias) → ours (E, heads, D).
+    ``fmt`` like 'model.layers.{{i}}.self_attn.q_proj' (with {{i}})."""
+    out = {"kernel": _stack(sd, fmt + ".weight", L, lambda w: _t(w).reshape(E, heads, D))}
+    if bias:
+        out["bias"] = _stack(sd, fmt + ".bias", L, lambda b: b.reshape(heads, D))
+    return out
 
 
 class InferenceV2Policy:
@@ -181,11 +191,8 @@ class OPTPolicy(InferenceV2Policy):
         def ln(prefix):
             return {"scale": stack(prefix + ".weight"), "bias": stack(prefix + ".bias")}
 
-        def proj(name):
-            return {"kernel": stack(f"model.decoder.layers.{{i}}.self_attn.{name}.weight",
-                                    lambda w: _t(w).reshape(E, H, D)),
-                    "bias": stack(f"model.decoder.layers.{{i}}.self_attn.{name}.bias",
-                                  lambda b: b.reshape(H, D))}
+        proj = lambda name: _proj(sd, L, E, D, "model.decoder.layers.{i}.self_attn." + name,
+                                  H, bias=True)
 
         params = {
             "embed_tokens": {"embedding": get("model.decoder.embed_tokens.weight")},
@@ -290,11 +297,8 @@ class PhiPolicy(InferenceV2Policy):
         get = lambda name: _get(sd, name)
         stack = lambda fmt, conv=(lambda w: w): _stack(sd, fmt, L, conv)
 
-        def proj(name, heads):
-            return {"kernel": stack(f"model.layers.{{i}}.self_attn.{name}.weight",
-                                    lambda w: _t(w).reshape(E, heads, D)),
-                    "bias": stack(f"model.layers.{{i}}.self_attn.{name}.bias",
-                                  lambda b: b.reshape(heads, D))}
+        proj = lambda name, heads: _proj(sd, L, E, D, "model.layers.{i}.self_attn." + name,
+                                         heads, bias=True)
 
         params = {
             "embed_tokens": {"embedding": get("model.embed_tokens.weight")},
@@ -400,6 +404,66 @@ class FalconPolicy(InferenceV2Policy):
         return params
 
 
+class Qwen2MoePolicy(InferenceV2Policy):
+    """ref: model_implementations/qwen_v2_moe/ — qkv-bias attention +
+    top-k expert MLP with shared expert; maps onto models/qwen2_moe.py."""
+    model_type = "qwen2_moe"
+
+    def build_config(self, hf_cfg):
+        from ....models.qwen2_moe import Qwen2MoeConfig
+        return Qwen2MoeConfig.from_hf(hf_cfg)
+
+    def build_model(self, cfg):
+        from ....models.qwen2_moe import Qwen2MoeForCausalLM
+        return Qwen2MoeForCausalLM(cfg)
+
+    def convert(self, sd, cfg):
+        H, KV = cfg.num_attention_heads, cfg.num_key_value_heads
+        D = cfg.hidden_size // H
+        E = cfg.hidden_size
+        L = cfg.num_hidden_layers
+        NE = cfg.num_experts
+        get = lambda name: _get(sd, name)
+        stack = lambda fmt, conv=(lambda w: w): _stack(sd, fmt, L, conv)
+
+        proj = lambda name, heads: _proj(sd, L, E, D, "model.layers.{i}.self_attn." + name,
+                                         heads, bias=cfg.qkv_bias)
+
+        def experts(w_name):
+            return np.stack([
+                np.stack([_t(get(f"model.layers.{i}.mlp.experts.{e}.{w_name}.weight"))
+                          for e in range(NE)]) for i in range(L)])
+
+        params = {
+            "embed_tokens": {"embedding": get("model.embed_tokens.weight")},
+            "norm": {"weight": get("model.norm.weight")},
+            "layers": {
+                "input_layernorm": {"weight": stack("model.layers.{i}.input_layernorm.weight")},
+                "post_attention_layernorm": {"weight": stack("model.layers.{i}.post_attention_layernorm.weight")},
+                "self_attn": {
+                    "q_proj": proj("q_proj", H),
+                    "k_proj": proj("k_proj", KV),
+                    "v_proj": proj("v_proj", KV),
+                    "o_proj": {"kernel": stack("model.layers.{i}.self_attn.o_proj.weight",
+                                               lambda w: _t(w).reshape(H, D, E))},
+                },
+                "mlp": {
+                    "gate": {"kernel": stack("model.layers.{i}.mlp.gate.weight", _t)},
+                    "w_gate": experts("gate_proj"),
+                    "w_up": experts("up_proj"),
+                    "w_down": experts("down_proj"),
+                    "shared_gate_proj": {"kernel": stack("model.layers.{i}.mlp.shared_expert.gate_proj.weight", _t)},
+                    "shared_up_proj": {"kernel": stack("model.layers.{i}.mlp.shared_expert.up_proj.weight", _t)},
+                    "shared_down_proj": {"kernel": stack("model.layers.{i}.mlp.shared_expert.down_proj.weight", _t)},
+                    "shared_expert_gate": {"kernel": stack("model.layers.{i}.mlp.shared_expert_gate.weight", _t)},
+                },
+            },
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = _tied_lm_head(sd, params["embed_tokens"]["embedding"])
+        return params
+
+
 POLICY_REGISTRY = {
     "llama": LlamaPolicy(),
     "mistral": MistralPolicy(),
@@ -409,6 +473,7 @@ POLICY_REGISTRY = {
     "opt": OPTPolicy(),
     "falcon": FalconPolicy(),
     "phi": PhiPolicy(),
+    "qwen2_moe": Qwen2MoePolicy(),
 }
 
 

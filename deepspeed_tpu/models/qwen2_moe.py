@@ -1,0 +1,192 @@
+"""Qwen2-MoE — llama-style attention (qkv bias) + sparse MoE MLP with a
+shared expert.
+
+ref: deepspeed/inference/v2/model_implementations/qwen_v2_moe/.  Per block:
+softmax-over-all-experts gating → top-k (optionally renormalized), experts
+are gated-SiLU MLPs at ``moe_intermediate_size``, plus a dense shared
+expert scaled by sigmoid(shared_expert_gate(x)).
+
+The expert mixture here is the exact dense formulation (every expert's
+output weighted by its routing weight, zeros for non-selected) — bit-exact
+with HF's gather-based compute and MXU-friendly via stacked-expert einsums.
+For large expert counts sharded over the mesh's expert axis, use
+deepspeed_tpu.moe.MoE (all-to-all dispatch with capacity) — this model
+targets checkpoint parity and fine-tuning.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from .llama import (EMBED, HEADS, HEAD_DIM, KV_HEADS, LAYERS, MLP, VOCAB, LlamaAttention, LlamaConfig,
+                    RMSNorm, _logical)
+
+EXPERTS = "experts"
+
+
+@dataclass(frozen=True)
+class Qwen2MoeConfig:
+    vocab_size: int = 151936
+    hidden_size: int = 2048
+    intermediate_size: int = 5632          # dense (unused when all-sparse)
+    moe_intermediate_size: int = 1408
+    shared_expert_intermediate_size: int = 5632
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    num_experts: int = 60
+    num_experts_per_tok: int = 4
+    norm_topk_prob: bool = False
+    qkv_bias: bool = True
+    max_position_embeddings: int = 8192
+    rope_theta: float = 1e6
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+    attention_impl: str = "reference"
+
+    def as_llama(self) -> LlamaConfig:
+        return LlamaConfig(vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+                           intermediate_size=self.moe_intermediate_size,
+                           num_hidden_layers=self.num_hidden_layers,
+                           num_attention_heads=self.num_attention_heads,
+                           num_key_value_heads=self.num_key_value_heads,
+                           max_position_embeddings=self.max_position_embeddings,
+                           rope_theta=self.rope_theta, rms_norm_eps=self.rms_norm_eps,
+                           dtype=self.dtype, param_dtype=self.param_dtype,
+                           attention_impl=self.attention_impl, attention_bias=self.qkv_bias)
+
+    @staticmethod
+    def from_hf(hf_cfg, **overrides):
+        if getattr(hf_cfg, "mlp_only_layers", None):
+            raise NotImplementedError("qwen2_moe mlp_only_layers (mixed dense/sparse stacks) "
+                                      "not supported with scan-over-layers")
+        if getattr(hf_cfg, "decoder_sparse_step", 1) != 1:
+            raise NotImplementedError("qwen2_moe decoder_sparse_step != 1 not supported")
+        fields = dict(vocab_size=hf_cfg.vocab_size,
+                      hidden_size=hf_cfg.hidden_size,
+                      intermediate_size=hf_cfg.intermediate_size,
+                      moe_intermediate_size=hf_cfg.moe_intermediate_size,
+                      shared_expert_intermediate_size=hf_cfg.shared_expert_intermediate_size,
+                      num_hidden_layers=hf_cfg.num_hidden_layers,
+                      num_attention_heads=hf_cfg.num_attention_heads,
+                      num_key_value_heads=getattr(hf_cfg, "num_key_value_heads", hf_cfg.num_attention_heads),
+                      num_experts=hf_cfg.num_experts,
+                      num_experts_per_tok=hf_cfg.num_experts_per_tok,
+                      norm_topk_prob=getattr(hf_cfg, "norm_topk_prob", False),
+                      qkv_bias=getattr(hf_cfg, "qkv_bias", True),
+                      max_position_embeddings=hf_cfg.max_position_embeddings,
+                      rope_theta=getattr(hf_cfg, "rope_theta", 1e6),
+                      rms_norm_eps=getattr(hf_cfg, "rms_norm_eps", 1e-6),
+                      tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", False))
+        fields.update(overrides)
+        return Qwen2MoeConfig(**fields)
+
+
+class Qwen2MoeSparseMLP(nn.Module):
+    cfg: Qwen2MoeConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        E, NE, M = cfg.hidden_size, cfg.num_experts, cfg.moe_intermediate_size
+        dt = cfg.dtype
+
+        gate_logits = nn.Dense(NE, use_bias=False, dtype=jnp.float32, param_dtype=cfg.param_dtype,
+                               name="gate")(x.astype(jnp.float32))         # [B,S,NE]
+        probs = jax.nn.softmax(gate_logits, axis=-1)
+        topv, topi = jax.lax.top_k(probs, cfg.num_experts_per_tok)
+        if cfg.norm_topk_prob:
+            topv = topv / (topv.sum(-1, keepdims=True) + 1e-20)
+        # dense routing weights: zeros except selected experts
+        onehot = jax.nn.one_hot(topi, NE, dtype=probs.dtype)   # [B,S,K,NE]
+        weights = (onehot * topv[..., None]).sum(-2)           # [B,S,NE]
+
+        w_gate = self.param("w_gate", _logical(nn.initializers.lecun_normal(), (EXPERTS, EMBED, MLP)),
+                            (NE, E, M), cfg.param_dtype)
+        w_up = self.param("w_up", _logical(nn.initializers.lecun_normal(), (EXPERTS, EMBED, MLP)),
+                          (NE, E, M), cfg.param_dtype)
+        w_down = self.param("w_down", _logical(nn.initializers.lecun_normal(), (EXPERTS, MLP, EMBED)),
+                            (NE, M, E), cfg.param_dtype)
+        # dense mixture: every expert evaluated, weighted-summed (exact HF math)
+        h = jnp.einsum("bse,nem->bsnm", x.astype(dt), w_gate.astype(dt))
+        u = jnp.einsum("bse,nem->bsnm", x.astype(dt), w_up.astype(dt))
+        act = nn.silu(h) * u
+        y = jnp.einsum("bsnm,nme->bsne", act, w_down.astype(dt))
+        out = jnp.einsum("bsne,bsn->bse", y.astype(jnp.float32), weights)
+
+        # shared expert with sigmoid gate (HF: shared_expert_gate Linear(E,1))
+        sh = nn.Dense(cfg.shared_expert_intermediate_size, use_bias=False, dtype=dt,
+                      param_dtype=cfg.param_dtype,
+                      kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, MLP)),
+                      name="shared_gate_proj")(x)
+        su = nn.Dense(cfg.shared_expert_intermediate_size, use_bias=False, dtype=dt,
+                      param_dtype=cfg.param_dtype,
+                      kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, MLP)),
+                      name="shared_up_proj")(x)
+        sd = nn.Dense(E, use_bias=False, dtype=dt, param_dtype=cfg.param_dtype,
+                      kernel_init=_logical(nn.initializers.lecun_normal(), (MLP, EMBED)),
+                      name="shared_down_proj")(nn.silu(sh) * su)
+        sgate = nn.Dense(1, use_bias=False, dtype=jnp.float32, param_dtype=cfg.param_dtype,
+                         name="shared_expert_gate")(x.astype(jnp.float32))
+        out = out + jax.nn.sigmoid(sgate) * sd.astype(jnp.float32)
+        return out.astype(x.dtype)
+
+
+class Qwen2MoeBlock(nn.Module):
+    cfg: Qwen2MoeConfig
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        lcfg = cfg.as_llama()
+        h = x + LlamaAttention(lcfg, name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="input_layernorm")(x),
+            positions, segment_ids)
+        out = h + Qwen2MoeSparseMLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="post_attention_layernorm")(h))
+        if self.scanned:
+            return out, None
+        return out
+
+
+class Qwen2MoeForCausalLM(nn.Module):
+    cfg: Qwen2MoeConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                         name="embed_tokens")
+        x = embed(input_ids)
+        block_cls = Qwen2MoeBlock
+        if cfg.remat:
+            block_cls = nn.remat(Qwen2MoeBlock, prevent_cse=not cfg.scan_layers)
+        if cfg.scan_layers:
+            blocks = nn.scan(block_cls, variable_axes={"params": 0}, split_rngs={"params": True},
+                             in_axes=(nn.broadcast, nn.broadcast), length=cfg.num_hidden_layers,
+                             metadata_params={nn.PARTITION_NAME: LAYERS})
+            x, _ = blocks(cfg, scanned=True, name="layers")(x, positions, segment_ids)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                x = block_cls(cfg, name=f"layers_{i}")(x, positions, segment_ids)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="norm")(x)
+        if cfg.tie_word_embeddings:
+            return embed.attend(x)
+        return nn.DenseGeneral(features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                               param_dtype=cfg.param_dtype,
+                               kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, VOCAB)),
+                               name="lm_head")(x)

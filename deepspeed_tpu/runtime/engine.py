@@ -315,22 +315,28 @@ class DeepSpeedEngine:
         # (memory_kind pinned_host); XLA streams them through the update
         # (ref: runtime/zero/offload_config.py + cpu_adam — same math, the
         # host residency is a sharding property, not a different optimizer)
+        host_kind_ok = [None]  # probe result shared by both offload blocks
+
         def try_host_offload(name, *sharding_trees):
             """Move shardings to host memory kind if the backend supports it
-            (one probe-compile); returns the trees (possibly unchanged)."""
-            try:
-                to_host = lambda s: s.with_memory_kind("pinned_host") \
-                    if isinstance(s, NamedSharding) else s
-                probe = NamedSharding(self.mesh, P())  # rank-agnostic probe
-                jax.jit(lambda x: x, out_shardings=to_host(probe)) \
-                    .lower(jax.ShapeDtypeStruct((1, ), jnp.float32)).compile()
-                out = tuple(jax.tree.map(to_host, t) for t in sharding_trees)
-                log_dist(f"{name}: resident in host memory (streamed through HBM)", ranks=[0])
-                return out
-            except Exception as e:
-                logger.warning(f"{name} requested but host memory kinds are unsupported "
-                               f"on this backend; keeping on device ({e})")
+            (one probe-compile, cached); returns the trees (possibly unchanged)."""
+            to_host = lambda s: s.with_memory_kind("pinned_host") \
+                if isinstance(s, NamedSharding) else s
+            if host_kind_ok[0] is None:
+                try:
+                    probe = NamedSharding(self.mesh, P())  # rank-agnostic probe
+                    jax.jit(lambda x: x, out_shardings=to_host(probe)) \
+                        .lower(jax.ShapeDtypeStruct((1, ), jnp.float32)).compile()
+                    host_kind_ok[0] = True
+                except Exception as e:
+                    host_kind_ok[0] = False
+                    logger.warning(f"host memory kinds unsupported on this backend; "
+                                   f"offload stays on device ({e})")
+            if not host_kind_ok[0]:
                 return sharding_trees
+            out = tuple(jax.tree.map(to_host, t) for t in sharding_trees)
+            log_dist(f"{name}: resident in host memory (streamed through HBM)", ranks=[0])
+            return out
 
         offload = self._config.zero_config.offload_optimizer
         if offload is not None and offload.device in ("cpu", "nvme"):

@@ -275,3 +275,32 @@ def test_phi_logits_parity(tmp_path):
     with torch.no_grad():
         want = hf_model(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_qwen2_moe_logits_parity(tmp_path):
+    """Qwen2-MoE conversion (top-k experts + shared expert) matches HF."""
+    import torch
+    from transformers import Qwen2MoeConfig as HFC, Qwen2MoeForCausalLM as HFM
+    torch.manual_seed(0)
+    hf_cfg = HFC(vocab_size=128, hidden_size=64, intermediate_size=96, moe_intermediate_size=48,
+                 shared_expert_intermediate_size=96, num_hidden_layers=2, num_attention_heads=4,
+                 num_key_value_heads=2, num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+                 max_position_embeddings=64, rope_theta=1e4, decoder_sparse_step=1,
+                 mlp_only_layers=[], tie_word_embeddings=False,
+                 attention_dropout=0.0)
+    hf_model = HFM(hf_cfg).eval()
+    d = tmp_path / "qwen2moe"
+    hf_model.save_pretrained(d)
+
+    from transformers import AutoConfig
+    from deepspeed_tpu.inference.v2.engine_factory import _load_state_dict
+    sd = _load_state_dict(str(d))
+    cfg, params = convert_hf_state_dict(sd, AutoConfig.from_pretrained(str(d), local_files_only=True))
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32, "remat": False})
+
+    from deepspeed_tpu.models.qwen2_moe import Qwen2MoeForCausalLM
+    ids = np.array([[5, 9, 2, 7, 1, 3]], np.int32)
+    got = np.asarray(Qwen2MoeForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids)))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    np.testing.assert_allclose(got, want, rtol=3e-3, atol=3e-3)
