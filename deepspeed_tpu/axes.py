@@ -1,0 +1,22 @@
+"""Canonical logical-axis vocabulary.
+
+Every model tags its params with these names; module_inject/tp_rules maps
+them to mesh axes per (zero stage, tp degree).  This module is import-leaf
+(no deps) so models, moe, and sharding rules can all share it without
+cycles.
+"""
+
+# dense transformer axes (models/llama.py et al.)
+EMBED = "embed"
+MLP = "mlp"
+HEADS = "heads"
+KV_HEADS = "kv_heads"
+HEAD_DIM = "head_dim"
+VOCAB = "vocab"
+LAYERS = "layers"
+
+# MoE expert axes (moe/experts.py): EXPERT_* exclude the 'expert' mesh axis
+# from the ZeRO dims — the EXPERTS dim already carries it
+EXPERTS = "experts"
+EXPERT_EMBED = "expert_embed"
+EXPERT_MLP = "expert_mlp"

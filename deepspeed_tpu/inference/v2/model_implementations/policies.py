@@ -49,11 +49,19 @@ def _tied_lm_head(sd, embedding):
 
 def _proj(sd, L, E, D, fmt, heads, bias: bool):
     """Attention projection: HF [heads*D, E](+bias) → ours (E, heads, D).
-    ``fmt`` like 'model.layers.{{i}}.self_attn.q_proj' (with {{i}})."""
+    ``fmt`` is a format string with an ``{i}`` layer placeholder, e.g.
+    'model.layers.{i}.self_attn.q_proj'."""
     out = {"kernel": _stack(sd, fmt + ".weight", L, lambda w: _t(w).reshape(E, heads, D))}
     if bias:
         out["bias"] = _stack(sd, fmt + ".bias", L, lambda b: b.reshape(heads, D))
     return out
+
+
+def _experts(sd, L, NE, fmt):
+    """[L, NE, in, out] stack of per-layer-per-expert kernels; ``fmt`` has
+    ``{i}`` (layer) and ``{e}`` (expert) placeholders."""
+    return np.stack([
+        np.stack([_t(_get(sd, fmt.format(i=i, e=e))) for e in range(NE)]) for i in range(L)])
 
 
 class InferenceV2Policy:
@@ -243,11 +251,8 @@ class MixtralPolicy(InferenceV2Policy):
 
         stack = lambda fmt, conv=(lambda w: w): _stack(sd, fmt, L, conv)
 
-        def experts(w_name):
-            # [L, NE, ...] from model.layers.{i}.block_sparse_moe.experts.{e}.{w1,w2,w3}
-            return np.stack([
-                np.stack([_t(get(f"model.layers.{i}.block_sparse_moe.experts.{e}.{w_name}.weight"))
-                          for e in range(NE)]) for i in range(L)])
+        experts = lambda w_name: _experts(
+            sd, L, NE, "model.layers.{i}.block_sparse_moe.experts.{e}." + w_name + ".weight")
 
         params = {
             "embed_tokens": {"embedding": get("model.embed_tokens.weight")},
@@ -429,10 +434,8 @@ class Qwen2MoePolicy(InferenceV2Policy):
         proj = lambda name, heads: _proj(sd, L, E, D, "model.layers.{i}.self_attn." + name,
                                          heads, bias=cfg.qkv_bias)
 
-        def experts(w_name):
-            return np.stack([
-                np.stack([_t(get(f"model.layers.{i}.mlp.experts.{e}.{w_name}.weight"))
-                          for e in range(NE)]) for i in range(L)])
+        experts = lambda w_name: _experts(
+            sd, L, NE, "model.layers.{i}.mlp.experts.{e}." + w_name + ".weight")
 
         params = {
             "embed_tokens": {"embedding": get("model.embed_tokens.weight")},

@@ -29,13 +29,7 @@ from flax import linen as nn
 # Logical axis vocabulary (consumed by module_inject/tp_rules.py)
 BATCH = "batch"
 SEQ = "seq_len"
-EMBED = "embed"
-MLP = "mlp"
-HEADS = "heads"
-KV_HEADS = "kv_heads"
-HEAD_DIM = "head_dim"
-VOCAB = "vocab"
-LAYERS = "layers"
+from ..axes import EMBED, HEAD_DIM, HEADS, KV_HEADS, LAYERS, MLP, VOCAB  # noqa: F401 (canonical vocabulary)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -15,18 +15,14 @@ targets checkpoint parity and fine-tuning.
 """
 
 from dataclasses import dataclass
-from functools import partial
 from typing import Any
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from flax import linen as nn
 
-from .llama import (EMBED, HEADS, HEAD_DIM, KV_HEADS, LAYERS, MLP, VOCAB, LlamaAttention, LlamaConfig,
-                    RMSNorm, _logical)
-
-EXPERTS = "experts"
+from .llama import EMBED, LAYERS, MLP, VOCAB, LlamaAttention, LlamaConfig, RMSNorm, _logical
+from ..axes import EXPERT_EMBED, EXPERT_MLP, EXPERTS
 
 
 @dataclass(frozen=True)
@@ -110,11 +106,14 @@ class Qwen2MoeSparseMLP(nn.Module):
         onehot = jax.nn.one_hot(topi, NE, dtype=probs.dtype)   # [B,S,K,NE]
         weights = (onehot * topv[..., None]).sum(-2)           # [B,S,NE]
 
-        w_gate = self.param("w_gate", _logical(nn.initializers.lecun_normal(), (EXPERTS, EMBED, MLP)),
+        # EXPERT_EMBED/EXPERT_MLP exclude the expert mesh axis from the ZeRO
+        # dims — the 'expert' axis is already consumed by the EXPERTS dim
+        # (see moe/experts.py + module_inject/tp_rules.py)
+        w_gate = self.param("w_gate", _logical(nn.initializers.lecun_normal(), (EXPERTS, EXPERT_EMBED, EXPERT_MLP)),
                             (NE, E, M), cfg.param_dtype)
-        w_up = self.param("w_up", _logical(nn.initializers.lecun_normal(), (EXPERTS, EMBED, MLP)),
+        w_up = self.param("w_up", _logical(nn.initializers.lecun_normal(), (EXPERTS, EXPERT_EMBED, EXPERT_MLP)),
                           (NE, E, M), cfg.param_dtype)
-        w_down = self.param("w_down", _logical(nn.initializers.lecun_normal(), (EXPERTS, MLP, EMBED)),
+        w_down = self.param("w_down", _logical(nn.initializers.lecun_normal(), (EXPERTS, EXPERT_MLP, EXPERT_EMBED)),
                             (NE, M, E), cfg.param_dtype)
         # dense mixture: every expert evaluated, weighted-summed (exact HF math)
         h = jnp.einsum("bse,nem->bsnm", x.astype(dt), w_gate.astype(dt))

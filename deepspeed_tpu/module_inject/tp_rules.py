@@ -30,7 +30,7 @@ from ..comm.mesh import DATA_AXIS, EXPERT_AXIS, PIPE_AXIS, SEQ_AXIS, TENSOR_AXIS
 from ..models.llama import EMBED, HEADS, HEAD_DIM, KV_HEADS, LAYERS, MLP, VOCAB  # noqa: F401
 from ..runtime.pipe.pipeline import STAGE_LAYERS
 
-EXPERTS = "experts"  # MoE expert axis (moe/experts.py)
+from ..axes import EXPERTS  # MoE expert axis (canonical: deepspeed_tpu/axes.py)
 
 Rules = List[Tuple[str, Optional[object]]]
 

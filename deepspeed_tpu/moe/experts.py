@@ -12,9 +12,7 @@ from typing import Any
 import jax.numpy as jnp
 from flax import linen as nn
 
-EXPERTS = "experts"
-EXPERT_EMBED = "expert_embed"  # distinct from dense EMBED: ZeRO shards these
-EXPERT_MLP = "expert_mlp"    # over (data, seq) only — "expert" axis already taken
+from ..axes import EXPERT_EMBED, EXPERT_MLP, EXPERTS  # noqa: F401 (canonical vocabulary)
 
 
 class ExpertsFFN(nn.Module):

@@ -13,7 +13,7 @@ import numpy as np
 from flax import linen as nn
 
 from ..comm.mesh import BATCH_AXES, axis_size, get_global_mesh
-from ..models.llama import EMBED
+from ..axes import EMBED
 from .experts import ExpertsFFN
 from .sharded_moe import _capacity, dispatch_combine, top1_gating, topk_gating
 

@@ -53,12 +53,18 @@ def zero_shard_spec(spec: Optional[P], shape: Tuple[int, ...], mesh: Mesh,
     # over the intra-node subgroup only), extend that dim with the missing
     # axes so optimizer state/grads shard over the FULL group
     # (ref: hpZ — secondary param partition, primary optimizer partition)
+    used_anywhere = set()
+    for e in entries:
+        used_anywhere.update(tuple(e) if isinstance(e, tuple) else ((e, ) if e is not None else ()))
     for d, e in enumerate(entries):
         cur = tuple(e) if isinstance(e, tuple) else ((e, ) if e is not None else ())
         present = [a for a in cur if a in axes]
         if not present:
             continue
-        missing = tuple(a for a in axes if a not in cur)
+        # extend with zero axes not used on ANY dim (e.g. expert params carry
+        # the 'expert' mesh axis on their expert dim — it must not be added
+        # to the ZeRO dim again)
+        missing = tuple(a for a in axes if a not in used_anywhere)
         if not missing:
             return P(*entries)
         full = cur + missing

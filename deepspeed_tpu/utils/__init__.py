@@ -1,0 +1,6 @@
+"""Utils (ref: deepspeed/utils/): logging, timers, groups, nvtx,
+zero_to_fp32."""
+
+from .logging import LoggerFactory, log_dist, logger
+from .nvtx import instrument_w_nvtx
+from .timer import SynchronizedWallClockTimer, ThroughputTimer

@@ -1,0 +1,72 @@
+"""Process-group lookups — axis-name façade over the global mesh.
+
+ref: deepspeed/utils/groups.py (707 LoC of torch.distributed subgroup
+bookkeeping).  Under GSPMD a "group" IS a mesh axis: these helpers return
+the axis names and sizes that collectives and shardings use, preserving the
+reference's query surface for migrated code.
+"""
+
+from ..comm.mesh import (DATA_AXIS, EXPERT_AXIS, PIPE_AXIS, SEQ_AXIS, TENSOR_AXIS, ZERO_AXES, axis_size)
+from ..comm.mesh import get_global_mesh as _mesh
+
+
+def _size(*axes):
+    m = _mesh()
+    return axis_size(m, *[a for a in axes if m.shape.get(a, 1) > 1]) if m is not None else 1
+
+
+def get_data_parallel_group():
+    """ref: groups._get_data_parallel_group — the combined ZeRO/data axes."""
+    return ZERO_AXES
+
+
+def get_data_parallel_world_size():
+    return _size(*ZERO_AXES)
+
+
+def get_model_parallel_group():
+    """ref: groups._get_model_parallel_group."""
+    return (TENSOR_AXIS, )
+
+
+def get_model_parallel_world_size():
+    return _size(TENSOR_AXIS)
+
+
+def get_tensor_model_parallel_group():
+    return (TENSOR_AXIS, )
+
+
+def get_tensor_model_parallel_world_size():
+    return _size(TENSOR_AXIS)
+
+
+def get_expert_parallel_group(name=None):
+    """ref: groups._get_expert_parallel_group."""
+    return (EXPERT_AXIS, )
+
+
+def get_expert_parallel_world_size(name=None):
+    return _size(EXPERT_AXIS)
+
+
+def get_expert_data_parallel_group(name=None):
+    """Expert-data group: DP axes excluding the expert axis."""
+    return tuple(a for a in ZERO_AXES if a != EXPERT_AXIS)
+
+
+def get_sequence_parallel_group():
+    """ref: groups._get_sequence_parallel_group."""
+    return (SEQ_AXIS, )
+
+
+def get_sequence_parallel_world_size():
+    return _size(SEQ_AXIS)
+
+
+def get_pipeline_parallel_group():
+    return (PIPE_AXIS, )
+
+
+def get_pipeline_parallel_world_size():
+    return _size(PIPE_AXIS)

@@ -1,0 +1,38 @@
+"""Profiler range annotation (ref: deepspeed/utils/nvtx.py:12
+instrument_w_nvtx + accelerator range_push/pop).
+
+On TPU the analog of NVTX ranges is ``jax.named_scope`` (shows up in
+xprof/perfetto traces) plus ``jax.profiler.TraceAnnotation`` for host-side
+spans."""
+
+import functools
+
+import jax
+
+
+def instrument_w_nvtx(func):
+    """Decorate ``func`` so its execution appears as a named range in
+    profiler traces (ref: nvtx.py instrument_w_nvtx)."""
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(func.__qualname__):
+            with jax.named_scope(func.__qualname__):
+                return func(*args, **kwargs)
+
+    return wrapped
+
+
+def range_push(name: str):
+    """ref: accelerator.range_push — host-side profiler range begin."""
+    ann = jax.profiler.TraceAnnotation(name)
+    ann.__enter__()
+    _STACK.append(ann)
+
+
+def range_pop():
+    if _STACK:
+        _STACK.pop().__exit__(None, None, None)
+
+
+_STACK = []

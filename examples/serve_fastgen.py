@@ -1,0 +1,31 @@
+#!/usr/bin/env python3
+"""Serve a llama-family HF checkpoint with the FastGen-v2 continuous-batching
+engine (paged KV, SplitFuse scheduling).
+
+    python examples/serve_fastgen.py --model /path/to/hf_llama [--int8]
+"""
+
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # run from a checkout
+
+import argparse
+
+from deepspeed_tpu.inference.v2.engine_factory import build_hf_engine
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", required=True)
+    p.add_argument("--int8", action="store_true", help="weight-only int8")
+    p.add_argument("--max-new", type=int, default=64)
+    args = p.parse_args()
+
+    engine = build_hf_engine(args.model, quantization_mode="int8" if args.int8 else None)
+    prompts = [[1, 15043, 3186], [1, 1724, 338, 278]]
+    outs = engine.generate(prompts, max_new_tokens=args.max_new)
+    for prompt, out in zip(prompts, outs):
+        print(f"prompt={prompt} -> generated {len(out)} tokens: {out[:16]}...")
+
+
+if __name__ == "__main__":
+    main()
