@@ -89,7 +89,10 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         host round-trips (the analog of the reference's cuda-graph'd
         inference containers).
         """
-        assert self.state is not None, "run a train batch or pass params before generate()"
+        if self.state is None:
+            # RLHF loops may roll out before the first update: materialize
+            # the (sharded) state from the prompt shapes
+            self._materialize_state(batch={"input_ids": np.asarray(input_ids)})
         he = self._config.hybrid_engine
         max_new = max_new_tokens or he.max_out_tokens
         ids = jnp.asarray(input_ids)

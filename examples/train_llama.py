@@ -38,7 +38,11 @@ def main():
     args = p.parse_args()
 
     cfg = PRESETS[args.preset]
-    config = {
+    if getattr(args, "deepspeed_config", None):
+        config = args.deepspeed_config  # user-provided ds_config.json wins
+    else:
+        config = None
+    config = config or {
         "train_batch_size": args.batch,
         "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "weight_decay": 0.1}},
         "scheduler": {"type": "WarmupCosineLR",
