@@ -51,6 +51,7 @@ class LlamaConfig:
     remat_policy: str = "nothing_saveable"
     attention_impl: str = "reference"  # reference | flash | ulysses
     attention_bias: bool = False  # qkv bias (Qwen2-style checkpoints)
+    sliding_window: int = 0  # 0 = full attention; >0 = mistral-style window
 
     @staticmethod
     def from_hf(hf_cfg, **overrides):
@@ -67,6 +68,10 @@ class LlamaConfig:
             rms_norm_eps=getattr(hf_cfg, "rms_norm_eps", 1e-5),
             tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
             attention_bias=getattr(hf_cfg, "attention_bias", False),
+            # HF gates the window with use_sliding_window (qwen2 ships
+            # sliding_window=32768 but use_sliding_window=False)
+            sliding_window=((getattr(hf_cfg, "sliding_window", None) or 0)
+                            if getattr(hf_cfg, "use_sliding_window", True) else 0),
         )
         fields.update(overrides)
         return LlamaConfig(**fields)
@@ -121,9 +126,10 @@ def apply_rope(x, cos, sin):
     return out.astype(x.dtype)
 
 
-def reference_attention(q, k, v, *, causal=True, segment_ids=None):
+def reference_attention(q, k, v, *, causal=True, segment_ids=None, sliding_window=0):
     """Pure-jnp softmax attention (the golden path; swapped for the Pallas
-    flash kernel via config.attention_impl)."""
+    flash kernel via config.attention_impl).  ``sliding_window>0`` restricts
+    each query to the last W keys (mistral)."""
     b, sq, nh, hd = q.shape
     _, sk, nkv, _ = k.shape
     if nkv != nh:
@@ -136,6 +142,8 @@ def reference_attention(q, k, v, *, causal=True, segment_ids=None):
         qpos = jnp.arange(sq)[:, None]
         kpos = jnp.arange(sk)[None, :]
         mask = qpos >= kpos
+        if sliding_window and sliding_window > 0:
+            mask = mask & (kpos > qpos - sliding_window)
         logits = jnp.where(mask[None, None], logits, -1e30)
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
@@ -181,7 +189,14 @@ class LlamaAttention(nn.Module):
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         attn_fn = get_attention_impl(cfg.attention_impl)
-        out = attn_fn(q, k, v, causal=True, segment_ids=segment_ids)
+        if cfg.sliding_window and cfg.attention_impl == "reference":
+            out = attn_fn(q, k, v, causal=True, segment_ids=segment_ids,
+                          sliding_window=cfg.sliding_window)
+        else:
+            if cfg.sliding_window:
+                raise NotImplementedError("sliding_window requires attention_impl='reference' "
+                                          "(flash/ulysses window masks land with the kernel)")
+            out = attn_fn(q, k, v, causal=True, segment_ids=segment_ids)
         out = nn.DenseGeneral(features=cfg.hidden_size,
                               axis=(-2, -1),
                               use_bias=False,

@@ -67,7 +67,7 @@ def _write_pages(pages, k_new, v_new, block_table, start_pos, page_size, chunk_l
     return pages.at[page_idx.reshape(-1), slot_idx.reshape(-1)].set(flat_kv)
 
 
-def paged_attention(q, pages, block_table, start_pos, chunk_lens, page_size):
+def paged_attention(q, pages, block_table, start_pos, chunk_lens, page_size, sliding_window=0):
     """Attention of a chunk's queries against (history + chunk) keys.
 
     q: [B, C, H, hd] (RoPE applied); pages: [P, page, 2, n_kv, hd] with the
@@ -93,6 +93,8 @@ def paged_attention(q, pages, block_table, start_pos, chunk_lens, page_size):
     qpos = start_pos[:, None] + jnp.arange(c)[None, :]                # [B, C]
     kpos = jnp.arange(max_pages * page_size)[None, :]                 # [1, S_kv]
     mask = kpos[:, None, :] <= qpos[..., None]                        # [B, C, S_kv]
+    if sliding_window and sliding_window > 0:  # mistral window (decode path)
+        mask = mask & (kpos[:, None, :] > qpos[..., None] - sliding_window)
     logits = jnp.where(mask[:, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bnck,bknd->bcnd", probs.astype(v.dtype), v)
@@ -128,11 +130,15 @@ class LlamaAttentionCache(nn.Module):
         pages = _write_pages(pages, k.astype(pages.dtype), v.astype(pages.dtype), block_table, start_pos,
                              self.page_size, chunk_lens)
         if cfg.attention_impl == "flash":
+            if getattr(cfg, "sliding_window", 0):
+                raise NotImplementedError("sliding_window decode requires the reference paged "
+                                          "attention (pallas window mask lands with the kernel)")
             # Pallas blocked-decode kernel (ops/paged_attention.py)
             from ..ops.paged_attention import paged_attention_pallas
             out = paged_attention_pallas(q, pages, block_table, start_pos, chunk_lens, self.page_size)
         else:
-            out = paged_attention(q, pages, block_table, start_pos, chunk_lens, self.page_size)
+            out = paged_attention(q, pages, block_table, start_pos, chunk_lens, self.page_size,
+                                  sliding_window=getattr(cfg, "sliding_window", 0))
         out = nn.DenseGeneral(features=cfg.hidden_size,
                               axis=(-2, -1),
                               use_bias=False,

@@ -38,11 +38,7 @@ def main():
     args = p.parse_args()
 
     cfg = PRESETS[args.preset]
-    if getattr(args, "deepspeed_config", None):
-        config = args.deepspeed_config  # user-provided ds_config.json wins
-    else:
-        config = None
-    config = config or {
+    config = args.deepspeed_config or {  # user-provided ds_config.json wins
         "train_batch_size": args.batch,
         "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "weight_decay": 0.1}},
         "scheduler": {"type": "WarmupCosineLR",

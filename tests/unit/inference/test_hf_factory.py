@@ -304,3 +304,34 @@ def test_qwen2_moe_logits_parity(tmp_path):
     with torch.no_grad():
         want = hf_model(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
     np.testing.assert_allclose(got, want, rtol=3e-3, atol=3e-3)
+
+
+def test_mistral_sliding_window_masks():
+    """sliding_window restricts attention: a distant key must not influence
+    the query when the window excludes it (both train + paged decode paths)."""
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    S = 16
+    base = dict(vocab_size=64, hidden_size=32, intermediate_size=48, num_hidden_layers=1,
+                num_attention_heads=2, num_key_value_heads=2, max_position_embeddings=S,
+                rope_theta=1e4, dtype=jnp.float32, remat=False)
+    full = LlamaForCausalLM(LlamaConfig(**base))
+    win = LlamaForCausalLM(LlamaConfig(**base, sliding_window=4))
+    ids = np.arange(S, dtype=np.int32)[None, :] % 64
+    v = full.init(jax.random.PRNGKey(0), jnp.asarray(ids))
+    out_full = np.asarray(full.apply(v, jnp.asarray(ids)))
+    out_win = np.asarray(win.apply(v, jnp.asarray(ids)))
+    # early positions (inside window) identical; late positions differ
+    np.testing.assert_allclose(out_win[0, :4], out_full[0, :4], rtol=1e-5)
+    assert np.abs(out_win[0, -1] - out_full[0, -1]).max() > 1e-5
+
+    # decode path: windowed engine reproduces the windowed train model
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+    from deepspeed_tpu.models.llama_cache import PagedKVConfig
+    eng = InferenceEngineV2(LlamaConfig(**base, sliding_window=4), v,
+                            RaggedInferenceEngineConfig(kv=PagedKVConfig(num_pages=32, page_size=4,
+                                                                         max_pages_per_seq=8),
+                                                        kv_dtype=jnp.float32))
+    prompt = list(ids[0, :10])
+    got = eng.generate([prompt], max_new_tokens=1)[0][0]
+    ref_logits = win.apply(v, jnp.asarray([prompt], jnp.int32))
+    assert got == int(np.argmax(np.asarray(ref_logits)[0, -1]))
