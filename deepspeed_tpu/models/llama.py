@@ -73,6 +73,16 @@ class LlamaConfig:
             sliding_window=((getattr(hf_cfg, "sliding_window", None) or 0)
                             if getattr(hf_cfg, "use_sliding_window", True) else 0),
         )
+        # qwen2's max_window_layers keeps the first N layers full-attention;
+        # mixed per-layer windows don't fit one scanned layer body
+        mwl = getattr(hf_cfg, "max_window_layers", None)
+        if fields["sliding_window"] and mwl is not None:
+            if mwl >= hf_cfg.num_hidden_layers:
+                fields["sliding_window"] = 0      # no layer actually windowed
+            elif mwl > 0:
+                raise NotImplementedError(
+                    f"mixed full/window attention (max_window_layers={mwl} of "
+                    f"{hf_cfg.num_hidden_layers}) is unsupported with scan-over-layers")
         fields.update(overrides)
         return LlamaConfig(**fields)
 
@@ -188,15 +198,12 @@ class LlamaAttention(nn.Module):
         cos, sin = rotary_embedding(positions, head_dim, cfg.rope_theta)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
+        if cfg.sliding_window and cfg.attention_impl != "reference":
+            raise NotImplementedError("sliding_window requires attention_impl='reference' "
+                                      "(flash/ulysses window masks land with the kernel)")
         attn_fn = get_attention_impl(cfg.attention_impl)
-        if cfg.sliding_window and cfg.attention_impl == "reference":
-            out = attn_fn(q, k, v, causal=True, segment_ids=segment_ids,
-                          sliding_window=cfg.sliding_window)
-        else:
-            if cfg.sliding_window:
-                raise NotImplementedError("sliding_window requires attention_impl='reference' "
-                                          "(flash/ulysses window masks land with the kernel)")
-            out = attn_fn(q, k, v, causal=True, segment_ids=segment_ids)
+        kw = {"sliding_window": cfg.sliding_window} if cfg.sliding_window else {}
+        out = attn_fn(q, k, v, causal=True, segment_ids=segment_ids, **kw)
         out = nn.DenseGeneral(features=cfg.hidden_size,
                               axis=(-2, -1),
                               use_bias=False,

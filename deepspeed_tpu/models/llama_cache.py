@@ -130,7 +130,7 @@ class LlamaAttentionCache(nn.Module):
         pages = _write_pages(pages, k.astype(pages.dtype), v.astype(pages.dtype), block_table, start_pos,
                              self.page_size, chunk_lens)
         if cfg.attention_impl == "flash":
-            if getattr(cfg, "sliding_window", 0):
+            if cfg.sliding_window:
                 raise NotImplementedError("sliding_window decode requires the reference paged "
                                           "attention (pallas window mask lands with the kernel)")
             # Pallas blocked-decode kernel (ops/paged_attention.py)
@@ -138,7 +138,7 @@ class LlamaAttentionCache(nn.Module):
             out = paged_attention_pallas(q, pages, block_table, start_pos, chunk_lens, self.page_size)
         else:
             out = paged_attention(q, pages, block_table, start_pos, chunk_lens, self.page_size,
-                                  sliding_window=getattr(cfg, "sliding_window", 0))
+                                  sliding_window=cfg.sliding_window)
         out = nn.DenseGeneral(features=cfg.hidden_size,
                               axis=(-2, -1),
                               use_bias=False,

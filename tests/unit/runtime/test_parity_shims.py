@@ -18,7 +18,7 @@ def test_groups_facade():
     assert groups.get_sequence_parallel_world_size() == 2
     assert groups.get_model_parallel_world_size() == 1
     assert "expert" not in groups.get_expert_data_parallel_group()
-    set_global_mesh(create_mesh(MeshSpec(data=-1)))  # restore default for other tests
+    # (the autouse _reset_global_mesh fixture restores the mesh afterwards)
 
 
 def test_nvtx_shim():
