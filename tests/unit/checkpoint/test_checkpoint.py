@@ -71,3 +71,36 @@ def test_reshard_across_zero_stages(tmp_path):
     e0.load_checkpoint(tmp_path, tag="z3")
     got = float(e0.eval_batch(batch=batch))
     assert abs(got - ref_loss) / abs(ref_loss) < 3e-3
+
+
+def test_reshard_across_mesh_topologies(tmp_path):
+    """Save under a pure-DP mesh, restore under a DP×SP×TP mesh: orbax
+    redistributes global arrays to the new shardings — the reference needs
+    the offline universal-checkpoint converter for this
+    (ref: checkpoint/ds_to_universal.py + reshape_meg_2d.py)."""
+    from deepspeed_tpu.comm.mesh import MeshSpec, create_mesh
+
+    batch = random_batch()
+    engine = make_engine({"zero_optimization": {"stage": 3}})
+    for _ in range(2):
+        engine.train_batch(batch=batch)
+    loss_before = float(engine.eval_batch(batch=batch))
+    engine.save_checkpoint(tmp_path, tag="topo")
+
+    # new topology: dp2 × sp2 × tp2 with ZeRO-3 + ulysses attention
+    mesh = create_mesh(MeshSpec(data=2, seq=2, tensor=2), devices=jax.devices()[:8])
+    from deepspeed_tpu.models.llama import LlamaConfig
+    cfg2 = LlamaConfig(**{**TINY.__dict__, "attention_impl": "ulysses"})
+    model = LlamaForCausalLM(cfg2)
+    fresh, _, _, _ = ds.initialize(model=model, mesh=mesh, config=base_config(**{
+        "zero_optimization": {"stage": 3}, "sequence_parallel_size": 2,
+        "tensor_parallel": {"autotp_size": 2}}))
+    fresh.train_batch(batch=random_batch(seed=7))
+    fresh.load_checkpoint(tmp_path, tag="topo")
+    loss_after = float(fresh.eval_batch(batch=batch))
+    # small delta = fp reduction-order differences under the TP/SP compute
+    # path, not weight corruption
+    assert abs(loss_before - loss_after) < 5e-3
+    # training continues under the NEW topology from the restored state
+    l = float(fresh.train_batch(batch=batch))
+    assert np.isfinite(l)
