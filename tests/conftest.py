@@ -50,3 +50,5 @@ def _reset_global_mesh():
     yield
     from deepspeed_tpu.comm import mesh as mesh_lib
     mesh_lib._GLOBAL_MESH = None
+    from deepspeed_tpu.comm import comm as comm_lib
+    comm_lib._COMMS_LOGGER = None

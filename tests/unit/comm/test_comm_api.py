@@ -1,9 +1,6 @@
 """deepspeed.comm façade tests (analog of reference tests/unit/comm/
 test_dist.py — collective semantics + comms logging)."""
 
-import sys, os
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -38,6 +35,10 @@ def test_axis_collectives_inside_shard_map():
     s, g, rs, idx = run(x)
     np.testing.assert_allclose(np.asarray(s)[0], np.asarray(x).sum(0), rtol=1e-6)
     np.testing.assert_allclose(np.asarray(g), np.asarray(x))
+    # reduce_scatter: rank r gets element r of the cross-rank sum of the
+    # flattened per-rank rows
+    want_rs = np.stack([np.asarray(x)[:, i] for i in range(4)]).sum(1)
+    np.testing.assert_allclose(np.asarray(rs).ravel(), want_rs, rtol=1e-6)
     np.testing.assert_array_equal(np.asarray(idx).ravel(), np.arange(4))
 
 

@@ -1,0 +1,64 @@
+"""Monitor tests (analog of reference tests/unit/monitor/test_monitor.py —
+backend construction + write_events fan-out)."""
+
+import csv
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.llama import LlamaForCausalLM
+from deepspeed_tpu.monitor.monitor import MonitorMaster, csvMonitor
+from deepspeed_tpu.runtime.config import CSVConfig, DeepSpeedMonitorConfig, TensorBoardConfig
+
+from simple_model import TINY, base_config, random_batch
+
+
+def _monitor_config(tmp_path, csv_enabled=True, tb_enabled=False):
+    return DeepSpeedMonitorConfig(
+        csv_monitor=CSVConfig(enabled=csv_enabled, output_path=str(tmp_path), job_name="job"),
+        tensorboard=TensorBoardConfig(enabled=tb_enabled, output_path=str(tmp_path), job_name="tb"),
+    )
+
+
+def test_csv_monitor_writes_events(tmp_path):
+    mon = csvMonitor(_monitor_config(tmp_path).csv_monitor)
+    mon.write_events([("Train/loss", 1.25, 1), ("Train/loss", 1.10, 2), ("Train/lr", 3e-4, 2)])
+    files = [f for root, _, fs in os.walk(tmp_path) for f in fs if f.endswith(".csv")]
+    assert files, "no csv written"
+    rows = []
+    for root, _, fs in os.walk(tmp_path):
+        for f in fs:
+            if f.endswith(".csv"):
+                rows.extend(list(csv.reader(open(os.path.join(root, f)))))
+    flat = [",".join(r) for r in rows]
+    assert any("1.25" in r for r in flat)
+
+
+def test_monitor_master_fanout_and_enabled_flag(tmp_path):
+    master = MonitorMaster(_monitor_config(tmp_path))
+    assert master.enabled
+    master.write_events([("Train/Samples/train_loss", 2.0, 8)])
+    files = [f for root, _, fs in os.walk(tmp_path) for f in fs if f.endswith(".csv")]
+    assert files
+
+    off = MonitorMaster(_monitor_config(tmp_path, csv_enabled=False))
+    assert not off.enabled
+
+
+def test_engine_writes_monitor_events(tmp_path):
+    cfg = base_config(**{"csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                                          "job_name": "engine_run"},
+                         "steps_per_print": 0})
+    engine, _, _, _ = ds.initialize(model=LlamaForCausalLM(TINY), config=cfg)
+    for _ in range(2):
+        engine.train_batch(batch=random_batch())
+    files = [os.path.join(root, f) for root, _, fs in os.walk(tmp_path) for f in fs if f.endswith(".csv")]
+    assert files, "engine did not write monitor events"
+    # the loss event must be present with a numeric value row
+    loss_files = [f for f in files if "train_loss" in os.path.basename(f)]
+    assert loss_files, f"no train_loss csv among {files}"
+    assert any(len(r) >= 2 for r in csv.reader(open(loss_files[0])))
