@@ -12,7 +12,7 @@ from typing import Any, Optional
 import jax.numpy as jnp
 from flax import linen as nn
 
-from .utils import asym_quantize, sym_quantize
+from .utils import asym_quantize, ste, sym_quantize
 
 
 class QuantAct(nn.Module):
@@ -43,14 +43,24 @@ class QuantAct(nn.Module):
         else:
             new_min, new_max = rng_min.value, rng_max.value
 
-        # quantize against the calibrated range: shift+scale into the range,
-        # fixed-levels round, then back (STE inside sym/asym_quantize)
+        # quantize against the calibrated range: the scale comes from the
+        # momentum-tracked min/max, NOT from the current tensor (ref:
+        # basic_layer.py QuantAct — quantization_utils asymmetric/symmetric
+        # linear quantization with the running-range scale); re-deriving amax
+        # from the clipped activations would make frozen calibration a no-op
+        # in eval.
         if self.quantization_type == "symmetric":
             bound = jnp.maximum(jnp.abs(new_min), jnp.abs(new_max)) + 1e-12
+            levels = 2.0**(self.num_bits - 1) - 1.0
+            scale = bound / levels
             xc = jnp.clip(x, -bound, bound)
-            return sym_quantize(xc, self.num_bits, num_groups=1)
-        xc = jnp.clip(x, new_min, new_max + 1e-12)
-        return asym_quantize(xc, self.num_bits, num_groups=1)
+            q = jnp.round(xc / scale) * scale
+            return ste(x, q.astype(x.dtype))
+        levels = 2.0**self.num_bits - 1.0
+        scale = (new_max - new_min + 1e-12) / levels
+        xc = jnp.clip(x, new_min, new_max)
+        q = jnp.round((xc - new_min) / scale) * scale + new_min
+        return ste(x, q.astype(x.dtype))
 
 
 class LinearLayerCompress(nn.Module):

@@ -158,7 +158,41 @@ def build_compression_fn(compression_dict: Dict[str, Any], abs_params) -> Any:
     logger.info(f"compression: {len(actions)} parameters matched "
                 f"({[t for t in ('wq', 'sp', 'rp', 'hp', 'cp') if any(k == t for a in actions.values() for k, _ in a)]})")
 
+    def _structured_mask(kind, w, cfg, stacked):
+        """Pruning mask for a (possibly scan-stacked) kernel.
+
+        The default model layout stacks per-layer kernels under a leading
+        layer axis (``model.layers.*`` paths, shapes [L, ...]); masks must be
+        computed per layer, not across the stack, so stacked kernels are
+        vmapped over axis 0.  Per-layer DenseGeneral kernels are flattened to
+        (in, out*) for row/channel pruning; head pruning handles the 2-D
+        (H*D, out) and 3-D o_proj (H, D, out) layouts and refuses anything
+        else loudly (ref: basic_layer.py head/row/channel pruning act on 2-D
+        nn.Linear weights)."""
+        if stacked and w.ndim > 2:
+            return jax.vmap(lambda wl: _structured_mask(kind, wl, cfg, False))(w)
+        if kind == "rp":
+            w2 = w.reshape(w.shape[0], -1)
+            return jnp.broadcast_to(row_mask_l1(w2, cfg["ratio"]), w2.shape).reshape(w.shape)
+        if kind == "cp":
+            w2 = w.reshape(w.shape[0], -1)
+            return jnp.broadcast_to(channel_mask_l1(w2, cfg["ratio"]), w2.shape).reshape(w.shape)
+        # head pruning
+        num_heads = cfg["num_heads"]
+        if w.ndim == 2:
+            return jnp.broadcast_to(head_mask_l1(w, cfg["ratio"], num_heads), w.shape)
+        if w.ndim == 3:
+            if w.shape[0] != num_heads:
+                raise ValueError(
+                    f"head pruning: 3-D kernel leading axis {w.shape[0]} != num_heads {num_heads} "
+                    f"(expected o_proj layout (H, D, out), got {w.shape})")
+            norms = jnp.sum(jnp.abs(w), axis=(1, 2))
+            from .utils import topk_mask
+            return jnp.broadcast_to(topk_mask(norms, cfg["ratio"])[:, None, None], w.shape)
+        raise ValueError(f"head pruning needs a 2-D (H*D, out) or 3-D (H, D, out) kernel, got shape {w.shape}")
+
     def apply_leaf(path, w, step):
+        stacked = "layers" in path.split(".")
         for kind, cfg in actions.get(path, ()):
             on = step >= cfg["offset"]
             if kind == "wq":
@@ -174,14 +208,9 @@ def build_compression_fn(compression_dict: Dict[str, Any], abs_params) -> Any:
                 w = jnp.where(on, wq_, w)
             elif kind == "sp":
                 w = jnp.where(on, w * jax.lax.stop_gradient(sparse_mask_l1(w, cfg["ratio"])), w)
-            elif kind == "rp":
-                w = jnp.where(on, w * jax.lax.stop_gradient(row_mask_l1(w, cfg["ratio"])), w)
-            elif kind == "cp":
-                w = jnp.where(on, w * jax.lax.stop_gradient(channel_mask_l1(w, cfg["ratio"])), w)
-            elif kind == "hp":
-                if w.ndim == 2:
-                    m = head_mask_l1(w, cfg["ratio"], cfg["num_heads"])
-                    w = jnp.where(on, w * jax.lax.stop_gradient(m), w)
+            elif kind in ("rp", "cp", "hp"):
+                m = _structured_mask(kind, w, cfg, stacked)
+                w = jnp.where(on, w * jax.lax.stop_gradient(m), w)
         return w
 
     def fn(params, step):

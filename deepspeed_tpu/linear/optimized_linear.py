@@ -123,27 +123,79 @@ def lora_trainable_mask(params) -> Any:
     return walk(params)
 
 
-def fuse_lora(params, lora_config: Optional[LoRAConfig] = None):
-    """Fold each adapter into its base kernel:  W ← W + (alpha/r)(A−bound)B
+def fuse_lora(params, lora_config: Optional[LoRAConfig] = None,
+              quantization_config: Optional[QuantizationConfig] = None):
+    """Fold each adapter into its base kernel:  W ← W + (alpha/r)·A·B
     (ref: hybrid_engine fuse_lora_weight → _fuse_lora).  Returns a new tree;
-    `unfuse_lora` reverses it exactly."""
-    return _fuse(params, lora_config or LoRAConfig(), sign=+1.0)
+    `unfuse_lora` reverses it exactly.
+
+    Accepts either a bare params tree or a full variables dict
+    ``{"params": ..., "quant": ...}`` — quantized LoRA bases live in the
+    ``quant`` collection as base_kernel_q/base_kernel_scale and are fused by
+    dequantize → fold → requantize (pass the model's ``quantization_config``
+    so the requantize grid matches; note unfuse after a quantized fuse is
+    exact only up to the quantization grid).  A lora_a/lora_b pair with no
+    fusable base in either collection raises instead of silently fusing
+    nothing."""
+    return _fuse(params, lora_config or LoRAConfig(), sign=+1.0,
+                 qcfg=quantization_config)
 
 
-def unfuse_lora(params, lora_config: Optional[LoRAConfig] = None):
+def unfuse_lora(params, lora_config: Optional[LoRAConfig] = None,
+                quantization_config: Optional[QuantizationConfig] = None):
     """ref: hybrid_engine unfuse_lora_weight."""
-    return _fuse(params, lora_config or LoRAConfig(), sign=-1.0)
+    return _fuse(params, lora_config or LoRAConfig(), sign=-1.0,
+                 qcfg=quantization_config)
 
 
-def _fuse(params, cfg, sign):
-    def walk(tree):
+def _fuse(params, cfg, sign, qcfg=None):
+    is_variables = isinstance(params, dict) and "params" in params and "quant" in params
+    quant_root = params.get("quant") if is_variables else None
+    params_root = params["params"] if is_variables else params
+    scaling = cfg.lora_alpha / cfg.lora_r
+
+    def walk(tree, quant_sibling):
         if not isinstance(tree, dict):
-            return tree
-        if "base_kernel" in tree and "lora_a" in tree and "lora_b" in tree:
-            w, a, b = tree["base_kernel"], tree["lora_a"], tree["lora_b"]
-            scaling = cfg.lora_alpha / cfg.lora_r
+            return tree, quant_sibling
+        if "lora_a" in tree and "lora_b" in tree:
+            a, b = tree["lora_a"], tree["lora_b"]
             delta = a @ b * scaling
-            return {**tree, "base_kernel": w + sign * delta.astype(w.dtype)}
-        return {k: walk(v) for k, v in tree.items()}
+            if "base_kernel" in tree:
+                w = tree["base_kernel"]
+                return {**tree, "base_kernel": w + sign * delta.astype(w.dtype)}, quant_sibling
+            if (isinstance(quant_sibling, dict) and "base_kernel_q" in quant_sibling
+                    and "base_kernel_scale" in quant_sibling):
+                q, s = quant_sibling["base_kernel_q"], quant_sibling["base_kernel_scale"]
+                shape = (a.shape[0], b.shape[1])
+                group_size = q.shape[-1]
+                if qcfg is None and q.dtype != jnp.float8_e4m3fn:
+                    # int8 storage can hold 4/6/8-bit grids — guessing 8 would
+                    # silently write out-of-range values for 4/6-bit bases
+                    raise ValueError(
+                        "fuse_lora: quantized base with int storage needs the model's "
+                        "quantization_config to requantize on the original grid")
+                eff = qcfg or QuantizationConfig(
+                    q_bits=8, q_dtype=q.dtype, group_size=group_size)
+                w = dequantize(q, s, shape, jnp.float32)
+                nq, ns = quantize(w + sign * delta.astype(jnp.float32), eff)
+                return tree, {**quant_sibling, "base_kernel_q": nq, "base_kernel_scale": ns}
+            raise ValueError(
+                "fuse_lora: found a lora_a/lora_b pair with no fusable base — "
+                "quantized bases live in the 'quant' collection; pass the full "
+                "variables dict {'params': ..., 'quant': ...} (and the model's "
+                "quantization_config) instead of the bare params tree")
+        out_p, out_q = {}, {}
+        for k, v in tree.items():
+            qs = quant_sibling.get(k) if isinstance(quant_sibling, dict) else None
+            np_, nq_ = walk(v, qs)
+            out_p[k] = np_
+            if isinstance(quant_sibling, dict) and k in quant_sibling:
+                out_q[k] = nq_
+        if isinstance(quant_sibling, dict):
+            out_q = {**quant_sibling, **out_q}
+        return out_p, (out_q if isinstance(quant_sibling, dict) else quant_sibling)
 
-    return walk(params)
+    new_params, new_quant = walk(params_root, quant_root)
+    if is_variables:
+        return {**params, "params": new_params, "quant": new_quant}
+    return new_params

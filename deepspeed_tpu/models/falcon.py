@@ -35,6 +35,7 @@ class FalconConfig:
     new_decoder_architecture: bool = False
     parallel_attn: bool = True
     num_ln_in_parallel_attn: int = 2  # new-arch: 2 = ln_attn+ln_mlp; 1 = shared (falcon-11B)
+    ffn_hidden_size: int = 0  # 0 → 4*hidden_size (HF default); falcon2-style variants override
     bias: bool = False
     layer_norm_epsilon: float = 1e-5
     rope_theta: float = 10000.0
@@ -70,6 +71,7 @@ class FalconConfig:
                       num_ln_in_parallel_attn=(getattr(hf_cfg, "num_ln_in_parallel_attn", None)
                                                or (2 if new_arch else 1)),
                       parallel_attn=getattr(hf_cfg, "parallel_attn", True),
+                      ffn_hidden_size=getattr(hf_cfg, "ffn_hidden_size", None) or 0,
                       bias=getattr(hf_cfg, "bias", False),
                       layer_norm_epsilon=getattr(hf_cfg, "layer_norm_epsilon", 1e-5),
                       rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
@@ -121,7 +123,8 @@ class FalconBlock(nn.Module):
             attn_in = ln(name="input_layernorm")(x)
             mlp_in = attn_in
         attn_out = FalconAttention(cfg, name="self_attention")(attn_in, positions, segment_ids)
-        h = nn.Dense(cfg.hidden_size * 4, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        ffn = cfg.ffn_hidden_size or cfg.hidden_size * 4
+        h = nn.Dense(ffn, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, MLP)),
                      name="dense_h_to_4h")(mlp_in)
         mlp_out = nn.Dense(cfg.hidden_size, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype,

@@ -104,6 +104,57 @@ def _logical(init, names):
     return nn.with_logical_partitioning(init, names)
 
 
+def _in_manual_mesh() -> bool:
+    """True inside a shard_map body (e.g. the pipeline rotation): GSPMD-level
+    sharding constraints are meaningless/illegal there."""
+    try:
+        from jax.sharding import get_abstract_mesh
+        return bool(get_abstract_mesh()._any_axis_manual)
+    except Exception:
+        return False
+
+
+def _skip_constraint(x) -> bool:
+    """Constraints are trace-time directives to GSPMD; eager values (golden
+    tests calling attention outside jit) and shard_map bodies skip them."""
+    return not isinstance(x, jax.core.Tracer) or _in_manual_mesh()
+
+
+def activation_constraint(x):
+    """Pin a [B, S, E] activation to the canonical (data×expert, seq, -)
+    layout.  Without this, sharding propagation lets the embedding lookup
+    inherit the table's ZeRO-3 fsdp sharding on the E dim, and the scan
+    carry (B,S layout) then needs an SPMD "involuntary full
+    rematerialization" reshard on while entry/exit — replicate + repartition
+    of the whole residual stream, once forward and once backward."""
+    from ..comm.mesh import BATCH_AXES, SEQ_AXIS, get_global_mesh, has_global_mesh
+    if not has_global_mesh() or _skip_constraint(x):
+        return x
+    mesh = get_global_mesh()
+    if all(mesh.shape.get(a, 1) == 1 for a in (*BATCH_AXES, SEQ_AXIS)):
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+    spec = PartitionSpec(BATCH_AXES, SEQ_AXIS if mesh.shape.get(SEQ_AXIS, 1) > 1 else None, None)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def logits_constraint(logits):
+    """Pin [B, S, V] logits to (data×expert, seq, tensor): with the lm_head
+    kernel vocab-parallel (see tp_rules.vocab_rules) this keeps the matmul's
+    fsdp all-gather on the weight side and the loss vocab-sharded over tp."""
+    from ..comm.mesh import BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, get_global_mesh, has_global_mesh
+    if not has_global_mesh() or _skip_constraint(logits):
+        return logits
+    mesh = get_global_mesh()
+    if all(mesh.shape.get(a, 1) == 1 for a in (*BATCH_AXES, SEQ_AXIS, TENSOR_AXIS)):
+        return logits
+    from jax.sharding import NamedSharding, PartitionSpec
+    spec = PartitionSpec(BATCH_AXES,
+                         SEQ_AXIS if mesh.shape.get(SEQ_AXIS, 1) > 1 else None,
+                         TENSOR_AXIS if mesh.shape.get(TENSOR_AXIS, 1) > 1 else None)
+    return jax.lax.with_sharding_constraint(logits, NamedSharding(mesh, spec))
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-5
     dtype: Any = jnp.bfloat16
@@ -136,6 +187,25 @@ def apply_rope(x, cos, sin):
     return out.astype(x.dtype)
 
 
+def _attn_logits_constraint(t):
+    """Pin [B, N, Q, K] attention scores (and everything softmax derives from
+    them) to the head-sharded layout the Ulysses all-to-all establishes.
+    Without it, the backward recompute under jax.checkpoint resolves parts of
+    the softmax head-sharded (from q/k) and parts seq-sharded (from the
+    positions/mask side), and the partitioner falls back to involuntary full
+    rematerialization between them."""
+    from ..comm.mesh import BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, get_global_mesh, has_global_mesh
+    if not has_global_mesh() or _skip_constraint(t):
+        return t
+    mesh = get_global_mesh()
+    head_axes = tuple(a for a in (SEQ_AXIS, TENSOR_AXIS) if mesh.shape.get(a, 1) > 1)
+    if not head_axes and all(mesh.shape.get(a, 1) == 1 for a in BATCH_AXES):
+        return t
+    from jax.sharding import NamedSharding, PartitionSpec
+    spec = PartitionSpec(BATCH_AXES, head_axes or None, None, None)
+    return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, spec))
+
+
 def reference_attention(q, k, v, *, causal=True, segment_ids=None, sliding_window=0):
     """Pure-jnp softmax attention (the golden path; swapped for the Pallas
     flash kernel via config.attention_impl).  ``sliding_window>0`` restricts
@@ -148,6 +218,7 @@ def reference_attention(q, k, v, *, causal=True, segment_ids=None, sliding_windo
         v = jnp.repeat(v, rep, axis=2)
     scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
     logits = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    logits = _attn_logits_constraint(logits)
     if causal:
         qpos = jnp.arange(sq)[:, None]
         kpos = jnp.arange(sk)[None, :]
@@ -162,9 +233,65 @@ def reference_attention(q, k, v, *, causal=True, segment_ids=None, sliding_windo
     return jnp.einsum("bnqk,bknd->bqnd", probs.astype(v.dtype), v)
 
 
+def chunked_attention(q, k, v, *, causal=True, segment_ids=None, sliding_window=0,
+                      chunk_size=256):
+    """Query-chunked attention with the softmax over the full key axis per
+    chunk — never materializes the [B, N, S, S] score tensor that makes
+    ``reference_attention`` HBM-bound at training sizes (each chunk's scores
+    are [B, N, C, S] and die inside the scan iteration).  The online-softmax
+    variant for host-offloaded KV lives in sequence/fpdt_layer.py; this one
+    assumes K/V fit on-chip, which holds whenever the model itself does.
+    ref role: csrc/transformer softmax/attention fusion — the memory shape of
+    FlashAttention without the Pallas kernel (which cannot compile through
+    the axon tunnel)."""
+    b, sq, nh, hd = q.shape
+    _, sk, nkv, _ = k.shape
+    if nkv != nh:
+        rep = nh // nkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if sq % chunk_size != 0 or sq < chunk_size:
+        from ..utils.logging import logger
+        logger.warning(f"chunked_attention: seq {sq} not a multiple of chunk {chunk_size}; "
+                       "falling back to reference attention (full [B,N,S,S] scores)")
+        return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                                   sliding_window=sliding_window)
+    scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
+    nc = sq // chunk_size
+    qc = q.reshape(b, nc, chunk_size, nh, hd).transpose(1, 0, 2, 3, 4)  # [nc,B,C,N,D]
+    kpos = jnp.arange(sk)
+
+    def body(carry, args):
+        q_i, i = args
+        # [B,N,C,S] f32 scores for this query chunk only
+        s = jnp.einsum("bcnd,bknd->bnck", q_i, k,
+                       preferred_element_type=jnp.float32) * scale
+        qpos = i * chunk_size + jnp.arange(chunk_size)
+        mask = jnp.ones((chunk_size, sk), bool)
+        if causal:
+            mask = qpos[:, None] >= kpos[None, :]
+            if sliding_window and sliding_window > 0:
+                mask = mask & (kpos[None, :] > qpos[:, None] - sliding_window)
+        s = jnp.where(mask[None, None], s, -1e30)
+        if segment_ids is not None:
+            q_seg = jax.lax.dynamic_slice_in_dim(segment_ids, i * chunk_size, chunk_size, axis=1)
+            seg_mask = q_seg[:, :, None] == segment_ids[:, None, :]
+            s = jnp.where(seg_mask[:, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bnck,bknd->bcnd", p.astype(v.dtype), v)
+        return carry, o
+
+    # segment_ids prevents the static mask slice above from being traced with
+    # a dynamic start when unused; keep i traced for the dynamic path
+    _, out = jax.lax.scan(body, (), (qc, jnp.arange(nc)))
+    return out.transpose(1, 0, 2, 3, 4).reshape(b, sq, nh, hd)
+
+
 def get_attention_impl(name: str) -> Callable:
     if name == "reference":
         return reference_attention
+    if name == "chunked":
+        return chunked_attention
     if name == "flash":
         from ..ops.flash_attention import flash_attention
         return flash_attention
@@ -198,9 +325,9 @@ class LlamaAttention(nn.Module):
         cos, sin = rotary_embedding(positions, head_dim, cfg.rope_theta)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        if cfg.sliding_window and cfg.attention_impl != "reference":
-            raise NotImplementedError("sliding_window requires attention_impl='reference' "
-                                      "(flash/ulysses window masks land with the kernel)")
+        if cfg.sliding_window and cfg.attention_impl not in ("reference", "chunked", "flash"):
+            raise NotImplementedError("sliding_window supports attention_impl reference/chunked/flash "
+                                      "(ulysses/ring window masks land with those kernels)")
         attn_fn = get_attention_impl(cfg.attention_impl)
         kw = {"sliding_window": cfg.sliding_window} if cfg.sliding_window else {}
         out = attn_fn(q, k, v, causal=True, segment_ids=segment_ids, **kw)
@@ -240,6 +367,12 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions, segment_ids=None, pld_scale=None):
         cfg = self.cfg
+        # pins the scan carry to (data×expert, seq, -) in BOTH directions:
+        # the transpose of a constraint on the block input constrains the
+        # backward carry (dx), which sharding propagation would otherwise
+        # solve to E-sharded from the fsdp-sharded kernels, forcing an
+        # involuntary full-remat reshard at the while boundary
+        x = activation_constraint(x)
         # progressive layer drop: the whole block's residual contribution is
         # gated by pld_scale = keep_mask/keep_prob (ref: PLD paper eq. 6 and
         # runtime/progressive_layer_drop.py pld_layer_mask)
@@ -295,8 +428,9 @@ class LlamaForCausalLM(nn.Module):
                          param_dtype=cfg.param_dtype,
                          embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
                          name="embed_tokens")
-        x = embed(input_ids)
+        x = activation_constraint(embed(input_ids))
         x = ScannedBlocks(cfg, name="model")(x, positions, segment_ids, pld_scale)
+        x = activation_constraint(x)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="norm")(x)
         if cfg.tie_word_embeddings:
             logits = embed.attend(x)
@@ -307,15 +441,21 @@ class LlamaForCausalLM(nn.Module):
                                      param_dtype=cfg.param_dtype,
                                      kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, VOCAB)),
                                      name="lm_head")(x)
-        return logits
+        return logits_constraint(logits)
 
 
 def causal_lm_loss(logits, labels, loss_mask=None):
     """Token-mean cross entropy in fp32 (ref: sequence/cross_entropy.py's
-    vocab-parallel CE is realised by GSPMD when lm_head is vocab-sharded)."""
-    logits = logits.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    vocab-parallel CE is realised by GSPMD when lm_head is vocab-sharded).
+
+    Computed as logsumexp(logits) - logits[label] rather than through
+    log_softmax: the reductions stream over the vocab axis (XLA fuses the
+    f32 cast into them), so no [B, S, V] f32 log-prob tensor is ever
+    materialized — at bench size that tensor alone is 1 GB/step of HBM
+    traffic."""
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)  # [B, S]
+    tgt = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0].astype(jnp.float32)
+    nll = lse - tgt
     if loss_mask is not None:
         denom = jnp.maximum(loss_mask.sum(), 1.0)
         return (nll * loss_mask).sum() / denom

@@ -67,12 +67,36 @@ def make_logical_rules(zero_stage: int, mesh: Mesh, fsdp_axes: Sequence[str] = Z
     return rules
 
 
-def logical_to_sharding(logical_spec_tree, mesh: Mesh, rules: Rules):
-    """Convert a pytree of flax logical PartitionSpecs to NamedShardings."""
+def vocab_rules(zero_stage: int, mesh: Mesh, fsdp_axes: Sequence[str] = ZERO_AXES) -> Rules:
+    """Rules for vocab-facing params (embedding table, lm_head kernel).
+
+    These shard on the VOCAB dim — Megatron vocab-parallel style — combining
+    the tensor axis with the ZeRO-3 fsdp axes, and leave the E dim
+    replicated.  Sharding their E dim (like every other kernel) would be the
+    same bytes but poisons sharding propagation: the embedding lookup output
+    inherits the E-sharding, and the (B,S)-laid-out scan carry then needs an
+    SPMD "involuntary full rematerialization" (replicate + repartition of the
+    whole residual stream) at the while boundary, forward and backward."""
+    tp = mesh.shape.get(TENSOR_AXIS, 1)
+    zero_axes = tuple(a for a in fsdp_axes if mesh.shape.get(a, 1) > 1)
+    vocab_axes = (TENSOR_AXIS, ) if tp > 1 else ()
+    if zero_stage >= 3:
+        vocab_axes = vocab_axes + zero_axes
+    rules = make_logical_rules(zero_stage, mesh, fsdp_axes)
+    return [(VOCAB, vocab_axes or None) if name == VOCAB else
+            (EMBED, None) if name == EMBED else (name, spec)
+            for name, spec in rules]
+
+
+def logical_to_sharding(logical_spec_tree, mesh: Mesh, rules: Rules, vrules: Optional[Rules] = None):
+    """Convert a pytree of flax logical PartitionSpecs to NamedShardings.
+    Specs containing the VOCAB axis use ``vrules`` (vocab-parallel layout)
+    when provided."""
     import jax
 
     def convert(spec):
-        mesh_spec = nn.logical_to_mesh_axes(spec, rules)
+        use = vrules if (vrules is not None and VOCAB in tuple(spec)) else rules
+        mesh_spec = nn.logical_to_mesh_axes(spec, use)
         return NamedSharding(mesh, mesh_spec)
 
     return jax.tree.map(convert, logical_spec_tree, is_leaf=lambda x: isinstance(x, P))
@@ -86,4 +110,5 @@ def param_shardings(abs_boxed_variables, mesh: Mesh, zero_stage: int, fsdp_axes:
     ``fsdp_axes`` restricts the ZeRO-3 partition group (MiCS/hpZ)."""
     logical = nn.get_partition_spec(abs_boxed_variables)
     rules = make_logical_rules(zero_stage, mesh, fsdp_axes=fsdp_axes)
-    return logical_to_sharding(logical, mesh, rules)
+    vrules = vocab_rules(zero_stage, mesh, fsdp_axes=fsdp_axes)
+    return logical_to_sharding(logical, mesh, rules, vrules=vrules)

@@ -1,17 +1,27 @@
-"""Pallas TPU flash attention.
+"""Pallas TPU flash attention — forward AND backward kernels.
 
 TPU-native replacement for the reference's fused attention kernels
 (ref: csrc/transformer/inference softmax/attention kernels and the
-FlashAttention integration the reference defers to).  Online-softmax tiling:
-grid over (batch*heads, q-blocks, kv-blocks) with running max / normaliser /
-accumulator carried in VMEM scratch across the kv-block (innermost,
-"arbitrary") grid dimension.  Causal blocks above the diagonal are skipped
-entirely (both the matmuls and the DMA cost is amortised by the grid order).
+FlashAttention integration the reference defers to, e.g.
+deepspeed/sequence/fpdt_layer.py:510 which assumes a flash kernel).
 
-Training: forward runs the Pallas kernel; backward currently recomputes via
-the jnp reference path under ``jax.custom_vjp`` (a dedicated backward kernel
-is the planned follow-up — the fwd kernel already gives the decode/eval win
-and the fwd-pass memory win).
+Forward: online-softmax tiling — grid over (batch*heads, q-blocks,
+kv-blocks) with running max / normaliser / accumulator carried in VMEM
+scratch across the kv-block (innermost, "arbitrary") grid dimension; causal
+blocks above the diagonal are skipped entirely.  The kernel also emits the
+per-row logsumexp so the backward never re-runs the softmax reduction.
+
+Backward: the standard two-kernel FlashAttention-2 split —
+  * dq kernel: grid (B*H, q-blocks, kv-blocks), dq accumulated in VMEM over
+    the inner kv sweep;
+  * dk/dv kernel: grid (B*H, kv-blocks, q-blocks), dk & dv accumulated in
+    VMEM over the inner q sweep;
+both recompute p = exp(s - lse) per tile from the saved lse (O(S) residuals,
+never the [S, S] score matrix), and delta = rowsum(do * o) per tile from the
+o/do blocks already resident in VMEM (cheaper than DMA'ing a lane-broadcast
+[BH, S, 128] delta input, which at head_dim 64 is twice the bytes of the o
+tile).  This replaces the old jnp-reference recompute fallback whose O(S^2)
+materialization erased the kernel's training value.
 """
 
 import functools
@@ -23,10 +33,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+LANE = 128  # TPU lane width: per-row scalars are stored lane-broadcast
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
-                      kv_blocks):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, block_q,
+                      block_k, kv_blocks):
+    lse_ref = rest[0] if len(rest) == 4 else None
+    m_scr, l_scr, acc_scr = rest[-3:]
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
@@ -67,11 +80,19 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scal
 
     @pl.when(ik == kv_blocks - 1)
     def _finalize():
-        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # TPU tiling needs the last two block dims (8, 128)-aligned, so
+            # the per-row scalar is broadcast across a 128-wide lane dim
+            # (same trick as jax's bundled TPU flash kernel's l/m outputs)
+            lse_ref[0] = jnp.broadcast_to(m_scr[:] + jnp.log(l), lse_ref[0].shape)
 
 
-def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
-    # q, k, v: [BH, S, D]
+def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret, emit_lse=True):
+    # q, k, v: [BH, S, D] → (o [BH, S, D], lse [BH, S, LANE] | None).
+    # emit_lse=False (pure-inference primal) skips the lse output entirely —
+    # at head_dim 128 it would otherwise double the kernel's HBM writes.
     bh, sq, d = q.shape
     _, sk, _ = k.shape
     block_q = min(block_q, sq)
@@ -83,7 +104,7 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
     grid = (bh, sq // block_q, kv_blocks)
     kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
                                kv_blocks=kv_blocks)
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -91,8 +112,10 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_specs=[pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))] + ([
+            pl.BlockSpec((1, block_q, LANE), lambda b, i, j: (b, i, 0))] if emit_lse else []),
+        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype)] + ([
+            jax.ShapeDtypeStruct((bh, sq, LANE), jnp.float32)] if emit_lse else []),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -102,40 +125,206 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
+    return (out[0], out[1]) if emit_lse else (out[0], None)
 
 
-def _reference(q, k, v, causal):
-    from ..models.llama import reference_attention
-    return reference_attention(q, k, v, causal=causal)
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_scr, *, scale, causal,
+                         block_q, block_k, kv_blocks):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)      # [bq, d]
+        k = k_ref[0].astype(jnp.float32)      # [bk, d]
+        v = v_ref[0].astype(jnp.float32)      # [bk, d]
+        do = do_ref[0].astype(jnp.float32)    # [bq, d]
+        o = o_ref[0].astype(jnp.float32)      # [bq, d]
+        lse = lse_ref[0][:, :1]               # [bq, 1] (lane-broadcast store)
+        delta = jnp.sum(do * o, axis=1, keepdims=True)  # [bq, 1]
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse)                  # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
+                                 preferred_element_type=jnp.float32)  # [bq, bk]
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot_general(ds, k, (((1, ), (0, )), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(iq * block_q + block_q - 1 >= ik * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ik == kv_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                          scale, causal, block_q, block_k, q_blocks):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)      # [bq, d]
+        k = k_ref[0].astype(jnp.float32)      # [bk, d]
+        v = v_ref[0].astype(jnp.float32)      # [bk, d]
+        do = do_ref[0].astype(jnp.float32)    # [bq, d]
+        o = o_ref[0].astype(jnp.float32)      # [bq, d]
+        lse = lse_ref[0][:, :1]               # [bq, 1] (lane-broadcast store)
+        delta = jnp.sum(do * o, axis=1, keepdims=True)  # [bq, 1]
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse)                  # [bq, bk]
+        # dv += pᵀ @ do
+        dv_scr[:] += jax.lax.dot_general(p, do, (((0, ), (0, )), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
+                                 preferred_element_type=jnp.float32)  # [bq, bk]
+        ds = p * (dp - delta) * scale
+        # dk += dsᵀ @ q
+        dk_scr[:] += jax.lax.dot_general(ds, q, (((0, ), (0, )), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(iq * block_q + block_q - 1 >= ik * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(iq == q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
+    # all [BH, S, D] (lse [BH, S]) → dq, dk, dv
+    bh, sq, d = q.shape
+    _, sk, _ = k.shape
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    kv_blocks = sk // block_k
+    q_blocks = sq // block_q
+    scale = 1.0 / (d**0.5)
+
+    dq_kernel = functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+                                  block_k=block_k, kv_blocks=kv_blocks)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, q_blocks, kv_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANE), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, o, do, lse)
+
+    dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+                                   block_k=block_k, q_blocks=q_blocks)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, kv_blocks, q_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, LANE), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, o, do, lse)
+    return dq, dk, dv
+
+
+def _to_bhsd(x, b, h, s, d):
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_attention(q, k, v, causal, block_q, block_k, interpret):
-    # [B, S, H, D] layout in, kernel runs on [B*H, S, D]
+    out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret, emit_lse=False)
+    return out
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret, emit_lse=True):
+    # [B, S, H, D] layout in, kernels run on [B*H, S, D]
     b, sq, h, d = q.shape
     _, sk, hk, _ = k.shape
+    rep = h // hk
     if hk != h:
-        rep = h // hk
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    out = _flash_fwd(qt, kt, vt, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-
-
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_attention(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    qt = _to_bhsd(q, b, h, sq, d)
+    kt = _to_bhsd(k, b, h, sk, d)
+    vt = _to_bhsd(v, b, h, sk, d)
+    out, lse = _flash_fwd(qt, kt, vt, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+                          emit_lse=emit_lse)
+    res = (qt, kt, vt, out, lse, (b, sq, sk, h, hk, d))
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3), res
 
 
 def _bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _reference(q_, k_, v_, causal), q, k, v)
-    return vjp(g)
+    qt, kt, vt, out, lse, (b, sq, sk, h, hk, d) = res
+    do = _to_bhsd(g, b, h, sq, d)
+    dq, dk, dv = _flash_bwd(qt, kt, vt, out, lse, do, causal=causal, block_q=block_q, block_k=block_k,
+                            interpret=interpret)
+    dq = dq.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    dk = dk.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    dv = dv.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    if hk != h:
+        rep = h // hk
+        # sum the grads of the repeated kv heads back onto the real ones
+        dk = dk.reshape(b, sk, hk, rep, d).sum(axis=3)
+        dv = dv.reshape(b, sk, hk, rep, d).sum(axis=3)
+    return dq, dk, dv
 
 
-_flash_attention.defvjp(_fwd, _bwd)
+def _flash_fwd_with_res(q, k, v, causal, block_q, block_k, interpret):
+    return _fwd(q, k, v, causal, block_q, block_k, interpret)
+
+
+_flash_attention.defvjp(_flash_fwd_with_res, _bwd)
 
 
 def flash_attention(q,
@@ -144,18 +333,20 @@ def flash_attention(q,
                     *,
                     causal: bool = True,
                     segment_ids=None,
+                    sliding_window: int = 0,
                     block_q: int = 256,
                     block_k: int = 256,
                     interpret: Optional[bool] = None):
     """Flash attention over [batch, seq, heads, head_dim] tensors.
 
-    GQA (fewer kv heads) handled by head repetition.  ``segment_ids`` falls
-    back to the reference path (packed-sequence masking lands with the
-    dedicated backward kernel).
+    GQA (fewer kv heads) handled by head repetition (grads reduced back in
+    the vjp).  ``segment_ids``/``sliding_window`` fall back to the chunked
+    jnp path (packed-sequence masking in-kernel is a follow-up).
     """
-    if segment_ids is not None:
-        from ..models.llama import reference_attention
-        return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    if segment_ids is not None or (sliding_window and sliding_window > 0):
+        from ..models.llama import chunked_attention
+        return chunked_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                                 sliding_window=sliding_window)
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     return _flash_attention(q, k, v, causal, block_q, block_k, interpret)

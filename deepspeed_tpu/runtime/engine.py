@@ -502,8 +502,13 @@ class DeepSpeedEngine:
         """
         cfg = self._config
         from .fp16.loss_scaler import StaticLossScaler
+        # fp16 is excluded from the fast path even at loss_scale=1: non-finite
+        # grads are real in half precision and the step must still be skipped
+        # on overflow (ref: fused_optimizer.py keeps the overflow check for
+        # static scales)
         static_unity = isinstance(self.loss_scaler, StaticLossScaler) and \
-            float(self.loss_scaler.init_scale) == 1.0
+            float(self.loss_scaler.init_scale) == 1.0 and \
+            self.compute_dtype != jnp.float16
         inv = (1.0 / self.gas) if static_unity else 1.0 / (state.scaler.cur_scale * self.gas)
         if cfg.gradient_predivide_factor != 1.0:
             inv = inv / cfg.gradient_predivide_factor

@@ -63,11 +63,20 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
     # ------------------------------------------------------------- LoRA
 
     def fuse_lora_weight(self):
-        """ref: hybrid_engine.py:135."""
+        """ref: hybrid_engine.py:135.
+
+        Quantized-base LoRA models keep their base in the 'quant' variable
+        collection, which is not part of TrainState — fusing is skipped (with
+        a warning) rather than raising, so generate(..., fuse_lora=True)
+        still runs with the unfused adapter path for them."""
         from ..linear import fuse_lora
+        from ..utils.logging import logger
         assert not self._lora_fused, "LoRA already fused"
-        self.state = self.state._replace(params=fuse_lora(self.state.params))
-        self._lora_fused = True
+        try:
+            self.state = self.state._replace(params=fuse_lora(self.state.params))
+            self._lora_fused = True
+        except ValueError as e:
+            logger.warning(f"fuse_lora skipped: {e}")
 
     def unfuse_lora_weight(self):
         """ref: hybrid_engine.py:142."""

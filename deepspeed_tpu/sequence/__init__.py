@@ -1,0 +1,8 @@
+from .cross_entropy import (vocab_sequence_parallel_cross_entropy, vocab_sequence_parallel_per_token_loss)
+from .layer import DistributedAttention
+
+__all__ = [
+    "DistributedAttention",
+    "vocab_sequence_parallel_cross_entropy",
+    "vocab_sequence_parallel_per_token_loss",
+]

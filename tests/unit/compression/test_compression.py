@@ -117,6 +117,72 @@ def test_channel_pruning_nonsquare():
     assert zero_rows == 4
 
 
+def test_row_pruning_stacked_layers():
+    # scan-stacked MLP kernel [L, in, out]: the mask must be per-layer
+    # (per-output-column within each layer), never across the stack
+    cfg = {
+        "row_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0, "method": "l1"},
+            "different_groups": {"rp1": {"params": {"dense_ratio": 0.5}, "modules": ["kernel"]}},
+        },
+    }
+    key = jax.random.PRNGKey(7)
+    params = {"model": {"layers": {"mlp": {"kernel": jax.random.normal(key, (3, 8, 16))}}}}
+    fn = build_compression_fn(cfg, jax.eval_shape(lambda: params))
+    out = np.asarray(fn(params, jnp.asarray(0, jnp.int32))["model"]["layers"]["mlp"]["kernel"])
+    for l in range(3):
+        zero_cols = (out[l] == 0).all(axis=0).sum()
+        assert zero_cols == 8, f"layer {l}: expected 8 zero output columns, got {zero_cols}"
+
+
+def test_channel_pruning_stacked_layers():
+    cfg = {
+        "channel_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0, "method": "l1"},
+            "different_groups": {"cp1": {"params": {"dense_ratio": 0.5}, "modules": ["kernel"]}},
+        },
+    }
+    params = {"model": {"layers": {"mlp": {"kernel": jax.random.normal(jax.random.PRNGKey(8), (3, 8, 16))}}}}
+    fn = build_compression_fn(cfg, jax.eval_shape(lambda: params))
+    out = np.asarray(fn(params, jnp.asarray(0, jnp.int32))["model"]["layers"]["mlp"]["kernel"])
+    for l in range(3):
+        zero_rows = (out[l] == 0).all(axis=1).sum()
+        assert zero_rows == 4, f"layer {l}: expected 4 zero input rows, got {zero_rows}"
+
+
+def test_head_pruning_stacked_o_proj():
+    # o_proj DenseGeneral layout stacked: [L, H, D, E] — whole heads zeroed per layer
+    cfg = {
+        "head_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0, "method": "topk",
+                                  "num_heads": 4},
+            "different_groups": {"hp1": {"params": {"dense_ratio": 0.5}, "modules": ["o_proj"]}},
+        },
+    }
+    params = {"model": {"layers": {"self_attn": {"o_proj": {
+        "kernel": jax.random.normal(jax.random.PRNGKey(9), (2, 4, 8, 32))}}}}}
+    fn = build_compression_fn(cfg, jax.eval_shape(lambda: params))
+    out = np.asarray(fn(params, jnp.asarray(0, jnp.int32))["model"]["layers"]["self_attn"]["o_proj"]["kernel"])
+    for l in range(2):
+        dead_heads = (out[l] == 0).all(axis=(1, 2)).sum()
+        assert dead_heads == 2, f"layer {l}: expected 2 pruned heads, got {dead_heads}"
+
+
+def test_head_pruning_bad_shape_is_loud():
+    cfg = {
+        "head_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0, "method": "topk",
+                                  "num_heads": 4},
+            "different_groups": {"hp1": {"params": {"dense_ratio": 0.5}, "modules": ["o_proj"]}},
+        },
+    }
+    # 3-D kernel whose leading axis is not num_heads (q_proj-style (in, H, D))
+    params = {"attn": {"o_proj": {"kernel": jnp.ones((16, 4, 8))}}}
+    fn = build_compression_fn(cfg, jax.eval_shape(lambda: params))
+    with pytest.raises(ValueError, match="head pruning"):
+        fn(params, jnp.asarray(0, jnp.int32))
+
+
 def test_stochastic_rounding_path():
     cfg = {
         "weight_quantization": {

@@ -219,18 +219,21 @@ def test_v2_engine_rejects_non_llama_family(tmp_path):
         build_hf_engine(str(d))
 
 
-@pytest.mark.parametrize("new_arch,kv,num_ln", [(False, 1, None), (True, 2, 2), (True, 2, 1)])
-def test_falcon_logits_parity(new_arch, kv, num_ln, tmp_path):
+@pytest.mark.parametrize("new_arch,kv,num_ln,ffn", [(False, 1, None, None), (True, 2, 2, None),
+                                                    (True, 2, 1, None), (True, 2, 2, 96)])
+def test_falcon_logits_parity(new_arch, kv, num_ln, ffn, tmp_path):
     """Falcon conversion (fused qkv split, parallel residual) matches HF —
-    incl. the falcon-11B single-shared-LN new-arch layout (num_ln=1)."""
+    incl. the falcon-11B single-shared-LN new-arch layout (num_ln=1) and
+    non-4x ffn_hidden_size variants (falcon2-style)."""
     import torch
     from transformers import FalconConfig as HFC, FalconForCausalLM as HFM
     torch.manual_seed(0)
+    extra = {"ffn_hidden_size": ffn} if ffn else {}
     hf_cfg = HFC(vocab_size=128, hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
                  new_decoder_architecture=new_arch, multi_query=(kv == 1), num_kv_heads=kv,
                  parallel_attn=True, bias=False, alibi=False, hidden_dropout=0.0,
                  attention_dropout=0.0, tie_word_embeddings=True,
-                 num_ln_in_parallel_attn=num_ln)
+                 num_ln_in_parallel_attn=num_ln, **extra)
     hf_model = HFM(hf_cfg).eval()
     d = tmp_path / f"falcon{int(new_arch)}"
     hf_model.save_pretrained(d)

@@ -99,3 +99,39 @@ def test_quantized_lora_base():
     assert "base_kernel_q" in v["quant"]
     assert "base_kernel" not in v["params"]  # no fp base weight
     assert m.apply(v, x).shape == (4, 32)
+
+
+def test_fuse_lora_quantized_base():
+    cfg = LoRAConfig(lora_r=4, lora_alpha=8)
+    qcfg = QuantizationConfig(q_bits=8, group_size=64)
+    m = LoRAOptimizedLinear(output_dim=32, lora_config=cfg, quantization_config=qcfg,
+                            dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(11), (4, 64), jnp.float32)
+    v = m.init(jax.random.PRNGKey(12), x)
+    v = {**v, "params": {**v["params"],
+                         "lora_b": jax.random.normal(jax.random.PRNGKey(13),
+                                                     v["params"]["lora_b"].shape) * 0.1}}
+    y_lora = np.asarray(m.apply(v, x))
+
+    fused = fuse_lora(v, cfg, quantization_config=qcfg)
+    assert not np.array_equal(np.asarray(fused["quant"]["base_kernel_q"]),
+                              np.asarray(v["quant"]["base_kernel_q"]))
+    # fused quantized base alone (adapter zeroed) reproduces the lora forward
+    # up to the fp8 quantization grid
+    zeroed = {**fused, "params": {**fused["params"],
+                                  "lora_b": jnp.zeros_like(fused["params"]["lora_b"])}}
+    y_fused = np.asarray(m.apply(zeroed, x))
+    # fp8 e4m3 has ~6% relative grid spacing; the matmul accumulates a few
+    # grid errors, so tolerance is loose but far below the adapter's effect
+    np.testing.assert_allclose(y_fused, y_lora, rtol=0.1, atol=0.2)
+    assert np.abs(y_fused - np.asarray(m.apply({**v, "params": zeroed["params"]}, x))).max() > 0.5
+
+
+def test_fuse_lora_bare_params_with_quant_base_raises():
+    cfg = LoRAConfig(lora_r=4)
+    qcfg = QuantizationConfig(q_bits=8, group_size=64)
+    m = LoRAOptimizedLinear(output_dim=32, lora_config=cfg, quantization_config=qcfg)
+    x = jax.random.normal(jax.random.PRNGKey(14), (4, 64), jnp.float32)
+    v = m.init(jax.random.PRNGKey(15), x)
+    with pytest.raises(ValueError, match="no fusable base"):
+        fuse_lora(v["params"], cfg)  # bare params tree: base lives in 'quant'

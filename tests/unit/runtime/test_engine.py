@@ -68,6 +68,23 @@ def test_fp16_dynamic_loss_scale():
     assert engine.loss_scale == 2.0**8  # no overflow happened
 
 
+def test_fp16_static_scale_one_still_skips_overflow():
+    """fp16 with an explicit '"loss_scale": 1' config must keep the overflow
+    check: non-finite grads are real in half precision even with nothing to
+    unscale, and a single inf grad may not corrupt the weights (ref:
+    fused_optimizer.py skips steps on overflow for static scales too)."""
+    engine = make_engine({"fp16": {"enabled": True, "loss_scale": 1}})
+    batch = random_batch()
+    engine.train_batch(batch=batch)
+    state = engine.state
+    bad_grads = jax.tree.map(lambda p: jnp.full(p.shape, jnp.inf, jnp.float32), state.params)
+    new_state, metrics = engine._apply_grads(state, bad_grads, jnp.asarray(1.0, jnp.float32))
+    assert bool(metrics.found_inf)
+    assert int(new_state.skipped_steps) == int(state.skipped_steps) + 1
+    for old, new in zip(jax.tree.leaves(state.master), jax.tree.leaves(new_state.master)):
+        np.testing.assert_array_equal(np.asarray(old), np.asarray(new))
+
+
 def test_gradient_accumulation_equivalence():
     """gas=2 with half micro-batches must match gas=1 on the full batch."""
     e1 = make_engine({"train_batch_size": 16, "gradient_accumulation_steps": 1})

@@ -1,0 +1,75 @@
+"""Sequence-parallel vocab cross entropy (ref: deepspeed/sequence/
+cross_entropy.py:1) — memory assertions, not just numerics: the whole point
+is that no replicated [B, S, V] tensor exists (VERDICT r1 #5)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import MeshSpec, create_mesh, set_global_mesh
+from deepspeed_tpu.sequence import vocab_sequence_parallel_cross_entropy
+
+B, S, V, E = 2, 8192, 8192, 64
+
+
+def _setup():
+    mesh = create_mesh(MeshSpec(data=2, seq=2, tensor=2), devices=jax.devices()[:8])
+    set_global_mesh(mesh)
+    rng = np.random.default_rng(0)
+    h = jnp.asarray(rng.normal(size=(B, S, E)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(E, V)) * 0.05, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, V, (B, S)), jnp.int32)
+    h = jax.device_put(h, NamedSharding(mesh, P("data", "seq", None)))
+    w = jax.device_put(w, NamedSharding(mesh, P(None, "tensor")))
+    labels = jax.device_put(labels, NamedSharding(mesh, P("data", "seq")))
+    return mesh, h, w, labels
+
+
+def loss_fn(w, h, labels):
+    logits = h @ w
+    return vocab_sequence_parallel_cross_entropy(logits, labels)
+
+
+def test_no_replicated_bsv_tensor_in_hlo():
+    """S=8k: the compiled step must only ever hold the (1/sp)x(1/tp) logits
+    shard — the full [B, S, V] f32 tensor (512 MB here, 16.8 GB at BASELINE
+    config 4) may not appear at any point in the partitioned program."""
+    mesh, h, w, labels = _setup()
+    step = jax.jit(jax.value_and_grad(loss_fn))
+    lowered = step.lower(w, h, labels)
+    compiled = lowered.compile()
+    txt = compiled.as_text()
+    # partitioned HLO shapes are per-device: shard shapes must appear...
+    assert f"[{B // 2},{S // 2},{V // 2}]" in txt.replace("f32", "").replace("bf16", ""), \
+        "expected per-device logits shard [B/dp, S/sp, V/tp] in the compiled program"
+    # ...and the full (replicated) logits shape must not
+    assert f"[{B},{S},{V}]" not in txt, \
+        "found a full [B, S, V] tensor — vocab/seq CE is materializing replicated logits"
+
+    # peak temp memory must be in shard territory, far under the 512 MB
+    # replicated logits (let alone fwd+bwd copies of them)
+    mem = compiled.memory_analysis()
+    temp = getattr(mem, "temp_size_in_bytes", None)
+    if temp is not None:
+        assert temp < 300 * 2**20, f"temp memory {temp/2**20:.0f} MB — logits look replicated"
+
+
+def test_matches_unsharded_loss_and_grad():
+    mesh, h, w, labels = _setup()
+    loss, grad = jax.jit(jax.value_and_grad(loss_fn))(w, h, labels)
+
+    # unsharded single-device reference (no mesh constraints)
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    mesh_lib._GLOBAL_MESH = None
+    h0, w0, l0 = map(np.asarray, (h, w, labels))
+    ref_loss, ref_grad = jax.jit(jax.value_and_grad(
+        lambda w, h, labels: loss_fn(w, h, labels)))(jnp.asarray(w0), jnp.asarray(h0), jnp.asarray(l0))
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(ref_grad), atol=1e-5, rtol=1e-4)
