@@ -1,3 +1,4 @@
 from .elasticity import (compute_elastic_config, elasticity_enabled, ensure_immutable_elastic_config,
                          get_candidate_batch_sizes, get_valid_chips)
 from .config import (ElasticityConfig, ElasticityConfigError, ElasticityError, ElasticityIncompatibleWorldSize)
+from .elastic_agent import DSElasticAgent

@@ -52,13 +52,13 @@ def build_hf_engine(path: str,
     sd = _load_state_dict(path)
     cfg, params = convert_hf_state_dict(sd, hf_cfg)
     from ...models.llama import LlamaConfig
-    if not isinstance(cfg, LlamaConfig):
-        # the ragged/paged serving loop is built on the llama-family cache
-        # model; other archs convert fine but must be served through
+    from ...models.mixtral import MixtralConfig
+    if not isinstance(cfg, (LlamaConfig, MixtralConfig)):
+        # other archs convert fine but must be served through
         # module_inject.replace_module + the v1/hybrid generate paths
         raise NotImplementedError(
-            f"FastGen-v2 serving covers llama-family checkpoints (llama/mistral/qwen2/phi3); "
-            f"model_type={hf_cfg.model_type!r} converts via "
+            f"FastGen-v2 serving covers llama-family (llama/mistral/qwen2/phi3) and mixtral "
+            f"checkpoints; model_type={hf_cfg.model_type!r} converts via "
             f"deepspeed_tpu.module_inject.replace_module(path) — use the returned model with "
             f"init_inference or the hybrid engine for generation")
     logger.info(f"build_hf_engine: model_type={hf_cfg.model_type} "

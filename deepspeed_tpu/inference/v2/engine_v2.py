@@ -34,6 +34,22 @@ from .ragged import BlockedKVCache, RaggedBatch, StateManager
 from .scheduler import SchedulerConfig, SplitFuseScheduler, StepPlan
 
 
+def build_cache_model(cfg, page_size: int):
+    """Per-arch paged-cache model dispatch (the reference's
+    model_implementations registry role, ref: inference/v2/engine_factory.py
+    arch switch)."""
+    from ...models.mixtral import MixtralConfig
+    if isinstance(cfg, MixtralConfig):
+        from ...models.mixtral_cache import MixtralForCausalLMWithCache
+        if cfg.drop_tokens:
+            # serving must be dropless: capacity drops would silently zero
+            # routed tokens and diverge from HF (the reference FastGen moe
+            # gating has no capacity limit at inference)
+            cfg = cfg.__class__(**{**cfg.__dict__, "drop_tokens": False})
+        return MixtralForCausalLMWithCache(cfg, page_size=page_size)
+    return LlamaForCausalLMWithCache(cfg, page_size=page_size)
+
+
 @dataclasses.dataclass(frozen=True)
 class RaggedInferenceEngineConfig:
     """ref: inference/v2/config_v2.py RaggedInferenceEngineConfig."""
@@ -54,7 +70,7 @@ class InferenceEngineV2:
         self.cfg = cfg
         self.econfig = engine_config or RaggedInferenceEngineConfig()
         kvcfg = self.econfig.kv
-        self.model = LlamaForCausalLMWithCache(cfg, page_size=kvcfg.page_size)
+        self.model = build_cache_model(cfg, kvcfg.page_size)
         # weight-only-quantized checkpoints: int8 stays in HBM, dequant is
         # traced into the step program (ref: inference/quantization kernels)
         from ..quantization import QuantizedParams

@@ -1,0 +1,90 @@
+"""Mixtral with paged KV cache — the serving twin of models/mixtral.py.
+
+ref: deepspeed/inference/v2/model_implementations/mixtral/policy.py:1 (+
+model.py) — the reference's marquee FastGen MoE target.  Same contract as
+``LlamaForCausalLMWithCache``: one chunked forward serving prefill /
+continuation / decode with the KV arena threaded through, except the dense
+SwiGLU MLP is the top-k-routed expert bank.  Routing at serving time runs
+``train=False`` (eval capacity factor, no gating noise) and the router aux
+loss is discarded.
+
+Param-tree compatibility: names mirror MixtralForCausalLM exactly
+(embed_tokens, layers/{self_attn, input_layernorm, post_attention_layernorm,
+block_sparse_moe/{gate, experts}}, norm, lm_head), so checkpoints converted
+by MixtralPolicy.convert — or trained with the training model — apply
+unchanged.
+"""
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..moe.layer import MoE
+from .llama import EMBED, LAYERS, VOCAB, RMSNorm, _logical
+from .llama_cache import LlamaAttentionCache
+from .mixtral import MixtralConfig
+
+
+class MixtralBlockCache(nn.Module):
+    cfg: MixtralConfig
+    page_size: int = 16
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, carry, layer_pages, positions=None, block_table=None, start_pos=None, chunk_lens=None):
+        cfg = self.cfg
+        x = carry
+        attn_out, layer_pages = LlamaAttentionCache(cfg.as_llama(), self.page_size, name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="input_layernorm")(x), positions,
+            layer_pages, block_table, start_pos, chunk_lens)
+        h = x + attn_out
+        moe_out, _l_aux, _counts = MoE(hidden_size=cfg.hidden_size,
+                                       num_experts=cfg.num_local_experts,
+                                       intermediate_size=cfg.intermediate_size,
+                                       k=cfg.num_experts_per_tok,
+                                       capacity_factor=cfg.capacity_factor,
+                                       eval_capacity_factor=cfg.eval_capacity_factor,
+                                       min_capacity=cfg.min_capacity,
+                                       drop_tokens=cfg.drop_tokens,
+                                       dtype=cfg.dtype,
+                                       param_dtype=cfg.param_dtype,
+                                       name="block_sparse_moe")(
+                                           RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype,
+                                                   name="post_attention_layernorm")(h), train=False)
+        out = h + moe_out
+        return out, layer_pages
+
+
+class MixtralForCausalLMWithCache(nn.Module):
+    """Chunked forward with paged KV over the MoE stack.  ``apply(variables,
+    tokens, start_pos, block_table, cache)`` → (logits, new_cache)."""
+    cfg: MixtralConfig
+    page_size: int = 16
+
+    @nn.compact
+    def __call__(self, input_ids, start_pos, block_table, cache, chunk_lens=None):
+        cfg = self.cfg
+        positions = start_pos[:, None] + jnp.arange(input_ids.shape[1])[None, :]
+        embed = nn.Embed(num_embeddings=cfg.vocab_size,
+                         features=cfg.hidden_size,
+                         dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype,
+                         embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                         name="embed_tokens")
+        x = embed(input_ids)
+        blocks = nn.scan(MixtralBlockCache,
+                         variable_axes={"params": 0},
+                         split_rngs={"params": True},
+                         in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
+                         out_axes=0,
+                         length=cfg.num_hidden_layers,
+                         metadata_params={nn.PARTITION_NAME: LAYERS})
+        x, cache = blocks(cfg, self.page_size, scanned=True,
+                          name="layers")(x, cache, positions, block_table, start_pos, chunk_lens)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="norm")(x)
+        logits = nn.DenseGeneral(features=cfg.vocab_size,
+                                 use_bias=False,
+                                 dtype=cfg.dtype,
+                                 param_dtype=cfg.param_dtype,
+                                 kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, VOCAB)),
+                                 name="lm_head")(x)
+        return logits, cache

@@ -1,0 +1,226 @@
+"""Variable batch size + LR scaling.
+
+ref: deepspeed/runtime/data_pipeline/data_sampling/variable_batch_size_and_lr.py:1
+(batch_by_seqlens, scale_lr, dataloader_for_variable_batch_size,
+lr_scheduler_for_variable_batch_size) — pack sequences into batches by a
+token budget ("Attention is all you need" §5.1 style), then scale the LR of
+each batch by its size relative to a reference batch size.
+
+TPU-native differences from the reference:
+  * every distinct (batch_size, seq_len) pair is a fresh XLA compilation, so
+    the packer QUANTIZES both: batch sizes land on ``batch_size_buckets``
+    and each microbatch pads its sequences up to a power-of-two-ish seqlen
+    bucket — steady state reuses a handful of compiled programs instead of
+    one per shape (the engine's jit cache is keyed on batch structure,
+    runtime/engine.py _ensure_ready);
+  * the LR scale is a trace-time constant per bucket (engine.
+    set_variable_batch_lr), not a per-step scheduler mutation — same math,
+    compiled form.
+"""
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ....utils.logging import logger
+
+
+def scale_lr(base_batch_size: int, batch_size: int, method: str = "linear", base_lr: float = 1.0) -> float:
+    """LR multiplier for a batch of ``batch_size`` given the reference
+    ``base_batch_size`` (ref: variable_batch_size_and_lr.py:149 scale_lr)."""
+    if method == "linear":
+        return base_lr * batch_size / base_batch_size
+    if method == "sqrt":
+        return base_lr * float(np.sqrt(batch_size / base_batch_size))
+    if method in (None, "none"):
+        return base_lr
+    raise ValueError(f"unknown LR scaling method {method!r} (linear | sqrt | none)")
+
+
+def batch_by_seqlens(seqlens: Sequence[int],
+                     max_tokens: int,
+                     sequence_ids_per_mb: Optional[Sequence[int]] = None,
+                     min_batch_size: int = 1,
+                     max_batch_size: Optional[int] = None,
+                     sequence_picking_order: str = "dataloader",
+                     effective_batch_size: int = 1,
+                     required_microbatches_of_same_size: bool = False,
+                     verbose: bool = False,
+                     seed: Optional[int] = None):
+    """Pack sample indices into microbatches under a token budget.
+
+    Returns ``(microbatch_ids, batch_sizes, batch_max_seqlens)`` where
+    ``microbatch_ids`` is a list of (batch_id, sample_ids) per microbatch,
+    ``batch_sizes`` the number of sequences in each effective batch (for LR
+    scaling), and ``batch_max_seqlens`` the max seqlen per effective batch
+    (ref: variable_batch_size_and_lr.py:23 batch_by_seqlens — same contract,
+    re-derived packing)."""
+    assert sequence_picking_order in ("random", "seqlen", "dataloader")
+    ids = list(range(len(seqlens))) if sequence_ids_per_mb is None else list(sequence_ids_per_mb)
+    pairs = [(seqlens[i], i) for i in ids]
+
+    long_ids = [i for l, i in pairs if l > max_tokens]
+    if long_ids:
+        logger.warning(f"batch_by_seqlens: {len(long_ids)} samples exceed max_tokens={max_tokens}; skipped")
+        pairs = [(l, i) for l, i in pairs if l <= max_tokens]
+
+    if sequence_picking_order == "random":
+        random.Random(seed).shuffle(pairs)
+    elif sequence_picking_order == "seqlen":
+        pairs.sort()
+
+    # greedy fill: a microbatch is padded to its max seqlen, so its token
+    # cost is len(mb) * max_seqlen(mb)
+    microbatches: List[List[int]] = []
+    cur: List[int] = []
+    cur_max = 0
+    for l, i in pairs:
+        new_max = max(cur_max, l)
+        if cur and ((len(cur) + 1) * new_max > max_tokens or
+                    (max_batch_size and len(cur) >= max_batch_size)):
+            microbatches.append(cur)
+            cur, cur_max = [], 0
+            new_max = l
+        cur.append(i)
+        cur_max = new_max
+    if cur:
+        microbatches.append(cur)
+    microbatches = [mb for mb in microbatches if len(mb) >= min_batch_size]
+
+    # group microbatches into effective batches of `effective_batch_size`
+    # microbatches each (the reference's gradient-accumulation grouping);
+    # drop the ragged tail group
+    n_groups = len(microbatches) // effective_batch_size
+    microbatches = microbatches[:n_groups * effective_batch_size]
+
+    if required_microbatches_of_same_size:
+        # within each effective batch, trim every microbatch to the group min
+        dropped = 0
+        for g in range(n_groups):
+            grp = microbatches[g * effective_batch_size:(g + 1) * effective_batch_size]
+            size = min(len(mb) for mb in grp)
+            for k, mb in enumerate(grp):
+                dropped += len(mb) - size
+                microbatches[g * effective_batch_size + k] = mb[:size]
+        if dropped:
+            logger.warning(f"batch_by_seqlens: same-size constraint dropped {dropped} samples "
+                           f"this epoch (reshuffle or relax required_microbatches_of_same_size)")
+
+    microbatch_ids = []
+    batch_sizes, batch_max_seqlens = [], []
+    for g in range(n_groups):
+        grp = microbatches[g * effective_batch_size:(g + 1) * effective_batch_size]
+        microbatch_ids.extend((g, mb) for mb in grp)
+        batch_sizes.append(sum(len(mb) for mb in grp))
+        batch_max_seqlens.append(max(max(seqlens[i] for i in mb) for mb in grp))
+    if verbose:
+        logger.info(f"batch_by_seqlens: {len(pairs)} samples -> {len(microbatches)} microbatches "
+                    f"in {n_groups} batches; sizes={batch_sizes}")
+    return microbatch_ids, batch_sizes, batch_max_seqlens
+
+
+def _seqlen_bucket(n: int, buckets: Optional[Sequence[int]] = None) -> int:
+    """Round n up to a compile-friendly bucket (next power of two by
+    default).  Raises when n exceeds every explicit bucket — silently
+    clamping would truncate data at _pad_rows."""
+    if buckets:
+        for b in sorted(buckets):
+            if n <= b:
+                return b
+        raise ValueError(f"{n} exceeds the largest bucket {max(buckets)}; "
+                         f"add a bigger bucket or cap the packer (max_tokens/max_batch_size)")
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class VariableBatchDataLoader:
+    """Iterate (padded) variable-size batches (ref:
+    variable_batch_size_and_lr.py:165 dataloader_for_variable_batch_size).
+
+    ``dataset[i]`` must return a dict of 1-D arrays (e.g. input_ids/labels);
+    each microbatch pads its sequences to the bucketed max seqlen and stacks
+    them.  Yields ``(batch_dict, batch_size)`` — feed batch_dict to
+    engine.train_batch and let engine.set_variable_batch_lr handle the LR.
+    """
+
+    def __init__(self,
+                 dataset,
+                 microbatch_ids: List[Tuple[int, List[int]]],
+                 seqlen_buckets: Optional[Sequence[int]] = None,
+                 batch_size_buckets: Optional[Sequence[int]] = None,
+                 round_batch_to: int = 1,
+                 pad_token_id: int = 0,
+                 pad_field: str = "input_ids"):
+        self.dataset = dataset
+        self.microbatch_ids = microbatch_ids
+        self.seqlen_buckets = seqlen_buckets
+        self.batch_size_buckets = batch_size_buckets
+        # data-parallel sharding needs the (padded) batch dim divisible by
+        # the dp world size — masked pad rows make up the difference
+        self.round_batch_to = max(1, int(round_batch_to))
+        self.pad_token_id = pad_token_id
+        self.pad_field = pad_field
+
+    def __len__(self):
+        return len(self.microbatch_ids)
+
+    def _pad_rows(self, rows: List[Dict[str, np.ndarray]]):
+        target_len = _seqlen_bucket(max(len(r[self.pad_field]) for r in rows), self.seqlen_buckets)
+        n = len(rows)
+        if self.batch_size_buckets:
+            n = _seqlen_bucket(n, self.batch_size_buckets)
+        n = -(-n // self.round_batch_to) * self.round_batch_to
+        out = {}
+        for key in rows[0]:
+            pad_val = self.pad_token_id if key == self.pad_field else 0
+            arr = np.full((n, target_len), pad_val, dtype=np.asarray(rows[0][key]).dtype)
+            for r_i, row in enumerate(rows):
+                v = np.asarray(row[key])
+                arr[r_i, :len(v)] = v
+            out[key] = arr
+        # padding rows contribute nothing: mask real tokens of real rows only
+        mask = np.zeros((n, target_len), np.float32)
+        for r_i, row in enumerate(rows):
+            mask[r_i, :len(np.asarray(row[self.pad_field]))] = 1.0
+        out.setdefault("loss_mask", mask)
+        return out, len(rows)
+
+    def __iter__(self):
+        for _gid, sample_ids in self.microbatch_ids:
+            rows = [self.dataset[i] for i in sample_ids]
+            yield self._pad_rows(rows)
+
+
+def get_dataloader_and_lr_scheduler_for_variable_batch_size_deepspeed(
+        dataset,
+        engine,
+        seqlens: Optional[Sequence[int]] = None,
+        max_tokens: int = 4096,
+        ref_batch_size: Optional[int] = None,
+        lr_scaling_method: str = "linear",
+        sequence_picking_order: str = "dataloader",
+        seqlen_buckets: Optional[Sequence[int]] = None,
+        batch_size_buckets: Optional[Sequence[int]] = None,
+        seed: Optional[int] = None,
+        pad_token_id: int = 0):
+    """One-call wiring (ref: variable_batch_size_and_lr.py:351): packs the
+    dataset by token budget, enables LR scaling on the engine, returns the
+    loader.  ``ref_batch_size`` defaults to the mean packed batch size."""
+    if seqlens is None:
+        seqlens = [len(np.asarray(dataset[i]["input_ids"])) for i in range(len(dataset))]
+    microbatch_ids, batch_sizes, _ = batch_by_seqlens(
+        seqlens, max_tokens, sequence_picking_order=sequence_picking_order, seed=seed)
+    if ref_batch_size is None:
+        ref_batch_size = max(1, int(round(float(np.mean(batch_sizes)))) if batch_sizes else 1)
+    engine.set_variable_batch_lr(ref_batch_size, method=lr_scaling_method)
+    # pad every batch to a multiple of the engine's data-parallel world so
+    # the (data, expert)-sharded batch dim always divides
+    from ....comm.mesh import BATCH_AXES, axis_size
+    round_to = axis_size(engine.mesh, *BATCH_AXES)
+    loader = VariableBatchDataLoader(dataset, microbatch_ids, seqlen_buckets=seqlen_buckets,
+                                     batch_size_buckets=batch_size_buckets,
+                                     round_batch_to=round_to, pad_token_id=pad_token_id)
+    return loader, engine.lr_scheduler

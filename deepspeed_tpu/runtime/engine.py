@@ -144,7 +144,14 @@ class DeepSpeedEngine:
         self.loss_scaler = create_loss_scaler(config.fp16_config, self.compute_dtype)
 
         # ---- optimizer transform + lr schedule
-        self.lr_base, self.lr_schedule = self._build_lr_schedule()
+        self.lr_base, self._base_lr_schedule = self._build_lr_schedule()
+        # variable-batch LR scaling (ref: data_sampling/variable_batch_size_
+        # and_lr.py scale_lr): _lr_scale is a python float read at TRACE time
+        # — each batch-size bucket compiles its own step with its own scale
+        # (the jit cache is keyed on it via _ensure_ready)
+        self._lr_scale = 1.0
+        self._vblr = None  # (ref_batch_size, method) when enabled
+        self.lr_schedule = lambda step: self._base_lr_schedule(step) * self._lr_scale
         self.opt = self._build_optimizer_transform()
         if lr_scheduler is None or callable(lr_scheduler) and not hasattr(lr_scheduler, "step"):
             self.lr_scheduler = LRSchedulerShim(self.lr_schedule)
@@ -443,6 +450,7 @@ class DeepSpeedEngine:
         compression/compress.py:100 init_compression)."""
         self._compression_requested = True
         self._step_key = None  # force step rebuild
+        self._step_cache = {}  # cached programs were traced without the transform
         if self.state is not None:
             self._build_compression()
 
@@ -598,16 +606,45 @@ class DeepSpeedEngine:
         if self._compression_requested and self._compression_fn is None:
             self._build_compression()
             self._compression_requested = False
+        if self._vblr is not None:
+            from .data_pipeline.data_sampling.variable_batch_size_and_lr import scale_lr
+            ref_bs, method = self._vblr
+            if isinstance(batch, dict) and batch.get("loss_mask") is not None:
+                # bucketed loaders pad with all-masked rows; the EFFECTIVE
+                # batch size (real sequences) drives the LR scale
+                bs = int(np.asarray(batch["loss_mask"]).any(axis=-1).sum())
+            else:
+                bs = int(np.shape(jax.tree.leaves(batch)[0])[0])
+            self._lr_scale = scale_lr(ref_bs, bs, method=method)
         # compiled fns are keyed by batch structure: a malformed batch fails
         # cleanly without poisoning the cache, and changing batch shapes
         # (e.g. curriculum seq-len growth) triggers a fresh compile
-        key = self._batch_key(batch)
+        key = self._batch_key(batch) + (self._lr_scale, )
         if getattr(self, "_step_key", None) != key:
-            self._build_train_step(batch)
-            self._eval_fn = None
+            # memoize built programs per key: alternating batch buckets
+            # (variable batch size, curriculum flips) must NOT retrace on
+            # every switch — steady state reuses the compiled set
+            cache = getattr(self, "_step_cache", None)
+            if cache is None:
+                cache = self._step_cache = {}
+            if key in cache:
+                (self._train_step_fn, self._accum_fn, self._apply_step_fn,
+                 self._batch_shardings, self._eval_fn) = cache[key]
+            else:
+                self._build_train_step(batch)
+                self._eval_fn = None
+                cache[key] = (self._train_step_fn, self._accum_fn, self._apply_step_fn,
+                              self._batch_shardings, self._eval_fn)
             self._step_key = key
 
     # ------------------------------------------------------------- public API
+
+    def set_variable_batch_lr(self, ref_batch_size: int, method: str = "linear"):
+        """Enable variable-batch LR scaling (ref: data_sampling/
+        variable_batch_size_and_lr.py lr_scheduler_for_variable_batch_size):
+        every train_batch's LR is multiplied by scale_lr(ref_batch_size,
+        actual_batch_size, method).  Pairs with VariableBatchDataLoader."""
+        self._vblr = (int(ref_batch_size), method)
 
     def train_batch(self, data_iter=None, batch=None):
         """Run one full training step = gas micro-batches (ref:
@@ -617,6 +654,9 @@ class DeepSpeedEngine:
             assert data_iter is not None, "provide data_iter or batch"
             micro = [next(data_iter) for _ in range(self.gas)]
             batch = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *micro) if self.gas > 1 else micro[0]
+        # shape donor for elastic re-materialization after a membership change
+        # (elasticity/elastic_agent.py) — host arrays, one batch, cheap
+        self.last_batch = batch
         self._ensure_ready(batch)
         prof_cfg = self._config.flops_profiler_config
         profiling_now = (self.flops_profiler is not None and self.global_steps == prof_cfg.profile_step)

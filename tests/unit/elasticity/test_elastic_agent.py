@@ -1,0 +1,86 @@
+"""Runtime elastic agent (ref: elasticity/elastic_agent.py:32 DSElasticAgent)
+— simulated world-size change 8 -> 4 on the CPU mesh: the agent must
+re-rendezvous, reshard-restore from the checkpoint, and continue with the
+same loss trajectory (global batch unchanged -> same math, new layout)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.comm.mesh import MeshSpec, create_mesh
+from deepspeed_tpu.elasticity import DSElasticAgent, ElasticityIncompatibleWorldSize
+from deepspeed_tpu.models.llama import LlamaForCausalLM, PRESETS
+
+from simple_model import base_config, random_batch
+
+CONFIG = base_config(**{
+    "train_batch_size": 8,
+    "zero_optimization": {"stage": 2},
+})
+
+
+def _factory(config, devices):
+    mesh = create_mesh(MeshSpec(data=len(devices)), devices=devices)
+    engine, _, _, _ = ds.initialize(model=LlamaForCausalLM(PRESETS["tiny"]), config=dict(config),
+                                    mesh=mesh, dist_init_required=False)
+    return engine
+
+
+def test_agent_survives_world_shrink(tmp_path):
+    devices = {"n": 8}
+    agent = DSElasticAgent(_factory, CONFIG, str(tmp_path / "ckpt"),
+                           devices_fn=lambda: jax.devices()[:devices["n"]])
+    agent.start()
+    batch = random_batch(8)
+
+    # straight-through reference run on the full mesh
+    ref = _factory(CONFIG, jax.devices()[:8])
+    ref_losses = [float(ref.train_batch(batch=batch)) for _ in range(4)]
+
+    losses = [float(agent.train_batch(batch=batch)) for _ in range(2)]
+    agent.save()
+
+    devices["n"] = 4  # two "hosts" fall out
+    assert agent.check_membership(), "membership change not detected"
+    assert agent.state.world_size == 4
+    assert int(agent.engine.state.step) == 2, "resume lost the step counter"
+
+    losses += [float(agent.train_batch(batch=batch)) for _ in range(2)]
+    # same global batch, same data => same trajectory modulo reduction order
+    np.testing.assert_allclose(losses, ref_losses, rtol=3e-3)
+
+
+def test_agent_no_change_is_noop(tmp_path):
+    agent = DSElasticAgent(_factory, CONFIG, str(tmp_path / "ckpt"),
+                           devices_fn=lambda: jax.devices()[:8])
+    engine = agent.start()
+    assert agent.check_membership() is False
+    assert agent.engine is engine  # not rebuilt
+
+
+def test_agent_rejects_incompatible_world(tmp_path):
+    cfg = dict(CONFIG)
+    cfg["elasticity"] = {
+        "enabled": True,
+        "max_train_batch_size": 32,
+        "micro_batch_sizes": [4],
+        "min_gpus": 2,
+        "max_gpus": 8,
+        "min_time": 0,
+        "version": 0.1,
+    }
+    devices = {"n": 8}
+    agent = DSElasticAgent(_factory, cfg, str(tmp_path / "ckpt"),
+                           devices_fn=lambda: jax.devices()[:devices["n"]])
+    agent.start()
+    agent.train_batch(batch=random_batch(8))
+    agent.save()
+    devices["n"] = 3  # 8 % 3 != 0 — no compatible (micro, gas)
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        agent.check_membership()

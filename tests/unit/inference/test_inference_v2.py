@@ -103,6 +103,57 @@ def test_kv_pages_released_on_flush(trained_params):
     assert eng.kv.allocator.free_pages == free0
 
 
+def _save_tiny_hf(tmp_path, kind):
+    import torch
+    torch.manual_seed(0)
+    if kind == "mixtral":
+        from transformers import MixtralConfig as HFC, MixtralForCausalLM as HFM
+        hf_cfg = HFC(vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+                     num_local_experts=4, num_experts_per_tok=2, rope_theta=1e4,
+                     tie_word_embeddings=False)
+    else:
+        from transformers import Qwen2Config as HFC, Qwen2ForCausalLM as HFM
+        hf_cfg = HFC(vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+                     rope_theta=1e4, use_sliding_window=False, tie_word_embeddings=False)
+    hf_model = HFM(hf_cfg).eval()
+    d = tmp_path / kind
+    hf_model.save_pretrained(d)
+    return str(d), hf_model
+
+
+def _hf_greedy(hf_model, prompt, n_new):
+    import torch
+    ids = torch.tensor([prompt], dtype=torch.int64)
+    with torch.no_grad():
+        for _ in range(n_new):
+            logits = hf_model(ids).logits
+            ids = torch.cat([ids, logits[:, -1].argmax(-1, keepdim=True)], dim=1)
+    return [int(t) for t in ids[0, len(prompt):]]
+
+
+@pytest.mark.parametrize("kind", ["qwen2", "mixtral"])
+def test_build_hf_engine_paged_generate(kind, tmp_path):
+    """VERDICT r1 #4: build_hf_engine must serve qwen2 AND mixtral (MoE
+    paged decode) through the v2 engine, matching HF greedy decode.
+    ref: inference/v2/model_implementations/{qwen_v2,mixtral}/policy.py."""
+    from deepspeed_tpu.inference.v2.engine_factory import build_hf_engine
+    path, hf_model = _save_tiny_hf(tmp_path, kind)
+    eng = build_hf_engine(path)
+    # fp32 for tight logits parity; the serving path itself forces dropless
+    # MoE routing (build_cache_model), so no drop_tokens override here
+    cfg = eng.cfg
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32, "remat": False})
+    kv = PagedKVConfig(num_pages=64, page_size=8, max_pages_per_seq=8)
+    eng = InferenceEngineV2(cfg, eng.params,
+                            RaggedInferenceEngineConfig(kv=kv, kv_dtype=jnp.float32))
+    prompt = [5, 9, 2, 7, 1, 3]
+    got = eng.generate([prompt], max_new_tokens=6)[0]
+    want = _hf_greedy(hf_model, prompt, 6)
+    assert got == want, f"{kind}: paged decode {got} != HF greedy {want}"
+
+
 def test_v1_engine_generate_matches(trained_params):
     """v1 init_inference greedy generate == cache-free golden."""
     import deepspeed_tpu as ds
