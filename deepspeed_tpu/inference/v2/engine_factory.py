@@ -51,16 +51,9 @@ def build_hf_engine(path: str,
     hf_cfg = AutoConfig.from_pretrained(path, local_files_only=True)
     sd = _load_state_dict(path)
     cfg, params = convert_hf_state_dict(sd, hf_cfg)
-    from ...models.llama import LlamaConfig
-    from ...models.mixtral import MixtralConfig
-    if not isinstance(cfg, (LlamaConfig, MixtralConfig)):
-        # other archs convert fine but must be served through
-        # module_inject.replace_module + the v1/hybrid generate paths
-        raise NotImplementedError(
-            f"FastGen-v2 serving covers llama-family (llama/mistral/qwen2/phi3) and mixtral "
-            f"checkpoints; model_type={hf_cfg.model_type!r} converts via "
-            f"deepspeed_tpu.module_inject.replace_module(path) — use the returned model with "
-            f"init_inference or the hybrid engine for generation")
+    # every registered policy's config has a paged cache twin (cache_zoo /
+    # mixtral_cache / llama_cache); unknown model_types already raised in
+    # policy_for during conversion
     logger.info(f"build_hf_engine: model_type={hf_cfg.model_type} "
                 f"{sum(p.size for p in _leaves(params))/1e6:.1f}M params")
 

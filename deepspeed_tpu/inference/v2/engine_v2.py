@@ -47,6 +47,10 @@ def build_cache_model(cfg, page_size: int):
             # gating has no capacity limit at inference)
             cfg = cfg.__class__(**{**cfg.__dict__, "drop_tokens": False})
         return MixtralForCausalLMWithCache(cfg, page_size=page_size)
+    from ...models.cache_zoo import CACHE_MODEL_REGISTRY
+    for cfg_cls, model_cls in CACHE_MODEL_REGISTRY.items():
+        if isinstance(cfg, cfg_cls):
+            return model_cls(cfg, page_size=page_size)
     return LlamaForCausalLMWithCache(cfg, page_size=page_size)
 
 

@@ -1,0 +1,342 @@
+"""Paged-KV serving twins for the non-llama model families.
+
+ref: deepspeed/inference/v2/model_implementations/{falcon,opt,phi,qwen_v2_moe}
+— the reference serves these arches through FastGen with per-arch policy +
+container classes; here each gets a cache twin whose param tree mirrors its
+training model exactly (so converted HF checkpoints apply unchanged) and
+whose attention goes through the shared ``paged_attention_core``
+(models/llama_cache.py): chunked forward, KV arena threaded through, one
+program for prefill / continuation / decode.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .llama import EMBED, HEAD_DIM, HEADS, KV_HEADS, LAYERS, MLP, VOCAB, RMSNorm, _logical, apply_rope, \
+    rotary_embedding
+from .llama_cache import paged_attention_core
+from .falcon import FalconConfig
+from .opt import OPTConfig
+from .phi import PhiConfig, apply_partial_rope
+from .qwen2_moe import Qwen2MoeConfig, Qwen2MoeSparseMLP
+
+
+# ------------------------------------------------------------------- falcon
+
+
+class FalconAttentionCache(nn.Module):
+    cfg: FalconConfig
+    page_size: int = 16
+
+    @nn.compact
+    def __call__(self, x, positions, pages, block_table, start_pos, chunk_lens=None):
+        cfg = self.cfg
+        H, KV = cfg.num_attention_heads, cfg.num_kv_heads
+        D = cfg.hidden_size // H
+        dense = partial(nn.DenseGeneral, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        q = dense(features=(H, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, HEADS, HEAD_DIM)),
+                  name="q_proj")(x)
+        k = dense(features=(KV, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
+                  name="k_proj")(x)
+        v = dense(features=(KV, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
+                  name="v_proj")(x)
+        cos, sin = rotary_embedding(positions, D, cfg.rope_theta)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        out, pages = paged_attention_core(q, k, v, pages, block_table, start_pos, chunk_lens, self.page_size,
+                                          attention_impl=cfg.attention_impl)
+        out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=cfg.bias,
+                              dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                              kernel_init=_logical(nn.initializers.lecun_normal(), (HEADS, HEAD_DIM, EMBED)),
+                              name="dense")(out)
+        return out, pages
+
+
+class FalconBlockCache(nn.Module):
+    cfg: FalconConfig
+    page_size: int = 16
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, carry, layer_pages, positions=None, block_table=None, start_pos=None, chunk_lens=None):
+        cfg = self.cfg
+        x = carry
+        ln = partial(nn.LayerNorm, epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+        if cfg.num_ln_in_parallel_attn == 2:
+            attn_in = ln(name="ln_attn")(x)
+            mlp_in = ln(name="ln_mlp")(x)
+        else:
+            attn_in = ln(name="input_layernorm")(x)
+            mlp_in = attn_in
+        attn_out, layer_pages = FalconAttentionCache(cfg, self.page_size, name="self_attention")(
+            attn_in, positions, layer_pages, block_table, start_pos, chunk_lens)
+        ffn = cfg.ffn_hidden_size or cfg.hidden_size * 4
+        h = nn.Dense(ffn, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, MLP)),
+                     name="dense_h_to_4h")(mlp_in)
+        mlp_out = nn.Dense(cfg.hidden_size, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                           kernel_init=_logical(nn.initializers.lecun_normal(), (MLP, EMBED)),
+                           name="dense_4h_to_h")(jax.nn.gelu(h, approximate=False))
+        return x + attn_out + mlp_out, layer_pages
+
+
+class FalconForCausalLMWithCache(nn.Module):
+    cfg: FalconConfig
+    page_size: int = 16
+
+    @nn.compact
+    def __call__(self, input_ids, start_pos, block_table, cache, chunk_lens=None):
+        cfg = self.cfg
+        positions = start_pos[:, None] + jnp.arange(input_ids.shape[1])[None, :]
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                         name="word_embeddings")
+        x = embed(input_ids)
+        blocks = nn.scan(FalconBlockCache, variable_axes={"params": 0}, split_rngs={"params": True},
+                         in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
+                         out_axes=0, length=cfg.num_hidden_layers,
+                         metadata_params={nn.PARTITION_NAME: LAYERS})
+        x, cache = blocks(cfg, self.page_size, scanned=True,
+                          name="h")(x, cache, positions, block_table, start_pos, chunk_lens)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         name="ln_f")(x)
+        if cfg.tie_word_embeddings:
+            return embed.attend(x), cache
+        logits = nn.DenseGeneral(features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                                 param_dtype=cfg.param_dtype,
+                                 kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, VOCAB)),
+                                 name="lm_head")(x)
+        return logits, cache
+
+
+# ---------------------------------------------------------------------- opt
+
+
+class OPTAttentionCache(nn.Module):
+    cfg: OPTConfig
+    page_size: int = 16
+
+    @nn.compact
+    def __call__(self, x, pages, block_table, start_pos, chunk_lens=None):
+        cfg = self.cfg
+        H = cfg.num_attention_heads
+        D = cfg.hidden_size // H
+        dense = partial(nn.DenseGeneral, use_bias=True, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        q = dense(features=(H, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, HEADS, HEAD_DIM)),
+                  name="q_proj")(x)
+        k = dense(features=(H, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
+                  name="k_proj")(x)
+        v = dense(features=(H, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
+                  name="v_proj")(x)
+        out, pages = paged_attention_core(q, k, v, pages, block_table, start_pos, chunk_lens, self.page_size,
+                                          attention_impl=cfg.attention_impl)
+        out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=True,
+                              dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                              kernel_init=_logical(nn.initializers.lecun_normal(), (HEADS, HEAD_DIM, EMBED)),
+                              name="out_proj")(out)
+        return out, pages
+
+
+class OPTBlockCache(nn.Module):
+    cfg: OPTConfig
+    page_size: int = 16
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, carry, layer_pages, positions=None, block_table=None, start_pos=None, chunk_lens=None):
+        cfg = self.cfg
+        x = carry
+        ln = partial(nn.LayerNorm, epsilon=1e-5, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        a_in = ln(name="self_attn_layer_norm")(x) if cfg.do_layer_norm_before else x
+        a, layer_pages = OPTAttentionCache(cfg, self.page_size, name="self_attn")(
+            a_in, layer_pages, block_table, start_pos, chunk_lens)
+        h = x + a
+        if not cfg.do_layer_norm_before:
+            h = ln(name="self_attn_layer_norm")(h)
+        m_in = ln(name="final_layer_norm")(h) if cfg.do_layer_norm_before else h
+        m = nn.Dense(cfg.ffn_dim, use_bias=True, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, MLP)), name="fc1")(m_in)
+        m = nn.Dense(cfg.hidden_size, use_bias=True, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=_logical(nn.initializers.lecun_normal(), (MLP, EMBED)),
+                     name="fc2")(jax.nn.relu(m))
+        out = h + m
+        if not cfg.do_layer_norm_before:
+            out = ln(name="final_layer_norm")(out)
+        return out, layer_pages
+
+
+class OPTForCausalLMWithCache(nn.Module):
+    cfg: OPTConfig
+    page_size: int = 16
+
+    @nn.compact
+    def __call__(self, input_ids, start_pos, block_table, cache, chunk_lens=None):
+        cfg = self.cfg
+        positions = start_pos[:, None] + jnp.arange(input_ids.shape[1])[None, :]
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                         name="embed_tokens")
+        pos_embed = nn.Embed(cfg.max_position_embeddings + 2, cfg.hidden_size, dtype=cfg.dtype,
+                             param_dtype=cfg.param_dtype, embedding_init=nn.initializers.normal(0.02),
+                             name="embed_positions")
+        # pad-region positions can exceed the learned table (prefill chunk >
+        # max_position): clamp — jnp.take would otherwise FILL (NaN)
+        safe_pos = jnp.minimum(positions, cfg.max_position_embeddings - 1)
+        x = embed(input_ids) + pos_embed(safe_pos + 2)
+        blocks = nn.scan(OPTBlockCache, variable_axes={"params": 0}, split_rngs={"params": True},
+                         in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
+                         out_axes=0, length=cfg.num_hidden_layers,
+                         metadata_params={nn.PARTITION_NAME: LAYERS})
+        x, cache = blocks(cfg, self.page_size, scanned=True,
+                          name="layers")(x, cache, positions, block_table, start_pos, chunk_lens)
+        if cfg.do_layer_norm_before:
+            x = nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                             name="final_layer_norm")(x)
+        if cfg.tie_word_embeddings:
+            return embed.attend(x), cache
+        logits = nn.DenseGeneral(features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                                 param_dtype=cfg.param_dtype,
+                                 kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, VOCAB)),
+                                 name="lm_head")(x)
+        return logits, cache
+
+
+# ---------------------------------------------------------------------- phi
+
+
+class PhiAttentionCache(nn.Module):
+    cfg: PhiConfig
+    page_size: int = 16
+
+    @nn.compact
+    def __call__(self, x, positions, pages, block_table, start_pos, chunk_lens=None):
+        cfg = self.cfg
+        H, KV = cfg.num_attention_heads, cfg.num_key_value_heads
+        D = cfg.hidden_size // H
+        rot_dim = int(D * cfg.partial_rotary_factor)
+        dense = partial(nn.DenseGeneral, use_bias=True, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        q = dense(features=(H, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, HEADS, HEAD_DIM)),
+                  name="q_proj")(x)
+        k = dense(features=(KV, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
+                  name="k_proj")(x)
+        v = dense(features=(KV, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
+                  name="v_proj")(x)
+        cos, sin = rotary_embedding(positions, rot_dim, cfg.rope_theta)
+        q = apply_partial_rope(q, cos, sin, rot_dim)
+        k = apply_partial_rope(k, cos, sin, rot_dim)
+        out, pages = paged_attention_core(q, k, v, pages, block_table, start_pos, chunk_lens, self.page_size,
+                                          attention_impl=cfg.attention_impl)
+        out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=True,
+                              dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                              kernel_init=_logical(nn.initializers.lecun_normal(), (HEADS, HEAD_DIM, EMBED)),
+                              name="dense")(out)
+        return out, pages
+
+
+class PhiBlockCache(nn.Module):
+    cfg: PhiConfig
+    page_size: int = 16
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, carry, layer_pages, positions=None, block_table=None, start_pos=None, chunk_lens=None):
+        cfg = self.cfg
+        x = carry
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         name="input_layernorm")(x)
+        attn_out, layer_pages = PhiAttentionCache(cfg, self.page_size, name="self_attn")(
+            h, positions, layer_pages, block_table, start_pos, chunk_lens)
+        m = nn.Dense(cfg.intermediate_size, use_bias=True, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, MLP)), name="fc1")(h)
+        m = jax.nn.gelu(m, approximate=True)
+        mlp_out = nn.Dense(cfg.hidden_size, use_bias=True, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                           kernel_init=_logical(nn.initializers.lecun_normal(), (MLP, EMBED)), name="fc2")(m)
+        return x + attn_out + mlp_out, layer_pages
+
+
+class PhiForCausalLMWithCache(nn.Module):
+    cfg: PhiConfig
+    page_size: int = 16
+
+    @nn.compact
+    def __call__(self, input_ids, start_pos, block_table, cache, chunk_lens=None):
+        cfg = self.cfg
+        positions = start_pos[:, None] + jnp.arange(input_ids.shape[1])[None, :]
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                         name="embed_tokens")
+        x = embed(input_ids)
+        blocks = nn.scan(PhiBlockCache, variable_axes={"params": 0}, split_rngs={"params": True},
+                         in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
+                         out_axes=0, length=cfg.num_hidden_layers,
+                         metadata_params={nn.PARTITION_NAME: LAYERS})
+        x, cache = blocks(cfg, self.page_size, scanned=True,
+                          name="layers")(x, cache, positions, block_table, start_pos, chunk_lens)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         name="final_layernorm")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=True, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                          kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, VOCAB)),
+                          name="lm_head")(x)
+        return logits, cache
+
+
+# ---------------------------------------------------------------- qwen2-moe
+
+
+class Qwen2MoeBlockCache(nn.Module):
+    cfg: Qwen2MoeConfig
+    page_size: int = 16
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, carry, layer_pages, positions=None, block_table=None, start_pos=None, chunk_lens=None):
+        from .llama_cache import LlamaAttentionCache
+        cfg = self.cfg
+        x = carry
+        attn_out, layer_pages = LlamaAttentionCache(cfg.as_llama(), self.page_size, name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="input_layernorm")(x), positions,
+            layer_pages, block_table, start_pos, chunk_lens)
+        h = x + attn_out
+        out = h + Qwen2MoeSparseMLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="post_attention_layernorm")(h))
+        return out, layer_pages
+
+
+class Qwen2MoeForCausalLMWithCache(nn.Module):
+    cfg: Qwen2MoeConfig
+    page_size: int = 16
+
+    @nn.compact
+    def __call__(self, input_ids, start_pos, block_table, cache, chunk_lens=None):
+        cfg = self.cfg
+        positions = start_pos[:, None] + jnp.arange(input_ids.shape[1])[None, :]
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                         name="embed_tokens")
+        x = embed(input_ids)
+        blocks = nn.scan(Qwen2MoeBlockCache, variable_axes={"params": 0}, split_rngs={"params": True},
+                         in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
+                         out_axes=0, length=cfg.num_hidden_layers,
+                         metadata_params={nn.PARTITION_NAME: LAYERS})
+        x, cache = blocks(cfg, self.page_size, scanned=True,
+                          name="layers")(x, cache, positions, block_table, start_pos, chunk_lens)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="norm")(x)
+        if cfg.tie_word_embeddings:
+            return embed.attend(x), cache
+        logits = nn.DenseGeneral(features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                                 param_dtype=cfg.param_dtype,
+                                 kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, VOCAB)),
+                                 name="lm_head")(x)
+        return logits, cache
+
+
+CACHE_MODEL_REGISTRY = {
+    FalconConfig: FalconForCausalLMWithCache,
+    OPTConfig: OPTForCausalLMWithCache,
+    PhiConfig: PhiForCausalLMWithCache,
+    Qwen2MoeConfig: Qwen2MoeForCausalLMWithCache,
+}

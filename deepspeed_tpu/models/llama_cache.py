@@ -39,11 +39,15 @@ class PagedKVConfig:
     max_pages_per_seq: int = 8
 
 
-def init_kv_cache(cfg: LlamaConfig, kv: PagedKVConfig, dtype=jnp.bfloat16):
+def init_kv_cache(cfg, kv: PagedKVConfig, dtype=jnp.bfloat16):
     """Allocate the paged arena: [L, P, page, 2, n_kv, hd].  Page 0 is the
-    reserved null page (block tables point unused slots at it)."""
+    reserved null page (block tables point unused slots at it).  Works for
+    any model-family config (falcon names its kv-head count differently;
+    MHA models have none)."""
     head_dim = cfg.hidden_size // cfg.num_attention_heads
-    return jnp.zeros((cfg.num_hidden_layers, kv.num_pages, kv.page_size, 2, cfg.num_key_value_heads, head_dim),
+    n_kv = getattr(cfg, "num_key_value_heads", None) or getattr(cfg, "num_kv_heads", None) \
+        or cfg.num_attention_heads
+    return jnp.zeros((cfg.num_hidden_layers, kv.num_pages, kv.page_size, 2, n_kv, head_dim),
                      dtype)
 
 
@@ -57,12 +61,20 @@ def _write_pages(pages, k_new, v_new, block_table, start_pos, page_size, chunk_l
     """
     b, c = k_new.shape[0], k_new.shape[1]
     positions = start_pos[:, None] + jnp.arange(c)[None, :]          # [B, C]
-    page_idx = jnp.take_along_axis(block_table, positions // page_size, axis=1)  # [B, C]
+    # page lookup must stay in-bounds for the pad region too (out-of-range
+    # take_along_axis would read junk pages)
+    page_slot = jnp.minimum(positions // page_size, block_table.shape[1] - 1)
+    page_idx = jnp.take_along_axis(block_table, page_slot, axis=1)   # [B, C]
+    kv_chunk = jnp.stack([k_new, v_new], axis=2)                      # [B, C, 2, n_kv, hd]
     if chunk_lens is not None:
         valid = jnp.arange(c)[None, :] < chunk_lens[:, None]          # [B, C]
         page_idx = jnp.where(valid, page_idx, 0)
+        # ALSO zero the redirected values: pad-region activations can be
+        # non-finite (e.g. out-of-range learned-position lookups fill NaN),
+        # and a NaN-poisoned null page turns masked attention into NaN via
+        # 0 * NaN in the probs @ V matmul
+        kv_chunk = jnp.where(valid[:, :, None, None, None], kv_chunk, 0)
     slot_idx = positions % page_size                                  # [B, C]
-    kv_chunk = jnp.stack([k_new, v_new], axis=2)                      # [B, C, 2, n_kv, hd]
     flat_kv = kv_chunk.reshape((-1, ) + kv_chunk.shape[2:])           # [B*C, 2, n_kv, hd]
     return pages.at[page_idx.reshape(-1), slot_idx.reshape(-1)].set(flat_kv)
 
@@ -104,6 +116,26 @@ def paged_attention(q, pages, block_table, start_pos, chunk_lens, page_size, sli
     return out
 
 
+def paged_attention_core(q, k, v, pages, block_table, start_pos, chunk_lens, page_size,
+                         attention_impl="reference", sliding_window=0):
+    """Shared paged-KV attention core for every model family's cache twin:
+    write this chunk's K/V into the arena, then attend the chunk's queries
+    against (history + chunk).  q/k/v are post-projection, post-RoPE
+    [B, C, N(H|KV), D].  Returns (out [B, C, H, D], new_pages)."""
+    pages = _write_pages(pages, k.astype(pages.dtype), v.astype(pages.dtype), block_table,
+                         start_pos, page_size, chunk_lens)
+    if attention_impl == "flash":
+        if sliding_window:
+            raise NotImplementedError("sliding_window decode requires the reference paged "
+                                      "attention (pallas window mask lands with the kernel)")
+        from ..ops.paged_attention import paged_attention_pallas
+        out = paged_attention_pallas(q, pages, block_table, start_pos, chunk_lens, page_size)
+    else:
+        out = paged_attention(q, pages, block_table, start_pos, chunk_lens, page_size,
+                              sliding_window=sliding_window)
+    return out, pages
+
+
 class LlamaAttentionCache(nn.Module):
     cfg: LlamaConfig
     page_size: int = 16
@@ -127,18 +159,9 @@ class LlamaAttentionCache(nn.Module):
         cos, sin = rotary_embedding(positions, head_dim, cfg.rope_theta)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        pages = _write_pages(pages, k.astype(pages.dtype), v.astype(pages.dtype), block_table, start_pos,
-                             self.page_size, chunk_lens)
-        if cfg.attention_impl == "flash":
-            if cfg.sliding_window:
-                raise NotImplementedError("sliding_window decode requires the reference paged "
-                                          "attention (pallas window mask lands with the kernel)")
-            # Pallas blocked-decode kernel (ops/paged_attention.py)
-            from ..ops.paged_attention import paged_attention_pallas
-            out = paged_attention_pallas(q, pages, block_table, start_pos, chunk_lens, self.page_size)
-        else:
-            out = paged_attention(q, pages, block_table, start_pos, chunk_lens, self.page_size,
-                                  sliding_window=cfg.sliding_window)
+        out, pages = paged_attention_core(q, k, v, pages, block_table, start_pos, chunk_lens,
+                                          self.page_size, attention_impl=cfg.attention_impl,
+                                          sliding_window=cfg.sliding_window)
         out = nn.DenseGeneral(features=cfg.hidden_size,
                               axis=(-2, -1),
                               use_bias=False,

@@ -208,14 +208,15 @@ def test_mixtral_logits_parity(tmp_path):
     np.testing.assert_allclose(np.asarray(logits), want, rtol=5e-3, atol=5e-3)
 
 
-def test_v2_engine_rejects_non_llama_family(tmp_path):
+def test_v2_engine_rejects_unknown_model_type(tmp_path):
+    """Archs with no inference policy fail loudly at conversion (every arch
+    WITH a policy now serves through the paged engine — see cache_zoo)."""
     import torch
-    from transformers import OPTConfig as HFC, OPTForCausalLM as HFM
+    from transformers import GPT2Config as HFC, GPT2LMHeadModel as HFM
     torch.manual_seed(0)
-    d = tmp_path / "opt_reject"
-    HFM(HFC(vocab_size=128, hidden_size=64, ffn_dim=96, num_hidden_layers=2,
-            num_attention_heads=4, max_position_embeddings=64, word_embed_proj_dim=64)).save_pretrained(d)
-    with pytest.raises(NotImplementedError, match="replace_module"):
+    d = tmp_path / "gpt2_reject"
+    HFM(HFC(vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64)).save_pretrained(d)
+    with pytest.raises(ValueError, match="no inference policy"):
         build_hf_engine(str(d))
 
 
