@@ -97,7 +97,14 @@ class InferenceEngineV2:
     def put(self, batch_uids: Sequence[int], batch_tokens: Sequence[Sequence[int]],
             max_new_tokens: Optional[int] = None) -> None:
         """Admit new sequences (ref: engine_v2.py:124 put)."""
+        max_pos = getattr(self.cfg, "max_position_embeddings", None)
         for uid, tokens in zip(batch_uids, batch_tokens):
+            need = len(tokens) + (max_new_tokens or self.econfig.max_new_tokens)
+            if max_pos is not None and need > max_pos:
+                # learned/rotary position tables end here; clamped positions
+                # would silently produce degraded logits (e.g. OPT's table)
+                raise ValueError(f"sequence {uid}: prompt+max_new_tokens = {need} exceeds the "
+                                 f"model's max_position_embeddings = {max_pos}")
             self.state.get_or_create(uid, list(tokens))
             self._max_new[uid] = max_new_tokens or self.econfig.max_new_tokens
 

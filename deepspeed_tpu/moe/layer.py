@@ -38,6 +38,11 @@ class MoE(nn.Module):
     def __call__(self, x, train: bool = True):
         b, s, d = x.shape
         mesh = get_global_mesh()
+        # TP×EP: split the token dim across the TP group so each token is
+        # routed exactly once (ref: moe/mappings.py drop_tokens before the
+        # experts); gathered back after the combine below
+        from .mappings import drop_tokens, gather_tokens
+        x = drop_tokens(x, dim=1)
         groups = axis_size(mesh, *BATCH_AXES)
         if b % groups != 0:
             groups = 1
@@ -84,4 +89,5 @@ class MoE(nn.Module):
                              name="experts")
         out = dispatch_combine(xg, combine, dispatch, experts)
         out = out.reshape(b, s, d).astype(x.dtype)
+        out = gather_tokens(out, dim=1)
         return out, jnp.mean(l_aux), jnp.sum(exp_counts, axis=0)

@@ -1,0 +1,69 @@
+"""MoE ↔ tensor-parallel token mappings.
+
+ref: deepspeed/moe/mappings.py:1 (gather_tokens / drop_tokens, adapted from
+Megatron mpu/mappings) — in the reference, TP ranks hold REPLICATED copies
+of each token, so before the experts every rank drops to its 1/tp slice of
+the sequence (each token routed exactly once) and after the combine the
+slices are all-gathered back; the autograd.Functions transpose to each
+other in backward.
+
+TPU-native shape: the same semantics as sharding constraints.  GSPMD
+inserts the slice / all-gather pair (and their transposed collectives in
+backward) from two `with_sharding_constraint` calls:
+
+  drop_tokens(x, dim)    — pin dim to the tensor axis (each TP shard owns a
+                           distinct token slice through the expert stack)
+  gather_tokens(x, dim)  — pin dim replicated over tensor again
+
+The MoE layer applies them around gating+dispatch whenever the mesh has a
+nontrivial tensor axis, making TP×EP a defined layout instead of whatever
+propagation guesses.
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..comm.mesh import BATCH_AXES, TENSOR_AXIS, get_global_mesh, has_global_mesh
+
+
+def _skip(x) -> bool:
+    if not has_global_mesh() or not isinstance(x, jax.core.Tracer):
+        return True
+    try:
+        from jax.sharding import get_abstract_mesh
+        if get_abstract_mesh()._any_axis_manual:
+            return True
+    except Exception:
+        pass
+    return get_global_mesh().shape.get(TENSOR_AXIS, 1) == 1
+
+
+def _token_spec(ndim: int, dim: int, tensor_on_dim: bool):
+    entries = [None] * ndim
+    entries[0] = BATCH_AXES  # batch dim carries the data axes as usual
+    if tensor_on_dim:
+        if dim == 0:
+            entries[0] = tuple(BATCH_AXES) + (TENSOR_AXIS, )
+        else:
+            entries[dim] = TENSOR_AXIS
+    return P(*entries)
+
+
+def drop_tokens(x, dim: int = 1):
+    """Split the token dim across the TP group (ref: mappings.py:113
+    drop_tokens).  Backward of this constraint is the all-gather."""
+    if _skip(x):
+        return x
+    mesh = get_global_mesh()
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, _token_spec(x.ndim, dim, tensor_on_dim=True)))
+
+
+def gather_tokens(x, dim: int = 1):
+    """All-gather the token dim across the TP group (ref: mappings.py:105
+    gather_tokens)."""
+    if _skip(x):
+        return x
+    mesh = get_global_mesh()
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, _token_spec(x.ndim, dim, tensor_on_dim=False)))

@@ -45,15 +45,18 @@ def quantize_int4(x, block: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:
     absmax = jnp.max(jnp.abs(xb), axis=1)
     scale = jnp.where(absmax == 0, 1.0, absmax / 7.0)
     q = jnp.clip(jnp.round(xb / scale[:, None]), -7, 7).astype(jnp.int8) + 8  # [1..15], 0 unused
-    lo = q[:, 0::2].astype(jnp.uint8)
-    hi = q[:, 1::2].astype(jnp.uint8)
+    # halves layout: nibble i packs elements (i, i + block/2) — contiguous
+    # slices keep the Pallas kernel (ops/quant_kernels.py) off gather paths
+    # Mosaic cannot lower; pack and unpack agree, so the wire format is free
+    lo = q[:, :block // 2].astype(jnp.uint8)
+    hi = q[:, block // 2:].astype(jnp.uint8)
     return (lo | (hi << 4)), scale
 
 
 def dequantize_int4(packed, scale, shape) -> jnp.ndarray:
     lo = (packed & 0xF).astype(jnp.int8) - 8
     hi = ((packed >> 4) & 0xF).astype(jnp.int8) - 8
-    q = jnp.stack([lo, hi], axis=-1).reshape(packed.shape[0], -1)
+    q = jnp.concatenate([lo, hi], axis=-1)  # halves layout (see quantize_int4)
     return (q.astype(jnp.float32) * scale[:, None]).reshape(shape)
 
 

@@ -22,8 +22,13 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from ...ops.quantizer import (dequantize_int4, dequantize_int8, pack_signs, quantize_int4,
-                              quantize_int8, unpack_signs)
+from ...ops.quantizer import pack_signs, unpack_signs
+# Pallas-fused quant/dequant on TPU, jnp fallback elsewhere (ref:
+# csrc/quantization swizzled_quantize.cu — the wire-format pack kernels)
+from ...ops.quant_kernels import (dequantize_int4_pallas as dequantize_int4,
+                                  dequantize_int8_pallas as dequantize_int8,
+                                  quantize_int4_pallas as quantize_int4,
+                                  quantize_int8_pallas as quantize_int8)
 
 
 def compressed_allreduce(x, error, axis_name: str) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -1,0 +1,59 @@
+"""Pallas quant/bit-pack kernels vs the jnp golden path
+(ref: csrc/quantization tests in tests/unit/ops/quantizer)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.quant_kernels import (dequantize_int4_pallas, dequantize_int8_pallas,
+                                             quantize_int4_pallas, quantize_int8_pallas)
+from deepspeed_tpu.ops.quantizer import (dequantize_int4, dequantize_int8, quantize_int4, quantize_int8)
+
+
+@pytest.fixture
+def x():
+    return jax.random.normal(jax.random.PRNGKey(0), (64 * 256, ), jnp.float32) * 3.0
+
+
+def test_int8_kernel_matches_jnp(x):
+    q_k, s_k = quantize_int8_pallas(x, block=256, interpret=True)
+    q_j, s_j = quantize_int8(x, block=256)
+    np.testing.assert_array_equal(np.asarray(q_k), np.asarray(q_j))
+    np.testing.assert_allclose(np.asarray(s_k), np.asarray(s_j), rtol=1e-6)
+    d_k = dequantize_int8_pallas(q_k, s_k, x.shape, interpret=True)
+    d_j = dequantize_int8(q_j, s_j, x.shape)
+    np.testing.assert_allclose(np.asarray(d_k), np.asarray(d_j), rtol=1e-6)
+
+
+def test_int4_kernel_matches_jnp(x):
+    q_k, s_k = quantize_int4_pallas(x, block=256, interpret=True)
+    q_j, s_j = quantize_int4(x, block=256)
+    np.testing.assert_array_equal(np.asarray(q_k), np.asarray(q_j))
+    np.testing.assert_allclose(np.asarray(s_k), np.asarray(s_j), rtol=1e-6)
+    d_k = dequantize_int4_pallas(q_k, s_k, x.shape, interpret=True)
+    d_j = dequantize_int4(q_j, s_j, x.shape)
+    np.testing.assert_allclose(np.asarray(d_k), np.asarray(d_j), rtol=1e-6)
+
+
+def test_zero_block_scale_is_one():
+    x = jnp.zeros((32 * 256, ), jnp.float32)
+    q, s = quantize_int8_pallas(x, block=256, interpret=True)
+    np.testing.assert_array_equal(np.asarray(s), 1.0)
+    np.testing.assert_array_equal(np.asarray(q), 0)
+
+
+def test_odd_shapes_fall_back():
+    x = jax.random.normal(jax.random.PRNGKey(1), (1000, ), jnp.float32)
+    q, s = quantize_int8_pallas(x, block=250, interpret=True)  # 250 not lane-aligned
+    q_j, s_j = quantize_int8(x, block=250)
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(q_j))
+
+
+def test_roundtrip_error_bounded(x):
+    q, s = quantize_int4_pallas(x, block=256, interpret=True)
+    d = dequantize_int4_pallas(q, s, x.shape, interpret=True)
+    # int4 grid: |err| <= scale/2 per element
+    per_block_scale = np.asarray(s).repeat(256)
+    err = np.abs(np.asarray(d) - np.asarray(x))
+    assert (err <= per_block_scale * 0.5 + 1e-6).all()
