@@ -47,6 +47,16 @@ def build_cache_model(cfg, page_size: int):
             # gating has no capacity limit at inference)
             cfg = cfg.__class__(**{**cfg.__dict__, "drop_tokens": False})
         return MixtralForCausalLMWithCache(cfg, page_size=page_size)
+    from ...models.qwen2_moe import Qwen2MoeConfig
+    if isinstance(cfg, Qwen2MoeConfig) and cfg.mixed_stack:
+        raise NotImplementedError(
+            "mixed dense/sparse qwen2-moe stacks (mlp_only_layers/decoder_sparse_step) "
+            "serve via init_inference — the paged twin is scan-over-layers only")
+    from ...models.falcon import FalconConfig
+    if isinstance(cfg, FalconConfig) and (cfg.alibi or not cfg.parallel_attn):
+        raise NotImplementedError(
+            "falcon-rw variants (alibi / sequential residual) serve via init_inference — "
+            "the paged falcon twin implements rotary + parallel residual only")
     from ...models.cache_zoo import CACHE_MODEL_REGISTRY
     for cfg_cls, model_cls in CACHE_MODEL_REGISTRY.items():
         if isinstance(cfg, cfg_cls):
@@ -98,6 +108,8 @@ class InferenceEngineV2:
             max_new_tokens: Optional[int] = None) -> None:
         """Admit new sequences (ref: engine_v2.py:124 put)."""
         max_pos = getattr(self.cfg, "max_position_embeddings", None)
+        # validate ALL before admitting ANY — a partial put would leave
+        # earlier sequences admitted when a later one raises
         for uid, tokens in zip(batch_uids, batch_tokens):
             need = len(tokens) + (max_new_tokens or self.econfig.max_new_tokens)
             if max_pos is not None and need > max_pos:
@@ -105,6 +117,7 @@ class InferenceEngineV2:
                 # would silently produce degraded logits (e.g. OPT's table)
                 raise ValueError(f"sequence {uid}: prompt+max_new_tokens = {need} exceeds the "
                                  f"model's max_position_embeddings = {max_pos}")
+        for uid, tokens in zip(batch_uids, batch_tokens):
             self.state.get_or_create(uid, list(tokens))
             self._max_new[uid] = max_new_tokens or self.econfig.max_new_tokens
 

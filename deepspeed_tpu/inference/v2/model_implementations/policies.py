@@ -205,8 +205,15 @@ class OPTPolicy(InferenceV2Policy):
         params = {
             "embed_tokens": {"embedding": get("model.decoder.embed_tokens.weight")},
             "embed_positions": {"embedding": get("model.decoder.embed_positions.weight")},
-            "final_layer_norm": {"scale": get("model.decoder.final_layer_norm.weight"),
-                                 "bias": get("model.decoder.final_layer_norm.bias")},
+            # post-LN OPT (opt-350m) has no top-level final LN
+            **({"final_layer_norm": {"scale": get("model.decoder.final_layer_norm.weight"),
+                                     "bias": get("model.decoder.final_layer_norm.bias")}}
+               if cfg.do_layer_norm_before else {}),
+            # opt-350m: embeddings live in word_embed_proj_dim, projected
+            # in/out around the decoder stack
+            **({"project_in": {"kernel": _t(get("model.decoder.project_in.weight"))},
+                "project_out": {"kernel": _t(get("model.decoder.project_out.weight"))}}
+               if cfg.word_embed_proj_dim else {}),
             "layers": {
                 "self_attn_layer_norm": ln("model.decoder.layers.{i}.self_attn_layer_norm"),
                 "final_layer_norm": ln("model.decoder.layers.{i}.final_layer_norm"),
@@ -320,6 +327,11 @@ class PhiPolicy(InferenceV2Policy):
                     "dense": {"kernel": stack("model.layers.{i}.self_attn.dense.weight",
                                               lambda w: _t(w).reshape(H, D, E)),
                               "bias": stack("model.layers.{i}.self_attn.dense.bias")},
+                    **({"q_layernorm": {"scale": stack("model.layers.{i}.self_attn.q_layernorm.weight"),
+                                        "bias": stack("model.layers.{i}.self_attn.q_layernorm.bias")},
+                        "k_layernorm": {"scale": stack("model.layers.{i}.self_attn.k_layernorm.weight"),
+                                        "bias": stack("model.layers.{i}.self_attn.k_layernorm.bias")}}
+                       if cfg.qk_layernorm else {}),
                 },
                 "fc1": {"kernel": stack("model.layers.{i}.mlp.fc1.weight", _t),
                         "bias": stack("model.layers.{i}.mlp.fc1.bias")},
@@ -354,31 +366,45 @@ class FalconPolicy(InferenceV2Policy):
 
         stack = lambda fmt, conv=(lambda w: w): _stack(sd, fmt, L, conv)
 
-        def split_qkv(w):
-            # w: [(rows), E] fused
+        def group_qkv(t, trailing):
+            """Reshape fused qkv rows into per-head groups; ``trailing`` is
+            () for biases, (E,) for weights."""
             if cfg.new_decoder_architecture:
                 qpk = H // KV
-                g = w.reshape(KV, qpk + 2, D, E)
-                q = g[:, :qpk].reshape(KV * qpk, D, E)      # == [H, D, E]
-                k = g[:, qpk].reshape(KV, D, E)
-                v = g[:, qpk + 1].reshape(KV, D, E)
-            elif KV == 1:  # 7b MQA: H q rows, then k, then v
-                g = w.reshape(H + 2, D, E)
-                q, k, v = g[:H], g[H:H + 1], g[H + 1:]
-            else:  # classic MHA: per-head interleave [H, 3, D]
-                g = w.reshape(H, 3, D, E)
-                q, k, v = g[:, 0], g[:, 1], g[:, 2]
+                g = t.reshape(KV, qpk + 2, D, *trailing)
+                return (g[:, :qpk].reshape(KV * qpk, D, *trailing),
+                        g[:, qpk].reshape(KV, D, *trailing),
+                        g[:, qpk + 1].reshape(KV, D, *trailing))
+            if KV == 1:  # 7b MQA: H q rows, then k, then v
+                g = t.reshape(H + 2, D, *trailing)
+                return g[:H], g[H:H + 1], g[H + 1:]
+            # classic MHA (falcon-rw): per-head interleave [H, 3, D]
+            g = t.reshape(H, 3, D, *trailing)
+            return g[:, 0], g[:, 1], g[:, 2]
+
+        def split_qkv(w):
+            q, k, v = group_qkv(w, (E, ))
             # [heads, D, E] → ours (E, heads, D)
             to_ours = lambda t: np.ascontiguousarray(np.transpose(t, (2, 0, 1)))
             return to_ours(q), to_ours(k), to_ours(v)
 
         qs, ks, vs = [], [], []
+        qbs, kbs, vbs = [], [], []
         for i in range(L):
             q, k, v = split_qkv(get(f"transformer.h.{i}.self_attention.query_key_value.weight"))
             qs.append(q); ks.append(k); vs.append(v)
+            if cfg.bias:
+                qb, kb, vb = group_qkv(get(f"transformer.h.{i}.self_attention.query_key_value.bias"), ())
+                qbs.append(qb); kbs.append(kb); vbs.append(vb)
 
         ln_blocks = {}
-        if cfg.num_ln_in_parallel_attn == 2:  # HF keys purely on this flag
+        if not cfg.parallel_attn:
+            # falcon-rw sequential residual: pre-attn + post-attn LNs
+            for ours, theirs in (("input_layernorm", "input_layernorm"),
+                                 ("post_attention_layernorm", "post_attention_layernorm")):
+                ln_blocks[ours] = {"scale": stack(f"transformer.h.{{i}}.{theirs}.weight"),
+                                   "bias": stack(f"transformer.h.{{i}}.{theirs}.bias")}
+        elif cfg.num_ln_in_parallel_attn == 2:  # HF keys purely on this flag
             for name in ("ln_attn", "ln_mlp"):
                 ln_blocks[name] = {"scale": stack(f"transformer.h.{{i}}.{name}.weight"),
                                    "bias": stack(f"transformer.h.{{i}}.{name}.bias")}
@@ -388,20 +414,34 @@ class FalconPolicy(InferenceV2Policy):
                 "scale": stack("transformer.h.{i}.input_layernorm.weight"),
                 "bias": stack("transformer.h.{i}.input_layernorm.bias")}
 
+        def with_bias(d, fmt):
+            return {**d, "bias": stack(fmt)} if cfg.bias else d
+
+        attn = {
+            "q_proj": {"kernel": np.stack(qs)},
+            "k_proj": {"kernel": np.stack(ks)},
+            "v_proj": {"kernel": np.stack(vs)},
+            "dense": with_bias({"kernel": stack("transformer.h.{i}.self_attention.dense.weight",
+                                                lambda w: _t(w).reshape(H, D, E))},
+                               "transformer.h.{i}.self_attention.dense.bias"),
+        }
+        if cfg.bias:
+            attn["q_proj"]["bias"] = np.stack(qbs)
+            attn["k_proj"]["bias"] = np.stack(kbs)
+            attn["v_proj"]["bias"] = np.stack(vbs)
+
         params = {
             "word_embeddings": {"embedding": get("transformer.word_embeddings.weight")},
             "ln_f": {"scale": get("transformer.ln_f.weight"), "bias": get("transformer.ln_f.bias")},
             "h": {
                 **ln_blocks,
-                "self_attention": {
-                    "q_proj": {"kernel": np.stack(qs)},
-                    "k_proj": {"kernel": np.stack(ks)},
-                    "v_proj": {"kernel": np.stack(vs)},
-                    "dense": {"kernel": stack("transformer.h.{i}.self_attention.dense.weight",
-                                              lambda w: _t(w).reshape(H, D, E))},
-                },
-                "dense_h_to_4h": {"kernel": stack("transformer.h.{i}.mlp.dense_h_to_4h.weight", _t)},
-                "dense_4h_to_h": {"kernel": stack("transformer.h.{i}.mlp.dense_4h_to_h.weight", _t)},
+                "self_attention": attn,
+                "dense_h_to_4h": with_bias(
+                    {"kernel": stack("transformer.h.{i}.mlp.dense_h_to_4h.weight", _t)},
+                    "transformer.h.{i}.mlp.dense_h_to_4h.bias"),
+                "dense_4h_to_h": with_bias(
+                    {"kernel": stack("transformer.h.{i}.mlp.dense_4h_to_h.weight", _t)},
+                    "transformer.h.{i}.mlp.dense_4h_to_h.bias"),
             },
         }
         if not cfg.tie_word_embeddings:
@@ -437,10 +477,58 @@ class Qwen2MoePolicy(InferenceV2Policy):
         experts = lambda w_name: _experts(
             sd, L, NE, "model.layers.{i}.mlp.experts.{e}." + w_name + ".weight")
 
+        def one_layer_attn(i):
+            p = f"model.layers.{i}.self_attn"
+            out = {
+                "q_proj": {"kernel": _t(get(f"{p}.q_proj.weight")).reshape(E, H, D)},
+                "k_proj": {"kernel": _t(get(f"{p}.k_proj.weight")).reshape(E, KV, D)},
+                "v_proj": {"kernel": _t(get(f"{p}.v_proj.weight")).reshape(E, KV, D)},
+                "o_proj": {"kernel": _t(get(f"{p}.o_proj.weight")).reshape(H, D, E)},
+            }
+            if cfg.qkv_bias:
+                for name, heads in (("q_proj", H), ("k_proj", KV), ("v_proj", KV)):
+                    out[name]["bias"] = get(f"{p}.{name}.bias").reshape(heads, D)
+            return out
+
+        def one_layer_sparse_mlp(i):
+            p = f"model.layers.{i}.mlp"
+            return {
+                "gate": {"kernel": _t(get(f"{p}.gate.weight"))},
+                "w_gate": np.stack([_t(get(f"{p}.experts.{e}.gate_proj.weight")) for e in range(NE)]),
+                "w_up": np.stack([_t(get(f"{p}.experts.{e}.up_proj.weight")) for e in range(NE)]),
+                "w_down": np.stack([_t(get(f"{p}.experts.{e}.down_proj.weight")) for e in range(NE)]),
+                "shared_gate_proj": {"kernel": _t(get(f"{p}.shared_expert.gate_proj.weight"))},
+                "shared_up_proj": {"kernel": _t(get(f"{p}.shared_expert.up_proj.weight"))},
+                "shared_down_proj": {"kernel": _t(get(f"{p}.shared_expert.down_proj.weight"))},
+                "shared_expert_gate": {"kernel": _t(get(f"{p}.shared_expert_gate.weight"))},
+            }
+
+        def one_layer_dense_mlp(i):
+            p = f"model.layers.{i}.mlp"
+            return {
+                "gate_proj": {"kernel": _t(get(f"{p}.gate_proj.weight"))},
+                "up_proj": {"kernel": _t(get(f"{p}.up_proj.weight"))},
+                "down_proj": {"kernel": _t(get(f"{p}.down_proj.weight"))},
+            }
+
         params = {
             "embed_tokens": {"embedding": get("model.embed_tokens.weight")},
             "norm": {"weight": get("model.norm.weight")},
-            "layers": {
+        }
+        if cfg.mixed_stack:
+            # per-layer trees for the unscanned model (layers_{i}): dense or
+            # sparse mlp per the HF rule (ref: Qwen2MoeDecoderLayer)
+            for i in range(L):
+                params[f"layers_{i}"] = {
+                    "input_layernorm": {"weight": get(f"model.layers.{i}.input_layernorm.weight")},
+                    "post_attention_layernorm": {
+                        "weight": get(f"model.layers.{i}.post_attention_layernorm.weight")},
+                    "self_attn": one_layer_attn(i),
+                    "mlp": (one_layer_sparse_mlp(i) if cfg.layer_is_sparse(i)
+                            else one_layer_dense_mlp(i)),
+                }
+        else:
+            params["layers"] = {
                 "input_layernorm": {"weight": stack("model.layers.{i}.input_layernorm.weight")},
                 "post_attention_layernorm": {"weight": stack("model.layers.{i}.post_attention_layernorm.weight")},
                 "self_attn": {
@@ -460,8 +548,7 @@ class Qwen2MoePolicy(InferenceV2Policy):
                     "shared_down_proj": {"kernel": stack("model.layers.{i}.mlp.shared_expert.down_proj.weight", _t)},
                     "shared_expert_gate": {"kernel": stack("model.layers.{i}.mlp.shared_expert_gate.weight", _t)},
                 },
-            },
-        }
+            }
         if not cfg.tie_word_embeddings:
             params["lm_head"] = _tied_lm_head(sd, params["embed_tokens"]["embedding"])
         return params

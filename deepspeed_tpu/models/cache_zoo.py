@@ -177,7 +177,8 @@ class OPTForCausalLMWithCache(nn.Module):
     def __call__(self, input_ids, start_pos, block_table, cache, chunk_lens=None):
         cfg = self.cfg
         positions = start_pos[:, None] + jnp.arange(input_ids.shape[1])[None, :]
-        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        proj_dim = cfg.word_embed_proj_dim or cfg.hidden_size
+        embed = nn.Embed(cfg.vocab_size, proj_dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                          embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
                          name="embed_tokens")
         pos_embed = nn.Embed(cfg.max_position_embeddings + 2, cfg.hidden_size, dtype=cfg.dtype,
@@ -186,7 +187,11 @@ class OPTForCausalLMWithCache(nn.Module):
         # pad-region positions can exceed the learned table (prefill chunk >
         # max_position): clamp — jnp.take would otherwise FILL (NaN)
         safe_pos = jnp.minimum(positions, cfg.max_position_embeddings - 1)
-        x = embed(input_ids) + pos_embed(safe_pos + 2)
+        x = embed(input_ids)
+        if proj_dim != cfg.hidden_size:
+            x = nn.Dense(cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="project_in")(x)
+        x = x + pos_embed(safe_pos + 2)
         blocks = nn.scan(OPTBlockCache, variable_axes={"params": 0}, split_rngs={"params": True},
                          in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
                          out_axes=0, length=cfg.num_hidden_layers,
@@ -196,6 +201,9 @@ class OPTForCausalLMWithCache(nn.Module):
         if cfg.do_layer_norm_before:
             x = nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                              name="final_layer_norm")(x)
+        if proj_dim != cfg.hidden_size:
+            x = nn.Dense(proj_dim, use_bias=False, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="project_out")(x)
         if cfg.tie_word_embeddings:
             return embed.attend(x), cache
         logits = nn.DenseGeneral(features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
@@ -225,6 +233,11 @@ class PhiAttentionCache(nn.Module):
                   name="k_proj")(x)
         v = dense(features=(KV, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
                   name="v_proj")(x)
+        if cfg.qk_layernorm:
+            q = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                             param_dtype=cfg.param_dtype, name="q_layernorm")(q)
+            k = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                             param_dtype=cfg.param_dtype, name="k_layernorm")(k)
         cos, sin = rotary_embedding(positions, rot_dim, cfg.rope_theta)
         q = apply_partial_rope(q, cos, sin, rot_dim)
         k = apply_partial_rope(k, cos, sin, rot_dim)

@@ -36,6 +36,7 @@ class FalconConfig:
     parallel_attn: bool = True
     num_ln_in_parallel_attn: int = 2  # new-arch: 2 = ln_attn+ln_mlp; 1 = shared (falcon-11B)
     ffn_hidden_size: int = 0  # 0 → 4*hidden_size (HF default); falcon2-style variants override
+    alibi: bool = False  # falcon-rw: alibi position bias instead of rotary
     bias: bool = False
     layer_norm_epsilon: float = 1e-5
     rope_theta: float = 10000.0
@@ -54,13 +55,6 @@ class FalconConfig:
             kv = getattr(hf_cfg, "num_kv_heads", hf_cfg.num_attention_heads)
         else:
             kv = 1 if getattr(hf_cfg, "multi_query", True) else hf_cfg.num_attention_heads
-        if getattr(hf_cfg, "alibi", False):
-            raise NotImplementedError("alibi falcon variants not supported (rotary only)")
-        if not getattr(hf_cfg, "parallel_attn", True):
-            raise NotImplementedError("sequential-residual falcon (parallel_attn=False, falcon-rw) "
-                                      "not supported")
-        if getattr(hf_cfg, "bias", False):
-            raise NotImplementedError("bias=True falcon variants (falcon-rw) not supported")
         fields = dict(vocab_size=hf_cfg.vocab_size,
                       hidden_size=hf_cfg.hidden_size,
                       num_hidden_layers=hf_cfg.num_hidden_layers,
@@ -72,12 +66,30 @@ class FalconConfig:
                                                or (2 if new_arch else 1)),
                       parallel_attn=getattr(hf_cfg, "parallel_attn", True),
                       ffn_hidden_size=getattr(hf_cfg, "ffn_hidden_size", None) or 0,
+                      alibi=getattr(hf_cfg, "alibi", False),
                       bias=getattr(hf_cfg, "bias", False),
                       layer_norm_epsilon=getattr(hf_cfg, "layer_norm_epsilon", 1e-5),
                       rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
                       tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", True))
         fields.update(overrides)
         return FalconConfig(**fields)
+
+
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    """Standard alibi slope schedule (ref: HF build_alibi_tensor / the
+    original train-short-test-long paper): powers of 2^(-8/m) for the
+    closest power-of-two head count, interleaved extras otherwise."""
+    import math
+
+    def pow2_slopes(n):
+        start = 2.0**(-(2.0**-(math.log2(n) - 3)))
+        return [start**(i + 1) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        return np.asarray(pow2_slopes(n_heads), np.float32)
+    closest = 2**math.floor(math.log2(n_heads))
+    extra = pow2_slopes(2 * closest)[0::2][:n_heads - closest]
+    return np.asarray(pow2_slopes(closest) + extra, np.float32)
 
 
 class FalconAttention(nn.Module):
@@ -95,10 +107,26 @@ class FalconAttention(nn.Module):
                   name="k_proj")(x)
         v = dense(features=(KV, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
                   name="v_proj")(x)
-        cos, sin = rotary_embedding(positions, D, cfg.rope_theta)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        out = get_attention_impl(cfg.attention_impl)(q, k, v, causal=True, segment_ids=segment_ids)
+        if cfg.alibi:
+            # falcon-rw: alibi position bias instead of rotary — softmax is
+            # row-shift-invariant, so slope*kpos ≡ slope*(kpos - qpos) under
+            # the causal mask (ref: HF build_alibi_tensor)
+            if cfg.attention_impl != "reference":
+                raise NotImplementedError("alibi falcon requires attention_impl='reference'")
+            slopes = jnp.asarray(alibi_slopes(H))                       # [H]
+            kpos = positions.astype(jnp.float32)                        # [B, S]
+            # HF adds alibi to the RAW scores before the 1/sqrt(D) scaling
+            # ((QK + alibi) * inv_norm) — fold the scale into the bias since
+            # reference_attention adds attn_bias post-scale
+            bias = (slopes[None, :, None, None] * kpos[:, None, None, :]) / jnp.sqrt(jnp.float32(D))
+            from .llama import reference_attention
+            out = reference_attention(q, k, v, causal=True, segment_ids=segment_ids,
+                                      attn_bias=bias)
+        else:
+            cos, sin = rotary_embedding(positions, D, cfg.rope_theta)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            out = get_attention_impl(cfg.attention_impl)(q, k, v, causal=True, segment_ids=segment_ids)
         return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=cfg.bias,
                                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                                kernel_init=_logical(nn.initializers.lecun_normal(), (HEADS, HEAD_DIM, EMBED)),
@@ -114,6 +142,24 @@ class FalconBlock(nn.Module):
         cfg = self.cfg
         ln = partial(nn.LayerNorm, epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype)
+        def mlp(mlp_in):
+            ffn = cfg.ffn_hidden_size or cfg.hidden_size * 4
+            h = nn.Dense(ffn, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, MLP)),
+                         name="dense_h_to_4h")(mlp_in)
+            return nn.Dense(cfg.hidden_size, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                            kernel_init=_logical(nn.initializers.lecun_normal(), (MLP, EMBED)),
+                            name="dense_4h_to_h")(jax.nn.gelu(h, approximate=False))
+
+        if not cfg.parallel_attn:
+            # falcon-rw sequential residual: ln1 → attn → add; ln2 → mlp → add
+            attn_in = ln(name="input_layernorm")(x)
+            h = x + FalconAttention(cfg, name="self_attention")(attn_in, positions, segment_ids)
+            out = h + mlp(ln(name="post_attention_layernorm")(h))
+            if self.scanned:
+                return out, None
+            return out
+
         if cfg.num_ln_in_parallel_attn == 2:  # HF keys purely on this flag
             attn_in = ln(name="ln_attn")(x)
             mlp_in = ln(name="ln_mlp")(x)
@@ -123,14 +169,7 @@ class FalconBlock(nn.Module):
             attn_in = ln(name="input_layernorm")(x)
             mlp_in = attn_in
         attn_out = FalconAttention(cfg, name="self_attention")(attn_in, positions, segment_ids)
-        ffn = cfg.ffn_hidden_size or cfg.hidden_size * 4
-        h = nn.Dense(ffn, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                     kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, MLP)),
-                     name="dense_h_to_4h")(mlp_in)
-        mlp_out = nn.Dense(cfg.hidden_size, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                           kernel_init=_logical(nn.initializers.lecun_normal(), (MLP, EMBED)),
-                           name="dense_4h_to_h")(jax.nn.gelu(h, approximate=False))
-        out = x + attn_out + mlp_out  # parallel residual
+        out = x + attn_out + mlp(mlp_in)  # parallel residual
         if self.scanned:
             return out, None
         return out

@@ -206,10 +206,12 @@ def _attn_logits_constraint(t):
     return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, spec))
 
 
-def reference_attention(q, k, v, *, causal=True, segment_ids=None, sliding_window=0):
+def reference_attention(q, k, v, *, causal=True, segment_ids=None, sliding_window=0,
+                        attn_bias=None):
     """Pure-jnp softmax attention (the golden path; swapped for the Pallas
     flash kernel via config.attention_impl).  ``sliding_window>0`` restricts
-    each query to the last W keys (mistral)."""
+    each query to the last W keys (mistral).  ``attn_bias`` is an additive
+    pre-softmax bias broadcastable to [B, N, Sq, Sk] (alibi slopes)."""
     b, sq, nh, hd = q.shape
     _, sk, nkv, _ = k.shape
     if nkv != nh:
@@ -218,6 +220,8 @@ def reference_attention(q, k, v, *, causal=True, segment_ids=None, sliding_windo
         v = jnp.repeat(v, rep, axis=2)
     scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
     logits = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if attn_bias is not None:
+        logits = logits + attn_bias.astype(jnp.float32)
     logits = _attn_logits_constraint(logits)
     if causal:
         qpos = jnp.arange(sq)[:, None]

@@ -32,6 +32,7 @@ class OPTConfig:
     num_attention_heads: int = 12
     max_position_embeddings: int = 2048
     do_layer_norm_before: bool = True
+    word_embed_proj_dim: int = 0  # 0 -> hidden_size; opt-350m projects 512->1024
     tie_word_embeddings: bool = True
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -42,13 +43,6 @@ class OPTConfig:
     @staticmethod
     def from_hf(hf_cfg, **overrides):
         proj = getattr(hf_cfg, "word_embed_proj_dim", None)
-        if proj not in (None, hf_cfg.hidden_size):
-            raise NotImplementedError(
-                f"OPT checkpoints with projected embeddings (word_embed_proj_dim={proj} != "
-                f"hidden_size={hf_cfg.hidden_size}, e.g. opt-350m) are not supported")
-        if not getattr(hf_cfg, "do_layer_norm_before", True):
-            raise NotImplementedError("post-LN OPT variants (do_layer_norm_before=False, "
-                                      "e.g. opt-350m) are not supported")
         fields = dict(vocab_size=hf_cfg.vocab_size,
                       hidden_size=hf_cfg.hidden_size,
                       ffn_dim=hf_cfg.ffn_dim,
@@ -56,6 +50,7 @@ class OPTConfig:
                       num_attention_heads=hf_cfg.num_attention_heads,
                       max_position_embeddings=hf_cfg.max_position_embeddings,
                       do_layer_norm_before=getattr(hf_cfg, "do_layer_norm_before", True),
+                      word_embed_proj_dim=0 if proj in (None, hf_cfg.hidden_size) else proj,
                       tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", True))
         fields.update(overrides)
         return OPTConfig(**fields)
@@ -121,7 +116,8 @@ class OPTForCausalLM(nn.Module):
         B, S = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S), (B, S))
-        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        proj_dim = cfg.word_embed_proj_dim or cfg.hidden_size
+        embed = nn.Embed(cfg.vocab_size, proj_dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                          embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
                          name="embed_tokens")
         # HF OPT offsets learned positions by 2 (padding convention)
@@ -129,7 +125,11 @@ class OPTForCausalLM(nn.Module):
                              param_dtype=cfg.param_dtype,
                              embedding_init=nn.initializers.normal(0.02),
                              name="embed_positions")
-        x = embed(input_ids) + pos_embed(positions + 2)
+        x = embed(input_ids)
+        if proj_dim != cfg.hidden_size:  # opt-350m: project_in/out around the stack
+            x = nn.Dense(cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="project_in")(x)
+        x = x + pos_embed(positions + 2)
 
         block_cls = OPTBlock
         if cfg.remat:
@@ -146,6 +146,9 @@ class OPTForCausalLM(nn.Module):
         if cfg.do_layer_norm_before:  # HF: final LN exists only for pre-LN OPT
             x = nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                              name="final_layer_norm")(x)
+        if proj_dim != cfg.hidden_size:
+            x = nn.Dense(proj_dim, use_bias=False, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="project_out")(x)
         if cfg.tie_word_embeddings:
             return embed.attend(x)
         return nn.DenseGeneral(features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,

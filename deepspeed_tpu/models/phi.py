@@ -32,6 +32,7 @@ class PhiConfig:
     layer_norm_eps: float = 1e-5
     max_position_embeddings: int = 2048
     tie_word_embeddings: bool = False
+    qk_layernorm: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     scan_layers: bool = True
@@ -51,9 +52,8 @@ class PhiConfig:
                       rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
                       layer_norm_eps=getattr(hf_cfg, "layer_norm_eps", 1e-5),
                       max_position_embeddings=hf_cfg.max_position_embeddings,
-                      tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", False))
-        if getattr(hf_cfg, "qk_layernorm", False):
-            raise NotImplementedError("phi qk_layernorm variants not supported")
+                      tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
+                      qk_layernorm=getattr(hf_cfg, "qk_layernorm", False))
         fields.update(overrides)
         return PhiConfig(**fields)
 
@@ -86,6 +86,13 @@ class PhiAttention(nn.Module):
                   name="k_proj")(x)
         v = dense(features=(KV, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
                   name="v_proj")(x)
+        if cfg.qk_layernorm:
+            # per-head LayerNorm over head_dim BEFORE rope (ref: HF PhiAttention
+            # q_layernorm/k_layernorm, phi-1/phi-1.5 checkpoints)
+            q = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                             param_dtype=cfg.param_dtype, name="q_layernorm")(q)
+            k = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                             param_dtype=cfg.param_dtype, name="k_layernorm")(k)
         cos, sin = rotary_embedding(positions, rot_dim, cfg.rope_theta)
         q = apply_partial_rope(q, cos, sin, rot_dim)
         k = apply_partial_rope(k, cos, sin, rot_dim)

@@ -38,6 +38,8 @@ class Qwen2MoeConfig:
     num_experts: int = 60
     num_experts_per_tok: int = 4
     norm_topk_prob: bool = False
+    mlp_only_layers: tuple = ()   # HF mlp_only_layers: dense-MLP layer indices
+    decoder_sparse_step: int = 1  # HF: layer i is sparse iff (i+1) % step == 0
     qkv_bias: bool = True
     max_position_embeddings: int = 8192
     rope_theta: float = 1e6
@@ -60,13 +62,18 @@ class Qwen2MoeConfig:
                            dtype=self.dtype, param_dtype=self.param_dtype,
                            attention_impl=self.attention_impl, attention_bias=self.qkv_bias)
 
+    def layer_is_sparse(self, i: int) -> bool:
+        """HF Qwen2MoeDecoderLayer rule: dense MLP for mlp_only_layers and
+        off-step layers, sparse MoE otherwise."""
+        return (i not in tuple(self.mlp_only_layers) and self.num_experts > 0
+                and (i + 1) % max(1, self.decoder_sparse_step) == 0)
+
+    @property
+    def mixed_stack(self) -> bool:
+        return any(not self.layer_is_sparse(i) for i in range(self.num_hidden_layers))
+
     @staticmethod
     def from_hf(hf_cfg, **overrides):
-        if getattr(hf_cfg, "mlp_only_layers", None):
-            raise NotImplementedError("qwen2_moe mlp_only_layers (mixed dense/sparse stacks) "
-                                      "not supported with scan-over-layers")
-        if getattr(hf_cfg, "decoder_sparse_step", 1) != 1:
-            raise NotImplementedError("qwen2_moe decoder_sparse_step != 1 not supported")
         fields = dict(vocab_size=hf_cfg.vocab_size,
                       hidden_size=hf_cfg.hidden_size,
                       intermediate_size=hf_cfg.intermediate_size,
@@ -82,9 +89,15 @@ class Qwen2MoeConfig:
                       max_position_embeddings=hf_cfg.max_position_embeddings,
                       rope_theta=getattr(hf_cfg, "rope_theta", 1e6),
                       rms_norm_eps=getattr(hf_cfg, "rms_norm_eps", 1e-6),
-                      tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", False))
+                      tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
+                      mlp_only_layers=tuple(getattr(hf_cfg, "mlp_only_layers", None) or ()),
+                      decoder_sparse_step=getattr(hf_cfg, "decoder_sparse_step", 1))
         fields.update(overrides)
-        return Qwen2MoeConfig(**fields)
+        cfg = Qwen2MoeConfig(**fields)
+        if cfg.mixed_stack and cfg.scan_layers:
+            # mixed dense/sparse layers can't share one scanned body
+            cfg = Qwen2MoeConfig(**{**cfg.__dict__, "scan_layers": False})
+        return cfg
 
 
 class Qwen2MoeSparseMLP(nn.Module):
@@ -140,9 +153,26 @@ class Qwen2MoeSparseMLP(nn.Module):
         return out.astype(x.dtype)
 
 
+class Qwen2MoeDenseMLP(nn.Module):
+    """SwiGLU dense MLP for mlp_only/off-step layers (ref: HF Qwen2MoeMLP
+    with config.intermediate_size)."""
+    cfg: Qwen2MoeConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda feats, names, name: nn.Dense(
+            feats, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=_logical(nn.initializers.lecun_normal(), names), name=name)
+        g = dense(cfg.intermediate_size, (EMBED, MLP), "gate_proj")(x)
+        u = dense(cfg.intermediate_size, (EMBED, MLP), "up_proj")(x)
+        return dense(cfg.hidden_size, (MLP, EMBED), "down_proj")(nn.silu(g) * u)
+
+
 class Qwen2MoeBlock(nn.Module):
     cfg: Qwen2MoeConfig
     scanned: bool = False
+    sparse: bool = True
 
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
@@ -151,7 +181,8 @@ class Qwen2MoeBlock(nn.Module):
         h = x + LlamaAttention(lcfg, name="self_attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="input_layernorm")(x),
             positions, segment_ids)
-        out = h + Qwen2MoeSparseMLP(cfg, name="mlp")(
+        mlp = Qwen2MoeSparseMLP(cfg, name="mlp") if self.sparse else Qwen2MoeDenseMLP(cfg, name="mlp")
+        out = h + mlp(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="post_attention_layernorm")(h))
         if self.scanned:
             return out, None
@@ -181,7 +212,7 @@ class Qwen2MoeForCausalLM(nn.Module):
             x, _ = blocks(cfg, scanned=True, name="layers")(x, positions, segment_ids)
         else:
             for i in range(cfg.num_hidden_layers):
-                x = block_cls(cfg, name=f"layers_{i}")(x, positions, segment_ids)
+                x = block_cls(cfg, sparse=cfg.layer_is_sparse(i), name=f"layers_{i}")(x, positions, segment_ids)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="norm")(x)
         if cfg.tie_word_embeddings:
             return embed.attend(x)

@@ -132,6 +132,63 @@ def fpdt_attention(q, k, v, *, causal: bool = True, segment_ids=None,
     return outs.swapaxes(0, 1).reshape(b, sq, nh, hd)
 
 
+def _current_sharding(ndim: int, memory_kind: str):
+    """Batch-sharded NamedSharding on the global mesh (or single-device)
+    with the given memory kind."""
+    from ..comm.mesh import BATCH_AXES, get_global_mesh, has_global_mesh
+    from jax.sharding import NamedSharding, PartitionSpec, SingleDeviceSharding
+    if has_global_mesh():
+        mesh = get_global_mesh()
+        spec = PartitionSpec(*([BATCH_AXES] + [None] * (ndim - 1)))
+        return NamedSharding(mesh, spec, memory_kind=memory_kind)
+    return SingleDeviceSharding(jax.devices()[0], memory_kind=memory_kind)
+
+
+def host_kv(k, v):
+    """Place the full K/V on HOST memory (the FPDT offloading KV store,
+    ref: sequence/fpdt_layer.py:510 _FPDTGPUOffloadingAttentionImpl_ — there
+    a hand-managed pinned-host tensor pair; here a memory_kind placement).
+    Feed the results to ``fpdt_host_offload_attention`` (jit the caller with
+    matching pinned_host in_shardings to keep them host-resident)."""
+    host = _current_sharding(k.ndim, "pinned_host")
+    return jax.device_put(k, host), jax.device_put(v, host)
+
+
+def fpdt_host_offload_attention(q, k, v, *, chunk_size: int = 512, causal: bool = True,
+                                q_offset: int = 0, k_offset: int = 0):
+    """Chunked attention whose KV lives in HOST memory: each iteration
+    slices one chunk from the host-resident K/V and copies it into device
+    memory before the matmuls (explicit ``jax.device_put`` inside the scan —
+    XLA's latency-hiding scheduler overlaps chunk i+1's host→HBM copy with
+    chunk i's compute, which is the reference's double buffering,
+    ref: fpdt_layer.py:510).  Device-resident working set is O(chunk), not
+    O(S); the [B, Sk, H, D] KV never materializes in HBM."""
+    b, sq, nh, hd = q.shape
+    sk = k.shape[1]
+    assert sk % chunk_size == 0, f"Sk={sk} not divisible by chunk_size={chunk_size}"
+    n_chunks = sk // chunk_size
+    scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
+    q32 = q.astype(jnp.float32)
+    q_pos = q_offset + jnp.arange(sq)
+    dev = _current_sharding(k.ndim, "device")
+
+    out0 = jnp.zeros((b, nh, sq, hd), jnp.float32)
+    lse0 = jnp.full((b, nh, sq), _NEG_INF, jnp.float32)
+
+    def step(carry, idx):
+        out, lse = carry
+        k_c = jax.lax.dynamic_slice_in_dim(k, idx * chunk_size, chunk_size, 1)
+        v_c = jax.lax.dynamic_slice_in_dim(v, idx * chunk_size, chunk_size, 1)
+        k_c = jax.device_put(k_c, dev)   # host → HBM, one chunk
+        v_c = jax.device_put(v_c, dev)
+        k_pos = k_offset + idx * chunk_size + jnp.arange(chunk_size)
+        c_out, c_lse = _chunk_partials(q32, k_c, v_c, q_pos, k_pos, scale, causal)
+        return update_out_and_lse(out, lse, c_out, c_lse), None
+
+    (out, lse), _ = jax.lax.scan(step, (out0, lse0), jnp.arange(n_chunks))
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
 class FPDTAttention:
     """Drop-in attention impl (``attn_fn(q, k, v, causal=..)``) combining
     FPDT chunking with optional Ulysses resharding when a ``seq`` mesh axis

@@ -339,3 +339,123 @@ def test_mistral_sliding_window_masks():
     got = eng.generate([prompt], max_new_tokens=1)[0][0]
     ref_logits = win.apply(v, jnp.asarray([prompt], jnp.int32))
     assert got == int(np.argmax(np.asarray(ref_logits)[0, -1]))
+
+
+def test_falcon_rw_logits_parity(tmp_path):
+    """falcon-rw: alibi position bias, SEQUENTIAL residual (parallel_attn
+    False), biases everywhere, classic MHA (VERDICT r1 weak #10)."""
+    import torch
+    from transformers import FalconConfig as HFC, FalconForCausalLM as HFM
+    torch.manual_seed(0)
+    hf_cfg = HFC(vocab_size=128, hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+                 new_decoder_architecture=False, multi_query=False, parallel_attn=False,
+                 bias=True, alibi=True, hidden_dropout=0.0, attention_dropout=0.0,
+                 tie_word_embeddings=True)
+    hf_model = HFM(hf_cfg).eval()
+    d = tmp_path / "falcon_rw"
+    hf_model.save_pretrained(d)
+
+    from transformers import AutoConfig
+    from deepspeed_tpu.inference.v2.engine_factory import _load_state_dict
+    sd = _load_state_dict(str(d))
+    cfg, params = convert_hf_state_dict(sd, AutoConfig.from_pretrained(str(d), local_files_only=True))
+    assert cfg.alibi and not cfg.parallel_attn and cfg.bias
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32, "remat": False})
+
+    from deepspeed_tpu.models.falcon import FalconForCausalLM
+    ids = np.array([[5, 9, 2, 7, 1, 3]], np.int32)
+    got = np.asarray(FalconForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids)))
+    import torch as _t
+    with _t.no_grad():
+        want = hf_model(_t.tensor(ids.astype(np.int64))).logits.float().numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_opt_350m_style_logits_parity(tmp_path):
+    """opt-350m layout: post-LN blocks + projected embeddings
+    (word_embed_proj_dim != hidden_size)."""
+    import torch
+    from transformers import OPTConfig as HFC, OPTForCausalLM as HFM
+    torch.manual_seed(0)
+    hf_cfg = HFC(vocab_size=128, hidden_size=64, ffn_dim=96, num_hidden_layers=2,
+                 num_attention_heads=4, max_position_embeddings=64, do_layer_norm_before=False,
+                 word_embed_proj_dim=32, dropout=0.0, attention_dropout=0.0,
+                 activation_function="relu")
+    hf_model = HFM(hf_cfg).eval()
+    d = tmp_path / "opt350m"
+    hf_model.save_pretrained(d)
+
+    from transformers import AutoConfig
+    from deepspeed_tpu.inference.v2.engine_factory import _load_state_dict
+    sd = _load_state_dict(str(d))
+    cfg, params = convert_hf_state_dict(sd, AutoConfig.from_pretrained(str(d), local_files_only=True))
+    assert cfg.word_embed_proj_dim == 32 and not cfg.do_layer_norm_before
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32, "remat": False})
+
+    from deepspeed_tpu.models.opt import OPTForCausalLM
+    ids = np.array([[5, 9, 2, 7, 1, 3]], np.int32)
+    got = np.asarray(OPTForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids)))
+    import torch as _t
+    with _t.no_grad():
+        want = hf_model(_t.tensor(ids.astype(np.int64))).logits.float().numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_phi_qk_layernorm_logits_parity(tmp_path):
+    import torch
+    from transformers import PhiConfig as HFC, PhiForCausalLM as HFM
+    torch.manual_seed(0)
+    hf_cfg = HFC(vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+                 num_attention_heads=4, num_key_value_heads=4, partial_rotary_factor=0.5,
+                 max_position_embeddings=64, rope_theta=1e4, hidden_dropout=0.0,
+                 attention_dropout=0.0, qk_layernorm=True, tie_word_embeddings=False)
+    hf_model = HFM(hf_cfg).eval()
+    d = tmp_path / "phi_qkln"
+    hf_model.save_pretrained(d)
+
+    from transformers import AutoConfig
+    from deepspeed_tpu.inference.v2.engine_factory import _load_state_dict
+    sd = _load_state_dict(str(d))
+    cfg, params = convert_hf_state_dict(sd, AutoConfig.from_pretrained(str(d), local_files_only=True))
+    assert cfg.qk_layernorm
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32, "remat": False})
+
+    from deepspeed_tpu.models.phi import PhiForCausalLM
+    ids = np.array([[5, 9, 2, 7, 1, 3]], np.int32)
+    got = np.asarray(PhiForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids)))
+    import torch as _t
+    with _t.no_grad():
+        want = hf_model(_t.tensor(ids.astype(np.int64))).logits.float().numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_qwen2_moe_mixed_stack_logits_parity(tmp_path):
+    """mlp_only_layers: layer 0 dense, layer 1 sparse — converts to the
+    unscanned per-layer model."""
+    import torch
+    from transformers import Qwen2MoeConfig as HFC, Qwen2MoeForCausalLM as HFM
+    torch.manual_seed(0)
+    hf_cfg = HFC(vocab_size=128, hidden_size=64, intermediate_size=96, moe_intermediate_size=48,
+                 shared_expert_intermediate_size=64, num_hidden_layers=2, num_attention_heads=4,
+                 num_key_value_heads=2, num_experts=4, num_experts_per_tok=2,
+                 max_position_embeddings=64, rope_theta=1e4, norm_topk_prob=False,
+                 tie_word_embeddings=False, mlp_only_layers=[0], decoder_sparse_step=1)
+    hf_model = HFM(hf_cfg).eval()
+    d = tmp_path / "qwen2_moe_mixed"
+    hf_model.save_pretrained(d)
+
+    from transformers import AutoConfig
+    from deepspeed_tpu.inference.v2.engine_factory import _load_state_dict
+    sd = _load_state_dict(str(d))
+    cfg, params = convert_hf_state_dict(sd, AutoConfig.from_pretrained(str(d), local_files_only=True))
+    assert cfg.mixed_stack and not cfg.scan_layers
+    assert "layers_0" in params and "gate_proj" in params["layers_0"]["mlp"]
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32, "remat": False})
+
+    from deepspeed_tpu.models.qwen2_moe import Qwen2MoeForCausalLM
+    ids = np.array([[5, 9, 2, 7, 1, 3]], np.int32)
+    got = np.asarray(Qwen2MoeForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids)))
+    import torch as _t
+    with _t.no_grad():
+        want = hf_model(_t.tensor(ids.astype(np.int64))).logits.float().numpy()
+    np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
