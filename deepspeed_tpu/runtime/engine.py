@@ -442,6 +442,16 @@ class DeepSpeedEngine:
             rng = jax.random.fold_in(jax.random.PRNGKey(17), step)
             mask, inv = pld_layer_mask(rng, self.module.cfg.num_hidden_layers, theta)
             kwargs["pld_scale"] = mask * inv
+        # TRUE-1F1B pipeline modules compute the loss INSIDE the schedule
+        # (post-stack per microbatch, interleaved backward); the engine's
+        # jax.grad then consumes the custom-VJP grads
+        if getattr(self.module, "schedule", None) == "1f1b":
+            if kwargs:
+                from .pipe.module import PipelineError
+                raise PipelineError(
+                    f"PipelineModule does not accept keyword model inputs {sorted(kwargs)} "
+                    "(same contract as the gpipe schedule)")
+            return self.module.apply_loss_1f1b({"params": params}, self.loss_fn, mb, *args)
         outputs = self.module.apply({"params": params}, *args, **kwargs)
         return self.loss_fn(outputs, mb)
 

@@ -136,12 +136,17 @@ class PipelineModule:
                  loss_fn: Optional[Callable] = None,
                  partition_method: str = "parameters",
                  activation_checkpoint_interval: int = 1,
-                 checkpointable_layers=None):
+                 checkpointable_layers=None,
+                 schedule: str = "gpipe"):
         if num_stages is None and topology is None:
             raise PipelineError("must provide num_stages or topology")
         if topology is not None and num_stages is None:
             num_stages = topology.get_dim("pipe")
         self.num_stages = int(num_stages)
+        if schedule not in ("gpipe", "1f1b"):
+            raise PipelineError(f"unknown pipeline schedule {schedule!r} (gpipe | 1f1b)")
+        self.schedule = schedule
+        self._1f1b_cache = {}
         self.loss_fn = loss_fn
         self.micro_batches = 1  # set by PipelineEngine (= gradient_accumulation_steps)
         self.remat = activation_checkpoint_interval != 0
@@ -282,6 +287,51 @@ class PipelineModule:
 
     def __call__(self, variables, x, *extras, **kwargs):
         return self.apply(variables, x, *extras, **kwargs)
+
+    def apply_loss_1f1b(self, variables, loss_fn, batch, x, *extras):
+        """Loss of one full batch under the TRUE 1F1B schedule (ref:
+        pipe/schedule.py:189 TrainSchedule): the post-stack + loss runs
+        inside the pipeline loop per microbatch, backward interleaves with
+        forward, live activations are bounded by the stash depth.  The pre
+        layers (embedding) stay outside and differentiate through dx."""
+        from .pipeline import make_pipelined_1f1b
+        params = variables["params"]
+        mesh = get_global_mesh()
+        start, stop = self._body_range
+        h = x
+        for idx in range(start):
+            h = self._apply_indexed(idx, params, h, extras)
+        if not self.body:
+            raise PipelineError("1f1b schedule requires a pipelined body")
+        blockmod = self.body[0]
+
+        def body_fn(layer_params, h, *ex):
+            return blockmod.apply({"params": layer_params}, h, *ex) \
+                if _accepts_extras(blockmod, h, ex, init=False) else blockmod.apply({"params": layer_params}, h)
+
+        nonbody = {k: v for k, v in params.items() if k != "body"}
+
+        def head_fn(nonbody_params, h_mb, mb_batch):
+            for idx in range(stop, len(self._layers)):
+                mod = self._layers[idx]
+                if not _is_module(mod):
+                    h_mb = mod(h_mb)
+                    continue
+                spec = self._specs[idx]
+                vs = {"params": nonbody_params[self._param_name(idx)]}
+                if isinstance(spec, TiedLayerSpec) and spec.forward_fn is not None \
+                        and idx != self._tied[spec.key][1]:
+                    h_mb = spec.forward_fn(mod, vs, h_mb)
+                else:
+                    h_mb = _apply_layer(mod, vs, h_mb, ())
+            return loss_fn(h_mb, mb_batch)
+
+        key = (id(mesh), self.micro_batches, id(loss_fn))
+        if key not in self._1f1b_cache:
+            self._1f1b_cache[key] = make_pipelined_1f1b(
+                body_fn, head_fn, mesh=mesh, num_stages=self.num_stages,
+                micro_batches=self.micro_batches, remat=self.remat)
+        return self._1f1b_cache[key](params["body"], nonbody, h, extras, batch)
 
     def _apply_indexed(self, idx, params, h, extras):
         mod = self._layers[idx]

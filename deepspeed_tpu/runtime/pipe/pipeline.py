@@ -68,6 +68,259 @@ def _unmicrobatch(tree):
     return jax.tree.map(join, tree)
 
 
+def _float0_like(tree):
+    """Cotangents for integer/bool leaves (jax requires float0 there)."""
+    import numpy as onp
+
+    def z(t):
+        if jnp.issubdtype(jnp.asarray(t).dtype, jnp.inexact):
+            return jnp.zeros_like(t)
+        return onp.zeros(onp.shape(t), jax.dtypes.float0)
+
+    return jax.tree.map(z, tree)
+
+
+def make_pipelined_1f1b(body_fn: Callable,
+                        head_fn: Callable,
+                        *,
+                        mesh,
+                        num_stages: int,
+                        micro_batches: int,
+                        remat: bool = True):
+    """Build a TRUE 1F1B pipeline executor: one scan interleaving forward and
+    backward microbatch work per tick (ref: pipe/schedule.py:189
+    TrainSchedule and its executor pipe/engine.py:1409 _exec_schedule).
+
+    Unlike ``pipelined_apply`` (GPipe: AD transposes the forward scan, so
+    every in-flight tick carry — O(M) microbatch activations per stage — is
+    saved for the backward), this executor computes gradients ITSELF inside
+    the tick loop: each stage keeps a stash of at most ``min(S+1, M)`` saved
+    stage inputs, runs its backward as soon as the matching cotangent
+    arrives, and retires the stash slot — the 1F1B live-activation profile.
+    The loss head runs inside the loop on the last stage (the reference puts
+    the loss in the PipelineModule for the same reason), so the backward is
+    seeded per microbatch without leaving the schedule.
+
+    The result is exposed to autodiff as a ``jax.custom_vjp``: the primal
+    computes (loss, grads) in one pass and saves the grads as residuals; the
+    bwd rule scales them by the upstream cotangent (valid because gradients
+    are linear in the scalar loss cotangent).  Upstream (embedding) layers
+    stay differentiable through the returned dx.
+
+    Args:
+      body_fn: ``(layer_params, h, *extras_mb) -> h`` — one block.
+      head_fn: ``(head_params, h_mb, mb_batch) -> scalar`` — the post-stack
+        (final norm / lm head / loss) for ONE microbatch.  ``head_params``
+        may be any pytree (it also flows through the caller's own forward,
+        e.g. tied embeddings; cotangents from both paths sum).
+    Returns:
+      ``f(body_params, head_params, x, extras, batch) -> loss`` with a
+      custom VJP.  ``batch`` is the per-microbatch-sliceable data pytree
+      (labels etc.); its cotangent is zero.
+    """
+    S, M = num_stages, micro_batches
+    T = 2 * (M + S - 1)
+    NB = min(S + 1, M)  # stash depth: the 1F1B bound (ref: num_pipe_buffers)
+    fwd_rotate = [(i, (i + 1) % S) for i in range(S)]
+    bwd_rotate = [(i, (i - 1) % S) for i in range(S)]
+    block = jax.checkpoint(body_fn) if remat else body_fn
+
+    def _value_and_grads(body_params, head_params, x, extras, batch):
+        for leaf in jax.tree.leaves(extras):
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+                raise NotImplementedError(
+                    "1f1b: float extras would receive ZERO gradient through the custom VJP "
+                    "(unlike gpipe, which differentiates extras); pass only integer/bool "
+                    "extras (positions, segment ids) or use schedule='gpipe'")
+        mbs = _microbatch(x, M)
+        extras_mb = tuple(_microbatch(e, M) for e in extras)
+        batch_mb = _microbatch(batch, M)
+        x_dtype = x.dtype
+        upcast_wire = jax.default_backend() == "cpu"
+
+        def _wire32(t):
+            if not upcast_wire:
+                return t
+            return jax.tree.map(lambda a: a.astype(jnp.float32)
+                                if jnp.issubdtype(a.dtype, jnp.floating) else a, t)
+
+        extras_dtypes = jax.tree.map(lambda a: a.dtype, extras_mb)
+        mbs32 = _wire32(mbs)
+        extras_mb32 = _wire32(extras_mb)
+
+        @partial(jax.shard_map,
+                 mesh=mesh,
+                 axis_names={PIPE_AXIS},
+                 in_specs=(P(PIPE_AXIS), P(), P(), P(), P()),
+                 out_specs=(P(), P(PIPE_AXIS), P(), P()),
+                 check_vma=False)
+        def run(params, head_params, mbs32, extras_mb32, batch_mb):
+            stage = jax.lax.axis_index(PIPE_AXIS)
+            mb_shape = mbs32.shape[1:]
+
+            def stage_fwd_with(p, h, ex):
+                def body(h, lp):
+                    return block(lp, h, *ex), None
+
+                h, _ = jax.lax.scan(body, h, p)
+                return h
+
+            def take_mb(tree, idx):
+                return jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(a, idx, 0, keepdims=False), tree)
+
+            carry0 = dict(
+                fwd_msg=jnp.zeros(mb_shape, jnp.float32 if upcast_wire else x_dtype),
+                fwd_msg_ex=jax.tree.map(lambda a: jnp.zeros(a.shape[1:], a.dtype), extras_mb32),
+                bwd_msg=jnp.zeros(mb_shape, jnp.float32),
+                stash_h=jnp.zeros((NB, ) + mb_shape, x_dtype),
+                stash_ex=jax.tree.map(lambda a: jnp.zeros((NB, ) + a.shape[1:], a.dtype),
+                                      jax.tree.map(lambda a, dt: jnp.zeros(a.shape[1:], dt),
+                                                   extras_mb32, extras_dtypes)),
+                seed=jnp.zeros((NB, ) + mb_shape, jnp.float32),
+                body_grads=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+                head_grads=jax.tree.map(lambda p: jnp.zeros(jnp.shape(p), jnp.float32), head_params),
+                dx=jnp.zeros((M, ) + mb_shape, jnp.float32),
+                loss=jnp.zeros((), jnp.float32),
+            )
+
+            def tick(carry, t):
+                parity_match = (t % 2) == (stage % 2)
+                mb_f = (t - stage) // 2
+                mb_b = (t - 2 * S + stage + 2) // 2
+                do_fwd = parity_match & (mb_f >= 0) & (mb_f < M)
+                do_bwd = (~parity_match) & (mb_b >= 0) & (mb_b < M)
+                is_first = stage == 0
+                is_last = stage == S - 1
+
+                def fwd_branch(carry):
+                    idx = jnp.maximum(mb_f, 0)
+                    x_in = take_mb(mbs32, idx).astype(x_dtype)
+                    ex_in = take_mb(extras_mb32, idx)
+                    h_in = jnp.where(is_first, x_in, carry["fwd_msg"].astype(x_dtype))
+                    ex_use = jax.tree.map(lambda new, old: jnp.where(is_first, new, old),
+                                          ex_in, carry["fwd_msg_ex"])
+                    ex_typed = jax.tree.map(lambda a, dt: a.astype(dt), ex_use, extras_dtypes)
+                    slot = idx % NB
+                    stash_h = jax.lax.dynamic_update_index_in_dim(carry["stash_h"], h_in, slot, 0)
+                    stash_ex = jax.tree.map(
+                        lambda buf, v: jax.lax.dynamic_update_index_in_dim(buf, v, slot, 0),
+                        carry["stash_ex"], ex_use)
+                    h_out = stage_fwd_with(params, h_in, ex_typed)
+                    # last stage ONLY: per-microbatch loss + backward seed
+                    # (runtime cond — other stages skip the head entirely)
+                    mb_data = take_mb(batch_mb, idx)
+
+                    def compute_head(_):
+                        def head_loss(hp, h):
+                            return head_fn(hp, h.astype(x_dtype), mb_data)
+
+                        return jax.value_and_grad(head_loss, argnums=(0, 1))(head_params, h_out)
+
+                    def skip_head(_):
+                        zero_hg = jax.tree.map(lambda pp: jnp.zeros(jnp.shape(pp), jnp.asarray(pp).dtype),
+                                               head_params)
+                        return jnp.zeros((), jnp.float32), (zero_hg, jnp.zeros_like(h_out))
+
+                    loss_mb, (dhead, dh) = jax.lax.cond(is_last, compute_head, skip_head, None)
+                    head_grads = jax.tree.map(lambda g, acc: acc + g.astype(jnp.float32),
+                                              dhead, carry["head_grads"])
+                    seed = jax.lax.dynamic_update_index_in_dim(
+                        carry["seed"], dh.astype(jnp.float32), slot, 0)
+                    return {**carry,
+                            "fwd_msg": _wire32(h_out) if upcast_wire else h_out,
+                            "fwd_msg_ex": ex_use,
+                            "stash_h": stash_h, "stash_ex": stash_ex,
+                            "seed": seed,
+                            "head_grads": head_grads,
+                            "loss": carry["loss"] + loss_mb.astype(jnp.float32)}
+
+                def bwd_branch(carry):
+                    idx = jnp.maximum(mb_b, 0)
+                    slot = idx % NB
+                    h_in = jax.lax.dynamic_index_in_dim(carry["stash_h"], slot, 0, keepdims=False)
+                    ex_in = jax.tree.map(
+                        lambda buf: jax.lax.dynamic_index_in_dim(buf, slot, 0, keepdims=False),
+                        carry["stash_ex"])
+                    ex_typed = jax.tree.map(lambda a, dt: a.astype(dt), ex_in, extras_dtypes)
+                    dh_seed = jax.lax.dynamic_index_in_dim(carry["seed"], slot, 0, keepdims=False)
+                    dh_out = jnp.where(is_last, dh_seed, carry["bwd_msg"]).astype(x_dtype)
+
+                    def f(p, h):
+                        return stage_fwd_with(p, h, ex_typed)
+
+                    _, vjp = jax.vjp(f, params, h_in)
+                    dparams, dh_in = vjp(dh_out)
+                    body_grads = jax.tree.map(lambda g, acc: acc + g.astype(jnp.float32),
+                                              dparams, carry["body_grads"])
+                    dx = jax.lax.dynamic_update_index_in_dim(
+                        carry["dx"], jnp.where(is_first, dh_in.astype(jnp.float32), 0.0), idx, 0)
+                    return {**carry,
+                            "bwd_msg": dh_in.astype(jnp.float32),
+                            "body_grads": body_grads,
+                            "dx": dx}
+
+                carry = jax.lax.cond(do_fwd, fwd_branch, lambda c: c, carry)
+                carry = jax.lax.cond(do_bwd, bwd_branch, lambda c: c, carry)
+                # rotate every tick: activations forward, cotangents backward
+                # (the SendActivation/SendGrad pair, ref: pipe/p2p.py:45);
+                # garbage rotations in warmup/cooldown are never consumed —
+                # validity is re-derived from the tick algebra at the consumer
+                carry = {**carry,
+                         "fwd_msg": jax.lax.ppermute(carry["fwd_msg"], PIPE_AXIS, fwd_rotate),
+                         "fwd_msg_ex": jax.tree.map(
+                             lambda a: jax.lax.ppermute(a, PIPE_AXIS, fwd_rotate),
+                             carry["fwd_msg_ex"]),
+                         "bwd_msg": jax.lax.ppermute(carry["bwd_msg"], PIPE_AXIS, bwd_rotate)}
+                return carry, None
+
+            carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+            # loss/head_grads live on the last stage, dx on the first —
+            # psum with zero elsewhere broadcasts them pipe-wide
+            loss = jax.lax.psum(carry["loss"], PIPE_AXIS) / M
+            head_grads = jax.tree.map(
+                lambda g: jax.lax.psum(g, PIPE_AXIS) / M, carry["head_grads"])
+            dx = jax.lax.psum(carry["dx"], PIPE_AXIS) / M
+            body_grads = jax.tree.map(lambda g: g / M, carry["body_grads"])
+            return loss, body_grads, head_grads, dx
+
+        loss, body_grads, head_grads, dx = run(body_params, head_params, mbs32,
+                                               extras_mb32, batch_mb)
+        dx = _unmicrobatch(dx).astype(jnp.float32)
+        return loss, (body_grads, head_grads, dx)
+
+    @jax.custom_vjp
+    def pipelined_1f1b(body_params, head_params, x, extras, batch):
+        # loss-only primal (eval_batch etc.): forward fill-drain + per-mb
+        # head — no vjp work, no grad accumulators.  Differentiated calls go
+        # through the fwd rule instead, which runs the interleaved 1F1B pass.
+        h = pipelined_apply(body_fn, body_params, x, extras,
+                            mesh=mesh, num_stages=S, micro_batches=M, remat=remat)
+        h_mb = _microbatch(h, M)
+        batch_mb = _microbatch(batch, M)
+
+        def one(i):
+            take = lambda tree: jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False), tree)
+            return head_fn(head_params, take(h_mb), take(batch_mb))
+
+        losses = jax.lax.map(one, jnp.arange(M))
+        return jnp.mean(losses)
+
+    def fwd(body_params, head_params, x, extras, batch):
+        loss, grads = _value_and_grads(body_params, head_params, x, extras, batch)
+        return loss, (grads, extras, batch)
+
+    def bwd(res, ct):
+        (body_grads, head_grads, dx), extras, batch = res
+        scale = lambda t: jax.tree.map(lambda g: g * ct, t)
+        return (scale(body_grads), scale(head_grads), (dx * ct).astype(jnp.float32),
+                _float0_like(extras), _float0_like(batch))
+
+    pipelined_1f1b.defvjp(fwd, bwd)
+    return pipelined_1f1b
+
+
 def pipelined_apply(body_fn: Callable,
                     body_params: Any,
                     x: jnp.ndarray,

@@ -216,3 +216,115 @@ def test_tied_embedding_pipeline():
 
     out = engine.eval_batch(data_iter=it())
     assert np.isfinite(float(out))
+
+
+# ------------------------------------------------------------- true 1F1B
+
+
+def test_1f1b_function_matches_sequential():
+    """make_pipelined_1f1b loss + grads (body, head, dx) == plain sequential
+    autodiff (ref: pipe/schedule.py:189 TrainSchedule semantics)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.runtime.pipe.pipeline import make_pipelined_1f1b
+
+    S, M, L, D = 2, 4, 4, 16
+    mesh = create_mesh(MeshSpec(pipe=S), devices=jax.devices()[:S])
+    rng = np.random.default_rng(0)
+    body_params = jnp.asarray(rng.normal(size=(L, D, D)) * 0.3, jnp.float32)
+    head_params = jnp.asarray(rng.normal(size=(D, )) * 0.5, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(8, 4, D)), jnp.float32)
+    extras = (jnp.asarray(rng.integers(0, 4, (8, 4)), jnp.int32), )
+    batch = {"labels": jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)}
+
+    def body_fn(w, h, pos):
+        return jnp.tanh(h @ w) + h * 0.1
+
+    def head_fn(hp, h, mb):
+        pred = jnp.einsum("bsd,d->bs", h, hp)
+        return jnp.mean((pred - mb["labels"])**2)
+
+    def ref_loss(bp, hp, x, extras, batch):
+        h = x
+        for i in range(L):
+            h = body_fn(bp[i], h, extras[0])
+        return head_fn(hp, h, batch)
+
+    gold = ref_loss(body_params, head_params, x, extras, batch)
+    g_b, g_h, g_x = jax.grad(ref_loss, argnums=(0, 1, 2))(body_params, head_params, x, extras, batch)
+
+    f = make_pipelined_1f1b(body_fn, head_fn, mesh=mesh, num_stages=S, micro_batches=M)
+    bp = jax.device_put(body_params, NamedSharding(mesh, P("pipe")))
+    loss = jax.jit(f)(bp, head_params, x, extras, batch)
+    np.testing.assert_allclose(float(loss), float(gold), rtol=1e-5)
+    gb, gh, gx = jax.jit(jax.grad(f, argnums=(0, 1, 2)))(bp, head_params, x, extras, batch)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(g_b), atol=2e-6)
+    np.testing.assert_allclose(np.asarray(gh), np.asarray(g_h), atol=2e-6)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(g_x), atol=2e-6)
+
+
+def test_1f1b_memory_below_gpipe():
+    """The 1F1B executor's point: peak temp memory < GPipe's AD-transposed
+    schedule at M=8, S=2 (VERDICT r1 #8 'Done =' criterion)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.runtime.pipe.pipeline import make_pipelined_1f1b, pipelined_apply
+
+    S, M, L, D, B, T = 2, 8, 4, 256, 16, 128
+    mesh = create_mesh(MeshSpec(pipe=S), devices=jax.devices()[:S])
+    rng = np.random.default_rng(0)
+    body_params = jnp.asarray(rng.normal(size=(L, D, D)) * 0.1, jnp.float32)
+    head_params = jnp.asarray(rng.normal(size=(D, )) * 0.5, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(B, T, D)), jnp.float32)
+    extras = (jnp.asarray(rng.integers(0, 4, (B, T)), jnp.int32), )
+    batch = {"labels": jnp.asarray(rng.normal(size=(B, T)), jnp.float32)}
+
+    def body_fn(w, h, pos):
+        return jnp.tanh(h @ w) + h * 0.1
+
+    def head_fn(hp, h, mb):
+        pred = jnp.einsum("bsd,d->bs", h, hp)
+        return jnp.mean((pred - mb["labels"])**2)
+
+    bp = jax.device_put(body_params, NamedSharding(mesh, P("pipe")))
+    f1 = make_pipelined_1f1b(body_fn, head_fn, mesh=mesh, num_stages=S, micro_batches=M)
+    m1 = jax.jit(jax.grad(f1, argnums=(0, 1, 2))).lower(
+        bp, head_params, x, extras, batch).compile().memory_analysis()
+
+    def gpipe_loss(bp, hp, x, extras, batch):
+        h = pipelined_apply(body_fn, bp, x, extras, mesh=mesh, num_stages=S, micro_batches=M)
+        return head_fn(hp, h, batch)
+
+    m2 = jax.jit(jax.grad(gpipe_loss, argnums=(0, 1, 2))).lower(
+        bp, head_params, x, extras, batch).compile().memory_analysis()
+    ratio = m1.temp_size_in_bytes / m2.temp_size_in_bytes
+    assert ratio < 0.75, (f"1F1B temp {m1.temp_size_in_bytes} not below GPipe "
+                          f"{m2.temp_size_in_bytes} (ratio {ratio:.2f})")
+
+
+def test_pipeline_engine_llama_1f1b_matches_gpipe():
+    """End-to-end: the 1F1B schedule through PipelineEngine produces the
+    same loss trajectory as the GPipe schedule (same math, different
+    execution order / memory profile)."""
+    from deepspeed_tpu.models.llama import llama_pipeline_layers
+
+    mesh = create_mesh(MeshSpec(pipe=2, data=-1))
+    set_global_mesh(mesh)
+    import copy
+    config = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 4,
+        "steps_per_print": 0,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "pipeline": {"stages": 2},
+    }
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, TINY.vocab_size, size=(16, 16), dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids}
+
+    losses = {}
+    for sched in ("gpipe", "1f1b"):
+        model = PipelineModule(layers=llama_pipeline_layers(TINY), num_stages=2, schedule=sched)
+        engine, _, _, _ = ds.initialize(model=model, config=copy.deepcopy(config), mesh=mesh)
+        losses[sched] = [float(engine.train_batch(batch=batch)) for _ in range(3)]
+    np.testing.assert_allclose(losses["1f1b"], losses["gpipe"], rtol=2e-4)
+    assert losses["1f1b"][-1] < losses["1f1b"][0]
