@@ -52,9 +52,12 @@ def main():
     eng.generate(prompts[:4], max_new_tokens=4)
 
     t_all = time.time()
-    eng.put(list(range(1000, 1000 + n_seqs)), prompts, max_new_tokens=new_tokens)
-    # drive prefill to completion (untimed for the decode metric)
-    while any(s.in_prefill for s in eng.state.seqs.values() if not s.done):
+    uids = list(range(1000, 1000 + n_seqs))
+    eng.put(uids, prompts, max_new_tokens=new_tokens)
+    # drive PROMPT prefill to completion (untimed for the decode metric);
+    # in_prefill is also true for freshly-sampled tokens, so gate on the
+    # prompt length explicitly
+    while any(eng.state.seqs[u].seen_tokens < prompt_len for u in uids):
         eng.step()
     t0 = time.time()
     generated = 0
