@@ -1,0 +1,173 @@
+"""Splash-style Pallas TPU block-sparse attention kernel.
+
+ref: csrc/sparse_attention + deepspeed/ops/sparse_attention/{matmul,softmax}
+(Triton block-sparse SDD/softmax/DSD kernels behind BigBird/Longformer
+configs) — and jax's bundled splash-attention as the TPU design pattern:
+the static layout's active-column table is passed as a SCALAR-PREFETCH
+operand, and the KV BlockSpec ``index_map`` reads it, so the kernel's grid
+only ever touches admitted blocks.  Dense work and DMA traffic scale with
+the number of active blocks L, not nb² — the entire point of block
+sparsity, now without the gather-based jnp path's [B, H, nb, L·block, D]
+materialization.
+
+The kernel is wrapped in a ``jax.custom_vjp`` whose backward recomputes
+through the differentiable jnp path (``sparse_attention``) — training works,
+the forward-pass memory/DMA win is the kernel's contribution.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+            scale, causal, block, L, num_heads):
+    bh = pl.program_id(0)
+    r = pl.program_id(1)
+    l = pl.program_id(2)
+    h = bh % num_heads
+
+    @pl.when(l == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # [block, d]
+        k = k_ref[0].astype(jnp.float32)          # [block, d]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            col = cols_ref[h, r, l]
+            qpos = r * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+            kpos = col * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+            s = jnp.where(qpos >= kpos, s, DEFAULT_MASK_VALUE)
+        m_prev = m_scr[:]
+        l_prev = l_scr[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        if causal:
+            # rows whose every admitted key is causally masked: s == MASK
+            # everywhere → p would be exp(0) = 1; zero them so the finalize
+            # emits zeros like the jnp golden
+            p = jnp.where(s > DEFAULT_MASK_VALUE * 0.5, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1, ), (0, )), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    # padded layout slots are skipped entirely (no DMA cost is saved for the
+    # already-mapped block, but no FLOPs/accumulation happen)
+    pl.when(valid_ref[h, r, l] != 0)(_compute)
+
+    @pl.when(l == L - 1)
+    def _finalize():
+        # fully-masked rows (no admitted keys) emit zeros, matching the jnp
+        # path's nan-free contract
+        safe_l = jnp.maximum(l_scr[:], 1e-30)
+        out = acc_scr[:] / safe_l
+        o_ref[0] = jnp.where(l_scr[:] > 0, out, 0.0).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _pallas_vjp(layout_key, block, causal, scale, interpret, q, k, v):
+    H = len(layout_key)
+    layout = np.asarray(layout_key, np.int64).reshape(H, -1)
+    nb = int(np.sqrt(layout.shape[1]))
+    return _fwd_impl(q, k, v, layout.reshape(H, nb, nb), block, causal, scale, interpret)
+
+
+def _pallas_vjp_fwd(layout_key, block, causal, scale, interpret, q, k, v):
+    return _pallas_vjp(layout_key, block, causal, scale, interpret, q, k, v), (q, k, v)
+
+
+def _pallas_vjp_bwd(layout_key, block, causal, scale, interpret, res, g):
+    # backward recomputes through the differentiable jnp golden
+    from .sparse_self_attention import sparse_attention
+    H = len(layout_key)
+    layout = np.asarray(layout_key, np.int64).reshape(H, -1)
+    nb = int(np.sqrt(layout.shape[1]))
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: sparse_attention(q_, k_, v_, layout.reshape(H, nb, nb), block,
+                                            causal=causal, scale=scale), q, k, v)
+    return vjp(g)
+
+
+_pallas_vjp.defvjp(_pallas_vjp_fwd, _pallas_vjp_bwd)
+
+
+def sparse_attention_pallas(q, k, v, layout, block: int, causal: bool = False,
+                            scale: Optional[float] = None,
+                            interpret: Optional[bool] = None):
+    """Block-sparse attention over [B, H, S, D] with a static [H, nb, nb]
+    layout — same contract as ``sparse_self_attention.sparse_attention``
+    (key_padding_mask unsupported; use the jnp path for that).  Forward runs
+    the splash kernel; backward recomputes through the jnp golden."""
+    layout = np.asarray(layout, np.int64)
+    layout_key = tuple(map(tuple, layout.reshape(layout.shape[0], -1).tolist()))
+    return _pallas_vjp(layout_key, block, causal, scale, interpret, q, k, v)
+
+
+def _fwd_impl(q, k, v, layout: np.ndarray, block: int, causal: bool = False,
+              scale: Optional[float] = None,
+              interpret: Optional[bool] = None):
+    from .sparse_self_attention import _row_gather_maps
+
+    B, H, S, D = q.shape
+    nb = S // block
+    assert layout.shape == (H, nb, nb), f"layout {layout.shape} != {(H, nb, nb)}"
+    cols, valid = _row_gather_maps(layout)
+    L = cols.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(D)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    cols_j = jnp.asarray(cols.reshape(H, nb, L), jnp.int32)
+    valid_j = jnp.asarray(valid.reshape(H, nb, L), jnp.int32)
+
+    kernel = functools.partial(_kernel, scale=scale, causal=causal, block=block, L=L,
+                               num_heads=H)
+    num_heads_static = H  # read by the index_map lambdas below
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * H, nb, L),
+        in_specs=[
+            pl.BlockSpec((1, block, D), lambda bh, r, l, cols, valid: (bh, r, 0)),
+            # the kv block index comes from the layout's active-column table
+            pl.BlockSpec((1, block, D),
+                         lambda bh, r, l, cols, valid: (bh, cols[bh % num_heads_static, r, l], 0)),
+            pl.BlockSpec((1, block, D),
+                         lambda bh, r, l, cols, valid: (bh, cols[bh % num_heads_static, r, l], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block, D), lambda bh, r, l, cols, valid: (bh, r, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block, 1), jnp.float32),
+            pltpu.VMEM((block, 1), jnp.float32),
+            pltpu.VMEM((block, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(cols_j, valid_j, qf, kf, vf)
+    return out.reshape(B, H, S, D)

@@ -108,7 +108,11 @@ class SparseSelfAttention:
     layout cache + functional apply)."""
 
     def __init__(self, sparsity_config: SparsityConfig, key_padding_mask_mode="add",
-                 attn_mask_mode="mul"):
+                 attn_mask_mode="mul", impl: str = "jnp"):
+        # impl: "jnp" (differentiable golden, supports key_padding_mask) or
+        # "pallas" (splash-style TPU kernel, fwd-only, no padding mask)
+        assert impl in ("jnp", "pallas"), impl
+        self.impl = impl
         self.sparsity_config = sparsity_config
         self._layouts = {}
 
@@ -122,5 +126,13 @@ class SparseSelfAttention:
         layout = self.get_layout(S)
         causal = (self.sparsity_config.attention == "unidirectional") \
             if causal is None and hasattr(self.sparsity_config, "attention") else bool(causal)
+        import jax as _jax
+        if self.impl == "pallas" and key_padding_mask is None \
+                and _jax.devices()[0].platform == "tpu":
+            # off-TPU the kernel would run the per-grid-step Python
+            # interpreter — orders of magnitude slower than the jnp path
+            from .pallas_kernel import sparse_attention_pallas
+            return sparse_attention_pallas(query, key, value, layout,
+                                           self.sparsity_config.block, causal=causal)
         return sparse_attention(query, key, value, layout, self.sparsity_config.block,
                                 causal=causal, key_padding_mask=key_padding_mask)
