@@ -120,6 +120,18 @@ def _skip_constraint(x) -> bool:
     return not isinstance(x, jax.core.Tracer) or _in_manual_mesh()
 
 
+def _resolve_remat_policy(name: str):
+    """jax.checkpoint_policies lookup plus 'flash_saveable': projection
+    dots AND the flash kernel's tagged outputs (out + lse) are saved, so
+    the backward runs the dedicated dq/dkv kernels against saved residuals
+    instead of re-running the forward kernel first."""
+    if name == "flash_saveable":
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names("flash_out", "flash_lse"))
+    return getattr(jax.checkpoint_policies, name, None)
+
+
 def activation_constraint(x):
     """Pin a [B, S, E] activation to the canonical (data×expert, seq, -)
     layout.  Without this, sharding propagation lets the embedding lookup
@@ -398,7 +410,7 @@ class ScannedBlocks(nn.Module):
         cfg = self.cfg
         block_cls = LlamaBlock
         if cfg.remat:
-            policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+            policy = _resolve_remat_policy(cfg.remat_policy)
             block_cls = nn.remat(LlamaBlock, policy=policy, prevent_cse=not cfg.scan_layers)
         if cfg.scan_layers:
             blocks = nn.scan(block_cls,

@@ -300,6 +300,14 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret, emit_lse=True):
     vt = _to_bhsd(v, b, h, sk, d)
     out, lse = _flash_fwd(qt, kt, vt, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
                           emit_lse=emit_lse)
+    if emit_lse:
+        # named so remat policies can SAVE the kernel outputs (see
+        # models/llama._resolve_remat_policy 'flash_saveable'): without
+        # this, per-block jax.checkpoint re-runs the forward kernel in the
+        # backward before the dq/dkv kernels — three attention passes
+        from jax.ad_checkpoint import checkpoint_name
+        out = checkpoint_name(out, "flash_out")
+        lse = checkpoint_name(lse, "flash_lse")
     res = (qt, kt, vt, out, lse, (b, sq, sk, h, hk, d))
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3), res
 
