@@ -68,7 +68,31 @@ def main():
     decode_tps = generated / dt
     total_tps = (generated + n_seqs * prompt_len) / wall  # incl. prefill work
 
-    print(json.dumps({
+    # ---- prefix-cache phase: shared system prompt served cold vs warm ----
+    # (ref: inference/v2/ragged/prefix_cache_manager.py — FastGen's prompt
+    # KV reuse).  Same prompts re-admitted after a flush hit the cached
+    # prefix pages, skipping all full-page prefill chunks.
+    shared = list(rng.integers(1, 32000, prompt_len))
+    sp_prompts = [shared + [int(x)] for x in rng.integers(1, 32000, 8)]
+
+    def run_shared(uids):
+        eng.put(uids, sp_prompts, max_new_tokens=8)
+        steps = 0
+        while any(not eng.state.seqs[u].done for u in uids):
+            eng.step()
+            steps += 1
+        t = time.time()
+        for u in uids:
+            eng.flush(u)
+        return steps, time.time() - t
+
+    cold_steps, _ = run_shared(list(range(5000, 5008)))
+    warm_t0 = time.time()
+    warm_steps, _ = run_shared(list(range(6000, 6008)))
+    warm_s = time.time() - warm_t0
+    pc = eng.kv.prefix_cache
+
+    result = {
         "metric": "decode_tokens_per_sec",
         "value": round(decode_tps, 1),
         "unit": "tokens/s",
@@ -79,8 +103,20 @@ def main():
             "new_tokens": new_tokens,
             "decode_s": round(dt, 3), "wall_s": round(wall, 3),
             "n_devices": jax.device_count(),
+            "prefix_cache": {
+                "cold_steps": cold_steps,
+                "warm_steps": warm_steps,
+                "warm_s": round(warm_s, 3),
+                "hits": pc.hits if pc else 0,
+                "cached_pages": pc.cached_pages if pc else 0,
+            },
         },
-    }))
+    }
+    print(json.dumps(result))
+    # driver-visible artifact so serving perf is tracked round-over-round
+    # alongside BENCH_r{N}.json (VERDICT r2 weakness 6)
+    with open("BENCH_SERVING.json", "w") as f:
+        json.dump(result, f, indent=1)
 
 
 if __name__ == "__main__":

@@ -74,6 +74,9 @@ class RaggedInferenceEngineConfig:
     greedy: bool = True
     temperature: float = 1.0
     kv_dtype: object = jnp.bfloat16
+    # KV page reuse across shared prompt prefixes
+    # (ref: inference/v2/ragged/prefix_cache_manager.py)
+    enable_prefix_cache: bool = True
 
 
 class InferenceEngineV2:
@@ -94,7 +97,8 @@ class InferenceEngineV2:
         else:
             self._qparams = None
             self.params = params
-        self.kv = BlockedKVCache(kvcfg.num_pages, kvcfg.page_size, kvcfg.max_pages_per_seq)
+        self.kv = BlockedKVCache(kvcfg.num_pages, kvcfg.page_size, kvcfg.max_pages_per_seq,
+                                 enable_prefix_cache=self.econfig.enable_prefix_cache)
         self.state = StateManager(self.kv, max_batch=self.econfig.scheduler.max_seqs)
         self.scheduler = SplitFuseScheduler(self.econfig.scheduler)
         self.cache = init_kv_cache(cfg, kvcfg, dtype=self.econfig.kv_dtype)
@@ -182,6 +186,7 @@ class InferenceEngineV2:
             seq = self.state.seqs[uid]
             n = int(rb.chunk_lens[i])
             seq.seen_tokens += n
+            self.state.note_progress(seq)
             if seq.in_prefill:
                 continue  # mid-prompt chunk: logits not used
             tok = int(next_tok[i])

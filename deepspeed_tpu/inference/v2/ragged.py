@@ -15,20 +15,25 @@ Python suffices on the host side while the device side stays compiled.
 """
 
 import dataclasses
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 
 class BlockedAllocator:
-    """Free-list allocator over KV pages (ref: blocked_allocator.py).
-    Page 0 is reserved as the null page that unused block-table slots
-    reference."""
+    """Refcounted free-list allocator over KV pages (ref:
+    blocked_allocator.py).  Page 0 is reserved as the null page that unused
+    block-table slots reference.  Refcounts exist for prefix caching: a full
+    page can be referenced by several sequences plus the
+    :class:`PrefixCacheManager`; it returns to the free list only when the
+    last reference drops."""
 
     def __init__(self, num_pages: int):
         assert num_pages >= 2
         self.num_pages = num_pages
         self._free: List[int] = list(range(1, num_pages))
+        self._rc = np.zeros(num_pages, np.int32)
 
     @property
     def free_pages(self) -> int:
@@ -38,12 +43,23 @@ class BlockedAllocator:
         if n > len(self._free):
             raise RuntimeError(f"KV cache exhausted: need {n} pages, have {len(self._free)}")
         pages, self._free = self._free[:n], self._free[n:]
+        self._rc[pages] = 1
         return pages
+
+    def retain(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            assert self._rc[p] > 0, f"retain of unallocated page {p}"
+            self._rc[p] += 1
+
+    def refcount(self, page: int) -> int:
+        return int(self._rc[page])
 
     def free(self, pages: Sequence[int]) -> None:
         for p in pages:
-            assert 0 < p < self.num_pages
-        self._free.extend(pages)
+            assert 0 < p < self.num_pages and self._rc[p] > 0
+            self._rc[p] -= 1
+            if self._rc[p] == 0:
+                self._free.append(p)
 
 
 @dataclasses.dataclass
@@ -55,6 +71,12 @@ class SequenceDescriptor:
     seen_tokens: int = 0                   # tokens whose KV is in cache
     generated: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    # prefix-cache cursor: pages [0, pc_pages) are already published (or came
+    # from the cache); pc_hash is the running chain hash at that boundary, so
+    # each register() call hashes only NEW full pages (O(1) amortized per
+    # token instead of rehashing the whole history every step)
+    pc_pages: int = 0
+    pc_hash: int = 0
 
     @property
     def remaining_prefill(self) -> int:
@@ -71,15 +93,111 @@ class SequenceDescriptor:
         return bool(self.generated) and self.remaining_prefill <= 1
 
 
+class PrefixCacheManager:
+    """KV-page reuse across sequences sharing a token prefix
+    (ref: inference/v2/ragged/prefix_cache_manager.py:13).
+
+    Full, token-aligned pages are content-addressed by a *chain hash* over
+    the whole token history they terminate — page k of a sequence is keyed
+    by H_k = hash(H_{k-1}, tokens[k·P:(k+1)·P]) — so a hit on H_k
+    transitively guarantees every earlier token matches too.  Matched pages
+    are attached to the new sequence read-only (full pages are immutable:
+    KV writes only ever land in the trailing partial page) and the prefill
+    skips straight past them.  The cache holds one refcount on every
+    registered page, so pages survive their creator's release and are
+    evicted LRU only under allocator pressure."""
+
+    _SEED = 0x9E3779B9
+
+    def __init__(self, allocator: "BlockedAllocator", page_size: int):
+        self.allocator = allocator
+        self.page_size = page_size
+        self._pages: Dict[int, int] = {}          # chain hash → page id
+        self._lru: "OrderedDict[int, None]" = OrderedDict()  # chain hash, oldest first
+        self.hits = 0
+        self.misses = 0
+
+    def _chain(self, tokens: Sequence[int]):
+        """Yield (chain_hash, page_index) for each FULL page of ``tokens``."""
+        h = self._SEED
+        for i in range(len(tokens) // self.page_size):
+            h = hash((h, tuple(tokens[i * self.page_size:(i + 1) * self.page_size])))
+            yield h, i
+
+    def match(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest run of cached pages covering a prefix of ``tokens``,
+        plus the chain hash at the match boundary (the caller seeds the
+        sequence's register() cursor with it).  Caps at len(tokens)-1 so
+        the engine still computes at least one prompt token (the last
+        one's logits seed generation).  Returned pages are retained on
+        behalf of the caller."""
+        matched: List[int] = []
+        h_end = self._SEED
+        usable = len(tokens) - 1
+        for h, i in self._chain(tokens):
+            if (i + 1) * self.page_size > usable:
+                break
+            page = self._pages.get(h)
+            if page is None:
+                break
+            matched.append(page)
+            h_end = h
+            self._lru.move_to_end(h)
+        if matched:
+            self.allocator.retain(matched)
+            self.hits += 1
+        elif len(tokens) > self.page_size:
+            self.misses += 1
+        return matched, h_end
+
+    def register(self, seq: "SequenceDescriptor") -> None:
+        """Publish ``seq``'s newly-completed full pages, resuming from the
+        sequence's cursor so each page is hashed exactly once.  A hash
+        already mapped to a different page keeps the existing mapping
+        (dedup would require copying KV — not worth it)."""
+        full = min(seq.seen_tokens // self.page_size, len(seq.pages))
+        h = seq.pc_hash if seq.pc_pages else self._SEED
+        for i in range(seq.pc_pages, full):
+            h = hash((h, tuple(seq.tokens[i * self.page_size:(i + 1) * self.page_size])))
+            if h not in self._pages:
+                self._pages[h] = seq.pages[i]
+                self._lru[h] = None
+                self.allocator.retain([seq.pages[i]])
+        seq.pc_pages = full
+        seq.pc_hash = h if full else seq.pc_hash
+
+    def evict(self, n: int) -> int:
+        """Drop up to ``n`` LRU pages held ONLY by the cache; returns how
+        many were actually freed."""
+        freed = 0
+        for h in list(self._lru):
+            if freed >= n:
+                break
+            page = self._pages[h]
+            if self.allocator.refcount(page) == 1:  # only the cache holds it
+                self.allocator.free([page])
+                del self._pages[h]
+                del self._lru[h]
+                freed += 1
+        return freed
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._pages)
+
+
 class BlockedKVCache:
     """Geometry + allocator pairing (ref: kv_cache.py:40).  The device
     arena itself lives in the engine (a donated jax array)."""
 
-    def __init__(self, num_pages: int, page_size: int, max_pages_per_seq: int):
+    def __init__(self, num_pages: int, page_size: int, max_pages_per_seq: int,
+                 enable_prefix_cache: bool = True):
         self.num_pages = num_pages
         self.page_size = page_size
         self.max_pages_per_seq = max_pages_per_seq
         self.allocator = BlockedAllocator(num_pages)
+        self.prefix_cache = (PrefixCacheManager(self.allocator, page_size)
+                             if enable_prefix_cache else None)
 
     def pages_needed(self, seq: SequenceDescriptor, new_tokens: int) -> int:
         total = len(seq.tokens) if new_tokens == 0 else seq.seen_tokens + new_tokens
@@ -91,6 +209,8 @@ class BlockedKVCache:
         if n:
             if len(seq.pages) + n > self.max_pages_per_seq:
                 raise RuntimeError(f"sequence {seq.uid} exceeds max_pages_per_seq={self.max_pages_per_seq}")
+            if self.prefix_cache is not None and n > self.allocator.free_pages:
+                self.prefix_cache.evict(n - self.allocator.free_pages)
             seq.pages.extend(self.allocator.allocate(n))
 
     def release(self, seq: SequenceDescriptor) -> None:
@@ -123,10 +243,24 @@ class StateManager:
 
     def get_or_create(self, uid: int, tokens: Optional[Sequence[int]] = None) -> SequenceDescriptor:
         if uid not in self.seqs:
-            self.seqs[uid] = SequenceDescriptor(uid=uid, tokens=list(tokens or []))
+            seq = SequenceDescriptor(uid=uid, tokens=list(tokens or []))
+            pc = self.kv.prefix_cache
+            if pc is not None and seq.tokens:
+                # reuse cached KV pages for the shared prompt prefix: the
+                # matched run is attached read-only and prefill starts after it
+                seq.pages, seq.pc_hash = pc.match(seq.tokens)
+                seq.pc_pages = len(seq.pages)
+                seq.seen_tokens = len(seq.pages) * self.kv.page_size
+            self.seqs[uid] = seq
         elif tokens:
             self.seqs[uid].tokens.extend(tokens)
         return self.seqs[uid]
+
+    def note_progress(self, seq: SequenceDescriptor) -> None:
+        """Called after ``seen_tokens`` advances: publish newly-completed
+        full pages to the prefix cache."""
+        if self.kv.prefix_cache is not None:
+            self.kv.prefix_cache.register(seq)
 
     def flush(self, uid: int) -> None:
         """Release a sequence's KV + state (ref: engine_v2.py flush)."""

@@ -24,6 +24,7 @@ copies.
 """
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -102,11 +103,18 @@ def _mask_if_diag(s, tab_ref, t, bq, bk):
     return jnp.where(keep, s, DEFAULT_MASK_VALUE)
 
 
-def _pack_width(d):
-    """Heads per block so the packed minor dim hits the 128-lane tile width
-    (TPU tiling rejects blocks whose minor dim is neither 128-divisible nor
-    the full array dim).  d=64 → 2 heads, d=32 → 4; d≥128 needs no packing."""
-    return max(1, LANE // d) if d < LANE else 1
+def _pack_width(d, h):
+    """Heads per block so the packed minor dim is tile-legal: either a
+    multiple of the 128-lane width (d=64 → 2 heads, d=32 → 4) or — when no
+    divisor of ``h`` gets there (e.g. tiny test models with h·d < 128) —
+    ALL heads, since a block equal to the full array minor dim is always
+    accepted by the tiling rules."""
+    if d % LANE == 0:
+        return 1
+    for p in range(1, h):
+        if h % p == 0 and (p * d) % LANE == 0:
+            return p
+    return h
 
 
 def _fwd2_kernel(tab_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale, bq, bk, P, d):
@@ -157,9 +165,11 @@ def _flash_fwd2(q, k, v, *, h, causal, block_q, block_k, interpret, emit_lse=Tru
     b, sq, hd = q.shape
     _, sk, _ = k.shape
     d = hd // h
-    P = _pack_width(d)
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
+    P = _pack_width(d, h)
+    # clamp to a divisor: gcd keeps blocks maximal for seq lens that are
+    # 128-multiples but not block-multiples (e.g. sq=768 with block 512 → 256)
+    bq = math.gcd(min(block_q, sq), sq)
+    bk = math.gcd(min(block_k, sk), sk)
     assert sq % bq == 0 and sk % bk == 0, (sq, sk, bq, bk)
     assert h % P == 0, (h, P)
     nq, nk = sq // bq, sk // bk
@@ -269,9 +279,11 @@ def _flash_bwd2(q, k, v, o, lse, do, *, h, causal, block_q, block_k, interpret):
     b, sq, hd = q.shape
     _, sk, _ = k.shape
     d = hd // h
-    P = _pack_width(d)
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
+    P = _pack_width(d, h)
+    # clamp to a divisor: gcd keeps blocks maximal for seq lens that are
+    # 128-multiples but not block-multiples (e.g. sq=768 with block 512 → 256)
+    bq = math.gcd(min(block_q, sq), sq)
+    bk = math.gcd(min(block_k, sk), sk)
     nq, nk = sq // bq, sk // bk
     scale = 1.0 / (d**0.5)
 
@@ -323,10 +335,6 @@ def _flash_bwd2(q, k, v, o, lse, do, *, h, causal, block_q, block_k, interpret):
         interpret=interpret,
     )(tab_c, q, k, v, o, do, lse)
     return dq, dk, dv
-
-
-def _to_bhsd(x, b, h, s, d):
-    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -403,7 +411,10 @@ def flash_attention(q,
     the vjp).  ``segment_ids``/``sliding_window`` fall back to the chunked
     jnp path (packed-sequence masking in-kernel is a follow-up).
     """
-    if segment_ids is not None or (sliding_window and sliding_window > 0):
+    if (segment_ids is not None or (sliding_window and sliding_window > 0)
+            or q.shape[1] % LANE != 0 or k.shape[1] % LANE != 0):
+        # packed-sequence masking in-kernel is a follow-up; ragged lengths
+        # would force sub-128 blocks that violate TPU tiling
         from ..models.llama import chunked_attention
         return chunked_attention(q, k, v, causal=causal, segment_ids=segment_ids,
                                  sliding_window=sliding_window)

@@ -100,7 +100,16 @@ def test_kv_pages_released_on_flush(trained_params):
     eng = _engine(trained_params)
     free0 = eng.kv.allocator.free_pages
     eng.generate([[5, 9, 2, 7, 1]], max_new_tokens=4)
-    assert eng.kv.allocator.free_pages == free0
+    # every page is either back on the free list or retained (refcount 1)
+    # by the prefix cache for future prefix hits — none is leaked to a
+    # flushed sequence
+    cached = eng.kv.prefix_cache.cached_pages
+    assert eng.kv.allocator.free_pages + cached == free0
+    # with the cache off, flush returns everything to the free list
+    eng2 = _engine(trained_params, enable_prefix_cache=False)
+    free0 = eng2.kv.allocator.free_pages
+    eng2.generate([[5, 9, 2, 7, 1]], max_new_tokens=4)
+    assert eng2.kv.allocator.free_pages == free0
 
 
 def _save_tiny_hf(tmp_path, kind):
@@ -205,3 +214,84 @@ def test_v1_kernel_inject_and_dtype(trained_params):
     leaf = jax.tree.leaves(eng.params)[0]
     assert leaf.dtype == jnp.bfloat16
     assert np.isfinite(np.asarray(logits, np.float32)).all()
+
+
+# ------------------------------------------------------------------ prefix cache
+
+
+def test_prefix_cache_shares_pages_and_matches_reference(trained_params):
+    """Shared system prompt: the second+ sequences reuse the first's full
+    prefix pages (one physical set) and still decode greedily identical to
+    the cache-free model (ref: prefix_cache_manager.py)."""
+    eng = _engine(trained_params)
+    prefix = list(range(1, 25))          # 24 tokens = 3 full pages @ page_size 8
+    prompts = [prefix + [30 + i] for i in range(4)]
+
+    outs = []
+    for i, p in enumerate(prompts):
+        eng.put([100 + i], [p], max_new_tokens=4)
+        while 100 + i in eng.state.seqs and not eng.state.seqs[100 + i].done:
+            eng.step()
+        outs.append(list(eng.state.seqs[100 + i].generated))
+
+    pc = eng.kv.prefix_cache
+    assert pc is not None and pc.hits >= 3, (pc.hits, pc.misses)
+    # all four sequences share the SAME 3 physical prefix pages
+    first_pages = eng.state.seqs[100].pages[:3]
+    for i in range(1, 4):
+        assert eng.state.seqs[100 + i].pages[:3] == first_pages
+        assert eng.state.seqs[100 + i].seen_tokens >= 24
+    # and the outputs match the cache-free golden decode
+    for p, got in zip(prompts, outs):
+        assert got == _reference_greedy(trained_params, p, 4), (p, got)
+
+
+def test_prefix_cache_page_accounting(trained_params):
+    """A shared-prefix batch allocates ~one set of prefix pages: 4 sequences
+    with a 3-page common prefix use 3 shared + 4 private tails, not 4x4."""
+    eng = _engine(trained_params)
+    alloc = eng.kv.allocator
+    base_free = alloc.free_pages
+    prefix = list(range(1, 25))
+    for i in range(4):
+        eng.put([200 + i], [prefix + [40 + i]], max_new_tokens=2)
+        while not eng.state.seqs[200 + i].done:
+            eng.step()
+    in_use = base_free - alloc.free_pages
+    # 3 prefix pages + <=2 tail pages per seq (25th token + 2 generated)
+    assert in_use <= 3 + 4 * 2, in_use
+    # releasing the sequences keeps the cached pages alive for future hits
+    cached_before = eng.kv.prefix_cache.cached_pages
+    for i in range(4):
+        eng.flush(200 + i)
+    assert eng.kv.prefix_cache.cached_pages == cached_before
+    eng.put([299], [prefix + [99]], max_new_tokens=2)
+    assert eng.state.seqs[299].seen_tokens >= 24  # hit after creators released
+
+
+def test_prefix_cache_eviction_under_pressure(trained_params):
+    """Allocator pressure evicts LRU cache-only pages instead of raising."""
+    kv = PagedKVConfig(num_pages=12, page_size=8, max_pages_per_seq=8)
+    sched = SchedulerConfig(token_budget=64, max_seqs=4, prefill_chunk=8, decode_bucket=4)
+    eng = build_engine(CFG, trained_params,
+                       RaggedInferenceEngineConfig(kv=kv, scheduler=sched, kv_dtype=jnp.float32))
+    # fill the cache with a 3-page prefix, then release
+    eng.put([1], [list(range(1, 26))], max_new_tokens=2)
+    while not eng.state.seqs[1].done:
+        eng.step()
+    eng.flush(1)
+    assert eng.kv.prefix_cache.cached_pages >= 3
+    # a DIFFERENT long prompt needs more pages than remain free → eviction
+    eng.put([2], [list(range(50, 75))], max_new_tokens=2)
+    while not eng.state.seqs[2].done:
+        eng.step()
+    assert eng.state.seqs[2].generated == _reference_greedy(trained_params, list(range(50, 75)), 2)
+
+
+def test_prefix_cache_disabled(trained_params):
+    eng = _engine(trained_params, enable_prefix_cache=False)
+    assert eng.kv.prefix_cache is None
+    eng.put([1], [list(range(1, 20))], max_new_tokens=2)
+    while not eng.state.seqs[1].done:
+        eng.step()
+    assert eng.state.seqs[1].generated == _reference_greedy(trained_params, list(range(1, 19 + 1)), 2)
