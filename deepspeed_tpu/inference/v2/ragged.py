@@ -112,7 +112,11 @@ class PrefixCacheManager:
     def __init__(self, allocator: "BlockedAllocator", page_size: int):
         self.allocator = allocator
         self.page_size = page_size
-        self._pages: Dict[int, int] = {}          # chain hash → page id
+        # chain hash → (page id, page's token tuple).  The tokens are kept
+        # for verification on match: a 64-bit hash collision would otherwise
+        # silently attach another prompt's KV pages (wrong output + cross-
+        # request prompt leakage); verifying costs O(page_size) per hit.
+        self._pages: Dict[int, Tuple[int, tuple]] = {}
         self._lru: "OrderedDict[int, None]" = OrderedDict()  # chain hash, oldest first
         self.hits = 0
         self.misses = 0
@@ -137,10 +141,10 @@ class PrefixCacheManager:
         for h, i in self._chain(tokens):
             if (i + 1) * self.page_size > usable:
                 break
-            page = self._pages.get(h)
-            if page is None:
+            entry = self._pages.get(h)
+            if entry is None or entry[1] != tuple(tokens[i * self.page_size:(i + 1) * self.page_size]):
                 break
-            matched.append(page)
+            matched.append(entry[0])
             h_end = h
             self._lru.move_to_end(h)
         if matched:
@@ -158,22 +162,28 @@ class PrefixCacheManager:
         full = min(seq.seen_tokens // self.page_size, len(seq.pages))
         h = seq.pc_hash if seq.pc_pages else self._SEED
         for i in range(seq.pc_pages, full):
-            h = hash((h, tuple(seq.tokens[i * self.page_size:(i + 1) * self.page_size])))
+            page_toks = tuple(seq.tokens[i * self.page_size:(i + 1) * self.page_size])
+            h = hash((h, page_toks))
             if h not in self._pages:
-                self._pages[h] = seq.pages[i]
+                self._pages[h] = (seq.pages[i], page_toks)
                 self._lru[h] = None
                 self.allocator.retain([seq.pages[i]])
         seq.pc_pages = full
         seq.pc_hash = h if full else seq.pc_hash
 
     def evict(self, n: int) -> int:
-        """Drop up to ``n`` LRU pages held ONLY by the cache; returns how
-        many were actually freed."""
+        """Drop up to ``n`` cache-only pages, NEWEST chain entries first.
+
+        Leaf-first order matters: chains are registered (and LRU-touched)
+        root→leaf, so oldest-first eviction would free chain ROOTS — one
+        freed root makes every descendant unmatchable (match() walks from
+        page 0) while their pages stay pinned by the cache.  Freeing leaves
+        keeps the surviving prefix useful.  Returns how many were freed."""
         freed = 0
-        for h in list(self._lru):
+        for h in reversed(list(self._lru)):
             if freed >= n:
                 break
-            page = self._pages[h]
+            page = self._pages[h][0]
             if self.allocator.refcount(page) == 1:  # only the cache holds it
                 self.allocator.free([page])
                 del self._pages[h]

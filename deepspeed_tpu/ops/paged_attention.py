@@ -12,11 +12,15 @@ Implementation notes:
     (``PrefetchScalarGridSpec``) so each grid step's page DMA address is
     computed from ``block_table[b, j]`` — the Pallas analog of the
     reference's atom-builder indirection (ragged/csrc/fast_host_buffer.cpp).
-  * grid = (batch, kv_heads, pages); the page dimension is "arbitrary"
-    (sequential) and carries the online-softmax state in VMEM scratch, like
-    ops/flash_attention.py.
+  * grid = (batch, pages); the page dimension is "arbitrary" (sequential)
+    and carries the online-softmax state in VMEM scratch.  Each grid step
+    DMAs one WHOLE page — [page, 2, n_kv, D], whose trailing block dims are
+    the full array dims and therefore always tile-legal — and loops the kv
+    heads in-kernel with per-head scratch.  (A per-head grid with a
+    [page, 1, 1, D] block is rejected by the TPU tiling rules: the
+    second-minor block dim 1 is neither 8-aligned nor the full n_kv dim.)
   * GQA: queries are laid out group-major ([B, n_kv, rep·C, D]) so each
-    kv-head grid step contracts its whole query group against one page.
+    head iteration contracts its whole query group against the page.
   * pages whose first key is beyond the chunk's last visible position are
     skipped (`pl.when`), so decode cost scales with the sequence's true
     length, not max_pages — SplitFuse's "decode is O(context)" property.
@@ -33,45 +37,50 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def _paged_kernel(bt_ref, sp_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  page_size, max_pages, chunk, scale):
+def _paged_kernel(bt_ref, sp_ref, q_ref, pg_ref, o_ref, *scr, page_size, max_pages, chunk,
+                  scale, n_kv):
     b = pl.program_id(0)
-    j = pl.program_id(2)
+    j = pl.program_id(1)
+    ms, ls, accs = scr[:n_kv], scr[n_kv:2 * n_kv], scr[2 * n_kv:]
 
     @pl.when(j == 0)
     def _init():
-        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+        for hh in range(n_kv):
+            ms[hh][:] = jnp.full_like(ms[hh], -jnp.inf)
+            ls[hh][:] = jnp.zeros_like(ls[hh])
+            accs[hh][:] = jnp.zeros_like(accs[hh])
 
     start = sp_ref[b]
     # last visible key position of this chunk is start + chunk - 1
     @pl.when(j * page_size <= start + chunk - 1)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)            # [repC, D]
-        k = k_ref[0, :, 0, 0].astype(jnp.float32)      # [page, D]
-        v = v_ref[0, :, 0, 0].astype(jnp.float32)      # [page, D]
-        rep_c = q.shape[0]
-        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
-                                preferred_element_type=jnp.float32) * scale  # [repC, page]
-        # row r of the group-major q block is chunk position r % chunk
-        row_c = jax.lax.broadcasted_iota(jnp.int32, (rep_c, page_size), 0) % chunk
-        kpos = j * page_size + jax.lax.broadcasted_iota(jnp.int32, (rep_c, page_size), 1)
-        s = jnp.where(kpos <= start + row_c, s, DEFAULT_MASK_VALUE)
-        m_prev = m_scr[:]
-        l_prev = l_scr[:]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_scr[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1, ), (0, )), ((), ())), preferred_element_type=jnp.float32)
-        m_scr[:] = m_new
+        for hh in range(n_kv):
+            # bf16 operands straight into the MXU, f32 accumulation
+            q = q_ref[0, hh]             # [repC, D]
+            k = pg_ref[0, :, 0, hh]      # [page, D]
+            v = pg_ref[0, :, 1, hh]      # [page, D]
+            rep_c = q.shape[0]
+            s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale  # [repC, page]
+            # row r of the group-major q block is chunk position r % chunk
+            row_c = jax.lax.broadcasted_iota(jnp.int32, (rep_c, page_size), 0) % chunk
+            kpos = j * page_size + jax.lax.broadcasted_iota(jnp.int32, (rep_c, page_size), 1)
+            s = jnp.where(kpos <= start + row_c, s, DEFAULT_MASK_VALUE)
+            m_prev = ms[hh][:]
+            l_prev = ls[hh][:]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            ls[hh][:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            accs[hh][:] = accs[hh][:] * alpha + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1, ), (0, )), ((), ())),
+                preferred_element_type=jnp.float32)
+            ms[hh][:] = m_new
 
     @pl.when(j == max_pages - 1)
     def _finalize():
-        o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+        for hh in range(n_kv):
+            o_ref[0, hh] = (accs[hh][:] / jnp.maximum(ls[hh][:], 1e-30)).astype(o_ref.dtype)
 
 
 def paged_attention_pallas(q, pages, block_table, start_pos, chunk_lens, page_size,
@@ -92,31 +101,32 @@ def paged_attention_pallas(q, pages, block_table, start_pos, chunk_lens, page_si
     # group-major query layout: [B, n_kv, rep*C, D], row = r*C + c
     qg = q.transpose(0, 2, 1, 3).reshape(b, n_kv, rep, c, d).reshape(b, n_kv, rep * c, d)
 
-    grid = (b, n_kv, max_pages)
+    grid = (b, max_pages)
     kernel = functools.partial(_paged_kernel, page_size=page_size, max_pages=max_pages,
-                               chunk=c, scale=scale)
+                               chunk=c, scale=scale, n_kv=n_kv)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, 1, rep * c, d), lambda b, h, j, bt, sp: (b, h, 0, 0)),
-                pl.BlockSpec((1, page_size, 1, 1, d), lambda b, h, j, bt, sp: (bt[b, j], 0, 0, h, 0)),
-                pl.BlockSpec((1, page_size, 1, 1, d), lambda b, h, j, bt, sp: (bt[b, j], 0, 1, h, 0)),
+                # q stays resident across the page sweep (index map constant in j)
+                pl.BlockSpec((1, n_kv, rep * c, d), lambda b, j, bt, sp: (b, 0, 0, 0)),
+                # one whole page: trailing dims (page, 2, n_kv, d) are the full
+                # array dims → always tile-legal
+                pl.BlockSpec((1, page_size, 2, n_kv, d),
+                             lambda b, j, bt, sp: (bt[b, j], 0, 0, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, 1, rep * c, d), lambda b, h, j, bt, sp: (b, h, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((rep * c, 1), jnp.float32),
-                pltpu.VMEM((rep * c, 1), jnp.float32),
-                pltpu.VMEM((rep * c, d), jnp.float32),
-            ],
+            out_specs=pl.BlockSpec((1, n_kv, rep * c, d), lambda b, j, bt, sp: (b, 0, 0, 0)),
+            scratch_shapes=([pltpu.VMEM((rep * c, 1), jnp.float32)] * n_kv +
+                            [pltpu.VMEM((rep * c, 1), jnp.float32)] * n_kv +
+                            [pltpu.VMEM((rep * c, d), jnp.float32)] * n_kv),
         ),
         out_shape=jax.ShapeDtypeStruct((b, n_kv, rep * c, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(block_table, start_pos, qg, pages, pages)
+    )(block_table, start_pos, qg, pages)
 
     out = out.reshape(b, n_kv, rep, c, d).reshape(b, h, c, d).transpose(0, 2, 1, 3)
     if chunk_lens is not None:

@@ -10,9 +10,14 @@ the number of active blocks L, not nb² — the entire point of block
 sparsity, now without the gather-based jnp path's [B, H, nb, L·block, D]
 materialization.
 
-The kernel is wrapped in a ``jax.custom_vjp`` whose backward recomputes
-through the differentiable jnp path (``sparse_attention``) — training works,
-the forward-pass memory/DMA win is the kernel's contribution.
+The backward is the same design run twice (mirroring the FA2 split in
+ops/flash_attention.py): a dq kernel sweeping each q row's admitted kv
+blocks via the row-major table, and a dk/dv kernel sweeping each kv
+column's admitted q blocks via the transposed table, both recomputing
+p = exp(s - lse) per admitted tile from the lse the forward saved (O(S)
+residuals).  No [S, S]-scale intermediate is ever materialized in either
+direction, and grads touch only admitted blocks — the previous VJP re-ran
+the jnp golden, gathering [B, H, nb, L·block, D] tensors.
 """
 
 import functools
@@ -27,8 +32,10 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def _kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-            scale, causal, block, L, num_heads):
+def _kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, block, L,
+            num_heads):
+    lse_ref = rest[0] if len(rest) == 4 else None
+    m_scr, l_scr, acc_scr = rest[-3:]
     bh = pl.program_id(0)
     r = pl.program_id(1)
     l = pl.program_id(2)
@@ -41,9 +48,11 @@ def _kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_s
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)          # [block, d]
-        k = k_ref[0].astype(jnp.float32)          # [block, d]
-        v = v_ref[0].astype(jnp.float32)
+        # bf16 operands straight into the MXU with f32 accumulation (casting
+        # to f32 first runs the dots at ~1/8 MXU rate)
+        q = q_ref[0]          # [block, d]
+        k = k_ref[0]          # [block, d]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -64,7 +73,7 @@ def _kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_s
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1, ), (0, )), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1, ), (0, )), ((), ())), preferred_element_type=jnp.float32)
         m_scr[:] = m_new
         l_scr[:] = l_new
 
@@ -79,31 +88,137 @@ def _kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_s
         safe_l = jnp.maximum(l_scr[:], 1e-30)
         out = acc_scr[:] / safe_l
         o_ref[0] = jnp.where(l_scr[:] > 0, out, 0.0).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # empty rows store +BIG so the backward's exp(s - lse) underflows
+            # to exactly 0 for every (masked) score
+            lse = jnp.where(l_scr[:] > 0, m_scr[:] + jnp.log(safe_l),
+                            jnp.float32(3e38))
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
+
+
+def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *, scale, causal, block,
+              r_idx, c_idx):
+    """Shared backward tile math for one admitted (q-row, kv-col) block pair:
+    returns (pr, ds) — both in the storage dtype, MXU-ready.  delta (the
+    per-row rowsum(do·o)) arrives precomputed — one fused jnp pass instead
+    of a per-tile [block, D] multiply-reduce, and o drops out of the
+    kernels' inputs entirely."""
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0][:, :1]
+    delta = delta_ref[0][:, :1]
+    s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = r_idx * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+        kpos = c_idx * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+        s = jnp.where(qpos >= kpos, s, DEFAULT_MASK_VALUE)
+    pr = jnp.exp(s - lse)                 # masked/empty entries underflow to 0
+    dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = pr * (dp - delta) * scale
+    return pr.astype(v.dtype), ds.astype(v.dtype)
+
+
+def _dq_kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale, causal, block, L, num_heads):
+    bh = pl.program_id(0)
+    r = pl.program_id(1)
+    l = pl.program_id(2)
+    h = bh % num_heads
+
+    @pl.when(l == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        _, ds = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, scale=scale,
+                          causal=causal, block=block, r_idx=r, c_idx=cols_ref[h, r, l])
+        dq_scr[:] += jax.lax.dot_general(ds, k_ref[0], (((1, ), (0, )), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    pl.when(valid_ref[h, r, l] != 0)(_compute)
+
+    @pl.when(l == L - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(rows_ref, valid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, dk_scr, dv_scr, *, scale, causal, block, L, num_heads):
+    bh = pl.program_id(0)
+    c = pl.program_id(1)
+    l = pl.program_id(2)
+    h = bh % num_heads
+
+    @pl.when(l == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        pr, ds = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, scale=scale,
+                           causal=causal, block=block, r_idx=rows_ref[h, c, l], c_idx=c)
+        dv_scr[:] += jax.lax.dot_general(pr, do_ref[0], (((0, ), (0, )), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dk_scr[:] += jax.lax.dot_general(ds, q_ref[0], (((0, ), (0, )), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    pl.when(valid_ref[h, c, l] != 0)(_compute)
+
+    @pl.when(l == L - 1)
+    def _finalize():
+        # columns no row attends to emit zero grads
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _col_gather_maps(layout: np.ndarray):
+    """layout [H, nb, nb] → rows [H, nb, Lt] int32 (active ROW-block ids per
+    kv column, padded with 0), valid [H, nb, Lt] bool — the transposed twin
+    of ``_row_gather_maps`` driving the dk/dv kernel's q sweep."""
+    return _row_maps_of(layout.transpose(0, 2, 1))
+
+
+def _row_maps_of(layout):
+    from .sparse_self_attention import _row_gather_maps
+    return _row_gather_maps(layout)
+
+
+LANE = 128  # lse is stored lane-broadcast (TPU tiling: minor dim 128)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
 def _pallas_vjp(layout_key, block, causal, scale, interpret, q, k, v):
+    out, _ = _fwd_impl(q, k, v, _layout_of(layout_key), block, causal, scale, interpret,
+                       emit_lse=False)
+    return out
+
+
+def _layout_of(layout_key):
     H = len(layout_key)
     layout = np.asarray(layout_key, np.int64).reshape(H, -1)
     nb = int(np.sqrt(layout.shape[1]))
-    return _fwd_impl(q, k, v, layout.reshape(H, nb, nb), block, causal, scale, interpret)
+    return layout.reshape(H, nb, nb)
 
 
 def _pallas_vjp_fwd(layout_key, block, causal, scale, interpret, q, k, v):
-    return _pallas_vjp(layout_key, block, causal, scale, interpret, q, k, v), (q, k, v)
+    out, lse = _fwd_impl(q, k, v, _layout_of(layout_key), block, causal, scale, interpret,
+                         emit_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _pallas_vjp_bwd(layout_key, block, causal, scale, interpret, res, g):
-    # backward recomputes through the differentiable jnp golden
-    from .sparse_self_attention import sparse_attention
-    H = len(layout_key)
-    layout = np.asarray(layout_key, np.int64).reshape(H, -1)
-    nb = int(np.sqrt(layout.shape[1]))
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: sparse_attention(q_, k_, v_, layout.reshape(H, nb, nb), block,
-                                            causal=causal, scale=scale), q, k, v)
-    return vjp(g)
+    # dq/dkv Pallas kernels driven by the same scalar-prefetch layout maps
+    # as the forward (row-major sweep for dq, column-major for dk/dv) —
+    # the saved O(S) lse replaces any softmax recompute and no [S, S]-scale
+    # intermediate is ever materialized (the old VJP re-ran the jnp golden,
+    # gathering [B, H, nb, L·block, D] score tensors)
+    q, k, v, out, lse = res
+    return _bwd_impl(q, k, v, out, lse, g, _layout_of(layout_key), block, causal, scale,
+                     interpret)
 
 
 _pallas_vjp.defvjp(_pallas_vjp_fwd, _pallas_vjp_bwd)
@@ -114,26 +229,30 @@ def sparse_attention_pallas(q, k, v, layout, block: int, causal: bool = False,
                             interpret: Optional[bool] = None):
     """Block-sparse attention over [B, H, S, D] with a static [H, nb, nb]
     layout — same contract as ``sparse_self_attention.sparse_attention``
-    (key_padding_mask unsupported; use the jnp path for that).  Forward runs
-    the splash kernel; backward recomputes through the jnp golden."""
+    (key_padding_mask unsupported; use the jnp path for that).  Forward and
+    backward both run splash-style kernels; training touches only admitted
+    blocks end to end."""
     layout = np.asarray(layout, np.int64)
     layout_key = tuple(map(tuple, layout.reshape(layout.shape[0], -1).tolist()))
     return _pallas_vjp(layout_key, block, causal, scale, interpret, q, k, v)
 
 
-def _fwd_impl(q, k, v, layout: np.ndarray, block: int, causal: bool = False,
-              scale: Optional[float] = None,
-              interpret: Optional[bool] = None):
-    from .sparse_self_attention import _row_gather_maps
-
+def _prep(q, layout, block, scale, interpret):
     B, H, S, D = q.shape
     nb = S // block
     assert layout.shape == (H, nb, nb), f"layout {layout.shape} != {(H, nb, nb)}"
-    cols, valid = _row_gather_maps(layout)
-    L = cols.shape[-1]
     scale = scale if scale is not None else 1.0 / np.sqrt(D)
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+    return B, H, S, D, nb, scale, interpret
+
+
+def _fwd_impl(q, k, v, layout: np.ndarray, block: int, causal: bool = False,
+              scale: Optional[float] = None, interpret: Optional[bool] = None,
+              emit_lse: bool = False):
+    B, H, S, D, nb, scale, interpret = _prep(q, layout, block, scale, interpret)
+    cols, valid = _row_maps_of(layout)
+    L = cols.shape[-1]
 
     qf = q.reshape(B * H, S, D)
     kf = k.reshape(B * H, S, D)
@@ -155,7 +274,9 @@ def _fwd_impl(q, k, v, layout: np.ndarray, block: int, causal: bool = False,
             pl.BlockSpec((1, block, D),
                          lambda bh, r, l, cols, valid: (bh, cols[bh % num_heads_static, r, l], 0)),
         ],
-        out_specs=pl.BlockSpec((1, block, D), lambda bh, r, l, cols, valid: (bh, r, 0)),
+        out_specs=[pl.BlockSpec((1, block, D), lambda bh, r, l, cols, valid: (bh, r, 0))] + ([
+            pl.BlockSpec((1, block, LANE), lambda bh, r, l, cols, valid: (bh, r, 0))]
+            if emit_lse else []),
         scratch_shapes=[
             pltpu.VMEM((block, 1), jnp.float32),
             pltpu.VMEM((block, 1), jnp.float32),
@@ -165,9 +286,105 @@ def _fwd_impl(q, k, v, layout: np.ndarray, block: int, causal: bool = False,
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        out_shape=[jax.ShapeDtypeStruct((B * H, S, D), q.dtype)] + ([
+            jax.ShapeDtypeStruct((B * H, S, LANE), jnp.float32)] if emit_lse else []),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(cols_j, valid_j, qf, kf, vf)
-    return out.reshape(B, H, S, D)
+    if emit_lse:
+        return out[0].reshape(B, H, S, D), out[1]
+    return out[0].reshape(B, H, S, D), None
+
+
+def _bwd_impl(q, k, v, out, lse, g, layout: np.ndarray, block: int, causal, scale, interpret):
+    B, H, S, D, nb, scale, interpret = _prep(q, layout, block, scale, interpret)
+    cols, valid = _row_maps_of(layout)
+    rows_t, valid_t = _col_gather_maps(layout)
+    L, Lt = cols.shape[-1], rows_t.shape[-1]
+
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    of = out.reshape(B * H, S, D)
+    dof = g.reshape(B * H, S, D).astype(q.dtype)
+    # delta = rowsum(do·o) once, lane-broadcast like lse (one fused XLA pass;
+    # the kernels would otherwise redo the [block, D] reduce per admitted tile)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, :, None], (B * H, S, LANE))
+    H_ = H  # read by index_map lambdas
+
+    def qrow(bh, r, l, cols, valid):
+        return (bh, r, 0)
+
+    def kgather(bh, r, l, cols, valid):
+        return (bh, cols[bh % H_, r, l], 0)
+
+    # dq: row-major sweep, same maps as the forward
+    cols_j = jnp.asarray(cols.reshape(H, nb, L), jnp.int32)
+    valid_j = jnp.asarray(valid.reshape(H, nb, L), jnp.int32)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal, block=block, L=L, num_heads=H),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B * H, nb, L),
+            in_specs=[
+                pl.BlockSpec((1, block, D), qrow),
+                pl.BlockSpec((1, block, D), kgather),
+                pl.BlockSpec((1, block, D), kgather),
+                pl.BlockSpec((1, block, D), qrow),
+                pl.BlockSpec((1, block, LANE), qrow),
+                pl.BlockSpec((1, block, LANE), qrow),
+            ],
+            out_specs=pl.BlockSpec((1, block, D), qrow),
+            scratch_shapes=[pltpu.VMEM((block, D), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(cols_j, valid_j, qf, kf, vf, dof, lse, delta)
+
+    # dk/dv: column-major sweep over the transposed maps; q/o/do/lse blocks
+    # are gathered by the active-ROW table while k/v/outputs sit at column c
+    rows_j = jnp.asarray(rows_t.reshape(H, nb, Lt), jnp.int32)
+    validt_j = jnp.asarray(valid_t.reshape(H, nb, Lt), jnp.int32)
+
+    def qgather(bh, c, l, rows, valid):
+        return (bh, rows[bh % H_, c, l], 0)
+
+    def kcol(bh, c, l, rows, valid):
+        return (bh, c, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, block=block, L=Lt,
+                          num_heads=H),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B * H, nb, Lt),
+            in_specs=[
+                pl.BlockSpec((1, block, D), qgather),
+                pl.BlockSpec((1, block, D), kcol),
+                pl.BlockSpec((1, block, D), kcol),
+                pl.BlockSpec((1, block, D), qgather),
+                pl.BlockSpec((1, block, LANE), qgather),
+                pl.BlockSpec((1, block, LANE), qgather),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block, D), kcol),
+                pl.BlockSpec((1, block, D), kcol),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block, D), jnp.float32),
+                pltpu.VMEM((block, D), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(rows_j, validt_j, qf, kf, vf, dof, lse, delta)
+    return (dq.reshape(B, H, S, D), dk.reshape(B, H, S, D), dv.reshape(B, H, S, D))

@@ -295,3 +295,39 @@ def test_prefix_cache_disabled(trained_params):
     while not eng.state.seqs[1].done:
         eng.step()
     assert eng.state.seqs[1].generated == _reference_greedy(trained_params, list(range(1, 19 + 1)), 2)
+
+
+def test_prefix_cache_evicts_leaves_first(trained_params):
+    """Eviction drops the NEWEST chain entries (leaves): freeing a root
+    would make every descendant unmatchable while staying pinned."""
+    eng = _engine(trained_params)
+    pc = eng.kv.prefix_cache
+    prompt = list(range(1, 26))        # 3 full pages @ page_size 8
+    eng.put([1], [prompt], max_new_tokens=2)
+    while not eng.state.seqs[1].done:
+        eng.step()
+    eng.flush(1)
+    before = pc.cached_pages
+    assert before >= 3
+    assert pc.evict(1) == 1
+    # the surviving prefix still matches (2 of the 3 prompt pages)
+    pages, _ = pc.match(prompt)
+    assert len(pages) == 2, len(pages)
+    eng.kv.allocator.free(pages)  # drop the refs match() took
+
+
+def test_prefix_cache_rejects_hash_collision(trained_params):
+    """A (simulated) chain-hash collision must NOT attach another prompt's
+    pages: match verifies the stored token tuple."""
+    eng = _engine(trained_params)
+    pc = eng.kv.prefix_cache
+    prompt = list(range(1, 18))        # 2 full pages
+    eng.put([1], [prompt], max_new_tokens=2)
+    while not eng.state.seqs[1].done:
+        eng.step()
+    # poison: rewrite the stored token tuples to a different prompt, keeping
+    # the hashes — as a real collision would
+    for h, (page, _) in list(pc._pages.items()):
+        pc._pages[h] = (page, tuple(range(900, 900 + eng.kv.page_size)))
+    pages, _ = pc.match(prompt)
+    assert pages == [], "collision-mismatched pages must not match"
