@@ -47,16 +47,6 @@ def build_cache_model(cfg, page_size: int):
             # gating has no capacity limit at inference)
             cfg = cfg.__class__(**{**cfg.__dict__, "drop_tokens": False})
         return MixtralForCausalLMWithCache(cfg, page_size=page_size)
-    from ...models.qwen2_moe import Qwen2MoeConfig
-    if isinstance(cfg, Qwen2MoeConfig) and cfg.mixed_stack:
-        raise NotImplementedError(
-            "mixed dense/sparse qwen2-moe stacks (mlp_only_layers/decoder_sparse_step) "
-            "serve via init_inference — the paged twin is scan-over-layers only")
-    from ...models.falcon import FalconConfig
-    if isinstance(cfg, FalconConfig) and (cfg.alibi or not cfg.parallel_attn):
-        raise NotImplementedError(
-            "falcon-rw variants (alibi / sequential residual) serve via init_inference — "
-            "the paged falcon twin implements rotary + parallel residual only")
     from ...models.cache_zoo import CACHE_MODEL_REGISTRY
     for cfg_cls, model_cls in CACHE_MODEL_REGISTRY.items():
         if isinstance(cfg, cfg_cls):

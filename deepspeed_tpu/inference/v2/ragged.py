@@ -112,11 +112,14 @@ class PrefixCacheManager:
     def __init__(self, allocator: "BlockedAllocator", page_size: int):
         self.allocator = allocator
         self.page_size = page_size
-        # chain hash → (page id, page's token tuple).  The tokens are kept
-        # for verification on match: a 64-bit hash collision would otherwise
-        # silently attach another prompt's KV pages (wrong output + cross-
-        # request prompt leakage); verifying costs O(page_size) per hit.
-        self._pages: Dict[int, Tuple[int, tuple]] = {}
+        # chain hash → (page id, page's token tuple, parent chain hash).
+        # The tokens are kept for verification on match: a 64-bit hash
+        # collision would otherwise silently attach another prompt's KV
+        # pages (wrong output + cross-request prompt leakage); verifying
+        # costs O(page_size) per hit.  The parent hash maintains per-entry
+        # child counts so eviction only ever removes LEAVES.
+        self._pages: Dict[int, Tuple[int, tuple, Optional[int]]] = {}
+        self._children: Dict[int, int] = {}       # chain hash → live child count
         self._lru: "OrderedDict[int, None]" = OrderedDict()  # chain hash, oldest first
         self.hits = 0
         self.misses = 0
@@ -146,7 +149,7 @@ class PrefixCacheManager:
                 break
             matched.append(entry[0])
             h_end = h
-            self._lru.move_to_end(h)
+            self._lru.move_to_end(h)  # whole chain refreshed root→leaf
         if matched:
             self.allocator.retain(matched)
             self.hits += 1
@@ -162,33 +165,50 @@ class PrefixCacheManager:
         full = min(seq.seen_tokens // self.page_size, len(seq.pages))
         h = seq.pc_hash if seq.pc_pages else self._SEED
         for i in range(seq.pc_pages, full):
+            parent = h if i else None
             page_toks = tuple(seq.tokens[i * self.page_size:(i + 1) * self.page_size])
             h = hash((h, page_toks))
             if h not in self._pages:
-                self._pages[h] = (seq.pages[i], page_toks)
+                self._pages[h] = (seq.pages[i], page_toks, parent)
+                self._children[h] = self._children.get(h, 0)
+                if parent is not None and parent in self._pages:
+                    self._children[parent] = self._children.get(parent, 0) + 1
                 self._lru[h] = None
                 self.allocator.retain([seq.pages[i]])
         seq.pc_pages = full
         seq.pc_hash = h if full else seq.pc_hash
 
     def evict(self, n: int) -> int:
-        """Drop up to ``n`` cache-only pages, NEWEST chain entries first.
+        """Drop up to ``n`` cache-only pages: LRU order, but LEAVES only.
 
-        Leaf-first order matters: chains are registered (and LRU-touched)
-        root→leaf, so oldest-first eviction would free chain ROOTS — one
-        freed root makes every descendant unmatchable (match() walks from
-        page 0) while their pages stay pinned by the cache.  Freeing leaves
-        keeps the surviving prefix useful.  Returns how many were freed."""
+        Freeing a chain's root would make every descendant unmatchable
+        (match() walks from page 0) while their pages stay pinned — and a
+        plain reversed-LRU walk would be global MRU eviction, thrashing the
+        hottest chain first.  Entries with live children are skipped, so a
+        cold chain dies leaf-by-leaf from the oldest while a hot chain's
+        recently-touched entries survive.  Each freed leaf may expose its
+        parent, so the sweep repeats until the quota is met or nothing is
+        evictable.  Returns how many pages were freed."""
         freed = 0
-        for h in reversed(list(self._lru)):
+        for h in list(self._lru):
             if freed >= n:
                 break
-            page = self._pages[h][0]
-            if self.allocator.refcount(page) == 1:  # only the cache holds it
+            # cascade: freeing a leaf exposes its parent — keep consuming
+            # THIS (older) chain before the sweep reaches hotter entries
+            while h is not None and freed < n and h in self._pages:
+                if self._children.get(h, 0) > 0:
+                    break  # not a leaf: descendants would be stranded
+                page, _, parent = self._pages[h]
+                if self.allocator.refcount(page) != 1:
+                    break  # a live sequence still shares this page
                 self.allocator.free([page])
                 del self._pages[h]
                 del self._lru[h]
+                self._children.pop(h, None)
+                if parent is not None and parent in self._children:
+                    self._children[parent] -= 1
                 freed += 1
+                h = parent
         return freed
 
     @property

@@ -43,11 +43,18 @@ class FalconAttentionCache(nn.Module):
                   name="k_proj")(x)
         v = dense(features=(KV, D), kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, KV_HEADS, HEAD_DIM)),
                   name="v_proj")(x)
-        cos, sin = rotary_embedding(positions, D, cfg.rope_theta)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        slopes = None
+        if cfg.alibi:
+            # falcon-rw: alibi position bias instead of rotary (same folding
+            # as models/falcon.py's training path)
+            from .falcon import alibi_slopes
+            slopes = jnp.asarray(alibi_slopes(H))
+        else:
+            cos, sin = rotary_embedding(positions, D, cfg.rope_theta)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
         out, pages = paged_attention_core(q, k, v, pages, block_table, start_pos, chunk_lens, self.page_size,
-                                          attention_impl=cfg.attention_impl)
+                                          attention_impl=cfg.attention_impl, alibi_slopes=slopes)
         out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=cfg.bias,
                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                               kernel_init=_logical(nn.initializers.lecun_normal(), (HEADS, HEAD_DIM, EMBED)),
@@ -66,6 +73,24 @@ class FalconBlockCache(nn.Module):
         x = carry
         ln = partial(nn.LayerNorm, epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype)
+
+        def mlp(mlp_in):
+            ffn = cfg.ffn_hidden_size or cfg.hidden_size * 4
+            h = nn.Dense(ffn, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, MLP)),
+                         name="dense_h_to_4h")(mlp_in)
+            return nn.Dense(cfg.hidden_size, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                            kernel_init=_logical(nn.initializers.lecun_normal(), (MLP, EMBED)),
+                            name="dense_4h_to_h")(jax.nn.gelu(h, approximate=False))
+
+        if not cfg.parallel_attn:
+            # falcon-rw sequential residual: ln1 → attn → add; ln2 → mlp → add
+            attn_in = ln(name="input_layernorm")(x)
+            attn_out, layer_pages = FalconAttentionCache(cfg, self.page_size, name="self_attention")(
+                attn_in, positions, layer_pages, block_table, start_pos, chunk_lens)
+            h = x + attn_out
+            return h + mlp(ln(name="post_attention_layernorm")(h)), layer_pages
+
         if cfg.num_ln_in_parallel_attn == 2:
             attn_in = ln(name="ln_attn")(x)
             mlp_in = ln(name="ln_mlp")(x)
@@ -74,14 +99,7 @@ class FalconBlockCache(nn.Module):
             mlp_in = attn_in
         attn_out, layer_pages = FalconAttentionCache(cfg, self.page_size, name="self_attention")(
             attn_in, positions, layer_pages, block_table, start_pos, chunk_lens)
-        ffn = cfg.ffn_hidden_size or cfg.hidden_size * 4
-        h = nn.Dense(ffn, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                     kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, MLP)),
-                     name="dense_h_to_4h")(mlp_in)
-        mlp_out = nn.Dense(cfg.hidden_size, use_bias=cfg.bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                           kernel_init=_logical(nn.initializers.lecun_normal(), (MLP, EMBED)),
-                           name="dense_4h_to_h")(jax.nn.gelu(h, approximate=False))
-        return x + attn_out + mlp_out, layer_pages
+        return x + attn_out + mlp(mlp_in), layer_pages
 
 
 class FalconForCausalLMWithCache(nn.Module):
@@ -304,17 +322,20 @@ class Qwen2MoeBlockCache(nn.Module):
     cfg: Qwen2MoeConfig
     page_size: int = 16
     scanned: bool = False
+    sparse: bool = True   # mixed stacks: dense SwiGLU for mlp_only/off-step layers
 
     @nn.compact
     def __call__(self, carry, layer_pages, positions=None, block_table=None, start_pos=None, chunk_lens=None):
         from .llama_cache import LlamaAttentionCache
+        from .qwen2_moe import Qwen2MoeDenseMLP
         cfg = self.cfg
         x = carry
         attn_out, layer_pages = LlamaAttentionCache(cfg.as_llama(), self.page_size, name="self_attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="input_layernorm")(x), positions,
             layer_pages, block_table, start_pos, chunk_lens)
         h = x + attn_out
-        out = h + Qwen2MoeSparseMLP(cfg, name="mlp")(
+        mlp = Qwen2MoeSparseMLP(cfg, name="mlp") if self.sparse else Qwen2MoeDenseMLP(cfg, name="mlp")
+        out = h + mlp(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="post_attention_layernorm")(h))
         return out, layer_pages
 
@@ -331,12 +352,24 @@ class Qwen2MoeForCausalLMWithCache(nn.Module):
                          embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
                          name="embed_tokens")
         x = embed(input_ids)
-        blocks = nn.scan(Qwen2MoeBlockCache, variable_axes={"params": 0}, split_rngs={"params": True},
-                         in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
-                         out_axes=0, length=cfg.num_hidden_layers,
-                         metadata_params={nn.PARTITION_NAME: LAYERS})
-        x, cache = blocks(cfg, self.page_size, scanned=True,
-                          name="layers")(x, cache, positions, block_table, start_pos, chunk_lens)
+        if cfg.mixed_stack:
+            # dense/sparse layers can't share one scanned body — unroll with
+            # per-layer dispatch, mirroring the training model's layers_{i}
+            # naming so converted checkpoints apply unchanged
+            new_pages = []
+            for i in range(cfg.num_hidden_layers):
+                x, pages_i = Qwen2MoeBlockCache(cfg, self.page_size, sparse=cfg.layer_is_sparse(i),
+                                                name=f"layers_{i}")(x, cache[i], positions,
+                                                                    block_table, start_pos, chunk_lens)
+                new_pages.append(pages_i)
+            cache = jnp.stack(new_pages)
+        else:
+            blocks = nn.scan(Qwen2MoeBlockCache, variable_axes={"params": 0}, split_rngs={"params": True},
+                             in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
+                             out_axes=0, length=cfg.num_hidden_layers,
+                             metadata_params={nn.PARTITION_NAME: LAYERS})
+            x, cache = blocks(cfg, self.page_size, scanned=True,
+                              name="layers")(x, cache, positions, block_table, start_pos, chunk_lens)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="norm")(x)
         if cfg.tie_word_embeddings:
             return embed.attend(x), cache

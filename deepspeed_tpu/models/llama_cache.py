@@ -79,14 +79,18 @@ def _write_pages(pages, k_new, v_new, block_table, start_pos, page_size, chunk_l
     return pages.at[page_idx.reshape(-1), slot_idx.reshape(-1)].set(flat_kv)
 
 
-def paged_attention(q, pages, block_table, start_pos, chunk_lens, page_size, sliding_window=0):
+def paged_attention(q, pages, block_table, start_pos, chunk_lens, page_size, sliding_window=0,
+                    alibi_slopes=None):
     """Attention of a chunk's queries against (history + chunk) keys.
 
     q: [B, C, H, hd] (RoPE applied); pages: [P, page, 2, n_kv, hd] with the
     chunk's K/V already written; block_table: [B, max_pages]; start_pos: [B]
     = context length before this chunk; chunk_lens: [B] or None — query rows
-    at/after a row's chunk_len (ragged padding) get zero output.  jnp
-    reference implementation — the Pallas blocked-decode kernel slots in
+    at/after a row's chunk_len (ragged padding) get zero output.
+    ``alibi_slopes`` [H]: falcon-rw per-key position bias slope·kpos·scale
+    (softmax is row-shift invariant, so the per-key form matches HF's
+    build_alibi_tensor — same folding as models/falcon.py's training path).
+    jnp reference implementation — the Pallas blocked-decode kernel slots in
     behind the same signature (ops/paged_attention.py).
     """
     b, c, h, d = q.shape
@@ -104,6 +108,11 @@ def paged_attention(q, pages, block_table, start_pos, chunk_lens, page_size, sli
     logits = jnp.einsum("bcnd,bknd->bnck", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
     qpos = start_pos[:, None] + jnp.arange(c)[None, :]                # [B, C]
     kpos = jnp.arange(max_pages * page_size)[None, :]                 # [1, S_kv]
+    if alibi_slopes is not None:
+        # HF adds alibi to RAW scores pre-scaling → fold the scale in
+        bias = alibi_slopes.astype(jnp.float32)[None, :, None, None] * \
+            kpos[0].astype(jnp.float32)[None, None, None, :] * scale
+        logits = logits + bias
     mask = kpos[:, None, :] <= qpos[..., None]                        # [B, C, S_kv]
     if sliding_window and sliding_window > 0:  # mistral window (decode path)
         mask = mask & (kpos[:, None, :] > qpos[..., None] - sliding_window)
@@ -117,22 +126,21 @@ def paged_attention(q, pages, block_table, start_pos, chunk_lens, page_size, sli
 
 
 def paged_attention_core(q, k, v, pages, block_table, start_pos, chunk_lens, page_size,
-                         attention_impl="reference", sliding_window=0):
+                         attention_impl="reference", sliding_window=0, alibi_slopes=None):
     """Shared paged-KV attention core for every model family's cache twin:
     write this chunk's K/V into the arena, then attend the chunk's queries
     against (history + chunk).  q/k/v are post-projection, post-RoPE
     [B, C, N(H|KV), D].  Returns (out [B, C, H, D], new_pages)."""
     pages = _write_pages(pages, k.astype(pages.dtype), v.astype(pages.dtype), block_table,
                          start_pos, page_size, chunk_lens)
-    if attention_impl == "flash":
-        if sliding_window:
-            raise NotImplementedError("sliding_window decode requires the reference paged "
-                                      "attention (pallas window mask lands with the kernel)")
+    if attention_impl == "flash" and not sliding_window and alibi_slopes is None:
         from ..ops.paged_attention import paged_attention_pallas
         out = paged_attention_pallas(q, pages, block_table, start_pos, chunk_lens, page_size)
     else:
+        # window masks / alibi bias decode through the jnp path (in-kernel
+        # variants land with the kernel)
         out = paged_attention(q, pages, block_table, start_pos, chunk_lens, page_size,
-                              sliding_window=sliding_window)
+                              sliding_window=sliding_window, alibi_slopes=alibi_slopes)
     return out, pages
 
 

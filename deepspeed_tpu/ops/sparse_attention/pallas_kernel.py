@@ -96,19 +96,19 @@ def _kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale, causa
             lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
 
 
-def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *, scale, causal, block,
+def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta, *, scale, causal, block,
               r_idx, c_idx):
     """Shared backward tile math for one admitted (q-row, kv-col) block pair:
-    returns (pr, ds) — both in the storage dtype, MXU-ready.  delta (the
-    per-row rowsum(do·o)) arrives precomputed — one fused jnp pass instead
-    of a per-tile [block, D] multiply-reduce, and o drops out of the
-    kernels' inputs entirely."""
+    returns (pr, ds) — both in the storage dtype, MXU-ready.  ``delta`` is
+    the per-row rowsum(do·o) [block, 1]: a lane-broadcast HBM input would be
+    [B·H, S, 128] f32 — 128× the O(S) data and 4× the DMA bytes of just
+    re-reading the bf16 o block (narrower minor dims are not tile-legal),
+    so callers compute it from the o/do blocks instead."""
     q = q_ref[0]
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0]
     lse = lse_ref[0][:, :1]
-    delta = delta_ref[0][:, :1]
     s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     if causal:
@@ -122,8 +122,8 @@ def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *, scale, causal,
     return pr.astype(v.dtype), ds.astype(v.dtype)
 
 
-def _dq_kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, block, L, num_heads):
+def _dq_kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+               dq_scr, delta_scr, *, scale, causal, block, L, num_heads):
     bh = pl.program_id(0)
     r = pl.program_id(1)
     l = pl.program_id(2)
@@ -132,9 +132,12 @@ def _dq_kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_
     @pl.when(l == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
+        # the q row is fixed across the l sweep: compute its delta once
+        delta_scr[:] = jnp.sum(do_ref[0].astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+                               axis=1, keepdims=True)
 
     def _compute():
-        _, ds = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, scale=scale,
+        _, ds = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_scr[:], scale=scale,
                           causal=causal, block=block, r_idx=r, c_idx=cols_ref[h, r, l])
         dq_scr[:] += jax.lax.dot_general(ds, k_ref[0], (((1, ), (0, )), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -146,7 +149,7 @@ def _dq_kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(rows_ref, valid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+def _dkv_kernel(rows_ref, valid_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
                 dv_ref, dk_scr, dv_scr, *, scale, causal, block, L, num_heads):
     bh = pl.program_id(0)
     c = pl.program_id(1)
@@ -159,7 +162,11 @@ def _dkv_kernel(rows_ref, valid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def _compute():
-        pr, ds = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, scale=scale,
+        # column-major sweep: the q row changes per tile, so delta is
+        # per-tile here ([block, D] reduce — cheap next to the [block²] exp)
+        delta = jnp.sum(do_ref[0].astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+                        axis=1, keepdims=True)
+        pr, ds = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta, scale=scale,
                            causal=causal, block=block, r_idx=rows_ref[h, c, l], c_idx=c)
         dv_scr[:] += jax.lax.dot_general(pr, do_ref[0], (((0, ), (0, )), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -308,10 +315,6 @@ def _bwd_impl(q, k, v, out, lse, g, layout: np.ndarray, block: int, causal, scal
     vf = v.reshape(B * H, S, D)
     of = out.reshape(B * H, S, D)
     dof = g.reshape(B * H, S, D).astype(q.dtype)
-    # delta = rowsum(do·o) once, lane-broadcast like lse (one fused XLA pass;
-    # the kernels would otherwise redo the [block, D] reduce per admitted tile)
-    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[:, :, None], (B * H, S, LANE))
     H_ = H  # read by index_map lambdas
 
     def qrow(bh, r, l, cols, valid):
@@ -333,17 +336,18 @@ def _bwd_impl(q, k, v, out, lse, g, layout: np.ndarray, block: int, causal, scal
                 pl.BlockSpec((1, block, D), kgather),
                 pl.BlockSpec((1, block, D), kgather),
                 pl.BlockSpec((1, block, D), qrow),
-                pl.BlockSpec((1, block, LANE), qrow),
+                pl.BlockSpec((1, block, D), qrow),
                 pl.BlockSpec((1, block, LANE), qrow),
             ],
             out_specs=pl.BlockSpec((1, block, D), qrow),
-            scratch_shapes=[pltpu.VMEM((block, D), jnp.float32)],
+            scratch_shapes=[pltpu.VMEM((block, D), jnp.float32),
+                            pltpu.VMEM((block, 1), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(cols_j, valid_j, qf, kf, vf, dof, lse, delta)
+    )(cols_j, valid_j, qf, kf, vf, of, dof, lse)
 
     # dk/dv: column-major sweep over the transposed maps; q/o/do/lse blocks
     # are gathered by the active-ROW table while k/v/outputs sit at column c
@@ -367,7 +371,7 @@ def _bwd_impl(q, k, v, out, lse, g, layout: np.ndarray, block: int, causal, scal
                 pl.BlockSpec((1, block, D), kcol),
                 pl.BlockSpec((1, block, D), kcol),
                 pl.BlockSpec((1, block, D), qgather),
-                pl.BlockSpec((1, block, LANE), qgather),
+                pl.BlockSpec((1, block, D), qgather),
                 pl.BlockSpec((1, block, LANE), qgather),
             ],
             out_specs=[
@@ -386,5 +390,5 @@ def _bwd_impl(q, k, v, out, lse, g, layout: np.ndarray, block: int, causal, scal
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(rows_j, validt_j, qf, kf, vf, dof, lse, delta)
+    )(rows_j, validt_j, qf, kf, vf, of, dof, lse)
     return (dq.reshape(B, H, S, D), dk.reshape(B, H, S, D), dv.reshape(B, H, S, D))

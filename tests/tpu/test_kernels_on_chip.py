@@ -9,8 +9,7 @@ headline bench shape.  Run on a TPU host with:
     DS_TPU_TESTS=1 python -m pytest tests/tpu -q
 
 Timing note: ``block_until_ready`` is not a reliable fence on tunneled
-platforms — every timing below fences with a value fetch, and kernels are
-iterated inside one jit (lax.scan) so tunnel RTT jitter amortizes away.
+platforms — every timing below fences with a value fetch.
 """
 
 import os
@@ -71,35 +70,6 @@ def test_flash_fwd_bwd_bf16_vs_golden():
         denom = max(1.0, np.abs(b).max())
         rel = np.abs(a - b).max() / denom
         assert rel < 5e-2, f"d{n} rel err {rel}"
-
-
-def _time_attn(impl_fn, q, k, v, iters=200, runs=4):
-    """fwd+bwd step time via in-jit iteration (tunnel-jitter safe)."""
-    import jax
-    import jax.numpy as jnp
-
-    # grad over ALL inputs: differentiating only q would let XLA dead-code
-    # the jnp path's dk/dv work while the custom-vjp kernels always compute
-    # all three — an unfair comparison
-    g = jax.grad(lambda q, k, v: jnp.sum(impl_fn(q, k, v).astype(jnp.float32)),
-                 argnums=(0, 1, 2))
-
-    @jax.jit
-    def many(q, k, v):
-        def body(c, _):
-            dq, dk, dv = g(q + c.astype(q.dtype), k, v)
-            out = dq.ravel()[0] + dk.ravel()[0] + dv.ravel()[0]
-            return out.astype(jnp.float32), None
-        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
-        return c
-
-    float(many(q, k, v))  # compile + warm
-    best = float("inf")
-    for _ in range(runs):
-        t0 = time.time()
-        float(many(q, k, v))  # value fetch = true fence
-        best = min(best, (time.time() - t0) / iters)
-    return best
 
 
 def _model_step_time(attention_impl, remat_policy, steps=10):

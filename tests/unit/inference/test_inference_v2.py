@@ -132,6 +132,21 @@ def _save_tiny_hf(tmp_path, kind):
                      new_decoder_architecture=True, num_kv_heads=2, parallel_attn=True,
                      bias=False, alibi=False, hidden_dropout=0.0, attention_dropout=0.0,
                      tie_word_embeddings=True, num_ln_in_parallel_attn=2)
+    elif kind == "falcon_rw":
+        from transformers import FalconConfig as HFC, FalconForCausalLM as HFM
+        # falcon-rw: alibi positions, sequential residual, multi-head kv
+        hf_cfg = HFC(vocab_size=128, hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+                     new_decoder_architecture=False, multi_query=False, parallel_attn=False,
+                     bias=True, alibi=True, hidden_dropout=0.0, attention_dropout=0.0,
+                     tie_word_embeddings=True)
+    elif kind == "qwen2_moe_mixed":
+        from transformers import Qwen2MoeConfig as HFC, Qwen2MoeForCausalLM as HFM
+        # mixed dense/sparse stack: layer 0 dense (mlp_only_layers)
+        hf_cfg = HFC(vocab_size=128, hidden_size=64, intermediate_size=96, moe_intermediate_size=48,
+                     shared_expert_intermediate_size=64, num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, num_experts=4, num_experts_per_tok=2,
+                     max_position_embeddings=64, rope_theta=1e4, norm_topk_prob=False,
+                     tie_word_embeddings=False, mlp_only_layers=[0], decoder_sparse_step=1)
     elif kind == "opt":
         from transformers import OPTConfig as HFC, OPTForCausalLM as HFM
         hf_cfg = HFC(vocab_size=128, hidden_size=64, ffn_dim=96, num_hidden_layers=2,
@@ -167,7 +182,7 @@ def _hf_greedy(hf_model, prompt, n_new):
     return [int(t) for t in ids[0, len(prompt):]]
 
 
-@pytest.mark.parametrize("kind", ["qwen2", "mixtral", "falcon", "opt", "phi", "qwen2_moe"])
+@pytest.mark.parametrize("kind", ["qwen2", "mixtral", "falcon", "falcon_rw", "opt", "phi", "qwen2_moe", "qwen2_moe_mixed"])
 def test_build_hf_engine_paged_generate(kind, tmp_path):
     """Every arch the reference serves through FastGen must generate through
     the paged v2 engine matching HF greedy decode (VERDICT r1 #4 + the full
@@ -327,7 +342,31 @@ def test_prefix_cache_rejects_hash_collision(trained_params):
         eng.step()
     # poison: rewrite the stored token tuples to a different prompt, keeping
     # the hashes — as a real collision would
-    for h, (page, _) in list(pc._pages.items()):
-        pc._pages[h] = (page, tuple(range(900, 900 + eng.kv.page_size)))
+    for h, (page, _, parent) in list(pc._pages.items()):
+        pc._pages[h] = (page, tuple(range(900, 900 + eng.kv.page_size)), parent)
     pages, _ = pc.match(prompt)
     assert pages == [], "collision-mismatched pages must not match"
+
+
+def test_prefix_cache_evicts_cold_chain_before_hot(trained_params):
+    """Two cached chains; the recently-matched (hot) one survives eviction —
+    leaf-only LRU, not global MRU."""
+    eng = _engine(trained_params)
+    pc = eng.kv.prefix_cache
+    cold = list(range(1, 26))
+    hot = list(range(50, 75))
+    for uid, p in ((1, cold), (2, hot)):
+        eng.put([uid], [p], max_new_tokens=2)
+        while not eng.state.seqs[uid].done:
+            eng.step()
+        eng.flush(uid)
+    # touch the hot chain (refreshes its whole LRU position)
+    pages, _ = pc.match(hot)
+    eng.kv.allocator.free(pages)
+    assert pc.evict(2) == 2
+    # cold chain lost its two leaves; hot chain fully intact
+    hot_pages, _ = pc.match(hot)
+    cold_pages, _ = pc.match(cold)
+    assert len(hot_pages) == 3, len(hot_pages)
+    assert len(cold_pages) == 1, len(cold_pages)
+    eng.kv.allocator.free(hot_pages + cold_pages)
