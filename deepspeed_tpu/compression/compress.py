@@ -192,7 +192,10 @@ def build_compression_fn(compression_dict: Dict[str, Any], abs_params) -> Any:
         raise ValueError(f"head pruning needs a 2-D (H*D, out) or 3-D (H, D, out) kernel, got shape {w.shape}")
 
     def apply_leaf(path, w, step):
-        stacked = "layers" in path.split(".")
+        # scan-stacked collections are named 'layers' (llama-family) or 'h'
+        # (falcon/gpt2); matching only 'layers' made falcon/gpt2 pruning
+        # silently compute masks across the whole [L, in, out] stack
+        stacked = any(seg in ("layers", "h") for seg in path.split("."))
         for kind, cfg in actions.get(path, ()):
             on = step >= cfg["offset"]
             if kind == "wq":

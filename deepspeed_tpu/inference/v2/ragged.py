@@ -119,7 +119,12 @@ class PrefixCacheManager:
         # costs O(page_size) per hit.  The parent hash maintains per-entry
         # child counts so eviction only ever removes LEAVES.
         self._pages: Dict[int, Tuple[int, tuple, Optional[int]]] = {}
-        self._children: Dict[int, int] = {}       # chain hash → live child count
+        # chain hash → set of live CHILD hashes.  Edges are recorded even
+        # when the parent entry is currently absent (evicted): if the parent
+        # is later re-registered while the child still lives, the edge must
+        # already exist or leaf-only eviction would free the parent and
+        # strand the child (a count-based scheme can't survive that order)
+        self._children: Dict[int, set] = {}
         self._lru: "OrderedDict[int, None]" = OrderedDict()  # chain hash, oldest first
         self.hits = 0
         self.misses = 0
@@ -170,9 +175,8 @@ class PrefixCacheManager:
             h = hash((h, page_toks))
             if h not in self._pages:
                 self._pages[h] = (seq.pages[i], page_toks, parent)
-                self._children[h] = self._children.get(h, 0)
-                if parent is not None and parent in self._pages:
-                    self._children[parent] = self._children.get(parent, 0) + 1
+                if parent is not None:
+                    self._children.setdefault(parent, set()).add(h)
                 self._lru[h] = None
                 self.allocator.retain([seq.pages[i]])
         seq.pc_pages = full
@@ -196,8 +200,8 @@ class PrefixCacheManager:
             # cascade: freeing a leaf exposes its parent — keep consuming
             # THIS (older) chain before the sweep reaches hotter entries
             while h is not None and freed < n and h in self._pages:
-                if self._children.get(h, 0) > 0:
-                    break  # not a leaf: descendants would be stranded
+                if self._children.get(h):
+                    break  # has live descendants: they would be stranded
                 page, _, parent = self._pages[h]
                 if self.allocator.refcount(page) != 1:
                     break  # a live sequence still shares this page
@@ -206,7 +210,9 @@ class PrefixCacheManager:
                 del self._lru[h]
                 self._children.pop(h, None)
                 if parent is not None and parent in self._children:
-                    self._children[parent] -= 1
+                    self._children[parent].discard(h)
+                    if not self._children[parent]:
+                        del self._children[parent]
                 freed += 1
                 h = parent
         return freed

@@ -35,10 +35,11 @@ class LoRAConfig:
 class QuantizationConfig:
     """ref: linear/config.py QuantizationConfig.
 
-    q_bits ∈ {8, 6, 4}; 8 stores jnp.float8_e4m3fn (native TPU fp8) unless
-    q_dtype overrides to int8; 6/4 store block-scaled ints (the reference's
-    fp_quantizer analog — csrc/fp_quantizer).  group_size: elements per
-    scaling group.
+    q_bits ∈ {12, 8, 6, 4}; 8 stores jnp.float8_e4m3fn (native TPU fp8)
+    unless q_dtype overrides to int8; 6/12 store block-scaled e3m2/e5m6
+    float codes bit-packed into uint8 (the reference's fp_quantizer packed
+    formats — csrc/fp_quantizer); 4 stores block-scaled ints.  group_size:
+    elements per scaling group.
     """
     q_bits: int = 8
     mantissa_bits: int = 3

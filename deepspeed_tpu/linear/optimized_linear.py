@@ -65,7 +65,7 @@ class LoRAOptimizedLinear(nn.Module):
             q0, s0 = init_q(rng)
             qw = self.variable("quant", "base_kernel_q", lambda: q0)
             sc = self.variable("quant", "base_kernel_scale", lambda: s0)
-            base_w = dequantize(qw.value, sc.value, (in_dim, self.output_dim), self.dtype)
+            base_w = dequantize(qw.value, sc.value, (in_dim, self.output_dim), self.dtype, cfg=qcfg)
         else:
             base_w = self.param("base_kernel", base_init, (in_dim, self.output_dim), jnp.float32)
             base_w = base_w.astype(self.dtype)
@@ -176,7 +176,7 @@ def _fuse(params, cfg, sign, qcfg=None):
                         "quantization_config to requantize on the original grid")
                 eff = qcfg or QuantizationConfig(
                     q_bits=8, q_dtype=q.dtype, group_size=group_size)
-                w = dequantize(q, s, shape, jnp.float32)
+                w = dequantize(q, s, shape, jnp.float32, cfg=eff)
                 nq, ns = quantize(w + sign * delta.astype(jnp.float32), eff)
                 return tree, {**quant_sibling, "base_kernel_q": nq, "base_kernel_scale": ns}
             raise ValueError(

@@ -20,6 +20,106 @@ from .config import QuantizationConfig
 
 F8_MAX = 448.0  # float8_e4m3fn finite max
 
+# --------------------------------------------------------------- fp6 / fp12
+# Reference parity: csrc/fp_quantizer/ packs fp6 (e3m2) and fp12 (e5m6)
+# weight formats on CUDA.  TPU has no sub-byte dtypes, so the same value
+# grids are realized with bit math and true uint8 packing (4 fp6 codes →
+# 3 bytes, 2 fp12 codes → 3 bytes); the dequant is jit-fused into the
+# consuming matmul so HBM holds only the packed payload + scales.
+
+_FP6_EXP_BIAS = 3
+
+
+def _fp6_value_table() -> np.ndarray:
+    """All 64 e3m2 values, indexed by code (sign|exp|mantissa)."""
+    vals = np.empty(64, np.float32)
+    for code in range(64):
+        s = -1.0 if code & 0x20 else 1.0
+        e = (code >> 2) & 0x7
+        m = code & 0x3
+        if e == 0:
+            v = (m / 4.0) * 2.0**(1 - _FP6_EXP_BIAS)     # subnormal
+        else:
+            v = (1 + m / 4.0) * 2.0**(e - _FP6_EXP_BIAS)
+        vals[code] = s * v
+    return vals
+
+
+_FP6_TABLE = _fp6_value_table()
+FP6_MAX = float(_FP6_TABLE.max())       # (1 + 3/4) * 2^4 = 28
+# encode via searchsorted over the sorted value grid: boundaries are the
+# midpoints between adjacent representable values
+_FP6_ORDER = np.argsort(_FP6_TABLE, kind="stable")
+_FP6_SORTED = _FP6_TABLE[_FP6_ORDER]
+_FP6_MIDS = (_FP6_SORTED[1:] + _FP6_SORTED[:-1]) / 2.0
+
+FP12_MAX = float(np.float32((1 + 63 / 64) * 2.0**15))   # e5m6 max = 65024
+
+
+def _fp6_encode(x):
+    """f32 in [-FP6_MAX, FP6_MAX] → uint8 codes 0..63 (round to nearest)."""
+    idx = jnp.searchsorted(jnp.asarray(_FP6_MIDS), x)
+    return jnp.asarray(_FP6_ORDER, jnp.uint8)[idx]
+
+
+def _fp6_decode(codes):
+    return jnp.asarray(_FP6_TABLE)[codes.astype(jnp.int32)]
+
+
+def _fp12_encode(x):
+    """f32 in [-FP12_MAX, FP12_MAX] → uint16 codes (12 significant bits).
+
+    e5m6 is float16 with the mantissa cut from 10 to 6 bits: cast to f16,
+    then round the low 4 mantissa bits away.  Adding 8 before the shift is
+    round-half-up with natural carry into the exponent; inputs are clipped
+    so the carry can never overflow past the e5m6 max."""
+    h = x.astype(jnp.float16)
+    bits = jax.lax.bitcast_convert_type(h, jnp.uint16).astype(jnp.uint32)
+    sign = bits & 0x8000
+    mag = bits & 0x7FFF
+    code = (sign >> 4) | ((mag + 8) >> 4)
+    return code.astype(jnp.uint16)
+
+
+def _fp12_decode(codes):
+    c = codes.astype(jnp.uint32)
+    bits = ((c & 0x800) << 4) | ((c & 0x7FF) << 4)
+    return jax.lax.bitcast_convert_type(bits.astype(jnp.uint16), jnp.float16).astype(jnp.float32)
+
+
+def _pack_fp6(codes):
+    """[N] uint8 6-bit codes (N % 4 == 0) → [3N/4] uint8."""
+    c = codes.reshape(-1, 4).astype(jnp.uint32)
+    b0 = (c[:, 0] | (c[:, 1] << 6)) & 0xFF
+    b1 = ((c[:, 1] >> 2) | (c[:, 2] << 4)) & 0xFF
+    b2 = ((c[:, 2] >> 4) | (c[:, 3] << 2)) & 0xFF
+    return jnp.stack([b0, b1, b2], axis=1).reshape(-1).astype(jnp.uint8)
+
+
+def _unpack_fp6(packed):
+    b = packed.reshape(-1, 3).astype(jnp.uint32)
+    c0 = b[:, 0] & 0x3F
+    c1 = ((b[:, 0] >> 6) | (b[:, 1] << 2)) & 0x3F
+    c2 = ((b[:, 1] >> 4) | (b[:, 2] << 4)) & 0x3F
+    c3 = (b[:, 2] >> 2) & 0x3F
+    return jnp.stack([c0, c1, c2, c3], axis=1).reshape(-1)
+
+
+def _pack_fp12(codes):
+    """[N] uint16 12-bit codes (N % 2 == 0) → [3N/2] uint8."""
+    c = codes.reshape(-1, 2).astype(jnp.uint32)
+    b0 = c[:, 0] & 0xFF
+    b1 = ((c[:, 0] >> 8) | ((c[:, 1] & 0xF) << 4)) & 0xFF
+    b2 = (c[:, 1] >> 4) & 0xFF
+    return jnp.stack([b0, b1, b2], axis=1).reshape(-1).astype(jnp.uint8)
+
+
+def _unpack_fp12(packed):
+    b = packed.reshape(-1, 3).astype(jnp.uint32)
+    c0 = b[:, 0] | ((b[:, 1] & 0xF) << 8)
+    c1 = (b[:, 1] >> 4) | (b[:, 2] << 4)
+    return jnp.stack([c0, c1], axis=1).reshape(-1).astype(jnp.uint16)
+
 
 def _group(x: jnp.ndarray, group_size: int) -> Tuple[jnp.ndarray, int]:
     flat = x.reshape(-1)
@@ -30,9 +130,25 @@ def _group(x: jnp.ndarray, group_size: int) -> Tuple[jnp.ndarray, int]:
 
 
 def quantize(x: jnp.ndarray, cfg: QuantizationConfig) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """→ (q, scales). q has cfg.q_dtype (fp8) or int8 storage for q_bits<8."""
+    """→ (q, scales). Storage by format: fp8 → native float8_e4m3fn;
+    fp6/fp12 (q_bits 6/12) → block-scaled e3m2/e5m6 codes bit-packed into
+    uint8 (4→3 / 2→3 bytes); other q_bits<8 → int8 codes."""
     g, _pad = _group(x.astype(jnp.float32), cfg.group_size)
     amax = jnp.max(jnp.abs(g), axis=1, keepdims=True) + 1e-12
+    if cfg.q_bits == 6:
+        scale = amax / FP6_MAX
+        codes = _fp6_encode(jnp.clip(g / scale, -FP6_MAX, FP6_MAX)).reshape(-1)
+        pad = (-codes.size) % 4
+        if pad:
+            codes = jnp.pad(codes, (0, pad))
+        return _pack_fp6(codes), scale.astype(jnp.float32)
+    if cfg.q_bits == 12:
+        scale = amax / FP12_MAX
+        codes = _fp12_encode(jnp.clip(g / scale, -FP12_MAX, FP12_MAX)).reshape(-1)
+        pad = (-codes.size) % 2
+        if pad:
+            codes = jnp.pad(codes, (0, pad))
+        return _pack_fp12(codes), scale.astype(jnp.float32)
     if cfg.q_bits >= 8 and cfg.q_dtype == jnp.float8_e4m3fn:
         scale = amax / F8_MAX
         q = (g / scale).astype(jnp.float8_e4m3fn)
@@ -43,9 +159,17 @@ def quantize(x: jnp.ndarray, cfg: QuantizationConfig) -> Tuple[jnp.ndarray, jnp.
     return q, scale.astype(jnp.float32)
 
 
-def dequantize(q: jnp.ndarray, scale: jnp.ndarray, shape, dtype=jnp.bfloat16) -> jnp.ndarray:
-    flat = (q.astype(jnp.float32) * scale).reshape(-1)
+def dequantize(q: jnp.ndarray, scale: jnp.ndarray, shape, dtype=jnp.bfloat16,
+               cfg: Optional[QuantizationConfig] = None) -> jnp.ndarray:
     n = int(np.prod(shape))
+    if cfg is not None and cfg.q_bits == 6:
+        vals = _fp6_decode(_unpack_fp6(q))
+        flat = (vals[:scale.size * cfg.group_size].reshape(-1, cfg.group_size) * scale).reshape(-1)
+    elif cfg is not None and cfg.q_bits == 12:
+        vals = _fp12_decode(_unpack_fp12(q))
+        flat = (vals[:scale.size * cfg.group_size].reshape(-1, cfg.group_size) * scale).reshape(-1)
+    else:
+        flat = (q.astype(jnp.float32) * scale).reshape(-1)
     return flat[:n].reshape(shape).astype(dtype)
 
 
@@ -67,7 +191,8 @@ class QuantizedParameter:
         return cls(q=q, scale=s, shape=tuple(np.shape(x)), dtype=dtype, quantization_config=cfg)
 
     def dequantized(self):
-        return dequantize(self.q, self.scale, self.shape, self.dtype)
+        return dequantize(self.q, self.scale, self.shape, self.dtype,
+                          cfg=self.quantization_config)
 
     @property
     def nbytes(self):
@@ -100,7 +225,7 @@ class QuantizedLinear(nn.Module):
         q_init, s_init = init_q(rng)
         qw = self.variable("quant", "kernel_q", lambda: q_init)
         sc = self.variable("quant", "kernel_scale", lambda: s_init)
-        w = dequantize(qw.value, sc.value, (in_dim, self.output_dim), self.dtype)
+        w = dequantize(qw.value, sc.value, (in_dim, self.output_dim), self.dtype, cfg=cfg)
         y = x.astype(self.dtype) @ w
         if self.bias:
             b = self.param("bias", nn.initializers.zeros_init(), (self.output_dim, ), self.dtype)

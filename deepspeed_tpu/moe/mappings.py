@@ -23,7 +23,7 @@ propagation guesses.
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..comm.mesh import BATCH_AXES, TENSOR_AXIS, get_global_mesh, has_global_mesh
+from ..comm.mesh import BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, get_global_mesh, has_global_mesh
 
 
 def _skip(x) -> bool:
@@ -39,13 +39,20 @@ def _skip(x) -> bool:
 
 
 def _token_spec(ndim: int, dim: int, tensor_on_dim: bool):
+    # keep any Ulysses SP sharding on the token dim: pinning it to TENSOR
+    # alone would force an all-gather of the sequence over the seq group at
+    # every MoE entry/exit on an SP×TP mesh — the drop should only REFINE
+    # the existing layout
+    seq_axes = (SEQ_AXIS, ) if get_global_mesh().shape.get(SEQ_AXIS, 1) > 1 else ()
     entries = [None] * ndim
     entries[0] = BATCH_AXES  # batch dim carries the data axes as usual
     if tensor_on_dim:
         if dim == 0:
-            entries[0] = tuple(BATCH_AXES) + (TENSOR_AXIS, )
+            entries[0] = tuple(BATCH_AXES) + seq_axes + (TENSOR_AXIS, )
         else:
-            entries[dim] = TENSOR_AXIS
+            entries[dim] = seq_axes + (TENSOR_AXIS, )
+    elif dim != 0 and seq_axes:
+        entries[dim] = seq_axes
     return P(*entries)
 
 

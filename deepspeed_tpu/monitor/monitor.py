@@ -63,6 +63,49 @@ class WandbMonitor(Monitor):
             self.wandb.log({name: value}, step=step)
 
 
+class CometMonitor(Monitor):
+    """ref: monitor/comet.py:23 CometMonitor — lazy comet_ml import, one
+    experiment per run, per-sample throttling via samples_log_interval."""
+
+    def __init__(self, comet_config):
+        super().__init__(comet_config)
+        self.sample_idx = 0
+        self.interval = getattr(comet_config, "samples_log_interval", 100)
+        try:
+            import comet_ml
+            kwargs = {}
+            if comet_config.api_key:
+                kwargs["api_key"] = comet_config.api_key
+            if comet_config.project:
+                kwargs["project_name"] = comet_config.project
+            if comet_config.workspace:
+                kwargs["workspace"] = comet_config.workspace
+            if comet_config.mode in ("offline", "disabled"):
+                kwargs["online"] = False
+            elif comet_config.online is not None:
+                kwargs["online"] = comet_config.online
+            if comet_config.experiment_key:
+                self.experiment = comet_ml.ExistingExperiment(
+                    previous_experiment=comet_config.experiment_key, **kwargs)
+            else:
+                self.experiment = comet_ml.Experiment(**kwargs)
+            if comet_config.experiment_name:
+                self.experiment.set_name(comet_config.experiment_name)
+            self.enabled = True
+        except Exception as e:  # comet_ml not installed / auth failure
+            logger.warning(f"Comet monitor disabled: {e}")
+            self.experiment = None
+
+    def write_events(self, event_list):
+        if self.experiment is None:
+            return
+        self.sample_idx += 1
+        if self.interval and (self.sample_idx - 1) % self.interval != 0:
+            return
+        for name, value, step in event_list:
+            self.experiment.log_metric(name, value, step=step)
+
+
 class csvMonitor(Monitor):
 
     def __init__(self, csv_config):
@@ -107,6 +150,10 @@ class MonitorMaster(Monitor):
                 self.monitors.append(m)
         if monitor_config.csv_monitor.enabled:
             m = csvMonitor(monitor_config.csv_monitor)
+            if m.enabled:
+                self.monitors.append(m)
+        if monitor_config.comet.enabled:
+            m = CometMonitor(monitor_config.comet)
             if m.enabled:
                 self.monitors.append(m)
         self.enabled = bool(self.monitors)

@@ -699,6 +699,15 @@ class DeepSpeedEngine:
             def eval_loss(state, b):
                 return self._microbatch_loss(state.params, b, step=state.step)
             self._eval_fn = jax.jit(eval_loss, in_shardings=(self.state_shardings, self._batch_shardings))
+            # refresh the per-bucket step cache: its entry was created with
+            # _eval_fn=None at train-step build time, and restoring that
+            # stale None on a bucket switch-and-back would force an eval
+            # retrace (advisor r2)
+            cache = getattr(self, "_step_cache", None)
+            key = getattr(self, "_step_key", None)
+            if cache is not None and key in cache:
+                cache[key] = (self._train_step_fn, self._accum_fn, self._apply_step_fn,
+                              self._batch_shardings, self._eval_fn)
         return self._eval_fn
 
     def forward(self, batch):

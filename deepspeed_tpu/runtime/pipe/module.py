@@ -326,12 +326,24 @@ class PipelineModule:
                     h_mb = _apply_layer(mod, vs, h_mb, ())
             return loss_fn(h_mb, mb_batch)
 
-        key = (id(mesh), self.micro_batches, id(loss_fn))
+        # cache key must not be id()-based: a recycled address after GC would
+        # silently reuse an executor closed over a dead mesh/loss_fn
+        # (advisor r2).  Key on the mesh's stable identity (axis names +
+        # shape + device ids) and hold a strong ref to loss_fn so its id is
+        # pinned for the cache's lifetime.
+        mesh_key = (tuple(mesh.axis_names), tuple(mesh.devices.shape),
+                    tuple(int(d.id) for d in mesh.devices.flat))
+        key = (mesh_key, self.micro_batches, id(loss_fn))
         if key not in self._1f1b_cache:
-            self._1f1b_cache[key] = make_pipelined_1f1b(
+            self._1f1b_cache[key] = (make_pipelined_1f1b(
                 body_fn, head_fn, mesh=mesh, num_stages=self.num_stages,
-                micro_batches=self.micro_batches, remat=self.remat)
-        return self._1f1b_cache[key](params["body"], nonbody, h, extras, batch)
+                micro_batches=self.micro_batches, remat=self.remat), loss_fn)
+            # the strong loss_fn ref pins its id (no GC recycling), but a
+            # caller building a fresh closure per step would then grow the
+            # cache without bound — keep the newest few executors (FIFO)
+            while len(self._1f1b_cache) > 8:
+                self._1f1b_cache.pop(next(iter(self._1f1b_cache)))
+        return self._1f1b_cache[key][0](params["body"], nonbody, h, extras, batch)
 
     def _apply_indexed(self, idx, params, h, extras):
         mod = self._layers[idx]

@@ -71,19 +71,45 @@ def ulysses_all_to_all(x, axis_name: str, scatter_idx: int, gather_idx: int):
 
 def ulysses_attention_shard_map(attn_fn: Callable, mesh=None, seq_axis: str = SEQ_AXIS):
     """Build a shard_map'd Ulysses attention: explicit collectives, for
-    kernels (e.g. Pallas flash) that must see the full sequence locally."""
+    kernels (e.g. Pallas flash) that must see the full sequence locally.
+
+    Uneven head counts (H % sp != 0, ref: deepspeed/sequence/layer.py:111)
+    are handled by zero-padding the head dim to the next sp multiple before
+    the head-scatter all-to-all and slicing it off after the seq-gather:
+    padded heads attend zero k/v (output exactly zero) and never reach the
+    caller.  The constraint-based ``DistributedAttention`` needs no padding
+    — GSPMD shards non-divisible dims with implicit padding."""
     mesh = mesh or get_global_mesh()
+    sp = mesh.shape.get(seq_axis, 1)
     qkv_spec = P(BATCH_AXES, seq_axis, TENSOR_AXIS if mesh.shape.get(TENSOR_AXIS, 1) > 1 else None, None)
 
     @partial(jax.shard_map, mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec)
     def wrapped(q, k, v):
-        if mesh.shape.get(seq_axis, 1) > 1:
+        if sp > 1:
             q = ulysses_all_to_all(q, seq_axis, 2, 1)
             k = ulysses_all_to_all(k, seq_axis, 2, 1)
             v = ulysses_all_to_all(v, seq_axis, 2, 1)
         out = attn_fn(q, k, v, causal=True)
-        if mesh.shape.get(seq_axis, 1) > 1:
+        if sp > 1:
             out = ulysses_all_to_all(out, seq_axis, 1, 2)
         return out
 
-    return wrapped
+    def call(q, k, v):
+        h = q.shape[2]
+        pad = (-h) % sp
+        if pad or k.shape[2] % sp:
+            # the head-scatter all_to_all needs BOTH head dims divisible by
+            # sp; GQA kv heads that aren't (whether or not q needs padding)
+            # are repeated to full width first so the group ratio survives
+            if k.shape[2] != h:
+                rep = h // k.shape[2]
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            if pad:
+                q = jnp.pad(q, ((0, 0), (0, 0), (0, pad), (0, 0)))
+                k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+                v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        out = wrapped(q, k, v)
+        return out[:, :, :h] if pad else out
+
+    return call

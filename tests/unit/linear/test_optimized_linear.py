@@ -29,9 +29,11 @@ def test_quantize_roundtrip(q_bits):
         cfg.q_dtype = jnp.int8
     x = jax.random.normal(jax.random.PRNGKey(0), (128, 64), jnp.float32)
     q, s = quantize(x, cfg)
-    back = dequantize(q, s, x.shape, jnp.float32)
+    back = dequantize(q, s, x.shape, jnp.float32, cfg=cfg)
     err = float(jnp.abs(back - x).max() / jnp.abs(x).max())
-    tol = {8: 0.05, 6: 0.08, 4: 0.2}[q_bits]
+    # 6 is now PACKED e3m2 fp6 (2 mantissa bits → 1/8 max rel step, ref
+    # csrc/fp_quantizer), not an int6 grid
+    tol = {8: 0.05, 6: 0.15, 4: 0.2}[q_bits]
     assert err < tol, f"{q_bits}-bit roundtrip error {err}"
 
 

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.comm.mesh import MeshSpec, SEQ_AXIS, create_mesh, set_global_mesh
 from deepspeed_tpu.models.llama import reference_attention
@@ -64,3 +65,43 @@ def test_ulysses_inside_model_training():
     batch = {"input_ids": ids, "labels": ids}
     losses = [float(engine.train_batch(batch=batch)) for _ in range(3)]
     assert losses[-1] < losses[0]
+
+
+def test_shard_map_ulysses_uneven_heads():
+    """H % sp != 0 (ref: deepspeed/sequence/layer.py:111 uneven heads):
+    heads=14 over sp=4 pads to 16 inside the wrapper, slices back after."""
+    mesh = create_mesh(MeshSpec(seq=4))
+    set_global_mesh(mesh)
+    q, k, v = _qkv(h=14, d=8)
+    ref = reference_attention(q, k, v, causal=True)
+    wrapped = ulysses_attention_shard_map(reference_attention, mesh=mesh)
+    out = wrapped(q, k, v)
+    assert out.shape == q.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_shard_map_ulysses_uneven_heads_gqa():
+    """Uneven q heads with grouped kv heads (14 q / 7 kv over sp=4)."""
+    mesh = create_mesh(MeshSpec(seq=4))
+    set_global_mesh(mesh)
+    q, _, _ = _qkv(h=14, d=8)
+    _, k, v = _qkv(h=7, d=8, seed=1)
+    ref = reference_attention(q, k, v, causal=True)
+    wrapped = ulysses_attention_shard_map(reference_attention, mesh=mesh)
+    out = wrapped(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_constraint_ulysses_uneven_heads():
+    """The GSPMD constraint path shards 14 heads over seq=4 via implicit
+    padding — full parity inside jit."""
+    from deepspeed_tpu.sequence.layer import DistributedAttention
+    mesh = create_mesh(MeshSpec(seq=4))
+    set_global_mesh(mesh)
+    q, k, v = _qkv(h=14, d=8)
+    ref = reference_attention(q, k, v, causal=True)
+    seq_sharded = NamedSharding(mesh, P(None, SEQ_AXIS, None, None))
+    q, k, v = (jax.device_put(t, seq_sharded) for t in (q, k, v))
+    attn = DistributedAttention(reference_attention)
+    out = jax.jit(lambda q, k, v: attn(q, k, v, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
