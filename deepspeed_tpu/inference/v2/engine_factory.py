@@ -51,11 +51,32 @@ def build_hf_engine(path: str,
     hf_cfg = AutoConfig.from_pretrained(path, local_files_only=True)
     sd = _load_state_dict(path)
     cfg, params = convert_hf_state_dict(sd, hf_cfg)
-    # every registered policy's config has a paged cache twin (cache_zoo /
-    # mixtral_cache / llama_cache); unknown model_types already raised in
-    # policy_for during conversion
     logger.info(f"build_hf_engine: model_type={hf_cfg.model_type} "
                 f"{sum(p.size for p in _leaves(params))/1e6:.1f}M params")
+
+    # v1-era archs (bloom / gpt-neox / gptj / gpt-neo) have conversion
+    # policies but no paged cache twin — the reference serves them through
+    # v1 kernel injection (module_inject/containers); here they route to the
+    # v1 jitted-forward engine behind a generate()-compatible surface
+    from ...models.llama import LlamaConfig
+    from ...models.cache_zoo import CACHE_MODEL_REGISTRY
+    from ...models.mixtral import MixtralConfig
+    twin_cfgs = (LlamaConfig, MixtralConfig, *CACHE_MODEL_REGISTRY.keys())
+    if not isinstance(cfg, twin_cfgs):
+        import deepspeed_tpu as ds
+        from .model_implementations.policies import policy_for
+        if quantization_mode is not None:
+            raise NotImplementedError(
+                f"quantization_mode={quantization_mode!r} requires the paged v2 engine; "
+                f"{hf_cfg.model_type} has no paged cache twin and serves via the v1 path")
+        if engine_config is not None:
+            logger.warning(f"build_hf_engine: engine_config is ignored for {hf_cfg.model_type} "
+                           "(v1 fallback path — no ragged scheduler/KV arena)")
+        model = policy_for(hf_cfg.model_type).build_model(cfg)
+        logger.info(f"build_hf_engine: {hf_cfg.model_type} has no paged twin — "
+                    "serving through the v1 engine (ref: v1 kernel-injection containers)")
+        return ds.init_inference(model=model, config={"dtype": "fp32"},
+                                 params={"params": params})
 
     if quantization_mode is not None:
         from ..quantization import quantize_inference_params

@@ -554,6 +554,197 @@ class Qwen2MoePolicy(InferenceV2Policy):
         return params
 
 
+
+
+class BloomPolicy(InferenceV2Policy):
+    """ref: module_inject/containers/bloom.py (BLOOMLayerPolicy) — fused qkv
+    stored (H, 3, D)-interleaved on the output dim, alibi positions, tied
+    head, LN after the word embedding."""
+    model_type = "bloom"
+
+    def build_config(self, hf_cfg):
+        from ....models.gpt_family import BloomConfig
+        return BloomConfig.from_hf(hf_cfg)
+
+    def build_model(self, cfg):
+        from ....models.gpt_family import BloomForCausalLM
+        return BloomForCausalLM(cfg)
+
+    def convert(self, sd, cfg):
+        H = cfg.num_attention_heads
+        D = cfg.hidden_size // H
+        E = cfg.hidden_size
+        L = cfg.num_hidden_layers
+        get = lambda name: _get(sd, name)
+        # some checkpoints prefix with "transformer."
+        pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        stack = lambda fmt, conv=(lambda w: w): _stack(sd, pre + fmt, L, conv)
+        ln = lambda fmt: {"scale": stack(fmt + ".weight"), "bias": stack(fmt + ".bias")}
+        params = {
+            "word_embeddings": {"embedding": get(pre + "word_embeddings.weight")},
+            "word_embeddings_layernorm": {
+                "scale": get(pre + "word_embeddings_layernorm.weight"),
+                "bias": get(pre + "word_embeddings_layernorm.bias")},
+            "ln_f": {"scale": get(pre + "ln_f.weight"), "bias": get(pre + "ln_f.bias")},
+            "h": {
+                "input_layernorm": ln("h.{i}.input_layernorm"),
+                "post_attention_layernorm": ln("h.{i}.post_attention_layernorm"),
+                "self_attention": {
+                    # HF [3E, E] whose output reshapes (H, 3, D) → ours [E, H, 3, D]
+                    "query_key_value": {
+                        "kernel": stack("h.{i}.self_attention.query_key_value.weight",
+                                        lambda w: _t(w).reshape(E, H, 3, D)),
+                        "bias": stack("h.{i}.self_attention.query_key_value.bias",
+                                      lambda b: b.reshape(H, 3, D))},
+                    "dense": {"kernel": stack("h.{i}.self_attention.dense.weight",
+                                              lambda w: _t(w).reshape(H, D, E)),
+                              "bias": stack("h.{i}.self_attention.dense.bias")},
+                },
+                "dense_h_to_4h": {"kernel": stack("h.{i}.mlp.dense_h_to_4h.weight", _t),
+                                  "bias": stack("h.{i}.mlp.dense_h_to_4h.bias")},
+                "dense_4h_to_h": {"kernel": stack("h.{i}.mlp.dense_4h_to_h.weight", _t),
+                                  "bias": stack("h.{i}.mlp.dense_4h_to_h.bias")},
+            },
+        }
+        return params
+
+
+class GPTNeoXPolicy(InferenceV2Policy):
+    """ref: module_inject/containers/gptneox.py (GPTNEOXLayerPolicy) — fused
+    qkv in per-head [q|k|v] layout, partial neox rotary, untied embed_out."""
+    model_type = "gpt_neox"
+
+    def build_config(self, hf_cfg):
+        from ....models.gpt_family import GPTNeoXConfig
+        return GPTNeoXConfig.from_hf(hf_cfg)
+
+    def build_model(self, cfg):
+        from ....models.gpt_family import GPTNeoXForCausalLM
+        return GPTNeoXForCausalLM(cfg)
+
+    def convert(self, sd, cfg):
+        H = cfg.num_attention_heads
+        D = cfg.hidden_size // H
+        E = cfg.hidden_size
+        L = cfg.num_hidden_layers
+        get = lambda name: _get(sd, name)
+        stack = lambda fmt, conv=(lambda w: w): _stack(sd, "gpt_neox." + fmt, L, conv)
+        ln = lambda fmt: {"scale": stack(fmt + ".weight"), "bias": stack(fmt + ".bias")}
+        params = {
+            "embed_in": {"embedding": get("gpt_neox.embed_in.weight")},
+            "final_layer_norm": {"scale": get("gpt_neox.final_layer_norm.weight"),
+                                 "bias": get("gpt_neox.final_layer_norm.bias")},
+            "embed_out": {"kernel": _t(get("embed_out.weight"))},
+            "layers": {
+                "input_layernorm": ln("layers.{i}.input_layernorm"),
+                "post_attention_layernorm": ln("layers.{i}.post_attention_layernorm"),
+                # HF [3E, E] whose output reshapes (H, 3*D) with per-head
+                # [q_h | k_h | v_h] → ours [E, H, 3, D] (3D row-major = (3, D))
+                "query_key_value": {
+                    "kernel": stack("layers.{i}.attention.query_key_value.weight",
+                                    lambda w: _t(w).reshape(E, H, 3, D)),
+                    "bias": stack("layers.{i}.attention.query_key_value.bias",
+                                  lambda b: b.reshape(H, 3, D))},
+                "dense": {"kernel": stack("layers.{i}.attention.dense.weight",
+                                          lambda w: _t(w).reshape(H, D, E)),
+                          "bias": stack("layers.{i}.attention.dense.bias")},
+                "dense_h_to_4h": {"kernel": stack("layers.{i}.mlp.dense_h_to_4h.weight", _t),
+                                  "bias": stack("layers.{i}.mlp.dense_h_to_4h.bias")},
+                "dense_4h_to_h": {"kernel": stack("layers.{i}.mlp.dense_4h_to_h.weight", _t),
+                                  "bias": stack("layers.{i}.mlp.dense_4h_to_h.bias")},
+            },
+        }
+        return params
+
+
+class GPTJPolicy(InferenceV2Policy):
+    """ref: module_inject/containers/gptj.py (HFGPTJLayerPolicy) — separate
+    unbiased q/k/v, interleaved rotary, one shared LN, biased lm_head."""
+    model_type = "gptj"
+
+    def build_config(self, hf_cfg):
+        from ....models.gpt_family import GPTJConfig
+        return GPTJConfig.from_hf(hf_cfg)
+
+    def build_model(self, cfg):
+        from ....models.gpt_family import GPTJForCausalLM
+        return GPTJForCausalLM(cfg)
+
+    def convert(self, sd, cfg):
+        H = cfg.num_attention_heads
+        D = cfg.hidden_size // H
+        E = cfg.hidden_size
+        L = cfg.num_hidden_layers
+        get = lambda name: _get(sd, name)
+        stack = lambda fmt, conv=(lambda w: w): _stack(sd, "transformer." + fmt, L, conv)
+        params = {
+            "wte": {"embedding": get("transformer.wte.weight")},
+            "ln_f": {"scale": get("transformer.ln_f.weight"), "bias": get("transformer.ln_f.bias")},
+            "lm_head": {"kernel": _t(get("lm_head.weight")), "bias": get("lm_head.bias")},
+            "h": {
+                "ln_1": {"scale": stack("h.{i}.ln_1.weight"), "bias": stack("h.{i}.ln_1.bias")},
+                "q_proj": {"kernel": stack("h.{i}.attn.q_proj.weight",
+                                           lambda w: _t(w).reshape(E, H, D))},
+                "k_proj": {"kernel": stack("h.{i}.attn.k_proj.weight",
+                                           lambda w: _t(w).reshape(E, H, D))},
+                "v_proj": {"kernel": stack("h.{i}.attn.v_proj.weight",
+                                           lambda w: _t(w).reshape(E, H, D))},
+                "out_proj": {"kernel": stack("h.{i}.attn.out_proj.weight",
+                                             lambda w: _t(w).reshape(H, D, E))},
+                "fc_in": {"kernel": stack("h.{i}.mlp.fc_in.weight", _t),
+                          "bias": stack("h.{i}.mlp.fc_in.bias")},
+                "fc_out": {"kernel": stack("h.{i}.mlp.fc_out.weight", _t),
+                           "bias": stack("h.{i}.mlp.fc_out.bias")},
+            },
+        }
+        return params
+
+
+class GPTNeoPolicy(InferenceV2Policy):
+    """ref: module_inject/containers/gptneo.py (HFGPTNEOLayerPolicy) —
+    learned positions, alternating global/local attention, tied head."""
+    model_type = "gpt_neo"
+
+    def build_config(self, hf_cfg):
+        from ....models.gpt_family import GPTNeoConfig
+        return GPTNeoConfig.from_hf(hf_cfg)
+
+    def build_model(self, cfg):
+        from ....models.gpt_family import GPTNeoForCausalLM
+        return GPTNeoForCausalLM(cfg)
+
+    def convert(self, sd, cfg):
+        H = cfg.num_attention_heads
+        D = cfg.hidden_size // H
+        E = cfg.hidden_size
+        L = cfg.num_hidden_layers
+        get = lambda name: _get(sd, name)
+        stack = lambda fmt, conv=(lambda w: w): _stack(sd, "transformer." + fmt, L, conv)
+        params = {
+            "wte": {"embedding": get("transformer.wte.weight")},
+            "wpe": {"embedding": get("transformer.wpe.weight")},
+            "ln_f": {"scale": get("transformer.ln_f.weight"), "bias": get("transformer.ln_f.bias")},
+            "h": {
+                "ln_1": {"scale": stack("h.{i}.ln_1.weight"), "bias": stack("h.{i}.ln_1.bias")},
+                "ln_2": {"scale": stack("h.{i}.ln_2.weight"), "bias": stack("h.{i}.ln_2.bias")},
+                "q_proj": {"kernel": stack("h.{i}.attn.attention.q_proj.weight",
+                                           lambda w: _t(w).reshape(E, H, D))},
+                "k_proj": {"kernel": stack("h.{i}.attn.attention.k_proj.weight",
+                                           lambda w: _t(w).reshape(E, H, D))},
+                "v_proj": {"kernel": stack("h.{i}.attn.attention.v_proj.weight",
+                                           lambda w: _t(w).reshape(E, H, D))},
+                "out_proj": {"kernel": stack("h.{i}.attn.attention.out_proj.weight",
+                                             lambda w: _t(w).reshape(H, D, E)),
+                             "bias": stack("h.{i}.attn.attention.out_proj.bias")},
+                "c_fc": {"kernel": stack("h.{i}.mlp.c_fc.weight", _t),
+                         "bias": stack("h.{i}.mlp.c_fc.bias")},
+                "c_proj": {"kernel": stack("h.{i}.mlp.c_proj.weight", _t),
+                           "bias": stack("h.{i}.mlp.c_proj.bias")},
+            },
+        }
+        return params
+
+
 POLICY_REGISTRY = {
     "llama": LlamaPolicy(),
     "mistral": MistralPolicy(),
@@ -564,6 +755,10 @@ POLICY_REGISTRY = {
     "falcon": FalconPolicy(),
     "phi": PhiPolicy(),
     "qwen2_moe": Qwen2MoePolicy(),
+    "bloom": BloomPolicy(),
+    "gpt_neox": GPTNeoXPolicy(),
+    "gptj": GPTJPolicy(),
+    "gpt_neo": GPTNeoPolicy(),
 }
 
 

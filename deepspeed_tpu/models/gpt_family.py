@@ -1,0 +1,459 @@
+"""v1-era GPT-family causal LMs: BLOOM, GPT-NeoX, GPT-J, GPT-Neo.
+
+Reference coverage: ``deepspeed/module_inject/containers/{bloom,gptneox,
+gptj,gptneo}.py`` — the reference serves these through v1 kernel-injection
+containers; here each is a native flax model sharing the Llama stack's
+design (scan-over-layers, logical-axis params, pluggable attention) with
+its family's quirks implemented exactly:
+
+  * BLOOM — ALiBi position bias (added UNSCALED to the scaled scores, HF
+    baddbmm semantics), fused qkv in (head, 3, dim) layout, LN after the
+    word embedding, sequential residual, tied head.
+  * GPT-NeoX — partial neox-style (half-split) rotary over
+    ``rotary_pct·D`` dims, fused qkv in (head, 3·dim) layout, parallel
+    residual (use_parallel_residual), untied embed_out.
+  * GPT-J — partial INTERLEAVED (rotate-every-two) rotary over
+    ``rotary_dim`` dims, one shared LN feeding both parallel branches,
+    biased lm_head.
+  * GPT-Neo — GPT-2-style learned positions, alternating global/local
+    attention layers (window_size) realized as a per-layer window array
+    scanned through one compiled body, untied... tied head.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from .falcon import alibi_slopes
+from .llama import (EMBED, HEAD_DIM, HEADS, LAYERS, MLP, VOCAB, _logical,
+                    get_attention_impl, reference_attention, rotary_embedding)
+from .phi import apply_partial_rope
+
+POSITIONS = "positions"
+
+
+def apply_rope_interleaved(x, positions, rotary_dim, theta=10000.0):
+    """GPT-J rotary: rotate-every-two pairing over the first ``rotary_dim``
+    dims (HF apply_rotary_pos_emb with duplicate_interleave), rest pass
+    through.  x: [B, S, N, D]."""
+    rot = x[..., :rotary_dim].astype(jnp.float32)
+    keep = x[..., rotary_dim:]
+    inv_freq = 1.0 / (theta**(jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim))
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq          # [B, S, rd/2]
+    sin = jnp.repeat(jnp.sin(ang), 2, axis=-1)[:, :, None, :]          # duplicate_interleave
+    cos = jnp.repeat(jnp.cos(ang), 2, axis=-1)[:, :, None, :]
+    x1 = rot[..., ::2]
+    x2 = rot[..., 1::2]
+    rot_ev = jnp.stack([-x2, x1], axis=-1).reshape(rot.shape)
+    out = rot * cos + rot_ev * sin
+    return jnp.concatenate([out.astype(x.dtype), keep], axis=-1)
+
+
+def _ln(cfg, name):
+    return nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                        scale_init=_logical(nn.initializers.ones_init(), (EMBED, )),
+                        bias_init=_logical(nn.initializers.zeros_init(), (EMBED, )), name=name)
+
+
+def _dense(cfg, feats, names, name, bias=True):
+    return nn.DenseGeneral(features=feats, use_bias=bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                           kernel_init=_logical(nn.initializers.normal(0.02), names),
+                           bias_init=_logical(nn.initializers.zeros_init(),
+                                              names[1:] if isinstance(feats, tuple) else (names[-1], )),
+                           name=name)
+
+
+def _mlp_gelu(cfg, x, inter, names=("dense_h_to_4h", "dense_4h_to_h"), bias=True):
+    h = _dense(cfg, inter, (EMBED, MLP), names[0], bias)(x)
+    return _dense(cfg, cfg.hidden_size, (MLP, EMBED), names[1], bias)(nn.gelu(h, approximate=True))
+
+
+def _scan_blocks(block_cls, cfg, n_layers, extra_in_axes=()):
+    # non-carry args are (positions, *extra, segment_ids): positions and
+    # segment_ids broadcast; extras (e.g. GPT-Neo's per-layer window) scan
+    return nn.scan(block_cls, variable_axes={"params": 0}, split_rngs={"params": True},
+                   in_axes=(nn.broadcast, ) + extra_in_axes + (nn.broadcast, ), length=n_layers,
+                   metadata_params={nn.PARTITION_NAME: LAYERS})
+
+
+# ------------------------------------------------------------------- BLOOM
+
+
+@dataclasses.dataclass(frozen=True)
+class BloomConfig:
+    vocab_size: int = 250880
+    hidden_size: int = 64
+    num_hidden_layers: int = 2
+    num_attention_heads: int = 8
+    layer_norm_epsilon: float = 1e-5
+    tie_word_embeddings: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = False
+    attention_impl: str = "reference"
+
+    @staticmethod
+    def from_hf(hf_cfg, **overrides):
+        fields = dict(vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+                      num_hidden_layers=hf_cfg.n_layer, num_attention_heads=hf_cfg.n_head,
+                      layer_norm_epsilon=getattr(hf_cfg, "layer_norm_epsilon", 1e-5),
+                      tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", True))
+        fields.update(overrides)
+        return BloomConfig(**fields)
+
+
+class BloomAttention(nn.Module):
+    cfg: BloomConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        H = cfg.num_attention_heads
+        D = cfg.hidden_size // H
+        qkv = _dense(cfg, (H, 3, D), (EMBED, HEADS, None, HEAD_DIM), "query_key_value")(x)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        # HF bloom: scores = alibi + (q·kᵀ)/√D — the alibi bias is NOT
+        # scaled (baddbmm beta=1, alpha=inv_norm), unlike falcon
+        slopes = jnp.asarray(alibi_slopes(H))
+        kpos = positions.astype(jnp.float32)
+        bias = slopes[None, :, None, None] * kpos[:, None, None, :]
+        out = reference_attention(q, k, v, causal=True, segment_ids=segment_ids, attn_bias=bias)
+        return nn.DenseGeneral(
+            features=cfg.hidden_size, axis=(-2, -1), use_bias=True, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=_logical(nn.initializers.normal(0.02), (HEADS, HEAD_DIM, EMBED)),
+            bias_init=_logical(nn.initializers.zeros_init(), (EMBED, )), name="dense")(out)
+
+
+class BloomBlock(nn.Module):
+    cfg: BloomConfig
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        h = x + BloomAttention(cfg, name="self_attention")(
+            _ln(cfg, "input_layernorm")(x), positions, segment_ids)
+        out = h + _mlp_gelu(cfg, _ln(cfg, "post_attention_layernorm")(h), 4 * cfg.hidden_size)
+        return (out, None) if self.scanned else out
+
+
+class BloomForCausalLM(nn.Module):
+    """ref: module_inject/containers/bloom.py (BLOOMLayerPolicy)."""
+    cfg: BloomConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                         name="word_embeddings")
+        x = _ln(cfg, "word_embeddings_layernorm")(embed(input_ids))
+        if cfg.scan_layers:
+            x, _ = _scan_blocks(BloomBlock, cfg, cfg.num_hidden_layers)(
+                cfg, scanned=True, name="h")(x, positions, segment_ids)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                x = BloomBlock(cfg, name=f"h_{i}")(x, positions, segment_ids)
+        x = _ln(cfg, "ln_f")(x)
+        return embed.attend(x)
+
+
+# ---------------------------------------------------------------- GPT-NeoX
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTNeoXConfig:
+    vocab_size: int = 50432
+    hidden_size: int = 64
+    intermediate_size: int = 256
+    num_hidden_layers: int = 2
+    num_attention_heads: int = 8
+    rotary_pct: float = 0.25
+    rope_theta: float = 10000.0
+    use_parallel_residual: bool = True
+    layer_norm_epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = False
+    attention_impl: str = "reference"
+
+    @staticmethod
+    def from_hf(hf_cfg, **overrides):
+        fields = dict(vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+                      intermediate_size=hf_cfg.intermediate_size,
+                      num_hidden_layers=hf_cfg.num_hidden_layers,
+                      num_attention_heads=hf_cfg.num_attention_heads,
+                      rotary_pct=getattr(hf_cfg, "rotary_pct", 0.25),
+                      rope_theta=getattr(hf_cfg, "rotary_emb_base", 10000.0),
+                      use_parallel_residual=getattr(hf_cfg, "use_parallel_residual", True),
+                      layer_norm_epsilon=getattr(hf_cfg, "layer_norm_eps", 1e-5))
+        fields.update(overrides)
+        return GPTNeoXConfig(**fields)
+
+
+class GPTNeoXBlock(nn.Module):
+    cfg: GPTNeoXConfig
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        H = cfg.num_attention_heads
+        D = cfg.hidden_size // H
+        rot = int(D * cfg.rotary_pct)
+
+        def attn(a_in):
+            qkv = _dense(cfg, (H, 3, D), (EMBED, HEADS, None, HEAD_DIM),
+                         "query_key_value")(a_in)
+            q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+            cos, sin = rotary_embedding(positions, rot, cfg.rope_theta)
+            q = apply_partial_rope(q, cos, sin, rot)
+            k = apply_partial_rope(k, cos, sin, rot)
+            out = get_attention_impl(cfg.attention_impl)(q, k, v, causal=True, segment_ids=segment_ids)
+            return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=True,
+                                   dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                   kernel_init=_logical(nn.initializers.normal(0.02), (HEADS, HEAD_DIM, EMBED)),
+                                   bias_init=_logical(nn.initializers.zeros_init(), (EMBED, )),
+                                   name="dense")(out)
+
+        attn_out = attn(_ln(cfg, "input_layernorm")(x))
+        if cfg.use_parallel_residual:
+            mlp_out = _mlp_gelu(cfg, _ln(cfg, "post_attention_layernorm")(x), cfg.intermediate_size)
+            out = x + attn_out + mlp_out
+        else:
+            h = x + attn_out
+            out = h + _mlp_gelu(cfg, _ln(cfg, "post_attention_layernorm")(h), cfg.intermediate_size)
+        return (out, None) if self.scanned else out
+
+
+class GPTNeoXForCausalLM(nn.Module):
+    """ref: module_inject/containers/gptneox.py (GPTNEOXLayerPolicy)."""
+    cfg: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                     name="embed_in")(input_ids)
+        if cfg.scan_layers:
+            x, _ = _scan_blocks(GPTNeoXBlock, cfg, cfg.num_hidden_layers)(
+                cfg, scanned=True, name="layers")(x, positions, segment_ids)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                x = GPTNeoXBlock(cfg, name=f"layers_{i}")(x, positions, segment_ids)
+        x = _ln(cfg, "final_layer_norm")(x)
+        return nn.DenseGeneral(features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                               param_dtype=cfg.param_dtype,
+                               kernel_init=_logical(nn.initializers.normal(0.02), (EMBED, VOCAB)),
+                               name="embed_out")(x)
+
+
+# ------------------------------------------------------------------- GPT-J
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTJConfig:
+    vocab_size: int = 50400
+    hidden_size: int = 64
+    intermediate_size: int = 256
+    num_hidden_layers: int = 2
+    num_attention_heads: int = 8
+    rotary_dim: int = 8
+    layer_norm_epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = False
+    attention_impl: str = "reference"
+
+    @staticmethod
+    def from_hf(hf_cfg, **overrides):
+        fields = dict(vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.n_embd,
+                      intermediate_size=getattr(hf_cfg, "n_inner", None) or 4 * hf_cfg.n_embd,
+                      num_hidden_layers=hf_cfg.n_layer, num_attention_heads=hf_cfg.n_head,
+                      rotary_dim=getattr(hf_cfg, "rotary_dim", None) or hf_cfg.n_embd // hf_cfg.n_head,
+                      layer_norm_epsilon=getattr(hf_cfg, "layer_norm_epsilon", 1e-5))
+        fields.update(overrides)
+        return GPTJConfig(**fields)
+
+
+class GPTJBlock(nn.Module):
+    cfg: GPTJConfig
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        H = cfg.num_attention_heads
+        D = cfg.hidden_size // H
+        a_in = _ln(cfg, "ln_1")(x)   # ONE shared LN feeds both parallel branches
+
+        proj = lambda name: nn.DenseGeneral(
+            features=(H, D), use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=_logical(nn.initializers.normal(0.02), (EMBED, HEADS, HEAD_DIM)), name=name)
+        q = apply_rope_interleaved(proj("q_proj")(a_in), positions, cfg.rotary_dim)
+        k = apply_rope_interleaved(proj("k_proj")(a_in), positions, cfg.rotary_dim)
+        v = proj("v_proj")(a_in)
+        out = get_attention_impl(cfg.attention_impl)(q, k, v, causal=True, segment_ids=segment_ids)
+        attn_out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
+                                   dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                   kernel_init=_logical(nn.initializers.normal(0.02), (HEADS, HEAD_DIM, EMBED)),
+                                   name="out_proj")(out)
+        mlp_out = _mlp_gelu(cfg, a_in, cfg.intermediate_size, names=("fc_in", "fc_out"))
+        out = x + attn_out + mlp_out
+        return (out, None) if self.scanned else out
+
+
+class GPTJForCausalLM(nn.Module):
+    """ref: module_inject/containers/gptj.py (HFGPTJLayerPolicy)."""
+    cfg: GPTJConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                     name="wte")(input_ids)
+        if cfg.scan_layers:
+            x, _ = _scan_blocks(GPTJBlock, cfg, cfg.num_hidden_layers)(
+                cfg, scanned=True, name="h")(x, positions, segment_ids)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                x = GPTJBlock(cfg, name=f"h_{i}")(x, positions, segment_ids)
+        x = _ln(cfg, "ln_f")(x)
+        # HF GPT-J lm_head carries a bias (unusual among the GPT family)
+        return nn.DenseGeneral(features=cfg.vocab_size, use_bias=True, dtype=cfg.dtype,
+                               param_dtype=cfg.param_dtype,
+                               kernel_init=_logical(nn.initializers.normal(0.02), (EMBED, VOCAB)),
+                               bias_init=_logical(nn.initializers.zeros_init(), (VOCAB, )),
+                               name="lm_head")(x)
+
+
+# ------------------------------------------------------------------ GPT-Neo
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTNeoConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 64
+    intermediate_size: int = 256
+    num_hidden_layers: int = 2
+    num_attention_heads: int = 8
+    max_position_embeddings: int = 2048
+    attention_layers: Tuple[str, ...] = ("global", "local")
+    window_size: int = 256
+    layer_norm_epsilon: float = 1e-5
+    tie_word_embeddings: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = False
+
+    @staticmethod
+    def from_hf(hf_cfg, **overrides):
+        fields = dict(vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+                      intermediate_size=getattr(hf_cfg, "intermediate_size", None) or 4 * hf_cfg.hidden_size,
+                      num_hidden_layers=hf_cfg.num_layers, num_attention_heads=hf_cfg.num_heads,
+                      max_position_embeddings=hf_cfg.max_position_embeddings,
+                      attention_layers=tuple(hf_cfg.attention_layers),
+                      window_size=getattr(hf_cfg, "window_size", 256),
+                      layer_norm_epsilon=getattr(hf_cfg, "layer_norm_epsilon", 1e-5))
+        fields.update(overrides)
+        return GPTNeoConfig(**fields)
+
+
+def _windowed_attention(q, k, v, window, segment_ids=None):
+    """Causal attention whose local window is a TRACED per-layer value
+    (window <= 0 means global) — this is what lets GPT-Neo's alternating
+    global/local stack ride ONE scanned layer body instead of unrolling.
+    NO 1/sqrt(D) score scaling: GPT-Neo was trained without it (HF
+    GPTNeoSelfAttention omits the division)."""
+    b, sq, nh, hd = q.shape
+    logits = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    qpos = jnp.arange(sq)[:, None]
+    kpos = jnp.arange(sq)[None, :]
+    eff = jnp.where(window > 0, window, sq + 1)
+    mask = (qpos >= kpos) & (kpos > qpos - eff)
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    if segment_ids is not None:
+        seg = segment_ids[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(seg[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bnqk,bknd->bqnd", probs.astype(v.dtype), v)
+
+
+class GPTNeoBlock(nn.Module):
+    cfg: GPTNeoConfig
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions, window, segment_ids=None):
+        cfg = self.cfg
+        H = cfg.num_attention_heads
+        D = cfg.hidden_size // H
+        a_in = _ln(cfg, "ln_1")(x)
+        proj = lambda name: nn.DenseGeneral(
+            features=(H, D), use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=_logical(nn.initializers.normal(0.02), (EMBED, HEADS, HEAD_DIM)), name=name)
+        out = _windowed_attention(proj("q_proj")(a_in), proj("k_proj")(a_in), proj("v_proj")(a_in),
+                                  window, segment_ids)
+        attn_out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=True,
+                                   dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                   kernel_init=_logical(nn.initializers.normal(0.02), (HEADS, HEAD_DIM, EMBED)),
+                                   bias_init=_logical(nn.initializers.zeros_init(), (EMBED, )),
+                                   name="out_proj")(out)
+        h = x + attn_out
+        out_ = h + _mlp_gelu(cfg, _ln(cfg, "ln_2")(h), cfg.intermediate_size, names=("c_fc", "c_proj"))
+        return (out_, None) if self.scanned else out_
+
+
+class GPTNeoForCausalLM(nn.Module):
+    """ref: module_inject/containers/gptneo.py (HFGPTNEOLayerPolicy)."""
+    cfg: GPTNeoConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                       name="wte")
+        wpe = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype,
+                       embedding_init=_logical(nn.initializers.normal(0.01), (POSITIONS, EMBED)),
+                       name="wpe")
+        x = wte(input_ids) + wpe(positions)
+        # per-layer window as scanned data: "local" layers attend the last
+        # window_size keys, "global" layers the whole causal prefix
+        layer_types = [cfg.attention_layers[i % len(cfg.attention_layers)]
+                       for i in range(cfg.num_hidden_layers)]
+        windows = jnp.asarray([cfg.window_size if t == "local" else 0 for t in layer_types],
+                              jnp.int32)
+        if cfg.scan_layers:
+            x, _ = _scan_blocks(GPTNeoBlock, cfg, cfg.num_hidden_layers, extra_in_axes=(0, ))(
+                cfg, scanned=True, name="h")(x, positions, windows, segment_ids)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                x = GPTNeoBlock(cfg, name=f"h_{i}")(x, positions, windows[i], segment_ids)
+        x = _ln(cfg, "ln_f")(x)
+        return wte.attend(x)

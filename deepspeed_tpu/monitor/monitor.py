@@ -71,6 +71,10 @@ class CometMonitor(Monitor):
         super().__init__(comet_config)
         self.sample_idx = 0
         self.interval = getattr(comet_config, "samples_log_interval", 100)
+        if getattr(comet_config, "mode", None) == "disabled":
+            # 'disabled' means OFF — not an offline experiment archive
+            self.experiment = None
+            return
         try:
             import comet_ml
             kwargs = {}
@@ -80,7 +84,7 @@ class CometMonitor(Monitor):
                 kwargs["project_name"] = comet_config.project
             if comet_config.workspace:
                 kwargs["workspace"] = comet_config.workspace
-            if comet_config.mode in ("offline", "disabled"):
+            if comet_config.mode == "offline":
                 kwargs["online"] = False
             elif comet_config.online is not None:
                 kwargs["online"] = comet_config.online

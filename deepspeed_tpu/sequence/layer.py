@@ -81,7 +81,8 @@ def ulysses_attention_shard_map(attn_fn: Callable, mesh=None, seq_axis: str = SE
     — GSPMD shards non-divisible dims with implicit padding."""
     mesh = mesh or get_global_mesh()
     sp = mesh.shape.get(seq_axis, 1)
-    qkv_spec = P(BATCH_AXES, seq_axis, TENSOR_AXIS if mesh.shape.get(TENSOR_AXIS, 1) > 1 else None, None)
+    tp = mesh.shape.get(TENSOR_AXIS, 1)
+    qkv_spec = P(BATCH_AXES, seq_axis, TENSOR_AXIS if tp > 1 else None, None)
 
     @partial(jax.shard_map, mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec)
     def wrapped(q, k, v):
@@ -96,8 +97,12 @@ def ulysses_attention_shard_map(attn_fn: Callable, mesh=None, seq_axis: str = SE
 
     def call(q, k, v):
         h = q.shape[2]
-        pad = (-h) % sp
-        if pad or k.shape[2] % sp:
+        # heads are first split over TENSOR by qkv_spec, and each TP shard's
+        # local heads then scatter over the seq group — so the pad target is
+        # a multiple of sp·tp, not just sp
+        unit = sp * tp
+        pad = (-h) % unit
+        if pad or k.shape[2] % unit:
             # the head-scatter all_to_all needs BOTH head dims divisible by
             # sp; GQA kv heads that aren't (whether or not q needs padding)
             # are repeated to full width first so the group ratio survives

@@ -105,3 +105,23 @@ def test_constraint_ulysses_uneven_heads():
     attn = DistributedAttention(reference_attention)
     out = jax.jit(lambda q, k, v: attn(q, k, v, causal=True))(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_shard_map_ulysses_sp_x_tp_heads():
+    """On an SP×TP mesh the pad unit is sp·tp: 12 heads over seq=2×tensor=2
+    needs each TP shard's 6 local heads divisible by sp=2 (ok), while 6
+    heads over seq=4 pads to 8."""
+    from deepspeed_tpu.comm.mesh import MeshSpec as MS
+    mesh = create_mesh(MS(seq=2, tensor=2))
+    set_global_mesh(mesh)
+    q, k, v = _qkv(h=12, d=8)
+    ref = reference_attention(q, k, v, causal=True)
+    out = ulysses_attention_shard_map(reference_attention, mesh=mesh)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    mesh = create_mesh(MS(seq=4))
+    set_global_mesh(mesh)
+    q, k, v = _qkv(h=6, d=8)
+    ref = reference_attention(q, k, v, causal=True)
+    out = ulysses_attention_shard_map(reference_attention, mesh=mesh)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
