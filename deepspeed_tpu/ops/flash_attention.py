@@ -156,7 +156,8 @@ def _fwd2_kernel(tab_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale, bq, bk, P, d
             l = jnp.maximum(ls[p][:], 1e-30)
             o_ref[0, :, p * d:(p + 1) * d] = (accs[p][:] / l).astype(o_ref.dtype)
             if lse_ref is not None:
-                lse_ref[0, p] = jnp.broadcast_to(ms[p][:] + jnp.log(l), lse_ref[0, p].shape)
+                lse_ref[0, p] = jnp.broadcast_to(ms[p][:] + jnp.log(l),
+                                                 lse_ref[0, p].shape).astype(lse_ref.dtype)
 
 
 def _flash_fwd2(q, k, v, *, h, causal, block_q, block_k, interpret, emit_lse=True):
@@ -195,8 +196,15 @@ def _flash_fwd2(q, k, v, *, h, causal, block_q, block_k, interpret, emit_lse=Tru
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
+        # lse stored in the INPUT dtype: the lane-broadcast layout makes it
+        # the LARGEST kernel operand (B·H·S·128 — written once, re-read by
+        # BOTH backward kernels).  bf16 runs halve that traffic (lse error
+        # ~2⁻⁹·|lse| scales p by ≲1.5%, comparable to the bf16 dot noise
+        # already present); f32 runs keep f32 lse and f32-grade grads
         out_shape=[jax.ShapeDtypeStruct((b, sq, hd), q.dtype)] + ([
-            jax.ShapeDtypeStruct((b, h, sq, LANE), jnp.float32)] if emit_lse else []),
+            jax.ShapeDtypeStruct((b, h, sq, LANE),
+                                 jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32)]
+            if emit_lse else []),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
@@ -213,7 +221,7 @@ def _bwd2_block(tab_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *, scale, 
     v = v_ref[0, :, p * d:(p + 1) * d]
     do = do_ref[0, :, p * d:(p + 1) * d]
     o = o_ref[0, :, p * d:(p + 1) * d]
-    lse = lse_ref[0, p][:, :1]
+    lse = lse_ref[0, p][:, :1].astype(jnp.float32)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=1, keepdims=True)
     s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                             preferred_element_type=jnp.float32) * scale

@@ -1,0 +1,17 @@
+import glob, json, sys
+from xprof.convert import raw_to_tool_data as rtd
+
+outdir = sys.argv[1]
+nsteps = float(sys.argv[2]) if len(sys.argv) > 2 else 5.0
+xspace = sorted(glob.glob(outdir + "/plugins/profile/*/*.xplane.pb"))[-1]
+data, _ = rtd.xspace_to_tool_data([xspace], "framework_op_stats", {})
+d = json.loads(data if isinstance(data, str) else data.decode())
+rows = [[c.get("v") for c in r["c"]] for r in d[0]["rows"]]
+dev = [r for r in rows if r[1] == "Device"]
+dev.sort(key=lambda r: -(r[7] or 0))
+tot = sum(r[7] or 0 for r in dev)
+print(f"total device self per step: {tot/nsteps/1e3:.1f} ms")
+for r in dev[:20]:
+    name = str(r[3])
+    short = "/".join(name.split("/")[-4:]) if len(name.split("/")) > 4 else name
+    print(f"{r[7]/nsteps/1e3:7.2f} ms/step  n={int(r[4]):4d} {str(r[2])[:20]:20s} {str(r[17]):8s} {str(r[14])[:8]:>8s}GF {short[:80]}")
