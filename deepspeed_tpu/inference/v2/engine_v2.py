@@ -67,6 +67,11 @@ class RaggedInferenceEngineConfig:
     # KV page reuse across shared prompt prefixes
     # (ref: inference/v2/ragged/prefix_cache_manager.py)
     enable_prefix_cache: bool = True
+    # pure-decode rounds fused into ONE compiled program (the reference's
+    # CUDA-graphs analog): dispatch/host overhead amortizes K×, which
+    # dominates decode at small models or over tunneled chips.  Sequences
+    # hitting EOS mid-block have their surplus tokens discarded host-side.
+    decode_steps_per_dispatch: int = 8
 
 
 class InferenceEngineV2:
@@ -145,14 +150,96 @@ class InferenceEngineV2:
             self._step_fns[key] = jax.jit(step, donate_argnums=(1, ))
         return self._step_fns[key]
 
+    def _compiled_multi_step(self, batch: int, k: int):
+        key = ("multi", batch, k)
+        if key not in self._step_fns:
+            logger.info(f"InferenceEngineV2: compiling multi-decode program batch={batch} k={k}")
+
+            def mstep(params, cache, tokens0, start_pos, block_tables, chunk_lens, rng):
+                if self._qparams is not None:
+                    params = {"params": self._qparams.dequantize(params["params"])}
+
+                def body(i, carry):
+                    cache, toks, out = carry
+                    logits, cache = self.model.apply(params, toks[:, None], start_pos + i,
+                                                     block_tables, cache, chunk_lens)
+                    row_logits = logits[:, 0]
+                    if self.econfig.greedy:
+                        nxt = jnp.argmax(row_logits, axis=-1).astype(jnp.int32)
+                    else:
+                        nxt = jax.random.categorical(
+                            jax.random.fold_in(rng, i),
+                            row_logits / self.econfig.temperature, axis=-1).astype(jnp.int32)
+                    return (cache, nxt, out.at[:, i].set(nxt))
+
+                out0 = jnp.zeros((batch, k), jnp.int32)
+                cache, _, out = jax.lax.fori_loop(0, k, body, (cache, tokens0, out0))
+                return out, cache
+
+            self._step_fns[key] = jax.jit(mstep, donate_argnums=(1, ))
+        return self._step_fns[key]
+
+    def _multi_decode(self, seqs, k: int) -> Dict[int, List[int]]:
+        """Run ``k`` fused decode rounds for a pure-decode batch."""
+        batch = self._bucket_batch(len(seqs))
+        for s in seqs:
+            # capacity for the WHOLE block up front; pack()'s per-token
+            # ensure_capacity then finds nothing left to allocate
+            self.kv.ensure_capacity(s, k)
+        rb: RaggedBatch = self.state.pack([(s, 1) for s in seqs], 1, pad_to=batch)
+
+        self.rng, sub = jax.random.split(self.rng)
+        fn = self._compiled_multi_step(batch, k)
+        toks, self.cache = fn(self.params, self.cache, jnp.asarray(rb.tokens[:, 0]),
+                              jnp.asarray(rb.start_pos), jnp.asarray(rb.block_tables),
+                              jnp.asarray(rb.chunk_lens), sub)
+        toks = np.asarray(toks)
+
+        out: Dict[int, List[int]] = {}
+        eos = self.econfig.eos_token_id
+        for i, s in enumerate(seqs):
+            before = len(s.generated)
+            s.seen_tokens += k
+            limit = self._max_new.get(s.uid, self.econfig.max_new_tokens)
+            for t in toks[i]:
+                s.tokens.append(int(t))
+                s.generated.append(int(t))
+                if len(s.generated) >= limit or (eos is not None and int(t) == eos):
+                    # surplus tokens computed past EOS/limit are discarded;
+                    # the KV written for them lies beyond the clamped seen
+                    # boundary and is released with the sequence
+                    s.done = True
+                    break
+            s.seen_tokens = min(s.seen_tokens, len(s.tokens))
+            self.state.note_progress(s)
+            out[s.uid] = list(s.generated[before:])
+        return out
+
     def _bucket_batch(self, n: int) -> int:
         q = self.econfig.scheduler.decode_bucket
         return min(self.state.max_batch, -(-n // q) * q)
 
-    def step(self) -> Dict[int, int]:
-        """Run one scheduled step; returns {uid: new_token} for sequences
-        that produced a token this step."""
+    def step(self) -> Dict[int, List[int]]:
+        """Run one scheduled step; returns {uid: [new tokens]} for
+        sequences that produced tokens this call — one token per uid on
+        the single-step path, up to ``decode_steps_per_dispatch`` on the
+        fused decode path."""
         plan: StepPlan = self.scheduler.plan(self.state)
+        k_cfg = self.econfig.decode_steps_per_dispatch
+        if k_cfg > 1 and plan.decode and not plan.prefill:
+            remaining = min(self._max_new.get(s.uid, self.econfig.max_new_tokens) -
+                            len(s.generated) for s in plan.decode)
+            pages_free = sum(self.kv.pages_needed(s, k_cfg)
+                             for s in plan.decode) <= self.kv.allocator.free_pages
+            # quantize k to a halving ladder (K, K/2, ...) — a data-dependent
+            # tail k would compile a fresh program mid-serve; each rung is
+            # one reusable program, sub-2 tails run the single-step path
+            if pages_free:
+                k = k_cfg
+                while k > 1 and remaining < k:
+                    k //= 2
+                if k > 1:
+                    return self._multi_decode(plan.decode, k)
         work: List = [(s, 1) for s in plan.decode] + list(plan.prefill)
         if not work:
             return {}
@@ -169,7 +256,7 @@ class InferenceEngineV2:
                                   jnp.asarray(rb.chunk_lens), sub)
         next_tok = np.asarray(next_tok)
 
-        out: Dict[int, int] = {}
+        out: Dict[int, List[int]] = {}
         for i, uid in enumerate(rb.uids):
             if uid < 0:
                 continue
@@ -182,7 +269,7 @@ class InferenceEngineV2:
             tok = int(next_tok[i])
             seq.tokens.append(tok)
             seq.generated.append(tok)
-            out[uid] = tok
+            out[uid] = [tok]
             eos = self.econfig.eos_token_id
             if len(seq.generated) >= self._max_new.get(uid, self.econfig.max_new_tokens) or \
                     (eos is not None and tok == eos):

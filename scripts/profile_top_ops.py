@@ -1,3 +1,12 @@
+#!/usr/bin/env python
+"""Summarize a jax.profiler trace: top device ops by self-time.
+
+Usage: python scripts/profile_top_ops.py <trace_dir> [n_steps]
+(<trace_dir> = the directory passed to jax.profiler.start_trace; n_steps
+divides totals into per-step figures.)  This is the xprof workflow the
+round-3 perf push ran on: capture 5 bench steps under start_trace/stop_trace,
+then read the framework_op_stats table.
+"""
 import glob, json, sys
 from xprof.convert import raw_to_tool_data as rtd
 

@@ -89,11 +89,19 @@ def test_eos_stops_generation(trained_params):
 
 
 def test_compiled_program_reuse(trained_params):
-    """Steady-state decode reuses ONE compiled program (shape bucketing)."""
+    """Steady-state serving uses a BOUNDED, shape-bucketed program set:
+    one prefill-chunk program, the fused-decode ladder (K, K/2, ... — one
+    per rung), and the single-step tail — never a per-shape compile."""
+    import math
     eng = _engine(trained_params)
     eng.generate([[5, 9, 2, 7, 1], [3, 3, 8]], max_new_tokens=8)
-    # one prefill-chunk program + one decode program
-    assert len(eng._step_fns) <= 2, list(eng._step_fns)
+    k = eng.econfig.decode_steps_per_dispatch
+    bound = 2 + max(0, int(math.log2(max(1, k))))
+    assert len(eng._step_fns) <= bound, list(eng._step_fns)
+    # a second generation of the same shape compiles NOTHING new
+    before = set(eng._step_fns)
+    eng.generate([[9, 1, 4], [2, 2, 6, 8]], max_new_tokens=8)
+    assert set(eng._step_fns) == before, (before, set(eng._step_fns))
 
 
 def test_kv_pages_released_on_flush(trained_params):
