@@ -745,6 +745,142 @@ class GPTNeoPolicy(InferenceV2Policy):
         return params
 
 
+
+
+class BertPolicy(InferenceV2Policy):
+    """ref: module_inject/containers/bert.py (HFBertLayerPolicy) — encoder
+    serving via the jitted v1 forward (no generation loop); converts HF
+    BertForMaskedLM into models/bert.BertForMaskedLM (scan-over-layers,
+    tied-decoder MLM head)."""
+    model_type = "bert"
+
+    def build_config(self, hf_cfg):
+        pet = getattr(hf_cfg, "position_embedding_type", "absolute")
+        if pet != "absolute":
+            raise ValueError(f"bert position_embedding_type={pet!r} unsupported "
+                             "(distance embeddings have no translation here); silently "
+                             "dropping them would serve wrong logits")
+        act = getattr(hf_cfg, "hidden_act", "gelu")
+        if act not in ("gelu", "gelu_new", "gelu_python"):
+            raise ValueError(f"bert hidden_act={act!r} unsupported (model uses gelu)")
+        from ....models.bert import BertConfig
+        return BertConfig.from_hf(hf_cfg)
+
+    def build_model(self, cfg):
+        from ....models.bert import BertForMaskedLM
+        return BertForMaskedLM(cfg)
+
+    def convert(self, sd, cfg):
+        H = cfg.num_attention_heads
+        D = cfg.hidden_size // H
+        E = cfg.hidden_size
+        L = cfg.num_hidden_layers
+        get = lambda name: _get(sd, name)
+        stack = lambda fmt, conv=(lambda w: w): _stack(sd, "bert.encoder.layer.{i}." + fmt, L, conv)
+        ln = lambda fmt: {"scale": stack(fmt + ".weight"), "bias": stack(fmt + ".bias")}
+        proj = lambda name: _proj(sd, L, E, D, "bert.encoder.layer.{i}.attention.self." + name,
+                                  H, bias=True)
+        params = {
+            "bert": {
+                "word_embeddings": {"embedding": get("bert.embeddings.word_embeddings.weight")},
+                "position_embeddings": {"embedding": get("bert.embeddings.position_embeddings.weight")},
+                "token_type_embeddings": {"embedding": get("bert.embeddings.token_type_embeddings.weight")},
+                "embeddings_ln": {"scale": get("bert.embeddings.LayerNorm.weight"),
+                                  "bias": get("bert.embeddings.LayerNorm.bias")},
+                "encoder": {
+                    "attention": {
+                        "query": proj("query"),
+                        "key": proj("key"),
+                        "value": proj("value"),
+                        "output": {"kernel": stack("attention.output.dense.weight",
+                                                   lambda w: _t(w).reshape(H, D, E)),
+                                   "bias": stack("attention.output.dense.bias")},
+                    },
+                    "attention_output_ln": ln("attention.output.LayerNorm"),
+                    "intermediate": {"kernel": stack("intermediate.dense.weight", _t),
+                                     "bias": stack("intermediate.dense.bias")},
+                    "output": {"kernel": stack("output.dense.weight", _t),
+                               "bias": stack("output.dense.bias")},
+                    "output_ln": ln("output.LayerNorm"),
+                },
+            },
+            "transform": {"kernel": _t(get("cls.predictions.transform.dense.weight")),
+                          "bias": get("cls.predictions.transform.dense.bias")},
+            "transform_ln": {"scale": get("cls.predictions.transform.LayerNorm.weight"),
+                             "bias": get("cls.predictions.transform.LayerNorm.bias")},
+            "decoder": {"kernel": _t(get("cls.predictions.decoder.weight"))
+                        if "cls.predictions.decoder.weight" in sd
+                        else _t(get("bert.embeddings.word_embeddings.weight")),
+                        "bias": get("cls.predictions.decoder.bias")
+                        if "cls.predictions.decoder.bias" in sd
+                        else get("cls.predictions.bias")},
+        }
+        return params
+
+
+
+
+class QwenV1Policy(InferenceV2Policy):
+    """ref: the reference's qwen (v1) container (module_inject) — the
+    trust_remote_code QWenLMHeadModel: llama math with a fused biased
+    c_attn, SwiGLU as c_proj(w1(x)·silu(w2(x))), RMSNorm ln_1/ln_2.
+    Mapped onto LlamaForCausalLM: c_attn split into q/k/v (MHA),
+    gate=w2 (the silu side), up=w1, down=c_proj."""
+    model_type = "qwen"
+
+    def build_config(self, hf_cfg):
+        cfg = LlamaConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+            intermediate_size=getattr(hf_cfg, "intermediate_size", 4 * hf_cfg.hidden_size) // 2,
+            num_hidden_layers=hf_cfg.num_hidden_layers,
+            num_attention_heads=hf_cfg.num_attention_heads,
+            num_key_value_heads=hf_cfg.num_attention_heads,
+            max_position_embeddings=getattr(hf_cfg, "max_position_embeddings", 8192),
+            rope_theta=getattr(hf_cfg, "rotary_emb_base", 10000.0),
+            rms_norm_eps=getattr(hf_cfg, "layer_norm_epsilon", 1e-6),
+            attention_bias=True, tie_word_embeddings=False)
+        return cfg
+
+    def convert(self, sd, cfg):
+        H = cfg.num_attention_heads
+        D = cfg.hidden_size // H
+        E = cfg.hidden_size
+        L = cfg.num_hidden_layers
+        get = lambda name: _get(sd, name)
+        stack = lambda fmt, conv=(lambda w: w): _stack(sd, "transformer.h.{i}." + fmt, L, conv)
+
+        # c_attn [3E, E] (+bias [3E]) fused as [q; k; v] — convert the fused
+        # tensor ONCE (it is the largest per-layer weight), then slice thirds
+        fused_w = stack("attn.c_attn.weight", lambda w: _t(w).reshape(E, 3, H, D))
+        fused_b = stack("attn.c_attn.bias", lambda b: b.reshape(3, H, D))
+
+        def split_qkv(part):
+            i = "qkv".index(part)
+            return {"kernel": np.ascontiguousarray(fused_w[:, :, i]),
+                    "bias": np.ascontiguousarray(fused_b[:, i])}
+
+        params = {
+            "embed_tokens": {"embedding": get("transformer.wte.weight")},
+            "norm": {"weight": get("transformer.ln_f.weight")},
+            "lm_head": {"kernel": _t(get("lm_head.weight"))},
+            "model": {"layers": {
+                "input_layernorm": {"weight": stack("ln_1.weight")},
+                "post_attention_layernorm": {"weight": stack("ln_2.weight")},
+                "self_attn": {
+                    "q_proj": split_qkv("q"), "k_proj": split_qkv("k"), "v_proj": split_qkv("v"),
+                    "o_proj": {"kernel": stack("attn.c_proj.weight",
+                                               lambda w: _t(w).reshape(H, D, E))},
+                },
+                "mlp": {
+                    "gate_proj": {"kernel": stack("mlp.w2.weight", _t)},
+                    "up_proj": {"kernel": stack("mlp.w1.weight", _t)},
+                    "down_proj": {"kernel": stack("mlp.c_proj.weight", _t)},
+                },
+            }},
+        }
+        return params
+
+
 POLICY_REGISTRY = {
     "llama": LlamaPolicy(),
     "mistral": MistralPolicy(),
@@ -759,6 +895,8 @@ POLICY_REGISTRY = {
     "gpt_neox": GPTNeoXPolicy(),
     "gptj": GPTJPolicy(),
     "gpt_neo": GPTNeoPolicy(),
+    "bert": BertPolicy(),
+    "qwen": QwenV1Policy(),
 }
 
 
