@@ -103,6 +103,37 @@ def test_flash_backward_gqa_grads():
                                    err_msg=f"d{name} mismatch")
 
 
+def test_flash_mqa_vmem_fallback():
+    """MQA-extreme head ratios (P·d past the VMEM cap) must route through
+    the repeated-KV fallback and still match the reference, fwd and bwd."""
+    from deepspeed_tpu.ops.flash_attention import _gqa_native_ok
+    q, k, v = _qkv(h=32, hk=1, s=64, d=64, seed=5)
+    assert not _gqa_native_ok(64, 32, 1)  # this shape must exercise the fallback
+    expected = reference_attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5, rtol=2e-5)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=32, block_k=32, interpret=True)**2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True)**2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_flash_rejects_non_divisible_heads():
+    """h % hk != 0 must fail loudly, not return garbage in the upper heads."""
+    q, _, _ = _qkv(h=6, s=128, d=64)
+    _, k, v = _qkv(h=4, s=128, d=64)
+    with pytest.raises(AssertionError, match="not a multiple"):
+        flash_attention(q, k, v, causal=True, block_q=32, block_k=32, interpret=True)
+
+
 def test_flash_bf16():
     q, k, v = (t.astype(jnp.bfloat16) for t in _qkv())
     expected = reference_attention(q, k, v, causal=True)
