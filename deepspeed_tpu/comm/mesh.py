@@ -104,6 +104,47 @@ def has_global_mesh() -> bool:
     return _GLOBAL_MESH is not None
 
 
+_TRACE_MESH: Optional[Mesh] = None
+
+
+def trace_mesh(mesh: Optional[Mesh]):
+    """Context manager marking *which mesh governs the computation being
+    traced*.  Engines wrap their jitted-fn invocations (where tracing
+    happens) in this; kernels that must wrap themselves in shard_map under a
+    multi-device mesh (Mosaic custom calls cannot be auto-partitioned by
+    GSPMD) consult it via ``get_trace_mesh``.  Deliberately NOT the global
+    mesh: that is process-wide and would hijack unrelated jits — e.g. a
+    single-device eval traced after an 8-device training engine was built."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ctx():
+        global _TRACE_MESH
+        prev = _TRACE_MESH
+        _TRACE_MESH = mesh
+        try:
+            yield
+        finally:
+            _TRACE_MESH = prev
+
+    return _ctx()
+
+
+def get_trace_mesh() -> Optional[Mesh]:
+    return _TRACE_MESH
+
+
+def in_manual_mesh() -> bool:
+    """True inside a shard_map body: GSPMD-level sharding constraints are
+    meaningless/illegal there, and shard_map-wrapping kernels must not
+    re-wrap."""
+    try:
+        from jax.sharding import get_abstract_mesh
+        return bool(get_abstract_mesh()._any_axis_manual)
+    except Exception:
+        return False
+
+
 def axis_size(mesh: Mesh, *axes: str) -> int:
     return int(np.prod([mesh.shape[a] for a in axes if a in mesh.shape]))
 

@@ -138,7 +138,8 @@ class InferenceEngine:
         if not isinstance(self._fwd, dict) or self._fwd.get("key") != key:
             self._fwd = {"key": key,
                          "fn": jax.jit(lambda p, a, kw: self.module.apply(p, *a, **kw, **static))}
-        with self.mesh:
+        from ..comm.mesh import trace_mesh
+        with self.mesh, trace_mesh(self.mesh):
             return self._fwd["fn"](self.params, args, traced)
 
     __call__ = forward
@@ -181,7 +182,8 @@ class InferenceEngine:
         eos = self.config.eos_token_id
         done = np.zeros(b, bool)
         n_done_at = np.full(b, s0 + max_new, np.int64)
-        with self.mesh:
+        from ..comm.mesh import trace_mesh
+        with self.mesh, trace_mesh(self.mesh):
             for t in range(max_new):
                 self._rng, sub = jax.random.split(self._rng)
                 buf, nxt = jstep(self.params, buf, jnp.int32(s0 + t), sub)

@@ -107,11 +107,8 @@ def _logical(init, names):
 def _in_manual_mesh() -> bool:
     """True inside a shard_map body (e.g. the pipeline rotation): GSPMD-level
     sharding constraints are meaningless/illegal there."""
-    try:
-        from jax.sharding import get_abstract_mesh
-        return bool(get_abstract_mesh()._any_axis_manual)
-    except Exception:
-        return False
+    from ..comm.mesh import in_manual_mesh
+    return in_manual_mesh()
 
 
 def _skip_constraint(x) -> bool:
@@ -129,6 +126,13 @@ def _resolve_remat_policy(name: str):
         return jax.checkpoint_policies.save_from_both_policies(
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             jax.checkpoint_policies.save_only_these_names("flash_out", "flash_lse"))
+    if name == "flash_only":
+        # memory-lean large-model policy: ONLY the flash kernel outputs are
+        # saved (so the backward still runs the dedicated dq/dkv kernels, no
+        # third attention pass) while every projection/MLP dot recomputes —
+        # under scan-over-layers the residual stack stays O(layers·B·S·E)
+        # instead of O(layers·B·S·intermediate)
+        return jax.checkpoint_policies.save_only_these_names("flash_out", "flash_lse")
     return getattr(jax.checkpoint_policies, name, None)
 
 

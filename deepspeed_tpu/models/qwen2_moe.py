@@ -19,6 +19,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import linen as nn
 
 from .llama import EMBED, LAYERS, MLP, VOCAB, LlamaAttention, LlamaConfig, RMSNorm, _logical
@@ -100,6 +101,32 @@ class Qwen2MoeConfig:
         return cfg
 
 
+def _moe_intermediate_constraint(t):
+    """Pin a [B, S, N_experts, *] dense-mixture intermediate to batch
+    sharding over the full (data×expert) group, experts LOCAL.
+
+    This model computes every expert for every token (exact HF math — see
+    the module docstring; capacity-based EP dispatch lives in moe.MoE), so
+    the FLOP-consistent layout is token-parallel over all DP axes with the
+    expert-stacked weights gathered per layer, exactly like ZeRO-3 dense
+    weights.  Leaving GSPMD to resolve the chain instead mixes the weights'
+    expert-axis sharding into the activations and falls back to
+    "Involuntary full rematerialization" — replicating a full [B,S,NE,E]
+    tensor per MoE layer (MULTICHIP_r03.json tail)."""
+    from ..comm.mesh import BATCH_AXES, get_global_mesh, has_global_mesh
+    from .llama import _skip_constraint
+    if not has_global_mesh() or _skip_constraint(t):
+        return t
+    mesh = get_global_mesh()
+    nb = int(np.prod([mesh.shape.get(a, 1) for a in BATCH_AXES]))
+    if nb == 1 or t.shape[0] % nb:
+        return t
+    from jax.sharding import NamedSharding, PartitionSpec
+    spec = [None] * t.ndim
+    spec[0] = BATCH_AXES
+    return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, PartitionSpec(*spec)))
+
+
 class Qwen2MoeSparseMLP(nn.Module):
     cfg: Qwen2MoeConfig
 
@@ -129,23 +156,30 @@ class Qwen2MoeSparseMLP(nn.Module):
         w_down = self.param("w_down", _logical(nn.initializers.lecun_normal(), (EXPERTS, EXPERT_MLP, EXPERT_EMBED)),
                             (NE, M, E), cfg.param_dtype)
         # dense mixture: every expert evaluated, weighted-summed (exact HF math)
-        h = jnp.einsum("bse,nem->bsnm", x.astype(dt), w_gate.astype(dt))
-        u = jnp.einsum("bse,nem->bsnm", x.astype(dt), w_up.astype(dt))
+        h = _moe_intermediate_constraint(jnp.einsum("bse,nem->bsnm", x.astype(dt), w_gate.astype(dt)))
+        u = _moe_intermediate_constraint(jnp.einsum("bse,nem->bsnm", x.astype(dt), w_up.astype(dt)))
         act = nn.silu(h) * u
-        y = jnp.einsum("bsnm,nme->bsne", act, w_down.astype(dt))
+        y = _moe_intermediate_constraint(jnp.einsum("bsnm,nme->bsne", act, w_down.astype(dt)))
         out = jnp.einsum("bsne,bsn->bse", y.astype(jnp.float32), weights)
+        from .llama import activation_constraint
+        out = activation_constraint(out)
 
         # shared expert with sigmoid gate (HF: shared_expert_gate Linear(E,1))
+        # shared kernels use the EXPERT-family EMBED rule (fsdp minus the
+        # expert axis): inside this block the expert weights already exclude
+        # 'expert' from their ZeRO dims, and mixing both conventions makes
+        # the scan backward reshard the shared kernels' grads through an
+        # SPMD involuntary full remat (r4 dryrun guard)
         sh = nn.Dense(cfg.shared_expert_intermediate_size, use_bias=False, dtype=dt,
                       param_dtype=cfg.param_dtype,
-                      kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, MLP)),
+                      kernel_init=_logical(nn.initializers.lecun_normal(), (EXPERT_EMBED, MLP)),
                       name="shared_gate_proj")(x)
         su = nn.Dense(cfg.shared_expert_intermediate_size, use_bias=False, dtype=dt,
                       param_dtype=cfg.param_dtype,
-                      kernel_init=_logical(nn.initializers.lecun_normal(), (EMBED, MLP)),
+                      kernel_init=_logical(nn.initializers.lecun_normal(), (EXPERT_EMBED, MLP)),
                       name="shared_up_proj")(x)
         sd = nn.Dense(E, use_bias=False, dtype=dt, param_dtype=cfg.param_dtype,
-                      kernel_init=_logical(nn.initializers.lecun_normal(), (MLP, EMBED)),
+                      kernel_init=_logical(nn.initializers.lecun_normal(), (MLP, EXPERT_EMBED)),
                       name="shared_down_proj")(nn.silu(sh) * su)
         sgate = nn.Dense(1, use_bias=False, dtype=jnp.float32, param_dtype=cfg.param_dtype,
                          name="shared_expert_gate")(x.astype(jnp.float32))

@@ -438,6 +438,36 @@ def _flash_fwd_with_res(q, k, v, causal, block_q, block_k, interpret):
 _flash_attention.defvjp(_flash_fwd_with_res, _bwd)
 
 
+def _flash_sharded(q, k, v, causal, block_q, block_k, interpret, mesh):
+    """Run the kernels inside shard_map over the governing (trace) mesh.
+
+    Mosaic custom calls cannot be auto-partitioned by GSPMD — a multi-device
+    jit containing a Pallas call must wrap it in shard_map.  Batch shards
+    over the data axes; heads shard over the seq/tensor axes when divisible
+    (the layout Ulysses' all-to-all and AutoTP establish); a non-divisible
+    dim replicates (correct, just not distributed)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..comm.mesh import BATCH_AXES, SEQ_AXIS, TENSOR_AXIS
+    b, _, h, _ = q.shape
+    hk = k.shape[2]
+    batch_axes = tuple(a for a in BATCH_AXES if mesh.shape.get(a, 1) > 1)
+    nb = math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1
+    if nb > 1 and b % nb:
+        batch_axes = ()
+    head_axes = tuple(a for a in (SEQ_AXIS, TENSOR_AXIS) if mesh.shape.get(a, 1) > 1)
+    nh = math.prod(mesh.shape[a] for a in head_axes) if head_axes else 1
+    if nh > 1 and (h % nh or hk % nh):
+        head_axes = ()
+    spec = P(batch_axes or None, None, head_axes or None, None)
+    fn = jax.shard_map(
+        lambda q_, k_, v_: _flash_attention(q_, k_, v_, causal, block_q, block_k, interpret),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        # pallas_call out_shapes carry no varying-mesh-axes annotation
+        check_vma=False)
+    return fn(q, k, v)
+
+
 def flash_attention(q,
                     k,
                     v,
@@ -466,4 +496,9 @@ def flash_attention(q,
                                  sliding_window=sliding_window)
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+    from ..comm.mesh import get_trace_mesh, in_manual_mesh
+    if isinstance(q, jax.core.Tracer) and not in_manual_mesh():
+        mesh = get_trace_mesh()
+        if mesh is not None and mesh.size > 1:
+            return _flash_sharded(q, k, v, causal, block_q, block_k, interpret, mesh)
     return _flash_attention(q, k, v, causal, block_q, block_k, interpret)

@@ -249,13 +249,18 @@ class DeepSpeedEngine:
 
     # ---------------------------------------------------------- state init
 
-    def _materialize_state(self, batch=None, params=None):
+    def _materialize_state(self, batch=None, params=None, abstract=False):
         """Create the sharded TrainState.
 
         Params are initialised directly into their partitioned layout
         (jit with out_shardings) — the analog of ``zero.Init``'s
         partition-at-construction (ref: runtime/zero/partition_parameters.py:825):
         no device ever holds the unsharded model.
+
+        ``abstract=True`` builds only shapes + shardings (ShapeDtypeStructs,
+        nothing allocated) — the AOT compile-only path behind
+        ``compile_aot`` for memory-budget analysis of models far larger
+        than the local host could hold.
         """
         from flax import linen as nn
 
@@ -280,8 +285,11 @@ class DeepSpeedEngine:
             def unboxed_init(rng):
                 return nn.meta.unbox(boxed_init(rng))
 
-            with self.mesh:
-                variables = jax.jit(unboxed_init, out_shardings=var_shardings)(self.init_rng)
+            if abstract:
+                variables = nn.meta.unbox(abs_boxed)
+            else:
+                with self.mesh:
+                    variables = jax.jit(unboxed_init, out_shardings=var_shardings)(self.init_rng)
         else:
             variables = params if isinstance(params, dict) and "params" in params else {"params": params}
             variables = nn.meta.unbox(variables)
@@ -367,10 +375,18 @@ class DeepSpeedEngine:
             scaler=jax.tree.map(lambda _: repl, abs_state.scaler),
             skipped_steps=repl,
         )
-        with self.mesh:
-            self.state = jax.jit(build_state, out_shardings=self.state_shardings)(raw_params)
+        if abstract:
+            # shape+sharding skeleton only: leaves are ShapeDtypeStructs
+            # carrying their NamedSharding — exactly what jit.lower accepts
+            self.state = jax.tree.map(
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s)
+                if isinstance(s, NamedSharding) else a, abs_state, self.state_shardings)
+        else:
+            with self.mesh:
+                self.state = jax.jit(build_state, out_shardings=self.state_shardings)(raw_params)
         n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(abs_params))
-        log_dist(f"Initialized TrainState: {n_params/1e6:.1f}M params, zero_stage={self.zero_stage}", ranks=[0])
+        log_dist(f"Initialized TrainState: {n_params/1e6:.1f}M params, zero_stage={self.zero_stage}"
+                 f"{' (abstract)' if abstract else ''}", ranks=[0])
 
     def _optstate_shardings(self, abs_opt_state, param_sh, master_sh):
         """Match each per-param moment tree inside opt_state to the master
@@ -530,28 +546,60 @@ class DeepSpeedEngine:
         inv = (1.0 / self.gas) if static_unity else 1.0 / (state.scaler.cur_scale * self.gas)
         if cfg.gradient_predivide_factor != 1.0:
             inv = inv / cfg.gradient_predivide_factor
-        grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
-        grads = jax.lax.with_sharding_constraint(grads, self._grad_shardings)
-
-        found_inf = jnp.asarray(False) if static_unity else found_inf_or_nan(grads)
-        grad_norm = opt_lib.global_norm(grads)
-        if cfg.gradient_clipping and cfg.gradient_clipping > 0:
-            clip_scale = jnp.minimum(1.0, cfg.gradient_clipping / (grad_norm + 1e-6))
-            grads = jax.tree.map(lambda g: g * clip_scale, grads)
 
         use_master = self.compute_dtype != jnp.float32
-        master = state.master if use_master else state.params
-        updates, new_opt_state = self.opt.update(grads, state.opt_state, master)
-        new_master = opt_lib.apply_updates(master, updates)
+        from ..ops.adam import AdamState
+        # use_master required: the fp32-compute variant would feed
+        # device-resident params into the host-compute region
+        stream_offload = (static_unity and use_master and self._host_offloaded_opt()
+                          and isinstance(state.opt_state, AdamState))
+        if stream_offload:
+            # leaf-streamed path: never materialize the fp32 grad tree — the
+            # norm reduces each leaf with an f32 accumulator (XLA fuses the
+            # cast into the reduction) and the cast happens per leaf inside
+            # the sequenced update
+            norm2 = sum(jnp.sum(jnp.square(g.astype(jnp.float32) * inv))
+                        for g in jax.tree.leaves(grads))
+            grad_norm = jnp.sqrt(norm2)
+            found_inf = jnp.asarray(False)
+            clip_scale = jnp.asarray(1.0, jnp.float32)
+            if cfg.gradient_clipping and cfg.gradient_clipping > 0:
+                clip_scale = jnp.minimum(1.0, cfg.gradient_clipping / (grad_norm + 1e-6))
+            new_params, new_master, new_opt_state = self._offload_streamed_update(
+                grads, state, inv, clip_scale)
+        else:
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+            grads = jax.lax.with_sharding_constraint(grads, self._grad_shardings)
 
-        if not static_unity:
-            # skip the update entirely on overflow (ref: fused_optimizer.py)
-            def pick(new, old):
-                return jax.tree.map(lambda n, o: jnp.where(found_inf, o, n), new, old)
+            found_inf = jnp.asarray(False) if static_unity else found_inf_or_nan(grads)
+            grad_norm = opt_lib.global_norm(grads)
+            if cfg.gradient_clipping and cfg.gradient_clipping > 0:
+                clip_scale = jnp.minimum(1.0, cfg.gradient_clipping / (grad_norm + 1e-6))
+                grads = jax.tree.map(lambda g: g * clip_scale, grads)
 
-            new_master = pick(new_master, master)
-            new_opt_state = pick(new_opt_state, state.opt_state)
-        new_params = jax.tree.map(lambda m: m.astype(self.compute_dtype), new_master) if use_master else new_master
+            master = state.master if use_master else state.params
+            # host-offloaded (pinned_host) states: memory-space typing
+            # requires the update's compute operands in device space —
+            # explicit transfers in; out_shardings stream the results back
+            master = self._from_host(master,
+                                     self.state_shardings.master if use_master
+                                     else self.state_shardings.params)
+            opt_in = self._from_host(state.opt_state, self.state_shardings.opt_state)
+            updates, new_opt_state = self.opt.update(grads, opt_in, master)
+            new_master = opt_lib.apply_updates(master, updates)
+
+            if not static_unity:
+                # skip the update entirely on overflow (ref: fused_optimizer.py)
+                def pick(new, old):
+                    return jax.tree.map(lambda n, o: jnp.where(found_inf, o, n), new, old)
+
+                new_master = pick(new_master, master)
+                # compare against the device-pulled opt_in, not the (possibly
+                # pinned_host) state.opt_state — mixing memory spaces in the
+                # where() fails to lower (advisor r4)
+                new_opt_state = pick(new_opt_state, opt_in)
+            new_params = jax.tree.map(lambda m: m.astype(self.compute_dtype),
+                                      new_master) if use_master else new_master
         new_scaler = self.loss_scaler.update(state.scaler, found_inf)
         lr_val = jnp.asarray(self.lr_schedule(state.step + 1), jnp.float32)
 
@@ -567,6 +615,71 @@ class DeepSpeedEngine:
                               lr=lr_val,
                               loss_scale=state.scaler.cur_scale)
         return new_state, metrics
+
+    def _host_offloaded_opt(self):
+        """True when master/optimizer shardings live in pinned_host."""
+        sh = (self.state_shardings.master, self.state_shardings.opt_state)
+        return any(isinstance(s, NamedSharding) and s.memory_kind == "pinned_host"
+                   for s in jax.tree.leaves(sh))
+
+    def _offload_streamed_update(self, grads, state, inv, clip_scale):
+        """CPU-Adam: the optimizer step executes as XLA HOST compute, on the
+        TPU host where the offloaded fp32 master/moments live.
+
+        Same division of labor as the reference (ref:
+        csrc/adam/cpu_adam_impl.cpp + runtime/zero/stage_1_and_2.py CPU
+        offload): device does fwd/bwd, the host applies Adam.  Grads cross
+        to the host; fresh compute-dtype params cross back.  Verified on
+        chip: loss parity with the on-device update to ~1e-3.
+
+        Honest limits (measured): a device-side whole-tree update hoists
+        every host→HBM pull to the program top (whole fp32 state on device
+        at once); this host-execute path still stages its I/O buffers
+        through HBM for layout conversion, so the single-chip capacity win
+        over no-offload is partial — at true 7B+ scale the answer is ZeRO
+        sharding across chips (see MEMBUDGET.json), not single-chip
+        offload.
+        """
+        from jax.experimental.compute_on import compute_on
+
+        use_master = self.compute_dtype != jnp.float32
+        master = state.master if use_master else state.params
+        opt_state = state.opt_state
+        host = NamedSharding(self.mesh, P()).with_memory_kind("pinned_host")
+
+        # grads keep their ZeRO sharding, only the memory kind changes — a
+        # replicated host spec would all-gather every leaf into each host
+        g_host = jax.tree.map(
+            lambda g, s: jax.device_put(
+                g, s.with_memory_kind("pinned_host") if isinstance(s, NamedSharding) else host),
+            grads, self._grad_shardings)
+        scal = jax.device_put(clip_scale * inv, host)
+        with compute_on("device_host"):
+            g32 = jax.tree.map(lambda g: g.astype(jnp.float32) * scal, g_host)
+            updates, new_opt_state = self.opt.update(g32, opt_state, master)
+            new_master = jax.tree.map(lambda m, u: m + u, master, updates)
+            new_params_h = jax.tree.map(lambda m: m.astype(self.compute_dtype),
+                                        new_master) if use_master else new_master
+        param_sh = self.state_shardings.params
+        new_params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s if isinstance(s, NamedSharding) else None),
+            new_params_h, param_sh)
+        return new_params, new_master, new_opt_state
+
+    def _from_host(self, tree, sh_tree):
+        """Pull host-offloaded (pinned_host) state into device space for the
+        update (ZeRO-Infinity streaming: XLA schedules the transfers leaf by
+        leaf, so only the leaves currently being updated occupy HBM)."""
+        leaves = [s for s in jax.tree.leaves(sh_tree) if isinstance(s, NamedSharding)]
+        if not any(s.memory_kind == "pinned_host" for s in leaves):
+            return tree
+
+        def pull(x, s):
+            if isinstance(s, NamedSharding) and s.memory_kind == "pinned_host":
+                return jax.device_put(x, s.with_memory_kind("device"))
+            return x
+
+        return jax.tree.map(pull, tree, sh_tree)
 
     def _build_train_step(self, batch):
         batch_sh = self._batch_sharding_tree(batch)
@@ -611,6 +724,10 @@ class DeepSpeedEngine:
                 tuple((_np.shape(l), str(getattr(l, "dtype", type(l)))) for l in leaves))
 
     def _ensure_ready(self, batch):
+        if getattr(self, "_abstract_state", False):
+            raise RuntimeError(
+                "this engine was AOT-compiled abstractly (compile_aot) and holds "
+                "no real state; create a fresh engine to train")
         if self.state is None:
             self._materialize_state(batch=batch)
         if self._compression_requested and self._compression_fn is None:
@@ -656,6 +773,34 @@ class DeepSpeedEngine:
         actual_batch_size, method).  Pairs with VariableBatchDataLoader."""
         self._vblr = (int(ref_batch_size), method)
 
+    def compile_aot(self, batch):
+        """AOT-compile the full train step WITHOUT allocating any state.
+
+        The TPU-native answer to the reference's ZeRO memory estimators
+        (ref: runtime/zero/stage3.py estimate_zero3_model_states_mem_needs_
+        all_live and the autotuner's memory model): instead of closed-form
+        approximations, the REAL compiled program's memory analysis — exact
+        per-device bytes for arguments (state), outputs, and XLA temp/peak
+        (activations, collectives) — at full model scale on any mesh,
+        including a virtual CPU mesh standing in for a pod slice.
+
+        Returns the ``jax`` Compiled object: ``.memory_analysis()`` for the
+        HBM budget, ``.cost_analysis()`` for FLOPs.  The engine holds only
+        ShapeDtypeStructs afterwards — training on it raises; build a fresh
+        engine to actually train.
+        """
+        assert self.state is None, (
+            "compile_aot requires a fresh engine: this one already holds real "
+            "training state, which abstract materialization would destroy")
+        self._materialize_state(batch=batch, abstract=True)
+        self._abstract_state = True
+        self._build_train_step(batch)
+        abs_batch = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype, sharding=s),
+            batch, self._batch_shardings)
+        with mesh_lib.trace_mesh(self.mesh):
+            return self._train_step_fn.lower(self.state, abs_batch).compile()
+
     def train_batch(self, data_iter=None, batch=None):
         """Run one full training step = gas micro-batches (ref:
         pipe/engine.py:338 train_batch; for non-pipeline configs this fuses
@@ -674,7 +819,8 @@ class DeepSpeedEngine:
             self.flops_profiler.start_profile(example_batch=batch)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
-        self.state, metrics = self._train_step_fn(self.state, batch)
+        with mesh_lib.trace_mesh(self.mesh):  # first call traces model code
+            self.state, metrics = self._train_step_fn(self.state, batch)
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
         if profiling_now:
@@ -716,7 +862,8 @@ class DeepSpeedEngine:
         self._last_batch = batch
         fn = self._build_eval_fn()
         self.timers(FORWARD_GLOBAL_TIMER).start()
-        loss = fn(self.state, batch)
+        with mesh_lib.trace_mesh(self.mesh):
+            loss = fn(self.state, batch)
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss
 
@@ -730,7 +877,8 @@ class DeepSpeedEngine:
         assert batch is not None, "call forward(batch) first or pass batch="
         self._ensure_ready(batch)
         self.timers(BACKWARD_GLOBAL_TIMER).start()
-        grads, loss_v = self._accum_fn(self.state, batch)
+        with mesh_lib.trace_mesh(self.mesh):
+            grads, loss_v = self._accum_fn(self.state, batch)
         if self._pending_grads is None:
             self._pending_grads, self._pending_loss = grads, loss_v
         else:
@@ -753,7 +901,8 @@ class DeepSpeedEngine:
         # note: _apply_grads divides by gas via the scaler path; pending grads
         # are summed over backward() calls which matches
         loss = self._pending_loss / self._micro_step_count
-        self.state, metrics = self._apply_step_fn(self.state, self._pending_grads, loss)
+        with mesh_lib.trace_mesh(self.mesh):
+            self.state, metrics = self._apply_step_fn(self.state, self._pending_grads, loss)
         self.timers(STEP_GLOBAL_TIMER).stop()
         self._pending_grads, self._pending_loss = None, None
         self._micro_step_count = 0
