@@ -22,6 +22,7 @@ matching the reference's use of ``seq_data_parallel_group`` for ZeRO
 (ref: runtime/engine.py:1677) and expert-data groups for MoE params.
 """
 
+import contextlib
 import dataclasses
 from typing import Optional, Sequence, Tuple
 
@@ -107,6 +108,7 @@ def has_global_mesh() -> bool:
 _TRACE_MESH: Optional[Mesh] = None
 
 
+@contextlib.contextmanager
 def trace_mesh(mesh: Optional[Mesh]):
     """Context manager marking *which mesh governs the computation being
     traced*.  Engines wrap their jitted-fn invocations (where tracing
@@ -115,19 +117,13 @@ def trace_mesh(mesh: Optional[Mesh]):
     GSPMD) consult it via ``get_trace_mesh``.  Deliberately NOT the global
     mesh: that is process-wide and would hijack unrelated jits — e.g. a
     single-device eval traced after an 8-device training engine was built."""
-    import contextlib
-
-    @contextlib.contextmanager
-    def _ctx():
-        global _TRACE_MESH
-        prev = _TRACE_MESH
-        _TRACE_MESH = mesh
-        try:
-            yield
-        finally:
-            _TRACE_MESH = prev
-
-    return _ctx()
+    global _TRACE_MESH
+    prev = _TRACE_MESH
+    _TRACE_MESH = mesh
+    try:
+        yield
+    finally:
+        _TRACE_MESH = prev
 
 
 def get_trace_mesh() -> Optional[Mesh]:

@@ -356,6 +356,9 @@ def get_attention_impl(name: str) -> Callable:
     if name == "ulysses":
         from ..sequence.layer import DistributedAttention
         return DistributedAttention(reference_attention)
+    if name == "fpdt":
+        from ..sequence.fpdt_layer import FPDTAttention
+        return FPDTAttention(ulysses=False)
     if name == "ring":
         from ..sequence.ring import ring_attention
         return ring_attention

@@ -494,9 +494,14 @@ def flash_attention(q,
         from ..models.llama import chunked_attention
         return chunked_attention(q, k, v, causal=causal, segment_ids=segment_ids,
                                  sliding_window=sliding_window)
-    if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
     from ..comm.mesh import get_trace_mesh, in_manual_mesh
+    if interpret is None:
+        # resolve against the GOVERNING mesh, not the local devices: an AOT
+        # compile for an offline TPU topology from a CPU-only host must
+        # lower the real kernels, not interpret mode
+        tm = get_trace_mesh()
+        dev = tm.devices.flat[0] if tm is not None else jax.devices()[0]
+        interpret = getattr(dev, "platform", "") != "tpu"
     if isinstance(q, jax.core.Tracer) and not in_manual_mesh():
         mesh = get_trace_mesh()
         if mesh is not None and mesh.size > 1:

@@ -92,11 +92,18 @@ def chunked_attention(q, k, v, *, chunk_size: int, causal: bool = True,
     out0 = jnp.zeros((b, nh, sq, hd), jnp.float32)
     lse0 = jnp.full((b, nh, sq), _NEG_INF, jnp.float32)
 
+    # per-chunk remat: without it the scan VJP stacks every chunk's
+    # [B,H,Sq,chunk] score residuals — at S=32k that is the full S^2 score
+    # matrix (24 GB measured), the exact thing FPDT exists to avoid.  The
+    # backward recomputes one chunk's partials at a time instead.
+    partials = jax.checkpoint(
+        lambda q_, k_, v_, qp, kp: _chunk_partials(q_, k_, v_, qp, kp, scale, causal))
+
     def step(carry, inputs):
         out, lse = carry
         idx, k_c, v_c = inputs
         k_pos = k_offset + idx * chunk_size + jnp.arange(chunk_size)
-        c_out, c_lse = _chunk_partials(q32, k_c, v_c, q_pos, k_pos, scale, causal)
+        c_out, c_lse = partials(q32, k_c, v_c, q_pos, k_pos)
         return update_out_and_lse(out, lse, c_out, c_lse), None
 
     (out, lse), _ = jax.lax.scan(step, (out0, lse0),
@@ -128,7 +135,10 @@ def fpdt_attention(q, k, v, *, causal: bool = True, segment_ids=None,
                                  causal=causal,
                                  q_offset=q_offset + idx * qc, k_offset=k_offset)
 
-    outs = jax.lax.map(one_q_chunk, (jnp.arange(n_q), q_chunks))
+    # outer remat bounds the map VJP's saved state to the q-chunk OUTPUTS:
+    # each q-chunk's inner KV scan is recomputed (and re-chunk-rematted)
+    # during its own backward — O(chunk^2) live, the FPDT memory profile
+    outs = jax.lax.map(jax.checkpoint(one_q_chunk), (jnp.arange(n_q), q_chunks))
     return outs.swapaxes(0, 1).reshape(b, sq, nh, hd)
 
 
@@ -175,6 +185,12 @@ def fpdt_host_offload_attention(q, k, v, *, chunk_size: int = 512, causal: bool 
     out0 = jnp.zeros((b, nh, sq, hd), jnp.float32)
     lse0 = jnp.full((b, nh, sq), _NEG_INF, jnp.float32)
 
+    # per-chunk remat, same as chunked_attention: the scan VJP must not
+    # stack every chunk's [B,H,Sq,chunk] score residuals (the full S^2
+    # matrix at long context)
+    partials = jax.checkpoint(
+        lambda q_, k_, v_, qp, kp: _chunk_partials(q_, k_, v_, qp, kp, scale, causal))
+
     def step(carry, idx):
         out, lse = carry
         k_c = jax.lax.dynamic_slice_in_dim(k, idx * chunk_size, chunk_size, 1)
@@ -182,7 +198,7 @@ def fpdt_host_offload_attention(q, k, v, *, chunk_size: int = 512, causal: bool 
         k_c = jax.device_put(k_c, dev)   # host → HBM, one chunk
         v_c = jax.device_put(v_c, dev)
         k_pos = k_offset + idx * chunk_size + jnp.arange(chunk_size)
-        c_out, c_lse = _chunk_partials(q32, k_c, v_c, q_pos, k_pos, scale, causal)
+        c_out, c_lse = partials(q32, k_c, v_c, q_pos, k_pos)
         return update_out_and_lse(out, lse, c_out, c_lse), None
 
     (out, lse), _ = jax.lax.scan(step, (out0, lse0), jnp.arange(n_chunks))
