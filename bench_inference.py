@@ -46,15 +46,22 @@ def main():
     sched = SchedulerConfig(token_budget=2048, max_seqs=n_seqs, prefill_chunk=128,
                             decode_bucket=n_seqs)
     eng = InferenceEngineV2(cfg, params, RaggedInferenceEngineConfig(
-        kv=kv, scheduler=sched, max_new_tokens=new_tokens))
+        kv=kv, scheduler=sched, max_new_tokens=new_tokens,
+        # r4: all 64 decode rounds in ONE dispatch (overshoot policy:
+        # surplus past a row's limit is discarded host-side) + unrolled
+        # layer trunk — both attack the measured dispatch/scan overhead at
+        # tiny decode shapes (1259 → 3664 tok/s vs r3)
+        decode_steps_per_dispatch=64, unroll_layers=True))
 
     rng = np.random.default_rng(0)
     prompts = [list(rng.integers(1, 32000, prompt_len)) for _ in range(n_seqs)]
 
-    # warmup: compile prefill + decode programs on a small run — max_new=16
-    # walks the whole fused-decode ladder (8, 4, 2, single) so every
-    # program compiles HERE, not inside the timed phase
-    eng.generate(prompts[:4], max_new_tokens=16)
+    # warmup: compile the prefill + fused-decode programs the timed phase
+    # uses.  With the overshoot policy k only shrinks under page/position
+    # pressure, so the k=64 rung covers the whole run (the arena is sized
+    # with headroom above the workload's 384 pages); max_new=63 also walks
+    # the single-step boundary programs
+    eng.generate(prompts[:4], max_new_tokens=63)
 
     t_all = time.time()
     uids = list(range(1000, 1000 + n_seqs))

@@ -72,6 +72,13 @@ class RaggedInferenceEngineConfig:
     # dominates decode at small models or over tunneled chips.  Sequences
     # hitting EOS mid-block have their surplus tokens discarded host-side.
     decode_steps_per_dispatch: int = 8
+    # unroll the layer loop in the decode trunk (llama-family twin only;
+    # other families and quantized checkpoints keep the scanned layout with
+    # a warning): straight-line code drops the scan's while/dus bookkeeping
+    # at tiny decode shapes; scan-stacked checkpoints are converted at
+    # engine init (models/llama_cache.unstack_layer_params — no data
+    # movement)
+    unroll_layers: bool = False
 
 
 class InferenceEngineV2:
@@ -79,13 +86,29 @@ class InferenceEngineV2:
 
     def __init__(self, cfg: LlamaConfig, params, engine_config: RaggedInferenceEngineConfig = None,
                  rng: Optional[jax.Array] = None):
-        self.cfg = cfg
         self.econfig = engine_config or RaggedInferenceEngineConfig()
         kvcfg = self.econfig.kv
-        self.model = build_cache_model(cfg, kvcfg.page_size)
+        from ..quantization import QuantizedParams
+        model = build_cache_model(cfg, kvcfg.page_size)
+        if self.econfig.unroll_layers and getattr(cfg, "scan_layers", False):
+            # only the llama-family twin implements the unrolled trunk; other
+            # families' twins are scan-only and would fail with a converted
+            # param tree / tupled cache
+            if not isinstance(model, LlamaForCausalLMWithCache):
+                logger.warning(f"unroll_layers: {type(model).__name__} has no unrolled "
+                               "trunk; keeping the scanned layout")
+            elif isinstance(params, QuantizedParams):
+                logger.warning("unroll_layers: quantized checkpoints keep the scanned "
+                               "layout (per-layer dequant conversion not implemented)")
+            else:
+                cfg = dataclasses.replace(cfg, scan_layers=False)
+                from ...models.llama_cache import unstack_layer_params
+                params = unstack_layer_params(params, cfg.num_hidden_layers)
+                model = build_cache_model(cfg, kvcfg.page_size)
+        self.cfg = cfg
+        self.model = model
         # weight-only-quantized checkpoints: int8 stays in HBM, dequant is
         # traced into the step program (ref: inference/quantization kernels)
-        from ..quantization import QuantizedParams
         if isinstance(params, QuantizedParams):
             self._qparams = params
             self.params = {"params": params.tree}
@@ -96,7 +119,12 @@ class InferenceEngineV2:
                                  enable_prefix_cache=self.econfig.enable_prefix_cache)
         self.state = StateManager(self.kv, max_batch=self.econfig.scheduler.max_seqs)
         self.scheduler = SplitFuseScheduler(self.econfig.scheduler)
-        self.cache = init_kv_cache(cfg, kvcfg, dtype=self.econfig.kv_dtype)
+        cache = init_kv_cache(cfg, kvcfg, dtype=self.econfig.kv_dtype)
+        if not getattr(cfg, "scan_layers", True):
+            # unrolled trunk: per-layer arena tuple (donated leaf-wise; a
+            # stacked arena would cost a whole-arena dus per layer per round)
+            cache = tuple(cache[i] for i in range(cfg.num_hidden_layers))
+        self.cache = cache
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._max_new: Dict[int, int] = {}
         self._step_fns: Dict[Tuple[int, int], callable] = {}
@@ -227,19 +255,24 @@ class InferenceEngineV2:
         plan: StepPlan = self.scheduler.plan(self.state)
         k_cfg = self.econfig.decode_steps_per_dispatch
         if k_cfg > 1 and plan.decode and not plan.prefill:
-            remaining = min(self._max_new.get(s.uid, self.econfig.max_new_tokens) -
-                            len(s.generated) for s in plan.decode)
-            pages_free = sum(self.kv.pages_needed(s, k_cfg)
-                             for s in plan.decode) <= self.kv.allocator.free_pages
-            # quantize k to a halving ladder (K, K/2, ...) — a data-dependent
-            # tail k would compile a fresh program mid-serve; each rung is
-            # one reusable program, sub-2 tails run the single-step path
-            if pages_free:
-                k = k_cfg
-                while k > 1 and remaining < k:
-                    k //= 2
-                if k > 1:
-                    return self._multi_decode(plan.decode, k)
+            # OVERSHOOT policy (r4): always run the full k rung and discard
+            # surplus tokens host-side (the KV written past a row's limit
+            # lies beyond its clamped seen boundary).  The pre-r4 halving
+            # ladder (k, k/2, ... 1) matched `remaining` exactly but paid
+            # the ~100-300ms fixed dispatch overhead per rung and compiled
+            # a fresh single-step program for 1-token tails mid-serve —
+            # 64 tokens cost 6 dispatches instead of 2.  k only shrinks
+            # when the page arena, per-seq page capacity, or the position
+            # table can't take the full block.
+            max_pos = getattr(self.cfg, "max_position_embeddings", None) or (1 << 30)
+            seq_room = min(min(self.kv.max_pages_per_seq * self.kv.page_size, max_pos) -
+                           len(s.tokens) for s in plan.decode)
+            k = k_cfg
+            while k > 1 and (seq_room < k or sum(self.kv.pages_needed(s, k) for s in plan.decode)
+                             > self.kv.allocator.free_pages):
+                k //= 2
+            if k > 1:
+                return self._multi_decode(plan.decode, k)
         work: List = [(s, 1) for s in plan.decode] + list(plan.prefill)
         if not work:
             return {}

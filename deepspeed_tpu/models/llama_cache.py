@@ -144,6 +144,27 @@ def paged_attention_core(q, k, v, pages, block_table, start_pos, chunk_lens, pag
     return out, pages
 
 
+def unstack_layer_params(variables, num_layers):
+    """Convert scan-stacked params (``model/layers/*`` leaves ``[L, ...]``)
+    to the unrolled layout (``model/layers_{i}/*``).
+
+    The training↔serving layout converter: checkpoints trained with
+    ``scan_layers=True`` (the training default) serve through the unrolled
+    decode trunk without re-export (r3 verdict: scan-only cache twins
+    blocked ``scan_layers=False`` serving).  No data movement — each
+    unrolled leaf is a view-slice of the stacked leaf."""
+    had_wrapper = isinstance(variables, dict) and "params" in variables
+    p = dict(variables["params"]) if had_wrapper else dict(variables)
+    m = dict(p.get("model", {}))
+    if "layers" not in m:
+        return variables  # already unrolled (or a foreign tree) — no-op
+    stacked = m.pop("layers")
+    for i in range(num_layers):
+        m[f"layers_{i}"] = jax.tree.map(lambda x, i=i: x[i], stacked)
+    p["model"] = m
+    return {"params": p} if had_wrapper else p
+
+
 class LlamaAttentionCache(nn.Module):
     cfg: LlamaConfig
     page_size: int = 16
@@ -225,6 +246,21 @@ class LlamaForCausalLMWithCache(nn.Module):
 
             @nn.compact
             def __call__(self, x, cache, positions, block_table, start_pos, chunk_lens):
+                if not self.cfg.scan_layers:
+                    # unrolled serving trunk (params layout model/layers_i/*,
+                    # see unstack_layer_params): straight-line code drops the
+                    # scan's while/dynamic-slice bookkeeping — measured ~22ms
+                    # of 123ms per 8 fused decode rounds at B32 (r4).  The
+                    # cache arrives as a TUPLE of per-layer arenas (donated
+                    # leaf-wise); an [L, ...] array would force a whole-arena
+                    # dynamic-update per layer
+                    new_pages = []
+                    for i in range(self.cfg.num_hidden_layers):
+                        x, pages_i = LlamaBlockCache(self.cfg, self.page_size,
+                                                     name=f"layers_{i}")(
+                            x, cache[i], positions, block_table, start_pos, chunk_lens)
+                        new_pages.append(pages_i)
+                    return x, tuple(new_pages)
                 blocks = nn.scan(LlamaBlockCache,
                                  variable_axes={"params": 0},
                                  split_rngs={"params": True},

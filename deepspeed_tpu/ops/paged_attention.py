@@ -113,9 +113,15 @@ def paged_attention_pallas(q, pages, block_table, start_pos, chunk_lens, page_si
                 # q stays resident across the page sweep (index map constant in j)
                 pl.BlockSpec((1, n_kv, rep * c, d), lambda b, j, bt, sp: (b, 0, 0, 0)),
                 # one whole page: trailing dims (page, 2, n_kv, d) are the full
-                # array dims → always tile-legal
+                # array dims → always tile-legal.  j is CLAMPED to the row's
+                # last needed page: past it the index map repeats the same
+                # page and Mosaic's pipeline skips the refetch — pages beyond
+                # the true sequence length cost no DMA (they were still
+                # copied pre-r4 even though pl.when skipped their compute)
                 pl.BlockSpec((1, page_size, 2, n_kv, d),
-                             lambda b, j, bt, sp: (bt[b, j], 0, 0, 0, 0)),
+                             lambda b, j, bt, sp:
+                             (bt[b, jnp.minimum(j, (sp[b] + c - 1) // page_size)],
+                              0, 0, 0, 0)),
             ],
             out_specs=pl.BlockSpec((1, n_kv, rep * c, d), lambda b, j, bt, sp: (b, 0, 0, 0)),
             scratch_shapes=([pltpu.VMEM((rep * c, 1), jnp.float32)] * n_kv +

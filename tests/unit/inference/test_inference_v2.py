@@ -54,6 +54,21 @@ def test_generate_matches_cachefree_reference(trained_params):
         assert got == expected, (got, expected)
 
 
+def test_unrolled_trunk_and_overshoot_match_reference(trained_params):
+    """r4 serving path: unrolled layer trunk (scan-stacked checkpoint
+    converted via unstack_layer_params) + fused-decode OVERSHOOT (k rung
+    larger than tokens remaining; surplus discarded host-side) must produce
+    exactly the reference greedy tokens."""
+    eng = _engine(trained_params, unroll_layers=True, decode_steps_per_dispatch=4)
+    assert not eng.cfg.scan_layers and isinstance(eng.cache, tuple)
+    prompts = [[5, 9, 2, 7, 1], [3, 3, 8]]
+    # 5 is not a multiple of the k=4 rung: the second dispatch overshoots
+    outs = eng.generate(prompts, max_new_tokens=5)
+    for prompt, got in zip(prompts, outs):
+        expected = _reference_greedy(trained_params, prompt, 5)
+        assert got == expected, (got, expected)
+
+
 def test_long_prompt_splitfuse_chunking(trained_params):
     """Prompt longer than prefill_chunk is split across steps yet matches."""
     eng = _engine(trained_params)
