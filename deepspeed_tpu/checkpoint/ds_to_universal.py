@@ -53,6 +53,24 @@ _MOMENT_NAMES = {
 }
 
 
+def canonicalize_param_name(name: str) -> str:
+    """Topology-invariant atom name.
+
+    Pipeline-stage trees name the same weights differently (``body.block.*``
+    for the stacked transformer blocks, ``layer_0.embed_tokens`` /
+    ``layer_N.{norm,lm_head}`` for the ends — see runtime/pipe/module.py);
+    the universal layout stores everything under the plain model's names so
+    a checkpoint saved at one (TP, PP, DP) topology loads at any other
+    (ref: the reference's name normalization across parallel layouts in
+    checkpoint/ds_to_universal.py merge_tp_slices + reshape_meg_2d.py)."""
+    parts = name.split(".")
+    if len(parts) > 2 and parts[0] == "body" and parts[1] == "block":
+        return ".".join(["model", "layers"] + parts[2:])
+    if len(parts) > 1 and parts[0].startswith("layer_") and parts[0][len("layer_"):].isdigit():
+        return ".".join(parts[1:])
+    return name
+
+
 def _flatten_with_names(tree, prefix=()) -> Dict[str, np.ndarray]:
     """Flax param dict → {'layers.0.attention.q.kernel': ndarray}."""
     out = {}
@@ -120,6 +138,14 @@ def convert_to_universal(input_folder: str,
     weights = _flatten_with_names(master if master is not None else state["params"])
     weights = {k: v.astype(np.float32) for k, v in weights.items()}
     moments = _find_moment_trees(state.get("opt_state"), weights)
+
+    # atoms live under topology-invariant names
+    canon = {k: canonicalize_param_name(k) for k in weights}
+    if len(set(canon.values())) != len(canon):
+        dupes = sorted({v for v in canon.values() if list(canon.values()).count(v) > 1})
+        raise ValueError(f"canonical atom name collision: {dupes[:5]}")
+    weights = {canon[k]: v for k, v in weights.items()}
+    moments = {atom: {canon[k]: v for k, v in tree.items()} for atom, tree in moments.items()}
 
     dst = os.path.join(os.path.abspath(output_folder), str(tag))
     zero_dir = os.path.join(dst, "zero")

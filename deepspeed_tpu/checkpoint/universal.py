@@ -80,6 +80,18 @@ def load_universal_checkpoint(engine, universal_dir: str, tag: Optional[str] = N
 
     atoms = load_universal_atoms(path)
     assert engine.state is not None, "materialize engine state first (run a batch or pass params)"
+    from .ds_to_universal import _flatten_with_names, canonicalize_param_name
+    # atoms carry topology-invariant names (legacy dirs may predate the
+    # canonicalization — normalize them too); remap onto THIS engine's param
+    # namespace, which may be a pipeline-stage tree
+    atoms = {canonicalize_param_name(k): v for k, v in atoms.items()}
+    host_params = jax.tree.map(lambda x: np.asarray(x), engine.state.params)
+    target_names = _flatten_with_names(host_params)
+    missing = [t for t in target_names if canonicalize_param_name(t) not in atoms]
+    if missing:
+        raise ValueError(f"universal checkpoint does not cover the engine's parameters "
+                         f"(missing e.g. {sorted(missing)[:5]})")
+    atoms = {t: atoms[canonicalize_param_name(t)] for t in target_names}
     import json
     step = None
     meta_path = os.path.join(path, "universal_meta.json")
@@ -90,8 +102,7 @@ def load_universal_checkpoint(engine, universal_dir: str, tag: Optional[str] = N
     fp32_flat = {p: a[FP32_WEIGHT] for p, a in atoms.items()}
     use_master = engine.state.master != ()
 
-    # params in compute dtype
-    host_params = jax.tree.map(lambda x: np.asarray(x), engine.state.params)
+    # params in compute dtype (host_params already copied for the name check)
     new_params = _rebuild_tree(host_params, fp32_flat)
     placed_params = jax.device_put(new_params, engine.state_shardings.params)
 

@@ -42,8 +42,15 @@ class OnebitAdamState(NamedTuple):
 
 
 def onebit_adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
-                freeze_step: int = 100, **_ignored) -> GradientTransformation:
-    """ref: runtime/fp16/onebit/adam.py:14 OnebitAdam."""
+                freeze_step: int = 100, compress_fn=None, **_ignored) -> GradientTransformation:
+    """ref: runtime/fp16/onebit/adam.py:14 OnebitAdam.
+
+    ``compress_fn(tensor, error) -> (compressed, new_error)`` plugs the
+    TRANSPORT in: the default is the local error-feedback sign quantization
+    (numerics only); the engine passes the wire-exchanging
+    ``runtime/comm/compressed.compressed_allreduce`` bound to the data axis
+    when it builds the shard_map training step (ref: the comm_backend
+    handles in runtime/fp16/onebit/adam.py:99)."""
     b1, b2 = betas
 
     def init(params):
@@ -61,7 +68,7 @@ def onebit_adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
             m_new = b1 * m + (1 - b1) * g
             # variance frozen after warmup (ref: adam.py exp_avg_sq freeze)
             v_new = jnp.where(frozen, v, b2 * v + (1 - b2) * g * g)
-            comp, e_comp = _sign_compress_ef(m_new, e)
+            comp, e_comp = (compress_fn or _sign_compress_ef)(m_new, e)
             m_used = jnp.where(frozen, comp, m_new)
             e_new = jnp.where(frozen, e_comp, e)
             bc1 = 1 - b1**count.astype(jnp.float32)
@@ -91,7 +98,7 @@ class ZeroOneAdamState(NamedTuple):
     var_updates: jnp.ndarray    # number of variance updates so far (bias corr)
 
 
-def zero_one_adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+def zero_one_adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0, compress_fn=None,
                   var_freeze_step: int = 100000, var_update_scaler: int = 16,
                   local_step_scaler: int = 32678, local_step_clipper: int = 16,
                   **_ignored) -> GradientTransformation:
@@ -125,7 +132,7 @@ def zero_one_adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
             g = g.astype(jnp.float32)
             m_new = b1 * m + (1 - b1) * g
             v_new = jnp.where(var_due, b2 * v + (1 - b2) * g * g, v)
-            comp, e_new = _sign_compress_ef(m_new, e)
+            comp, e_new = (compress_fn or _sign_compress_ef)(m_new, e)
             bc1 = 1 - b1**count.astype(jnp.float32)
             bc2 = 1 - b2**jnp.maximum(var_updates, 1).astype(jnp.float32)
             step = (comp / bc1) / (jnp.sqrt(v_new / bc2) + eps)
@@ -150,7 +157,7 @@ class OnebitLambState(NamedTuple):
     frozen_ratio: any  # per-tensor trust ratio recorded at freeze
 
 
-def onebit_lamb(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+def onebit_lamb(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0, compress_fn=None,
                 freeze_step: int = 100, max_coeff: float = 10.0, min_coeff: float = 0.01,
                 **_ignored) -> GradientTransformation:
     """ref: runtime/fp16/onebit/lamb.py:15 OnebitLamb — LAMB whose layerwise
@@ -174,7 +181,7 @@ def onebit_lamb(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
             p32 = p.astype(jnp.float32)
             m_new = b1 * m + (1 - b1) * g
             v_new = jnp.where(frozen, v, b2 * v + (1 - b2) * g * g)
-            comp, e_comp = _sign_compress_ef(m_new, e)
+            comp, e_comp = (compress_fn or _sign_compress_ef)(m_new, e)
             m_used = jnp.where(frozen, comp, m_new)
             e_new = jnp.where(frozen, e_comp, e)
             bc1 = 1 - b1**count.astype(jnp.float32)

@@ -1,0 +1,87 @@
+"""Checkpoint reshape matrix through the UNIVERSAL path (r3 verdict item
+10, mirroring the reference's DistributedFixture resharding fixtures in
+tests/unit/checkpoint/test_zero_optimizer.py):
+
+    save at (TP2, PP2, DP2)  →  load at (TP1, PP1, DP4)
+    save at (TP1, PP1, DP4)  →  load at (TP2, PP2, DP2)
+
+The pipeline engine names its weights as stage trees (body.block.*,
+layer_N.*); the universal converter stores topology-invariant atoms and
+the loader remaps them onto whichever tree the target engine uses
+(checkpoint/ds_to_universal.canonicalize_param_name)."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import numpy as np
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.checkpoint import convert_to_universal, load_universal_checkpoint
+from deepspeed_tpu.comm.mesh import MeshSpec, create_mesh, set_global_mesh
+from deepspeed_tpu.models.llama import LlamaForCausalLM, llama_pipeline_layers
+from deepspeed_tpu.runtime.pipe import PipelineModule
+
+from simple_model import TINY, base_config, random_batch
+
+
+def _pp_engine():
+    """(TP2, PP2, DP2) pipeline engine over all 8 devices."""
+    mesh = create_mesh(MeshSpec(pipe=2, data=2, tensor=2), devices=jax.devices()[:8])
+    set_global_mesh(mesh)
+    pm = PipelineModule(layers=llama_pipeline_layers(TINY), num_stages=2)
+    engine, _, _, _ = ds.initialize(
+        model=pm, mesh=mesh, dist_init_required=False,
+        config=base_config(**{
+            "train_batch_size": 16, "gradient_accumulation_steps": 2,
+            "zero_optimization": {"stage": 1}, "pipeline": {"stages": 2},
+            "tensor_parallel": {"autotp_size": 2}}))
+    return engine
+
+
+def _dp_engine():
+    """(TP1, PP1, DP4) plain engine."""
+    mesh = create_mesh(MeshSpec(data=4), devices=jax.devices()[:4])
+    set_global_mesh(mesh)
+    engine, _, _, _ = ds.initialize(
+        model=LlamaForCausalLM(TINY), mesh=mesh, dist_init_required=False,
+        config=base_config(**{"train_batch_size": 16,
+                              "zero_optimization": {"stage": 1}}))
+    return engine
+
+
+def _steps(engine, batch, n):
+    return [float(engine.train_batch(batch=batch)) for _ in range(n)]
+
+
+def test_pp2tp2dp2_to_dp4_via_universal(tmp_path):
+    batch = random_batch(batch_size=16)
+    pp = _pp_engine()
+    _steps(pp, batch, 2)
+    pp.save_checkpoint(tmp_path / "pp", tag="m")
+    uni = convert_to_universal(str(tmp_path / "pp"), str(tmp_path / "uni"), tag="m")
+    # the continuation the restored engine must reproduce
+    expected = _steps(pp, batch, 2)
+
+    dp = _dp_engine()
+    _steps(dp, random_batch(batch_size=16, seed=9), 1)  # diverge first
+    load_universal_checkpoint(dp, uni)
+    got = _steps(dp, batch, 2)
+    # same weights + optimizer moments + step ⇒ same training trajectory,
+    # up to TP/PP vs DP reduction-order fp noise
+    np.testing.assert_allclose(got, expected, rtol=3e-3, atol=3e-3)
+
+
+def test_dp4_to_pp2tp2dp2_via_universal(tmp_path):
+    batch = random_batch(batch_size=16)
+    dp = _dp_engine()
+    _steps(dp, batch, 2)
+    dp.save_checkpoint(tmp_path / "dp", tag="m")
+    uni = convert_to_universal(str(tmp_path / "dp"), str(tmp_path / "uni"), tag="m")
+    expected = _steps(dp, batch, 2)
+
+    pp = _pp_engine()
+    _steps(pp, random_batch(batch_size=16, seed=9), 1)
+    load_universal_checkpoint(pp, uni)
+    got = _steps(pp, batch, 2)
+    np.testing.assert_allclose(got, expected, rtol=3e-3, atol=3e-3)

@@ -62,10 +62,12 @@ def test_unrolled_trunk_and_overshoot_match_reference(trained_params):
     eng = _engine(trained_params, unroll_layers=True, decode_steps_per_dispatch=4)
     assert not eng.cfg.scan_layers and isinstance(eng.cache, tuple)
     prompts = [[5, 9, 2, 7, 1], [3, 3, 8]]
-    # 5 is not a multiple of the k=4 rung: the second dispatch overshoots
-    outs = eng.generate(prompts, max_new_tokens=5)
+    # prefill emits token 1; the remaining 5 take a k=4 rung plus a second
+    # rung that OVERSHOOTS by 3 — those surplus tokens must be discarded
+    # host-side without corrupting the sequence
+    outs = eng.generate(prompts, max_new_tokens=6)
     for prompt, got in zip(prompts, outs):
-        expected = _reference_greedy(trained_params, prompt, 5)
+        expected = _reference_greedy(trained_params, prompt, 6)
         assert got == expected, (got, expected)
 
 

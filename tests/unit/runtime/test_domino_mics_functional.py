@@ -1,0 +1,122 @@
+"""Functional tests for Domino (TP-overlap transformer) and MiCS
+(sub-group ZeRO partitioning) — r3 verdict item 8: both existed with only
+parity-shim smoke coverage; "existing != working".
+
+Domino: loss/grad parity of the µ-batch-chunked TP layer against the
+unchunked computation on a real tensor-parallel mesh, plus an HLO check
+that each µ-batch chain carries its own TP allreduce (the overlap
+surface XLA schedules — ref: deepspeed/runtime/domino/transformer.py:411).
+
+MiCS: XLA's compiled collectives must stay INSIDE the configured
+sub-group — the all-gather replica groups never span the outer DP axis
+(ref: deepspeed/runtime/zero/mics.py MiCS_Init(shard_size)).
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.comm.mesh import MeshSpec, create_mesh
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.module_inject.tp_rules import param_shardings
+from deepspeed_tpu.runtime.domino.transformer import DominoTransformer
+
+
+def _domino_apply(mb, mesh, variables, x):
+    model = DominoTransformer(num_layers=2, hidden_size=64, num_attention_heads=4,
+                              ffn_hidden_size=128, micro_batches=mb)
+
+    def loss(v, x):
+        return jnp.sum(model.apply(v, x)**2)
+
+    fn = jax.jit(jax.value_and_grad(loss))
+    with mesh:
+        return fn(variables, x)
+
+
+def test_domino_microbatch_chunks_match_unchunked_on_tp_mesh():
+    mesh = create_mesh(MeshSpec(tensor=2), devices=jax.devices()[:2])
+    model = DominoTransformer(num_layers=2, hidden_size=64, num_attention_heads=4,
+                              ffn_hidden_size=128, micro_batches=4)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 16, 64)), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+
+    loss4, grads4 = _domino_apply(4, mesh, variables, x)
+    loss1, grads1 = _domino_apply(1, mesh, variables, x)
+    np.testing.assert_allclose(float(loss4), float(loss1), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(grads4), jax.tree.leaves(grads1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
+def test_domino_each_chain_carries_its_own_allreduce():
+    """The overlap surface: with n µ-batches over a TP mesh the program has
+    (at least) one TP collective per chain per row-parallel matmul — those
+    independent chains are what XLA's scheduler overlaps."""
+    mesh = create_mesh(MeshSpec(tensor=2), devices=jax.devices()[:2])
+    model = DominoTransformer(num_layers=1, hidden_size=64, num_attention_heads=4,
+                              ffn_hidden_size=128, micro_batches=4)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 16, 64)), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    sh = param_shardings(jax.eval_shape(lambda: variables), mesh, zero_stage=0)
+    variables = jax.device_put(variables, sh)
+    with mesh:
+        compiled = jax.jit(lambda v, x: model.apply(v, x)).lower(variables, x).compile()
+    hlo = compiled.as_text()
+    n_ar = len(re.findall(r" all-reduce(?:-start)?\(", hlo))
+    # 4 chains x 2 row-parallel matmuls (attention out + mlp out) = 8
+    # launched; XLA's all-reduce combiner may merge some at these tiny test
+    # sizes (its threshold keeps real-model chains separate), so assert the
+    # per-chain comm surface exists rather than the exact count
+    assert n_ar >= 4, f"expected multiple per-chain TP allreduces, got {n_ar}"
+
+
+CFG = LlamaConfig(vocab_size=256, hidden_size=128, intermediate_size=256,
+                  num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                  max_position_embeddings=64, rope_theta=1e4)
+
+
+def _allgather_group_sizes(hlo):
+    """Group size of every all-gather in the optimized HLO.  XLA prints the
+    iota form ``replica_groups=[G,S]<=[...]`` (G groups of S devices)."""
+    return [int(m.group(2)) for m in
+            re.finditer(r"all-gather[^\n]*replica_groups=\[(\d+),(\d+)\]", hlo)]
+
+
+def test_mics_allgathers_stay_in_subgroup():
+    """mics_shard_size=2 on a (data=2, expert=2) mesh: params shard over the
+    INNER axis only, so every parameter all-gather's replica groups must be
+    within-subgroup pairs — never the full 4-device world."""
+    mesh = create_mesh(MeshSpec(data=2, expert=2), devices=jax.devices()[:4])
+    engine, _, _, _ = ds.initialize(
+        model=LlamaForCausalLM(CFG), mesh=mesh, dist_init_required=False,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3, "mics_shard_size": 2},
+                "bf16": {"enabled": True}})
+    ids = np.zeros((8, 32), dtype=np.int32)
+    compiled = engine.compile_aot({"input_ids": ids, "labels": ids})
+    sizes = _allgather_group_sizes(compiled.as_text())
+    assert sizes, "no all-gathers found — MiCS params don't seem sharded at all"
+    world = mesh.size
+    assert all(s < world for s in sizes), (
+        f"an all-gather spans the full {world}-device world "
+        f"(group sizes {sorted(set(sizes))}) — MiCS sub-grouping not applied")
+
+
+def test_mics_subgroup_vs_full_sharding_differs():
+    """Control: without mics_shard_size the same config all-gathers over the
+    full 4-device group (proves the assertion above is not vacuous)."""
+    mesh = create_mesh(MeshSpec(data=2, expert=2), devices=jax.devices()[:4])
+    engine, _, _, _ = ds.initialize(
+        model=LlamaForCausalLM(CFG), mesh=mesh, dist_init_required=False,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3},
+                "bf16": {"enabled": True}})
+    ids = np.zeros((8, 32), dtype=np.int32)
+    sizes = _allgather_group_sizes(engine.compile_aot({"input_ids": ids, "labels": ids}).as_text())
+    assert any(s == mesh.size for s in sizes), (
+        f"expected a full-world all-gather in the non-MiCS control (sizes {sorted(set(sizes))})")

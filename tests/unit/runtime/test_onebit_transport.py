@@ -1,0 +1,81 @@
+"""1-bit optimizer COMPRESSED TRANSPORT end-to-end (r3 verdict item 2).
+
+The reference compresses the wire (ref: runtime/comm/nccl.py:16
+NcclBackend.compressed_allreduce behind fp16/onebit/adam.py); pre-r4 we
+reproduced only the local numerics.  These tests drive the full path: a
+``comm_backend_name`` on the optimizer routes the training step through a
+shard_map whose momentum exchange is runtime/comm/compressed.py's
+sign-packed allreduce — and assert (a) the packed uint8 wire is really in
+the compiled program, (b) CommsLogger sees the reduced byte count, and
+(c) convergence parity with the uncompressed optimizer on the same data.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.comm import comm as dist
+from deepspeed_tpu.comm.mesh import MeshSpec, create_mesh
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+CFG = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                  max_position_embeddings=64, rope_theta=1e4,
+                  dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def _train(opt_cfg, n_dev, steps=24, seed=0):
+    mesh = create_mesh(MeshSpec(data=n_dev), devices=jax.devices()[:n_dev])
+    engine, _, _, _ = ds.initialize(
+        model=LlamaForCausalLM(CFG), mesh=mesh, dist_init_required=False,
+        config={"train_batch_size": 8, "optimizer": opt_cfg,
+                "zero_optimization": {"stage": 0}, "steps_per_print": 0})
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 256, (8, 32)).astype(np.int32)
+    losses = [float(engine.train_batch(batch={"input_ids": ids, "labels": ids}))
+              for _ in range(steps)]
+    return engine, losses
+
+
+def test_compressed_transport_wire_and_convergence():
+    dist.configure(enabled=True)
+    onebit = {"type": "OneBitAdam",
+              "params": {"lr": 1e-3, "freeze_step": 4, "comm_backend_name": "nccl"}}
+    engine, losses = _train(onebit, n_dev=8)
+    assert engine._onebit_comm_backend is not None  # transport path active
+    assert all(np.isfinite(losses)), losses
+
+    # (a) the packed 1-bit wire is IN the compiled program: the momentum
+    # exchange all-gathers uint8 sign words, not fp32 tensors
+    ids = np.zeros((8, 32), np.int32)
+    hlo = engine._train_step_fn.lower(engine.state,
+                                      {"input_ids": ids, "labels": ids}).as_text()
+    assert "all_gather" in hlo and "ui8" in hlo, "no uint8 all-gather in the step"
+
+    # (b) CommsLogger recorded the compressed byte count: n/8 + 4 per tensor
+    n_params_bytes = sum((int(np.prod(l.shape)) + 7) // 8 + 4
+                         for l in jax.tree.leaves(engine.state.params))
+    comms = dist.comms_logger().comms_dict
+    assert "compressed_allreduce" in comms
+    assert n_params_bytes in comms["compressed_allreduce"]
+    # 1-bit+scale is ~1/30 of the fp32 transport it replaces
+    fp32_bytes = sum(4 * int(np.prod(l.shape)) for l in jax.tree.leaves(engine.state.params))
+    assert n_params_bytes < fp32_bytes / 25
+
+    # (c) convergence parity: the WIRE must not change what the algorithm
+    # converges to — control is the same OneBitAdam with local compression
+    # numerics and no exchange (GSPMD-meaned grads)
+    _, base = _train({"type": "OneBitAdam", "params": {"lr": 1e-3, "freeze_step": 4}},
+                     n_dev=8)
+    assert losses[-1] < losses[0] * 0.7, f"no convergence: {losses[0]} -> {losses[-1]}"
+    assert abs(losses[-1] - base[-1]) < 0.25 * max(1.0, abs(base[-1])), (losses[-1], base[-1])
+
+
+def test_transport_falls_back_without_data_axis():
+    onebit = {"type": "OneBitAdam",
+              "params": {"lr": 1e-3, "freeze_step": 4, "comm_backend_name": "nccl"}}
+    engine, losses = _train(onebit, n_dev=1, steps=6)
+    assert engine._onebit_comm_backend is None  # fell back to local numerics
+    assert all(np.isfinite(losses))
