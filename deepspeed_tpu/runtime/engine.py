@@ -278,6 +278,31 @@ class DeepSpeedEngine:
             return fused_adam(lr=self.lr_schedule, adam_w_mode=adam_w, **params)
         return OPTIMIZER_FACTORIES[name](lr=self.lr_schedule, **params)
 
+    def _nvme_pipelined_active(self) -> bool:
+        """True when optimizer states should live on NVMe with the pipelined
+        double-buffered swap (ref: swap_tensor/pipelined_optimizer_swapper.py):
+        offload_optimizer device=nvme + nvme_path, an Adam-family optimizer,
+        static-unity scaling and a single-device mesh (the per-group update
+        streams through host memory; the sharded multi-chip answer is ZeRO)."""
+        off = self._config.zero_config.offload_optimizer
+        if off is None or str(getattr(off, "device", "")) != "nvme" \
+                or not getattr(off, "nvme_path", None):
+            return False
+        from .fp16.loss_scaler import StaticLossScaler
+        name = (self._config.optimizer_config.type or "").lower() \
+            if self._config.optimizer_config else "adamw"
+        ok = (self.mesh.size == 1
+              and name in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER, "cpuadam")
+              and isinstance(self.loss_scaler, StaticLossScaler)
+              and float(self.loss_scaler.init_scale) == 1.0
+              and self.compute_dtype != jnp.float16)
+        if not ok:
+            logger.warning("offload_optimizer device=nvme: pipelined swap needs a "
+                           "single-device mesh, Adam-family optimizer and non-fp16 "
+                           "static-unity scaling — falling back to host memory-kind "
+                           "offload")
+        return ok
+
     def _compressed_transport_active(self) -> bool:
         """True when the 1-bit momentum exchange should ride the compressed
         wire: a comm backend was requested, there is a >1 data axis to
@@ -371,10 +396,17 @@ class DeepSpeedEngine:
         self._grad_shardings = make_grad_shardings(param_sh, abs_params, self.mesh, self.zero_stage,
                                                    zero_axes=state_axes)
 
+        nvme_pipe_early = self._nvme_pipelined_active()
+
         @partial(jax.jit, out_shardings=None)
         def build_state(p):
-            master = jax.tree.map(lambda x: x.astype(jnp.float32), p) if use_master else ()
-            opt_state = self.opt.init(master if use_master else p)
+            if nvme_pipe_early:
+                # pipelined NVMe offload: master + moments live on DISK
+                # (PipelinedNVMeOptimizer); the device state is params-only
+                master, opt_state = (), ()
+            else:
+                master = jax.tree.map(lambda x: x.astype(jnp.float32), p) if use_master else ()
+                opt_state = self.opt.init(master if use_master else p)
             return TrainState(step=jnp.zeros((), jnp.int32),
                               params=cast(p),
                               master=master,
@@ -414,7 +446,8 @@ class DeepSpeedEngine:
             return out
 
         offload = self._config.zero_config.offload_optimizer
-        if offload is not None and offload.device in ("cpu", "nvme"):
+        nvme_pipe = nvme_pipe_early  # computed once above (warns on fallback)
+        if offload is not None and offload.device in ("cpu", "nvme") and not nvme_pipe:
             if use_master:
                 master_sh, opt_sh = try_host_offload("offload_optimizer", master_sh, opt_sh)
             else:
@@ -430,7 +463,7 @@ class DeepSpeedEngine:
         self.state_shardings = TrainState(
             step=repl,
             params=param_sh,
-            master=master_sh if use_master else (),
+            master=master_sh if use_master and not nvme_pipe else (),
             opt_state=opt_sh,
             scaler=jax.tree.map(lambda _: repl, abs_state.scaler),
             skipped_steps=repl,
@@ -444,6 +477,12 @@ class DeepSpeedEngine:
         else:
             with self.mesh:
                 self.state = jax.jit(build_state, out_shardings=self.state_shardings)(raw_params)
+        if nvme_pipe and not abstract and getattr(self, "_nvme_opt", None) is None:
+            from .swap_tensor.pipelined_optimizer_swapper import PipelinedNVMeOptimizer
+            self._nvme_opt = PipelinedNVMeOptimizer(
+                self.opt, jax.tree.leaves(self.state.params),
+                self._config.zero_config.offload_optimizer.nvme_path,
+                compute_dtype=self.compute_dtype)
         n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(abs_params))
         log_dist(f"Initialized TrainState: {n_params/1e6:.1f}M params, zero_stage={self.zero_stage}"
                  f"{' (abstract)' if abstract else ''}", ranks=[0])
@@ -823,7 +862,71 @@ class DeepSpeedEngine:
         self._accum_fn = unsupported
         self._apply_step_fn = unsupported
 
+    def _build_nvme_train_step(self, batch):
+        """Device program for the pipelined-NVMe mode: fwd/bwd only — grads,
+        loss and the grad norm come OUT; the optimizer update runs per
+        sub-group against disk-resident states (PipelinedNVMeOptimizer)."""
+        batch_sh = self._batch_sharding_tree(batch)
+        repl = NamedSharding(self.mesh, P())
+        inv = 1.0 / self.gas
+        if self._config.gradient_predivide_factor != 1.0:
+            inv = inv / self._config.gradient_predivide_factor
+        if getattr(self, "_nvme_opt", None) is not None:
+            # lr/phase inputs are baked at trace time (e.g. variable-batch
+            # _lr_scale rides self.lr_schedule): a step rebuild must retrace
+            # the per-group update programs too
+            self._nvme_opt._update_fns.clear()
+
+        def grad_step(state, b):
+            grads, loss = self._grads_for_batch(state, b)
+            norm2 = sum(jnp.sum(jnp.square(g.astype(jnp.float32) * inv))
+                        for g in jax.tree.leaves(grads))
+            return grads, loss, jnp.sqrt(norm2)
+
+        self._train_step_fn = jax.jit(grad_step, in_shardings=(self.state_shardings, batch_sh))
+        self._batch_shardings = batch_sh
+
+        def unsupported(*a, **k):
+            raise RuntimeError("the imperative forward/backward/step path does not support "
+                               "pipelined NVMe optimizer offload; use train_batch()")
+
+        self._accum_fn = unsupported
+        self._apply_step_fn = unsupported
+
+    def _nvme_train_step(self, batch):
+        """Host-orchestrated step: device fwd/bwd (async), then the
+        double-buffered per-group update.  Step N's tail disk writes drain
+        while step N+1's fwd/bwd dispatches (the overlap the reference gets
+        from its swap pipeline)."""
+        nv = self._nvme_opt
+        nv.events.append(("step_entry_pending_writes", nv.pending_writes()))
+        state = self.state
+        grads, loss, gnorm = self._train_step_fn(state, batch)
+        inv = 1.0 / self.gas
+        cfg = self._config
+        if cfg.gradient_predivide_factor != 1.0:
+            inv = inv / cfg.gradient_predivide_factor
+        scale = jnp.asarray(inv, jnp.float32)
+        if cfg.gradient_clipping and cfg.gradient_clipping > 0:
+            scale = scale * jnp.minimum(1.0, cfg.gradient_clipping / (gnorm + 1e-6))
+        new_leaves = nv.step(jax.tree.leaves(grads), jnp.asarray(self.global_steps, jnp.int32),
+                             scale)
+        tdef = jax.tree.structure(state.params)
+        new_state = state._replace(params=jax.tree.unflatten(tdef, new_leaves),
+                                   step=state.step + 1)
+        metrics = StepMetrics(loss=loss.astype(jnp.float32),
+                              grad_norm=gnorm,
+                              found_inf=jnp.asarray(False),
+                              lr=jnp.asarray(self.lr_schedule(state.step + 1), jnp.float32),
+                              loss_scale=jnp.asarray(1.0, jnp.float32))
+        return new_state, metrics
+
     def _build_train_step(self, batch):
+        if getattr(self, "_nvme_opt", None) is not None or \
+                (getattr(self, "_abstract_state", False) and self._nvme_pipelined_active()):
+            # abstract (compile_aot) engines build the nvme grad-step program
+            # too: the normal path would feed the () opt_state to opt.update
+            return self._build_nvme_train_step(batch)
         if getattr(self, "_onebit_comm_backend", None):
             return self._build_compressed_train_step(
                 batch, warmup=self.global_steps < self._onebit_freeze_step)
@@ -974,7 +1077,10 @@ class DeepSpeedEngine:
         import time as _time
         _step_t0 = _time.time()
         with mesh_lib.trace_mesh(self.mesh):  # first call traces model code
-            self.state, metrics = self._train_step_fn(self.state, batch)
+            if getattr(self, "_nvme_opt", None) is not None:
+                self.state, metrics = self._nvme_train_step(batch)
+            else:
+                self.state, metrics = self._train_step_fn(self.state, batch)
         if getattr(self, "_compressed_wire_bytes", None) \
                 and self.global_steps >= self._onebit_freeze_step \
                 and not self._rebuilt_this_step:
@@ -1171,14 +1277,34 @@ class DeepSpeedEngine:
         self._offloaded = {}
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True, exclude_frozen_parameters=False):
+        if getattr(self, "_nvme_opt", None) is not None:
+            # the optimizer state lives on NVMe; the checkpoint captures
+            # params + step, and resume re-reads the swap files at
+            # nvme_path (they are flushed durable here)
+            self._nvme_opt.swapper.flush_writes()
+            logger.warning("save_checkpoint with pipelined NVMe offload: optimizer "
+                           "moments stay in the nvme_path swap files — keep that "
+                           "directory alongside the checkpoint to resume exactly")
         from ..checkpoint.engine import save_checkpoint as _save
         return _save(self, save_dir, tag=tag, client_state=client_state or {}, save_latest=save_latest)
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
         from ..checkpoint.engine import load_checkpoint as _load
-        return _load(self, load_dir, tag=tag, load_optimizer_states=load_optimizer_states,
-                     load_module_only=load_module_only)
+        out = _load(self, load_dir, tag=tag, load_optimizer_states=load_optimizer_states,
+                    load_module_only=load_module_only)
+        if getattr(self, "_nvme_opt", None) is not None and self.state is not None:
+            # the disk-resident fp32 master must correspond to the restored
+            # params — otherwise the first step would silently revert the
+            # loaded weights to whatever the swap files held (e.g. the
+            # random init written at materialization)
+            leaves = jax.tree.leaves(self.state.params)
+            if not self._nvme_opt.master_matches_params(leaves, self.compute_dtype):
+                logger.warning("pipelined NVMe offload: swap files do not match the "
+                               "loaded checkpoint — reinitializing disk master from "
+                               "the restored weights (Adam moments reset to zero)")
+                self._nvme_opt.resync_master_from_params(leaves)
+        return out
 
     # ------------------------------------------------------------- properties
 

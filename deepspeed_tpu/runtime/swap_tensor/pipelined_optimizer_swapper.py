@@ -1,0 +1,208 @@
+"""Pipelined NVMe optimizer-state swapping (ZeRO-Infinity's in-step path).
+
+Reference: ``deepspeed/runtime/swap_tensor/pipelined_optimizer_swapper.py``
+— optimizer sub-states live on NVMe and are double-buffered around the
+update: while sub-group *g* updates, group *g+1*'s read and group *g-1*'s
+write are in flight on the aio threads, and the tail writes drain while
+the NEXT step's forward/backward runs on the device.
+
+TPU-native realisation: the fwd/bwd stays ONE jitted device program
+(grads + loss + grad-norm out); the optimizer update runs per sub-group
+in a small jitted program whose fp32 master/moments stream
+disk → host → HBM → disk through ``PartitionedOptimizerSwapper``
+(ops/aio C++ thread pool underneath).  Configured by
+``zero_optimization.offload_optimizer: {device: nvme, nvme_path: ...}``.
+"""
+
+import dataclasses
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.logging import log_dist
+from .swapper import AioSwapConfig, PartitionedOptimizerSwapper
+
+
+class PipelinedNVMeOptimizer:
+    """Owns the fp32 master + Adam moments on NVMe, partitioned into
+    byte-balanced sub-groups of parameter leaves; ``step`` runs the
+    double-buffered update loop.  ``events`` records the issue order
+    (prefetch/update/writeback) so tests can assert the overlap structure
+    without depending on disk timing."""
+
+    def __init__(self, opt, param_leaves, nvme_path: str, n_groups: int = 4,
+                 compute_dtype=jnp.bfloat16, aio: AioSwapConfig = AioSwapConfig()):
+        self.opt = opt
+        self.compute_dtype = compute_dtype
+        self.swapper = PartitionedOptimizerSwapper(nvme_path, aio)
+        # bounded instrumentation ring (tests assert the double-buffer issue
+        # order; production steps must not accumulate host memory)
+        self.events = deque(maxlen=512)
+        self._update_fns: Dict[int, Callable] = {}
+
+        # byte-balanced contiguous leaf partition
+        sizes = [int(np.prod(l.shape)) * 4 for l in param_leaves]
+        target = max(1, sum(sizes) // max(1, n_groups))
+        self.groups: List[List[int]] = []
+        cur, acc = [], 0
+        for i, s in enumerate(sizes):
+            cur.append(i)
+            acc += s
+            if acc >= target and len(self.groups) < n_groups - 1:
+                self.groups.append(cur)
+                cur, acc = [], 0
+        if cur:
+            self.groups.append(cur)
+        self.n_groups = len(self.groups)
+
+        # resume: matching swap files from a previous run are REUSED (the
+        # checkpoint stores params+step; the moments live here — see
+        # engine.save_checkpoint); otherwise initialize fp32 master from
+        # the params + zero moments, written straight to disk, never
+        # resident in full
+        shapes = [[list(param_leaves[i].shape) for i in idxs] for idxs in self.groups]
+        meta_path = self.swapper.swapper.dir / "pipelined_meta.json"
+        if self._try_resume(meta_path, shapes):
+            log_dist(f"PipelinedNVMeOptimizer: resumed {self.n_groups} sub-groups "
+                     f"from {nvme_path}", ranks=[0])
+            return
+        for g, idxs in enumerate(self.groups):
+            master = [np.asarray(jax.device_get(param_leaves[i]), np.float32) for i in idxs]
+            sub = {"master": master,
+                   "mu": [np.zeros_like(m) for m in master],
+                   "nu": [np.zeros_like(m) for m in master]}
+            self.swapper.swap_out_group(g, sub, blocking=True)
+        import json
+        with open(meta_path, "w") as f:
+            json.dump({"groups": shapes}, f)
+        log_dist(f"PipelinedNVMeOptimizer: {len(param_leaves)} leaves in "
+                 f"{self.n_groups} sub-groups on {nvme_path}", ranks=[0])
+
+    def _try_resume(self, meta_path, shapes) -> bool:
+        """Rebuild the swapper manifests from persisted metadata when the
+        on-disk sub-states match this model's partitioning exactly."""
+        import json
+        if not meta_path.exists():
+            return False
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if meta.get("groups") != shapes:
+            log_dist(f"PipelinedNVMeOptimizer: swap files at {meta_path.parent} "
+                     "belong to a different model partitioning — reinitializing",
+                     ranks=[0])
+            return False
+        ts = self.swapper.swapper
+        for g, group_shapes in enumerate(shapes):
+            key = f"optgroup_{g}"
+            if not ts._path(key).exists():
+                return False
+            # leaf order of {"master": [...], "mu": [...], "nu": [...]} is
+            # alphabetical keys → master, mu, nu; offsets re-derived with the
+            # same alignment rule the writer used
+            template = {"master": [np.empty(0)] * len(group_shapes),
+                        "mu": [np.empty(0)] * len(group_shapes),
+                        "nu": [np.empty(0)] * len(group_shapes)}
+            all_shapes = [tuple(s) for s in group_shapes] * 3
+            offsets, off = [], 0
+            for s in all_shapes:
+                offsets.append(off)
+                off += ts._align(int(np.prod(s)) * 4 if s else 4)
+            ts._manifests[key] = {
+                "treedef": jax.tree.structure(template),
+                "shapes": all_shapes,
+                "dtypes": ["float32"] * len(all_shapes),
+                "offsets": offsets,
+            }
+        return True
+
+    def _group_update(self, g: int):
+        """Jitted per-group update: AdamState math over this group's leaves
+        (the generic GradientTransformation applied to a sub-tree)."""
+        if g not in self._update_fns:
+            from ...ops.adam import AdamState
+
+            def upd(master, mu, nu, grads, count, scale):
+                g32 = [x.astype(jnp.float32) * scale for x in grads]
+                updates, st = self.opt.update(g32, AdamState(count, mu, nu), master)
+                new_master = [m + u for m, u in zip(master, updates)]
+                new_params = [m.astype(self.compute_dtype) for m in new_master]
+                return new_master, st.exp_avg, st.exp_avg_sq, new_params
+
+            self._update_fns[g] = jax.jit(upd, donate_argnums=(0, 1, 2))
+        return self._update_fns[g]
+
+    def pending_writes(self) -> int:
+        return len(self.swapper._pending_out)
+
+    def resync_master_from_params(self, param_leaves):
+        """Rewrite the disk master from freshly-loaded params (zeroing the
+        moments): called by load_checkpoint when the swap files do NOT
+        belong to the loaded training state."""
+        self.swapper.flush_writes()
+        for g, idxs in enumerate(self.groups):
+            master = [np.asarray(jax.device_get(param_leaves[i]), np.float32) for i in idxs]
+            sub = {"master": master,
+                   "mu": [np.zeros_like(m) for m in master],
+                   "nu": [np.zeros_like(m) for m in master]}
+            self.swapper.swap_out_group(g, sub, blocking=True)
+
+    def master_matches_params(self, param_leaves, compute_dtype) -> bool:
+        """True when the disk master corresponds to ``param_leaves`` (the
+        true-resume case: params were cast from exactly this master).
+        Checks one representative leaf per group."""
+        self.swapper.flush_writes()
+        for g, idxs in enumerate(self.groups):
+            sub = self.swapper.swap_in_group(g)
+            disk = np.asarray(sub["master"][0], np.float32).astype(compute_dtype)
+            live = np.asarray(jax.device_get(param_leaves[idxs[0]]))
+            if disk.shape != live.shape or not np.allclose(disk, live, atol=0, rtol=0):
+                return False
+        return True
+
+    def step(self, grad_leaves, count, clip_scale):
+        """Double-buffered update sweep.  Returns the new compute-dtype
+        param leaves (device), in original leaf order."""
+        new_params: List[Optional[Any]] = [None] * sum(len(g) for g in self.groups)
+        self.swapper.prefetch_group(0)
+        self.events.append(("prefetch_issue", 0))
+        for g, idxs in enumerate(self.groups):
+            if g + 1 < self.n_groups:
+                # next group's disk read rides the aio threads WHILE this
+                # group's update computes (the double buffer)
+                self.swapper.prefetch_group(g + 1)
+                self.events.append(("prefetch_issue", g + 1))
+            sub = self.swapper.swap_in_group(g)
+            nm, nmu, nnu, np_leaves = self._group_update(g)(
+                sub["master"], sub["mu"], sub["nu"],
+                [grad_leaves[i] for i in idxs], count, clip_scale)
+            self.events.append(("update_done", g))
+            for i, p in zip(idxs, np_leaves):
+                new_params[i] = p
+            host_sub = {"master": [np.asarray(x) for x in jax.device_get(nm)],
+                        "mu": [np.asarray(x) for x in jax.device_get(nmu)],
+                        "nu": [np.asarray(x) for x in jax.device_get(nnu)]}
+            # async write-back: drains while group g+1 updates — and the
+            # LAST groups' writes drain while the next step's fwd/bwd runs
+            self.swapper.swap_out_group(g, host_sub, blocking=False)
+            self.events.append(("writeback_issue", g))
+        return new_params
+
+    def state_dict_host(self):
+        """Materialize the full optimizer state on host (checkpointing)."""
+        self.swapper.flush_writes()
+        out = []
+        for g in range(self.n_groups):
+            out.append(self.swapper.swap_in_group(g))
+            # reading consumed the pending-in handle; re-register nothing —
+            # the on-disk copy is still valid
+        return out
+
+    def teardown(self):
+        self.swapper.flush_writes()
+        self.swapper.swapper.teardown()

@@ -1,0 +1,103 @@
+"""Pipelined NVMe optimizer swap (r3 verdict item 7).
+
+The reference overlaps NVMe optimizer-state traffic with the step
+(ref: deepspeed/runtime/swap_tensor/pipelined_optimizer_swapper.py);
+pre-r4 we had the aio engine and an offload_states roundtrip but no
+in-step pipelined swap.  These tests drive
+``offload_optimizer: {device: nvme, nvme_path}`` end to end: numerics
+parity with the on-device optimizer, and the double-buffer ISSUE ORDER —
+group g+1's disk read in flight before group g's update completes, and
+step N's tail writes still pending when step N+1 begins.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.comm.mesh import MeshSpec, create_mesh
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+CFG = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                  max_position_embeddings=64, rope_theta=1e4,
+                  dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def _train(zero_cfg, steps=5):
+    mesh = create_mesh(MeshSpec(data=1), devices=jax.devices()[:1])
+    engine, _, _, _ = ds.initialize(
+        model=LlamaForCausalLM(CFG), mesh=mesh, dist_init_required=False,
+        config={"train_batch_size": 8, "gradient_clipping": 1.0,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": zero_cfg, "steps_per_print": 0})
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, (8, 32)).astype(np.int32)
+    losses = [float(engine.train_batch(batch={"input_ids": ids, "labels": ids}))
+              for _ in range(steps)]
+    return engine, losses
+
+
+def test_nvme_pipelined_matches_on_device_optimizer(tmp_path):
+    eng_nvme, nvme_losses = _train(
+        {"stage": 0, "offload_optimizer": {"device": "nvme", "nvme_path": str(tmp_path)}})
+    assert getattr(eng_nvme, "_nvme_opt", None) is not None, "pipelined path not active"
+    _, base_losses = _train({"stage": 0})
+    # identical math, states merely roundtripped through disk per step
+    np.testing.assert_allclose(nvme_losses, base_losses, rtol=2e-4, atol=2e-4)
+
+    # the device never holds the optimizer state in this mode
+    assert eng_nvme.state.master == () and eng_nvme.state.opt_state == ()
+
+
+def test_nvme_resume_continues_exactly(tmp_path):
+    """Checkpoint resume: params+step from the checkpoint, moments re-read
+    from the surviving swap files — the continuation must match the
+    uninterrupted run."""
+    zero = {"stage": 0, "offload_optimizer": {"device": "nvme",
+                                              "nvme_path": str(tmp_path / "swap")}}
+    eng, full = _train(zero, steps=5)
+
+    # interrupted twin: same data, fresh swap dir
+    zero2 = {"stage": 0, "offload_optimizer": {"device": "nvme",
+                                               "nvme_path": str(tmp_path / "swap2")}}
+    eng_a, first3 = _train(zero2, steps=3)
+    eng_a.save_checkpoint(tmp_path / "ckpt", tag="t")
+
+    mesh = create_mesh(MeshSpec(data=1), devices=jax.devices()[:1])
+    eng_b, _, _, _ = ds.initialize(
+        model=LlamaForCausalLM(CFG), mesh=mesh, dist_init_required=False,
+        config={"train_batch_size": 8, "gradient_clipping": 1.0,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": zero2, "steps_per_print": 0})
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, (8, 32)).astype(np.int32)
+    batch = {"input_ids": ids, "labels": ids}
+    eng_b._ensure_ready(batch)  # materialize: _try_resume REUSES the swap
+    # files (a training step here would corrupt the disk-resident moments)
+    eng_b.load_checkpoint(tmp_path / "ckpt", tag="t", load_optimizer_states=False)
+    eng_b.global_steps = 3
+    got = [float(eng_b.train_batch(batch=batch)) for _ in range(2)]
+    np.testing.assert_allclose(got, full[3:], rtol=2e-3, atol=2e-3)
+
+
+def test_nvme_double_buffer_issue_order(tmp_path):
+    eng, losses = _train(
+        {"stage": 0, "offload_optimizer": {"device": "nvme", "nvme_path": str(tmp_path)}},
+        steps=3)
+    assert all(np.isfinite(losses))
+    nv = eng._nvme_opt
+    assert nv.n_groups >= 2, f"partitioning degenerate: {nv.n_groups} groups"
+    ev = list(nv.events)
+
+    # within a step: group g+1's read is ISSUED before group g's update
+    # completes (the double buffer)
+    first_upd = ev.index(("update_done", 0))
+    assert ("prefetch_issue", 1) in ev[:first_upd], ev[:first_upd + 1]
+
+    # across steps: a later step begins while earlier writebacks are still
+    # registered as pending (drained lazily by the next read of that group)
+    entries = [n for tag, n in ev if tag == "step_entry_pending_writes"]
+    assert len(entries) == 3
+    assert any(n > 0 for n in entries[1:]), (
+        f"no step started with disk writes in flight: {entries}")
