@@ -126,10 +126,42 @@ def mixtral_8x7b_ep_zero3():
         backend=backend, zero_stage=3)
 
 
+def llama3_8b_zero3_v5p64():
+    """The north-star config (BASELINE.json acceptance bar): Llama-3-8B,
+    ZeRO-3 + FusedAdam on a v5p-64 slice, global batch 64."""
+    import numpy as np
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.llama import LlamaForCausalLM, PRESETS
+
+    global TOPOLOGY
+    prev, TOPOLOGY = TOPOLOGY, "v5p:4x4x4"
+    try:
+        mesh, backend = _mesh(64, data=64)
+    finally:
+        TOPOLOGY = prev
+    on_tpu = backend.startswith("v5")
+    cfg = dataclasses.replace(
+        PRESETS["llama3-8b"],
+        attention_impl="flash" if on_tpu else "chunked",
+        scan_layers=True, remat=True,
+        remat_policy="flash_saveable" if on_tpu else "dots_with_no_batch_dims_saveable")
+    engine, _, _, _ = ds.initialize(
+        model=LlamaForCausalLM(cfg), mesh=mesh, dist_init_required=False,
+        config={"train_batch_size": 64,
+                "optimizer": {"type": "FusedAdam", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": 3},
+                "bf16": {"enabled": True}})
+    ids = np.zeros((64, 8192), dtype=np.int32)
+    return engine, {"input_ids": ids, "labels": ids}, dict(
+        model="llama3-8b", seq=8192, global_batch=64, mesh="data=64",
+        backend=backend, zero_stage=3)
+
+
 CONFIGS = {
     "llama3_8b_zero3_v5p16": llama3_8b_zero3_v5p16,
     "llama3_8b_ulysses32k": llama3_8b_ulysses32k,
     "mixtral_8x7b_ep_zero3": mixtral_8x7b_ep_zero3,
+    "llama3_8b_zero3_v5p64": llama3_8b_zero3_v5p64,
 }
 
 
