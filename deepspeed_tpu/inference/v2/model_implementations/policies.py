@@ -820,6 +820,175 @@ class BertPolicy(InferenceV2Policy):
 
 
 
+class DistilBertPolicy(InferenceV2Policy):
+    """ref: module_inject/containers/distil_bert.py (HFDistilBertLayerPolicy)
+    — DistilBERT is BERT minus token-type embeddings and pooler with renamed
+    modules (q_lin/k_lin/v_lin, sa_layer_norm, ffn.lin1/lin2,
+    vocab_transform/vocab_projector); served through the same
+    models/bert.BertForMaskedLM with a zero token-type table (the add is a
+    no-op for token_type_ids=0)."""
+    model_type = "distilbert"
+
+    def build_config(self, hf_cfg):
+        act = getattr(hf_cfg, "activation", "gelu")
+        if act != "gelu":
+            raise ValueError(f"distilbert activation={act!r} unsupported (model uses gelu)")
+        from ....models.bert import BertConfig
+        return BertConfig(vocab_size=hf_cfg.vocab_size,
+                          hidden_size=hf_cfg.dim,
+                          num_hidden_layers=hf_cfg.n_layers,
+                          num_attention_heads=hf_cfg.n_heads,
+                          intermediate_size=hf_cfg.hidden_dim,
+                          max_position_embeddings=hf_cfg.max_position_embeddings,
+                          type_vocab_size=2,
+                          layer_norm_eps=1e-12)
+
+    def build_model(self, cfg):
+        from ....models.bert import BertForMaskedLM
+        return BertForMaskedLM(cfg)
+
+    def convert(self, sd, cfg):
+        H = cfg.num_attention_heads
+        E = cfg.hidden_size
+        D = E // H
+        L = cfg.num_hidden_layers
+        get = lambda name: _get(sd, name)
+        stack = lambda fmt, conv=(lambda w: w): _stack(
+            sd, "distilbert.transformer.layer.{i}." + fmt, L, conv)
+        ln = lambda fmt: {"scale": stack(fmt + ".weight"), "bias": stack(fmt + ".bias")}
+        proj = lambda name: _proj(sd, L, E, D,
+                                  "distilbert.transformer.layer.{i}.attention." + name,
+                                  H, bias=True)
+        return {
+            "bert": {
+                "word_embeddings": {"embedding": get("distilbert.embeddings.word_embeddings.weight")},
+                "position_embeddings": {"embedding": get("distilbert.embeddings.position_embeddings.weight")},
+                # distilbert has no token types: a zero table makes the
+                # shared encoder's add a no-op
+                "token_type_embeddings": {"embedding": np.zeros((cfg.type_vocab_size, E), np.float32)},
+                "embeddings_ln": {"scale": get("distilbert.embeddings.LayerNorm.weight"),
+                                  "bias": get("distilbert.embeddings.LayerNorm.bias")},
+                "encoder": {
+                    "attention": {
+                        "query": proj("q_lin"),
+                        "key": proj("k_lin"),
+                        "value": proj("v_lin"),
+                        "output": {"kernel": stack("attention.out_lin.weight",
+                                                   lambda w: _t(w).reshape(H, D, E)),
+                                   "bias": stack("attention.out_lin.bias")},
+                    },
+                    "attention_output_ln": ln("sa_layer_norm"),
+                    "intermediate": {"kernel": stack("ffn.lin1.weight", _t),
+                                     "bias": stack("ffn.lin1.bias")},
+                    "output": {"kernel": stack("ffn.lin2.weight", _t),
+                               "bias": stack("ffn.lin2.bias")},
+                    "output_ln": ln("output_layer_norm"),
+                },
+            },
+            "transform": {"kernel": _t(get("vocab_transform.weight")),
+                          "bias": get("vocab_transform.bias")},
+            "transform_ln": {"scale": get("vocab_layer_norm.weight"),
+                             "bias": get("vocab_layer_norm.bias")},
+            "decoder": {"kernel": _t(get("vocab_projector.weight")),
+                        "bias": get("vocab_projector.bias")},
+        }
+
+
+class ClipPolicy(InferenceV2Policy):
+    """ref: module_inject/containers/clip.py (HFCLIPLayerPolicy) — the CLIP
+    dual encoder (stable-diffusion's text conditioner).  Whole-model
+    conversion of HF CLIPModel onto models/clip.ClipModel (pre-LN towers,
+    quick-GELU, EOS pooling, patch-conv vision embeddings)."""
+    model_type = "clip"
+
+    def build_config(self, hf_cfg):
+        from ....models.clip import ClipConfig, ClipTextConfig, ClipVisionConfig
+        t, v = hf_cfg.text_config, hf_cfg.vision_config
+        for tower in (t, v):
+            act = getattr(tower, "hidden_act", "quick_gelu")
+            if act != "quick_gelu":
+                raise ValueError(f"clip hidden_act={act!r} unsupported (the towers "
+                                 "compute quick_gelu; serving other activations would "
+                                 "silently diverge from HF)")
+        text = ClipTextConfig(vocab_size=t.vocab_size, hidden_size=t.hidden_size,
+                              num_hidden_layers=t.num_hidden_layers,
+                              num_attention_heads=t.num_attention_heads,
+                              intermediate_size=t.intermediate_size,
+                              max_position_embeddings=t.max_position_embeddings,
+                              layer_norm_eps=t.layer_norm_eps,
+                              eos_token_id=getattr(t, "eos_token_id", 49407))
+        vision = ClipVisionConfig(hidden_size=v.hidden_size,
+                                  num_hidden_layers=v.num_hidden_layers,
+                                  num_attention_heads=v.num_attention_heads,
+                                  intermediate_size=v.intermediate_size,
+                                  image_size=v.image_size, patch_size=v.patch_size,
+                                  num_channels=v.num_channels,
+                                  layer_norm_eps=v.layer_norm_eps)
+        return ClipConfig(text=text, vision=vision, projection_dim=hf_cfg.projection_dim)
+
+    def build_model(self, cfg):
+        import dataclasses as _dc
+
+        from ....models.clip import ClipModel
+        return ClipModel(_dc.replace(cfg.text, dtype=cfg.dtype),
+                         _dc.replace(cfg.vision, dtype=cfg.dtype),
+                         projection_dim=cfg.projection_dim)
+
+    def _tower(self, sd, prefix, cfg, H):
+        E = cfg.hidden_size
+        D = E // H
+        get = lambda name: _get(sd, prefix + name)
+        out = {}
+        for i in range(cfg.num_hidden_layers):
+            lp = f"encoder.layers.{i}."
+            lnp = lambda n: {"scale": get(lp + n + ".weight"), "bias": get(lp + n + ".bias")}
+            pj = lambda n: {"kernel": _t(get(lp + f"self_attn.{n}.weight")).reshape(E, H, D),
+                            "bias": get(lp + f"self_attn.{n}.bias").reshape(H, D)}
+            out[f"layers_{i}"] = {
+                "self_attn": {"q_proj": pj("q_proj"), "k_proj": pj("k_proj"),
+                              "v_proj": pj("v_proj"),
+                              "out_proj": {"kernel": _t(get(lp + "self_attn.out_proj.weight"))
+                                           .reshape(H, D, E),
+                                           "bias": get(lp + "self_attn.out_proj.bias")}},
+                "layer_norm1": lnp("layer_norm1"),
+                "layer_norm2": lnp("layer_norm2"),
+                "fc1": {"kernel": _t(get(lp + "mlp.fc1.weight")), "bias": get(lp + "mlp.fc1.bias")},
+                "fc2": {"kernel": _t(get(lp + "mlp.fc2.weight")), "bias": get(lp + "mlp.fc2.bias")},
+            }
+        return out
+
+    def convert(self, sd, cfg):
+        text, vision = cfg.text, cfg.vision
+        get = lambda name: _get(sd, name)
+        tm = self._tower(sd, "text_model.", text, text.num_attention_heads)
+        tm.update({
+            "token_embedding": {"embedding": get("text_model.embeddings.token_embedding.weight")},
+            "position_embedding": get("text_model.embeddings.position_embedding.weight"),
+            "final_layer_norm": {"scale": get("text_model.final_layer_norm.weight"),
+                                 "bias": get("text_model.final_layer_norm.bias")},
+        })
+        vm = self._tower(sd, "vision_model.", vision, vision.num_attention_heads)
+        vm.update({
+            # HF conv weight [E, C, ph, pw] → flax [ph, pw, C, E]
+            "patch_embedding": {"kernel": np.ascontiguousarray(
+                np.transpose(get("vision_model.embeddings.patch_embedding.weight"), (2, 3, 1, 0)))},
+            "class_embedding": get("vision_model.embeddings.class_embedding"),
+            "position_embedding": get("vision_model.embeddings.position_embedding.weight"),
+            # "pre_layrnorm" is the HF checkpoint's own (sic) spelling
+            "pre_layrnorm": {"scale": get("vision_model.pre_layrnorm.weight"),
+                             "bias": get("vision_model.pre_layrnorm.bias")},
+            "post_layernorm": {"scale": get("vision_model.post_layernorm.weight"),
+                               "bias": get("vision_model.post_layernorm.bias")},
+        })
+        return {
+            "text_model": tm,
+            "vision_model": vm,
+            "text_projection": {"kernel": _t(get("text_projection.weight"))},
+            "visual_projection": {"kernel": _t(get("visual_projection.weight"))},
+            "logit_scale": get("logit_scale"),
+        }
+
+
 class QwenV1Policy(InferenceV2Policy):
     """ref: the reference's qwen (v1) container (module_inject) — the
     trust_remote_code QWenLMHeadModel: llama math with a fused biased
@@ -896,6 +1065,8 @@ POLICY_REGISTRY = {
     "gptj": GPTJPolicy(),
     "gpt_neo": GPTNeoPolicy(),
     "bert": BertPolicy(),
+    "distilbert": DistilBertPolicy(),
+    "clip": ClipPolicy(),
     "qwen": QwenV1Policy(),
 }
 

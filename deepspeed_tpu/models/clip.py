@@ -1,0 +1,201 @@
+"""CLIP — dual-encoder text/vision model (stable-diffusion's conditioning
+encoder and the reference's CLIP injection target).
+
+ref: deepspeed/module_inject/containers/clip.py (HFCLIPLayerPolicy) — the
+reference TP-injects the CLIP encoder layers inside diffusion pipelines;
+here the whole model is a flax module pair (pre-LN transformer towers,
+quick-GELU MLPs, causal text attention with EOS pooling, patch-conv vision
+embeddings) fed by a weight-conversion policy
+(inference/v2/model_implementations/policies.ClipPolicy), so text/vision
+encoders serve through the same jitted v1 path as every other family.
+"""
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .llama import EMBED, HEAD_DIM, HEADS, MLP, VOCAB, _logical
+
+
+@dataclasses.dataclass(frozen=True)
+class ClipTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 512
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 8
+    intermediate_size: int = 2048
+    max_position_embeddings: int = 77
+    layer_norm_eps: float = 1e-5
+    eos_token_id: int = 49407
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+
+@dataclasses.dataclass(frozen=True)
+class ClipVisionConfig:
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    image_size: int = 224
+    patch_size: int = 32
+    num_channels: int = 3
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+
+def quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+class ClipAttention(nn.Module):
+    hidden_size: int
+    num_heads: int
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, causal: bool):
+        H = self.num_heads
+        D = self.hidden_size // H
+        dense = lambda feats, names, name: nn.DenseGeneral(
+            feats, use_bias=True, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=_logical(nn.initializers.lecun_normal(), names), name=name)
+        q = dense((H, D), (EMBED, HEADS, HEAD_DIM), "q_proj")(x)
+        k = dense((H, D), (EMBED, HEADS, HEAD_DIM), "k_proj")(x)
+        v = dense((H, D), (EMBED, HEADS, HEAD_DIM), "v_proj")(x)
+        scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+        if causal:
+            S = x.shape[1]
+            mask = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+        return nn.DenseGeneral(self.hidden_size, axis=(-2, -1), use_bias=True,
+                               dtype=self.dtype, param_dtype=self.param_dtype,
+                               kernel_init=_logical(nn.initializers.lecun_normal(),
+                                                    (HEADS, HEAD_DIM, EMBED)),
+                               name="out_proj")(o)
+
+
+class ClipEncoderLayer(nn.Module):
+    hidden_size: int
+    num_heads: int
+    intermediate_size: int
+    eps: float
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, causal: bool):
+        ln = lambda name: nn.LayerNorm(epsilon=self.eps, dtype=self.dtype,
+                                       param_dtype=self.param_dtype, name=name)
+        x = x + ClipAttention(self.hidden_size, self.num_heads, self.dtype,
+                              self.param_dtype, name="self_attn")(ln("layer_norm1")(x), causal)
+        dense = lambda feats, names, name: nn.Dense(
+            feats, use_bias=True, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=_logical(nn.initializers.lecun_normal(), names), name=name)
+        h = ln("layer_norm2")(x)
+        h = dense(self.intermediate_size, (EMBED, MLP), "fc1")(h)
+        h = quick_gelu(h)
+        return x + dense(self.hidden_size, (MLP, EMBED), "fc2")(h)
+
+
+class ClipTextModel(nn.Module):
+    """Pre-LN causal text tower; returns (last_hidden_state, pooled) where
+    pooled = the EOS token's final hidden state (HF CLIPTextModel)."""
+    cfg: ClipTextConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        tok = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype,
+                       embedding_init=_logical(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                       name="token_embedding")(input_ids)
+        pos = self.param("position_embedding",
+                         _logical(nn.initializers.normal(0.01), ("pos", EMBED)),
+                         (cfg.max_position_embeddings, cfg.hidden_size), cfg.param_dtype)
+        x = tok + pos[None, :input_ids.shape[1]].astype(cfg.dtype)
+        for i in range(cfg.num_hidden_layers):
+            x = ClipEncoderLayer(cfg.hidden_size, cfg.num_attention_heads,
+                                 cfg.intermediate_size, cfg.layer_norm_eps,
+                                 cfg.dtype, cfg.param_dtype, name=f"layers_{i}")(x, causal=True)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="final_layer_norm")(x)
+        # pooled = hidden state at the (first) EOS position per row
+        eos_pos = jnp.argmax((input_ids == cfg.eos_token_id).astype(jnp.int32), axis=1)
+        pooled = jnp.take_along_axis(x, eos_pos[:, None, None], axis=1)[:, 0]
+        return x, pooled
+
+
+class ClipVisionModel(nn.Module):
+    """Patch-conv vision tower with class token; returns
+    (last_hidden_state, pooled) where pooled = post-LN class embedding
+    (HF CLIPVisionModel)."""
+    cfg: ClipVisionConfig
+
+    @nn.compact
+    def __call__(self, pixel_values):
+        cfg = self.cfg
+        # pixel_values: [B, H, W, C] (NHWC — torch callers transpose NCHW)
+        patches = nn.Conv(cfg.hidden_size, (cfg.patch_size, cfg.patch_size),
+                          strides=(cfg.patch_size, cfg.patch_size), use_bias=False,
+                          dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                          name="patch_embedding")(pixel_values)
+        b, gh, gw, e = patches.shape
+        x = patches.reshape(b, gh * gw, e)
+        cls = self.param("class_embedding", nn.initializers.normal(0.02),
+                         (cfg.hidden_size, ), cfg.param_dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls.astype(cfg.dtype), (b, 1, e)), x], axis=1)
+        pos = self.param("position_embedding", nn.initializers.normal(0.01),
+                         (gh * gw + 1, cfg.hidden_size), cfg.param_dtype)
+        x = x + pos[None].astype(cfg.dtype)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="pre_layrnorm")(x)
+        for i in range(cfg.num_hidden_layers):
+            x = ClipEncoderLayer(cfg.hidden_size, cfg.num_attention_heads,
+                                 cfg.intermediate_size, cfg.layer_norm_eps,
+                                 cfg.dtype, cfg.param_dtype, name=f"layers_{i}")(x, causal=False)
+        pooled = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                              param_dtype=cfg.param_dtype, name="post_layernorm")(x[:, 0])
+        return x, pooled
+
+
+@dataclasses.dataclass(frozen=True)
+class ClipConfig:
+    """Bundle config for the dual encoder — carries the serving ``dtype``
+    so the policy contract (rebuild via ``cfg.__class__(**cfg.__dict__)``)
+    holds like every other family."""
+    text: ClipTextConfig = ClipTextConfig()
+    vision: ClipVisionConfig = ClipVisionConfig()
+    projection_dim: int = 512
+    dtype: Any = jnp.float32
+
+
+class ClipModel(nn.Module):
+    """Dual encoder + projections + temperature (HF CLIPModel): returns
+    (logits_per_image, logits_per_text, text_embeds, image_embeds)."""
+    text_cfg: ClipTextConfig
+    vision_cfg: ClipVisionConfig
+    projection_dim: int = 512
+
+    @nn.compact
+    def __call__(self, input_ids, pixel_values):
+        _, tpool = ClipTextModel(self.text_cfg, name="text_model")(input_ids)
+        _, vpool = ClipVisionModel(self.vision_cfg, name="vision_model")(pixel_values)
+        proj = lambda name: nn.Dense(self.projection_dim, use_bias=False,
+                                     dtype=jnp.float32, param_dtype=jnp.float32, name=name)
+        t = proj("text_projection")(tpool.astype(jnp.float32))
+        v = proj("visual_projection")(vpool.astype(jnp.float32))
+        t = t / jnp.linalg.norm(t, axis=-1, keepdims=True)
+        v = v / jnp.linalg.norm(v, axis=-1, keepdims=True)
+        logit_scale = self.param("logit_scale", nn.initializers.constant(2.6592), ())
+        scale = jnp.exp(logit_scale)
+        logits_per_text = t @ v.T * scale
+        return logits_per_text.T, logits_per_text, t, v

@@ -32,14 +32,14 @@ V5P_HBM_BYTES = 95.74e9  # TPU v5p: 95 GiB HBM2e per chip
 TOPOLOGY = "v5p:2x2x4"   # 16 chips — BASELINE config 3's slice
 
 
-def _mesh(n=16, **axes):
-    """16-device mesh over the offline TPU topology, CPU fallback."""
+def _mesh(n=16, topology=TOPOLOGY, **axes):
+    """n-device mesh over the offline TPU topology, CPU fallback."""
     import jax
     from deepspeed_tpu.comm.mesh import MeshSpec, create_mesh
     try:
         from jax.experimental import topologies
-        topo = topologies.get_topology_desc(platform="tpu", topology_name=TOPOLOGY)
-        return create_mesh(MeshSpec(**axes), devices=topo.devices[:n]), TOPOLOGY
+        topo = topologies.get_topology_desc(platform="tpu", topology_name=topology)
+        return create_mesh(MeshSpec(**axes), devices=topo.devices[:n]), topology
     except Exception as e:
         print(f"offline TPU topology unavailable ({e}); using virtual CPU mesh", flush=True)
         if jax.device_count() < n or jax.devices()[0].platform != "cpu":
@@ -133,12 +133,7 @@ def llama3_8b_zero3_v5p64():
     import deepspeed_tpu as ds
     from deepspeed_tpu.models.llama import LlamaForCausalLM, PRESETS
 
-    global TOPOLOGY
-    prev, TOPOLOGY = TOPOLOGY, "v5p:4x4x4"
-    try:
-        mesh, backend = _mesh(64, data=64)
-    finally:
-        TOPOLOGY = prev
+    mesh, backend = _mesh(64, topology="v5p:4x4x4", data=64)
     on_tpu = backend.startswith("v5")
     cfg = dataclasses.replace(
         PRESETS["llama3-8b"],

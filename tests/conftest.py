@@ -133,6 +133,12 @@ SLOW_TESTS = {
     "runtime/test_engine.py::test_zero_stages_match_stage0",
     "runtime/test_engine.py::test_zero_stages_reduce_per_device_memory",
     "runtime/test_engine.py::test_zero_stages_train",
+    "runtime/half_precision/test_fp16.py::test_fp16_trains_across_zero_stages",
+    "runtime/half_precision/test_fp16.py::test_fp16_optimizer_combos",
+    "runtime/half_precision/test_fp16.py::test_fp16_gas_accumulates_in_fp32",
+    "runtime/half_precision/test_fp16.py::test_fp16_matches_fp32_trajectory",
+    "runtime/half_precision/test_fp16.py::test_fp16_min_loss_scale_floor",
+    "runtime/half_precision/test_fp16.py::test_fp16_gradient_clipping",
     "runtime/test_hybrid_engine.py::test_generate_eos_truncation",
     "runtime/test_hybrid_engine.py::test_sampled_generation_deterministic_rng",
     "runtime/test_hybrid_engine.py::test_train_generate_interleaved",

@@ -116,6 +116,68 @@ def test_bert_hf_logits_parity():
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
 
 
+def test_distilbert_hf_logits_parity():
+    """ref: module_inject/containers/distil_bert.py — converted HF
+    DistilBertForMaskedLM reproduces HF MLM logits through the shared
+    BERT encoder (zero token-type table)."""
+    import torch
+    from transformers import DistilBertConfig as HFC, DistilBertForMaskedLM as HFM
+    torch.manual_seed(0)
+    hf_cfg = HFC(vocab_size=128, dim=64, n_layers=2, n_heads=4, hidden_dim=128,
+                 max_position_embeddings=64, dropout=0.0, attention_dropout=0.0)
+    hf_model = HFM(hf_cfg).eval()
+    cfg, params = convert_hf_state_dict(hf_model.state_dict(), hf_cfg)
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32})
+    from deepspeed_tpu.inference.v2.model_implementations.policies import policy_for
+    model = policy_for("distilbert").build_model(cfg)
+    ids = np.array([[5, 9, 2, 7, 1, 3, 11, 4]], np.int32)
+    got = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_clip_hf_parity():
+    """ref: module_inject/containers/clip.py — converted HF CLIPModel
+    reproduces the dual-encoder similarity logits and embeds (text tower
+    EOS pooling + vision tower class pooling + projections)."""
+    import torch
+    from transformers import CLIPConfig as HFC, CLIPModel as HFM
+    torch.manual_seed(0)
+    hf_cfg = HFC(
+        text_config={"vocab_size": 64, "hidden_size": 32, "num_hidden_layers": 2,
+                     "num_attention_heads": 4, "intermediate_size": 64,
+                     "max_position_embeddings": 16, "eos_token_id": 63,
+                     "bos_token_id": 62, "pad_token_id": 61},
+        vision_config={"hidden_size": 32, "num_hidden_layers": 2, "num_attention_heads": 4,
+                       "intermediate_size": 64, "image_size": 16, "patch_size": 8,
+                       "num_channels": 3},
+        projection_dim=24)
+    hf_model = HFM(hf_cfg).eval()
+    from deepspeed_tpu.inference.v2.model_implementations.policies import policy_for
+    pol = policy_for("clip")
+    cfg = pol.build_config(hf_cfg)
+    params = pol.convert(hf_model.state_dict(), cfg)
+    model = pol.build_model(cfg)
+
+    rng = np.random.default_rng(0)
+    ids = np.array([[62, 5, 9, 2, 63, 61, 61, 61],
+                    [62, 7, 63, 61, 61, 61, 61, 61]], np.int32)
+    pix = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+    li, lt, t_emb, v_emb = model.apply(
+        {"params": params}, jnp.asarray(ids),
+        jnp.asarray(np.transpose(pix, (0, 2, 3, 1))))  # NCHW → NHWC
+    with torch.no_grad():
+        want = hf_model(input_ids=torch.tensor(ids.astype(np.int64)),
+                        pixel_values=torch.tensor(pix))
+    np.testing.assert_allclose(np.asarray(li), want.logits_per_image.numpy(),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(t_emb), want.text_embeds.numpy(),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(v_emb), want.image_embeds.numpy(),
+                               rtol=2e-3, atol=2e-3)
+
+
 def test_qwen_v1_policy_mapping():
     """qwen-v1 is trust_remote_code (no transformers class to compare), but
     its math is llama-with-biased-fused-qkv: re-pack a tiny HF llama's

@@ -1,0 +1,178 @@
+"""Engine-level fp16 behavior (analog of the reference's
+tests/unit/runtime/half_precision/test_fp16.py — 38 scenario tests around
+dynamic loss scaling, overflow skip, optimizer combos and ZeRO stages).
+
+The compiled step carries the scaler as traced state: overflow detection,
+the skip, the scale adjustment and the skipped-step counter all happen
+on-device inside ONE program (ref: fp16/loss_scaler.py + fused_optimizer
+step logic, compiled rather than hook-driven here)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                  max_position_embeddings=64, rope_theta=1e4)
+
+
+def _engine(fp16=None, zero=0, opt=None, extra=None):
+    config = {
+        "train_batch_size": 8,
+        "optimizer": opt or {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": zero},
+        "fp16": fp16 or {"enabled": True},
+        "steps_per_print": 0,
+    }
+    config.update(extra or {})
+    engine, _, _, _ = ds.initialize(model=LlamaForCausalLM(CFG), config=config)
+    return engine
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 128, (8, 16)).astype(np.int32)
+    return {"input_ids": ids, "labels": ids}
+
+
+@pytest.mark.parametrize("zero", [0, 1, 2])
+def test_fp16_trains_across_zero_stages(zero):
+    engine = _engine(zero=zero)
+    b = _batch()
+    losses = [float(engine.train_batch(batch=b)) for _ in range(4)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    # params run in half precision, master copy stays fp32
+    assert jax.tree.leaves(engine.state.params)[0].dtype == jnp.float16
+    assert jax.tree.leaves(engine.state.master)[0].dtype == jnp.float32
+
+
+def test_fp16_dynamic_scale_starts_at_initial_power():
+    engine = _engine(fp16={"enabled": True, "initial_scale_power": 8})
+    engine.train_batch(batch=_batch())
+    assert float(engine.state.scaler.cur_scale) in (2.0**8, 2.0**7)  # may halve on step-1 overflow
+
+
+def test_fp16_overflow_skips_step_and_halves_scale():
+    """A scale far beyond fp16 range forces inf grads: the step must be
+    SKIPPED (params unchanged), counted, and the scale halved — all inside
+    the compiled program (ref: fused_optimizer.py overflow branch)."""
+    engine = _engine(fp16={"enabled": True, "initial_scale_power": 20, "hysteresis": 1})
+    b = _batch()
+    engine._ensure_ready(b)  # materialize to snapshot the initial params
+    before = [np.asarray(l) for l in jax.tree.leaves(engine.state.params)]
+    engine.train_batch(batch=b)
+    metrics_found_inf = int(engine.state.skipped_steps)
+    if metrics_found_inf == 0:
+        pytest.skip("2^20 scale did not overflow this model (platform fp16 range)")
+    after = jax.tree.leaves(engine.state.params)
+    for x, y in zip(before, after):
+        np.testing.assert_array_equal(x, np.asarray(y))
+    assert float(engine.state.scaler.cur_scale) == 2.0**19
+
+
+def test_fp16_scale_grows_after_window():
+    engine = _engine(fp16={"enabled": True, "initial_scale_power": 4,
+                           "loss_scale_window": 2})
+    b = _batch()
+    for _ in range(2):
+        engine.train_batch(batch=b)
+    assert int(engine.state.skipped_steps) == 0
+    assert float(engine.state.scaler.cur_scale) == 2.0**5  # doubled after window
+
+
+def test_fp16_static_loss_scale_constant():
+    engine = _engine(fp16={"enabled": True, "loss_scale": 128.0})
+    b = _batch()
+    for _ in range(3):
+        loss = engine.train_batch(batch=b)
+    assert float(engine.state.scaler.cur_scale) == 128.0
+    assert np.isfinite(float(loss))
+
+
+def test_fp16_min_loss_scale_floor():
+    engine = _engine(fp16={"enabled": True, "initial_scale_power": 20,
+                           "hysteresis": 1, "min_loss_scale": 2.0**18})
+    b = _batch()
+    for _ in range(6):
+        engine.train_batch(batch=b)
+    if int(engine.state.skipped_steps) == 0:
+        pytest.skip("no overflow at this scale on this platform")
+    assert float(engine.state.scaler.cur_scale) >= 2.0**18
+
+
+def test_fp16_matches_fp32_trajectory():
+    """Same data, fp16 vs fp32 compute: early-loss trajectories agree to
+    half-precision noise (the scaled-gradient path introduces no bias)."""
+    b = _batch()
+    e16 = _engine(fp16={"enabled": True, "loss_scale": 8.0})
+    e32 = _engine(fp16={"enabled": False})
+    l16 = [float(e16.train_batch(batch=b)) for _ in range(3)]
+    l32 = [float(e32.train_batch(batch=b)) for _ in range(3)]
+    np.testing.assert_allclose(l16, l32, rtol=3e-2, atol=3e-2)
+
+
+def test_fp16_gradient_clipping():
+    engine = _engine(fp16={"enabled": True, "loss_scale": 16.0},
+                     extra={"gradient_clipping": 0.05})
+    b = _batch()
+    losses = [float(engine.train_batch(batch=b)) for _ in range(3)]
+    assert all(np.isfinite(losses))
+    # clipping operates on UNSCALED grads: the reported grad_norm must be
+    # scale-independent, so a second engine with a different static scale
+    # clips identically
+    e2 = _engine(fp16={"enabled": True, "loss_scale": 256.0},
+                 extra={"gradient_clipping": 0.05})
+    l2 = [float(e2.train_batch(batch=b)) for _ in range(3)]
+    np.testing.assert_allclose(losses, l2, rtol=3e-2, atol=3e-2)
+
+
+@pytest.mark.parametrize("opt", [
+    {"type": "Lamb", "params": {"lr": 1e-3}},
+    {"type": "Lion", "params": {"lr": 1e-4}},
+    {"type": "SGD", "params": {"lr": 1e-2}},
+])
+def test_fp16_optimizer_combos(opt):
+    engine = _engine(opt=opt)
+    b = _batch()
+    losses = [float(engine.train_batch(batch=b)) for _ in range(3)]
+    assert all(np.isfinite(losses)), (opt, losses)
+
+
+def test_fp16_checkpoint_roundtrip_preserves_scaler(tmp_path):
+    engine = _engine(fp16={"enabled": True, "initial_scale_power": 6,
+                           "loss_scale_window": 2})
+    b = _batch()
+    for _ in range(2):
+        engine.train_batch(batch=b)
+    scale_before = float(engine.state.scaler.cur_scale)
+    engine.save_checkpoint(tmp_path, tag="t")
+
+    fresh = _engine(fp16={"enabled": True, "initial_scale_power": 6,
+                          "loss_scale_window": 2})
+    fresh.train_batch(batch=b)
+    fresh.load_checkpoint(tmp_path, tag="t")
+    assert float(fresh.state.scaler.cur_scale) == scale_before
+    l1 = float(engine.train_batch(batch=b))
+    l2 = float(fresh.train_batch(batch=b))
+    assert abs(l1 - l2) < 2e-3
+
+
+def test_fp16_gas_accumulates_in_fp32():
+    """Gradient accumulation under fp16 sums micro-grads in fp32 (ref:
+    grad_accum_dtype) — the gas=2 run matches the gas=1 run on the same
+    global batch."""
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, (16, 16)).astype(np.int32)
+    b = {"input_ids": ids, "labels": ids}
+    e1 = _engine(fp16={"enabled": True, "loss_scale": 8.0},
+                 extra={"train_batch_size": 16})
+    e2 = _engine(fp16={"enabled": True, "loss_scale": 8.0},
+                 extra={"train_batch_size": 16, "gradient_accumulation_steps": 2})
+    l1 = [float(e1.train_batch(batch=b)) for _ in range(2)]
+    l2 = [float(e2.train_batch(batch=b)) for _ in range(2)]
+    np.testing.assert_allclose(l1, l2, rtol=3e-2, atol=3e-2)
