@@ -72,6 +72,7 @@ def main():
     mfu = tps * flops_per_token / peak_flops_per_chip() / jax.device_count()
 
     tps_fpdt, _, _, loss_fpdt = run("fpdt", seq, batch, steps=2, windows=2)
+    tps_64k, _, _, loss_64k = run("flash", 65536, 1, steps=2, windows=2)
 
     out = {
         "metric": "longctx_train_tokens_per_sec_per_chip",
@@ -81,8 +82,9 @@ def main():
         "extra": {
             "seq": seq, "batch": batch, "mfu": round(mfu, 4),
             "n_params": n_params,
-            "loss_finite": bool(np.isfinite(loss) and np.isfinite(loss_fpdt)),
+            "loss_finite": bool(np.isfinite(loss) and np.isfinite(loss_fpdt) and np.isfinite(loss_64k)),
             "fpdt_tokens_per_sec_per_chip": round(tps_fpdt / jax.device_count(), 1),
+            "flash_64k_tokens_per_sec_per_chip": round(tps_64k / jax.device_count(), 1),
             "flash_over_fpdt": round(tps / tps_fpdt, 2),
             "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
             "distributed_32k_compile_proof": "MEMBUDGET.json:llama3_8b_ulysses32k",

@@ -133,6 +133,8 @@ SLOW_TESTS = {
     "runtime/test_engine.py::test_zero_stages_match_stage0",
     "runtime/test_engine.py::test_zero_stages_reduce_per_device_memory",
     "runtime/test_engine.py::test_zero_stages_train",
+    "checkpoint/test_reshape_matrix.py::test_dp4_to_pp2tp2dp2_via_universal",
+    "runtime/test_nvme_pipelined_optimizer.py::test_nvme_resume_continues_exactly",
     "runtime/half_precision/test_fp16.py::test_fp16_trains_across_zero_stages",
     "runtime/half_precision/test_fp16.py::test_fp16_optimizer_combos",
     "runtime/half_precision/test_fp16.py::test_fp16_gas_accumulates_in_fp32",
