@@ -311,10 +311,7 @@ class DeepSpeedEngine:
         optimizers require ZeRO stage <= 1; here stage 0 + gas 1)."""
         if getattr(self, "_onebit_comm_backend", None) is None:
             return False
-        from ..comm.mesh import DATA_AXIS
-        pure_dp = all(size == 1 for ax, size in self.mesh.shape.items() if ax != DATA_AXIS)
-        ok = (self.mesh.shape.get(DATA_AXIS, 1) > 1 and pure_dp and self.zero_stage == 0
-              and self.gas == 1 and self.compute_dtype != jnp.float16)
+        ok = self._manual_ddp_eligible()
         if not ok:
             logger.warning(
                 "onebit comm_backend_name set but compressed transport needs a pure-DP "
@@ -793,21 +790,55 @@ class DeepSpeedEngine:
 
         return jax.tree.map(pull, tree, sh_tree)
 
+    def _manual_ddp_eligible(self) -> bool:
+        """Shared eligibility for the manual-DDP compressed transports
+        (1-bit momentum wire, qgZ gradient wire): a >1 pure-DP data axis,
+        replicated state (stage 0), gas=1 and non-fp16 compute."""
+        from ..comm.mesh import DATA_AXIS
+        pure_dp = all(size == 1 for ax, size in self.mesh.shape.items() if ax != DATA_AXIS)
+        return (self.mesh.shape.get(DATA_AXIS, 1) > 1 and pure_dp and self.zero_stage == 0
+                and self.gas == 1 and self.compute_dtype != jnp.float16)
+
+    def _qgz_active(self) -> bool:
+        """ZeRO++ qgZ gradient transport (zero_quantized_gradients): the
+        step's grad reduction rides int8 — quantized all-to-all
+        reduce-scatter + quantized all-gather (ref:
+        runtime/comm/coalesced_collectives.py:31).  Decision latched (and
+        the fallback warned) ONCE — step-program rebuilds must not re-warn,
+        and a 1-bit run with the flag also set must not claim the fp32
+        wire is in use."""
+        if getattr(self, "_qgz_decided", None) is None:
+            if not getattr(self._config.zero_config, "zero_quantized_gradients", False) \
+                    or getattr(self, "_onebit_comm_backend", None):
+                self._qgz_decided = False
+            else:
+                self._qgz_decided = self._manual_ddp_eligible()
+                if not self._qgz_decided:
+                    logger.warning("zero_quantized_gradients needs a pure-DP mesh, zero "
+                                   "stage 0, gas=1 and non-fp16 compute — gradients stay "
+                                   "on the fp32 wire")
+        return self._qgz_decided
+
     def _build_compressed_train_step(self, batch, warmup: bool):
-        """Manual-DDP step for the 1-bit optimizer family with the momentum
-        exchange on the COMPRESSED wire (r3 verdict item 2: the pieces
-        existed but no config path routed the training step through them).
+        """Manual-DDP step with the grad/momentum exchange on the
+        COMPRESSED wire (r3 verdict item 2: the pieces existed but no
+        config path routed the training step through them).
 
         Per-device gradients are computed WITHOUT a GSPMD mean — each
-        worker differentiates only its batch shard, exactly the reference
-        flow (fp16/onebit/adam.py: local momentum update, then
-        compressed_allreduce of the momentum over the world).  The
-        optimizer's ``compress_fn`` (bound in _build_optimizer_transform)
-        runs ``runtime/comm/compressed.compressed_allreduce`` inside this
-        shard_map: n/8 sign bytes + one fp32 scale per tensor on the wire
-        instead of 4n (ref: runtime/comm/nccl.py:16 compressed_allreduce).
+        worker differentiates only its batch shard.  Two transports:
+
+        * 1-bit family (comm_backend_name): the reference flow
+          (fp16/onebit/adam.py — local momentum update, then
+          compressed_allreduce of the momentum): n/8 sign bytes + one
+          fp32 scale per tensor instead of 4n
+          (ref: runtime/comm/nccl.py:16).
+        * qgZ (zero_quantized_gradients): int8 quantized all-to-all
+          reduce-scatter + quantized all-gather of the GRADS before a
+          normal optimizer update
+          (ref: runtime/comm/coalesced_collectives.py:31).
         """
         from ..comm.mesh import DATA_AXIS
+        qgz = self._qgz_active()
         batch_sh = self._batch_sharding_tree(batch)
         repl = NamedSharding(self.mesh, P())
         metrics_sh = StepMetrics(*([repl] * 5))
@@ -825,7 +856,24 @@ class DeepSpeedEngine:
                 return (loss * scale).astype(jnp.float32), loss
 
             grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params, b)
-            if warmup:
+            if qgz:
+                from .comm.compressed import all_to_all_quant_reduce, quantized_all_gather
+                world = self.mesh.shape[DATA_AXIS]
+
+                def qreduce(g):
+                    flat = g.reshape(-1).astype(jnp.float32)
+                    # pad so both the per-rank split and the 256-blocks line
+                    # up; zero padding is exact under the mean
+                    unit = world * 256
+                    pad = (-flat.size) % unit
+                    if pad:
+                        flat = jnp.concatenate([flat, jnp.zeros((pad, ), flat.dtype)])
+                    shard = all_to_all_quant_reduce(flat, DATA_AXIS, bits=8, block=256)
+                    full = quantized_all_gather(shard, DATA_AXIS, bits=8, block=256)
+                    return full[:g.size].reshape(g.shape).astype(g.dtype)
+
+                grads = jax.tree.map(qreduce, grads)
+            elif warmup:
                 # warmup stage: full-precision gradient allreduce, exactly
                 # the reference backend pre-freeze (fp16/onebit/adam.py) —
                 # without it worker params fork (local grads, no exchange
@@ -850,14 +898,28 @@ class DeepSpeedEngine:
                                       donate_argnums=(0, ))
         self._batch_shardings = batch_sh
 
-        # wire accounting for CommsLogger: signs (n/8) + fp32 scale per
-        # momentum tensor, vs 4n for the fp32 transport it replaces
-        self._compressed_wire_bytes = sum(
-            (int(np.prod(l.shape)) + 7) // 8 + 4 for l in jax.tree.leaves(self.state.params))
+        # wire accounting for CommsLogger, vs 4n fp32 transport:
+        # 1-bit → signs (n/8) + one fp32 scale per tensor; qgZ → int8 both
+        # directions (n + n/256 scale bytes each way)
+        if qgz:
+            # per direction: padded int8 payload + one fp32 scale per 256-block
+            # (the padding to world*256 is real wire traffic)
+            unit = self.mesh.shape[DATA_AXIS] * 256
+
+            def leaf_bytes(n):
+                padded = -(-n // unit) * unit
+                return 2 * (padded + 4 * (padded // 256))
+
+            self._compressed_wire_bytes = sum(
+                leaf_bytes(int(np.prod(l.shape))) for l in jax.tree.leaves(self.state.params))
+        else:
+            self._compressed_wire_bytes = sum(
+                (int(np.prod(l.shape)) + 7) // 8 + 4 for l in jax.tree.leaves(self.state.params))
+        self._compressed_wire_name = "all_to_all_quant_reduce" if qgz else "compressed_allreduce"
 
         def unsupported(*a, **k):
             raise RuntimeError("the imperative forward/backward/step path does not support "
-                               "compressed 1-bit transport; use train_batch()")
+                               "compressed gradient/momentum transport; use train_batch()")
 
         self._accum_fn = unsupported
         self._apply_step_fn = unsupported
@@ -930,6 +992,8 @@ class DeepSpeedEngine:
         if getattr(self, "_onebit_comm_backend", None):
             return self._build_compressed_train_step(
                 batch, warmup=self.global_steps < self._onebit_freeze_step)
+        if self._qgz_active():
+            return self._build_compressed_train_step(batch, warmup=False)
         batch_sh = self._batch_sharding_tree(batch)
         repl = NamedSharding(self.mesh, P())
 
@@ -1082,7 +1146,7 @@ class DeepSpeedEngine:
             else:
                 self.state, metrics = self._train_step_fn(self.state, batch)
         if getattr(self, "_compressed_wire_bytes", None) \
-                and self.global_steps >= self._onebit_freeze_step \
+                and self.global_steps >= getattr(self, "_onebit_freeze_step", 0) \
                 and not self._rebuilt_this_step:
             # only compression-phase steps carry the 1-bit wire (warmup's
             # traffic is the fp32 grad pmean); latency = dispatch wall time,
@@ -1090,7 +1154,7 @@ class DeepSpeedEngine:
             # just (re)built the program are skipped — their wall time is
             # dominated by compilation, not the wire
             from ..comm import comm as dist
-            dist._record("compressed_allreduce", _step_t0, self._compressed_wire_bytes)
+            dist._record(self._compressed_wire_name, _step_t0, self._compressed_wire_bytes)
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
         if profiling_now:
