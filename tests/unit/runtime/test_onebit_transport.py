@@ -80,7 +80,7 @@ def test_qgz_gradient_transport_end_to_end():
     parity against the fp32-wire control."""
     mesh = create_mesh(MeshSpec(data=8), devices=jax.devices()[:8])
 
-    def train(zero, steps=10):
+    def train(zero, steps=6):
         engine, _, _, _ = ds.initialize(
             model=LlamaForCausalLM(CFG), mesh=mesh, dist_init_required=False,
             config={"train_batch_size": 8,
