@@ -116,6 +116,9 @@ class InferenceV2Policy:
             for name, heads in (("q_proj", H), ("k_proj", KV), ("v_proj", KV)):
                 params["model"]["layers"]["self_attn"][name]["bias"] = layer_stack(
                     "model.layers.{{i}}.self_attn.{0}.bias".format(name), lambda b: b.reshape(heads, D))
+        if getattr(cfg, "attention_out_bias", False):
+            params["model"]["layers"]["self_attn"]["o_proj"]["bias"] = layer_stack(
+                "model.layers.{i}.self_attn.o_proj.bias", lambda b: b)
         if cfg.tie_word_embeddings or "lm_head.weight" not in sd:
             params["lm_head"] = {"kernel": _t(params["embed_tokens"]["embedding"])}
         else:
@@ -126,6 +129,12 @@ class InferenceV2Policy:
 class LlamaPolicy(InferenceV2Policy):
     """ref: model_implementations/llama_v2/ (+v1/v3 via config)."""
     model_type = "llama"
+
+    def build_config(self, hf_cfg):
+        # HF llama's attention_bias flag covers q/k/v AND o_proj (unlike
+        # qwen2, whose o_proj is bias-free)
+        ab = getattr(hf_cfg, "attention_bias", False)
+        return LlamaConfig.from_hf(hf_cfg, attention_out_bias=ab)
 
 
 class MistralPolicy(InferenceV2Policy):
@@ -141,6 +150,18 @@ class Qwen2Policy(InferenceV2Policy):
 
     def build_config(self, hf_cfg):
         return LlamaConfig.from_hf(hf_cfg, attention_bias=True)
+
+
+class InternLMPolicy(InferenceV2Policy):
+    """ref: module_inject/containers/internlm.py — InternLM-1: llama layout
+    whose HF config spells the attention-bias flag ``bias`` and whose
+    checkpoints carry q/k/v AND o_proj biases.  (InternLM-2's fused
+    wqkv/w1-w3 naming is a different scheme and is not handled here.)"""
+    model_type = "internlm"
+
+    def build_config(self, hf_cfg):
+        bias = bool(getattr(hf_cfg, "bias", False))
+        return LlamaConfig.from_hf(hf_cfg, attention_bias=bias, attention_out_bias=bias)
 
 
 class Phi3Policy(InferenceV2Policy):
@@ -1068,6 +1089,7 @@ POLICY_REGISTRY = {
     "distilbert": DistilBertPolicy(),
     "clip": ClipPolicy(),
     "qwen": QwenV1Policy(),
+    "internlm": InternLMPolicy(),
 }
 
 

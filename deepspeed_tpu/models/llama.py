@@ -51,6 +51,7 @@ class LlamaConfig:
     remat_policy: str = "nothing_saveable"
     attention_impl: str = "reference"  # reference | flash | ulysses
     attention_bias: bool = False  # qkv bias (Qwen2-style checkpoints)
+    attention_out_bias: bool = False  # o_proj bias (InternLM-1-style checkpoints)
     sliding_window: int = 0  # 0 = full attention; >0 = mistral-style window
 
     @staticmethod
@@ -394,7 +395,7 @@ class LlamaAttention(nn.Module):
         out = attn_fn(q, k, v, causal=True, segment_ids=segment_ids, **kw)
         out = nn.DenseGeneral(features=cfg.hidden_size,
                               axis=(-2, -1),
-                              use_bias=False,
+                              use_bias=cfg.attention_out_bias,
                               dtype=cfg.dtype,
                               param_dtype=cfg.param_dtype,
                               kernel_init=_logical(nn.initializers.lecun_normal(), (HEADS, HEAD_DIM, EMBED)),

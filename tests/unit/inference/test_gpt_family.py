@@ -137,6 +137,39 @@ def test_distilbert_hf_logits_parity():
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
 
 
+def test_internlm_policy_biased_llama_parity():
+    """ref: module_inject/containers/internlm.py — InternLM is llama layout
+    whose HF config names the qkv/o bias flag ``bias``; the converted model
+    must reproduce biased-llama logits."""
+    import torch
+    from transformers import LlamaConfig as HFC, LlamaForCausalLM as HFM
+    torch.manual_seed(0)
+    hf_cfg = HFC(vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+                 num_attention_heads=4, num_key_value_heads=4, max_position_embeddings=64,
+                 rope_theta=1e4, attention_bias=True, tie_word_embeddings=False)
+    hf_model = HFM(hf_cfg).eval()
+    # HF zero-inits Linear biases — randomize them so the parity check is
+    # NOT vacuous w.r.t. bias conversion (incl. o_proj.bias)
+    with torch.no_grad():
+        for name, p in hf_model.named_parameters():
+            if name.endswith("proj.bias"):
+                p.copy_(torch.randn_like(p) * 0.1)
+    hf_cfg.bias = True  # the InternLM spelling
+    from deepspeed_tpu.inference.v2.model_implementations.policies import policy_for
+    pol = policy_for("internlm")
+    cfg = pol.build_config(hf_cfg)
+    assert cfg.attention_bias and cfg.attention_out_bias
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32})
+    params = pol.convert(hf_model.state_dict(), cfg)
+    from deepspeed_tpu.models.llama import LlamaForCausalLM
+    model = LlamaForCausalLM(cfg)
+    ids = np.array([[5, 9, 2, 7, 1, 3, 11, 4]], np.int32)
+    got = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
 def test_clip_hf_parity():
     """ref: module_inject/containers/clip.py — converted HF CLIPModel
     reproduces the dual-encoder similarity logits and embeds (text tower
