@@ -128,6 +128,37 @@ def quantized_all_gather(shard, axis_name: str, bits: int = 8, block: int = 256)
     return deq.reshape(-1)
 
 
+def padded_quant_allreduce(x, axis_name: str, world: int, bits: int = 8, block: int = 256,
+                           error=None, err_beta: float = 0.8):
+    """Whole-tensor quantized allreduce on the qgZ wire: pad to a
+    world×block multiple (zero padding is exact under the mean), quantized
+    all-to-all reduce-scatter, quantized all-gather, truncate back.
+
+    With ``error`` (same shape as ``x``): the LoCo variant — the previous
+    round's quantization error folds back pre-quantization and the new
+    residual is returned alongside.  Returns ``reduced`` or
+    ``(reduced, new_error)``.  The single codec home for both the engine's
+    qgZ step and the LoCo optimizer wrapper."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    unit = world * block
+    pad = (-flat.size) % unit
+    if error is None:
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad, ), flat.dtype)])
+        shard = all_to_all_quant_reduce(flat, axis_name, bits=bits, block=block)
+        full = quantized_all_gather(shard, axis_name, bits=bits, block=block)
+        return full[:x.size].reshape(x.shape).astype(x.dtype)
+    ef = error.reshape(-1).astype(jnp.float32)
+    if pad:
+        z = jnp.zeros((pad, ), jnp.float32)
+        flat, ef = jnp.concatenate([flat, z]), jnp.concatenate([ef, z])
+    shard, new_err = loco_all_to_all_quant_reduce(flat, ef, axis_name, bits=bits,
+                                                  block=block, err_beta=err_beta)
+    full = quantized_all_gather(shard, axis_name, bits=bits, block=block)
+    return (full[:x.size].reshape(x.shape).astype(x.dtype),
+            new_err[:x.size].reshape(x.shape))
+
+
 def loco_all_to_all_quant_reduce(x, error, axis_name: str, bits: int = 8, block: int = 256,
                                  err_beta: float = 0.8):
     """LoCo-qgZ: quantized gradient reduction WITH local error feedback

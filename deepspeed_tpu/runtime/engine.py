@@ -226,7 +226,7 @@ class DeepSpeedEngine:
             raise TypeError("client optimizer must be an optax-style GradientTransformation")
         cfg = self._config.optimizer_config
         if cfg is None or cfg.type is None:
-            return adamw(lr=self.lr_schedule)
+            return self._maybe_loco_wrap(adamw(lr=self.lr_schedule))
         name = cfg.type.lower()
         if name not in OPTIMIZER_FACTORIES:
             raise ValueError(f"Unknown optimizer {cfg.type}; known: {sorted(OPTIMIZER_FACTORIES)}")
@@ -275,8 +275,72 @@ class DeepSpeedEngine:
         if name in (ADAM_OPTIMIZER, FUSED_ADAM_OPTIMIZER, "cpuadam"):
             # the reference's adam_w_mode flag (ops/adam/fused_adam.py)
             adam_w = params.pop("adam_w_mode", True)
-            return fused_adam(lr=self.lr_schedule, adam_w_mode=adam_w, **params)
-        return OPTIMIZER_FACTORIES[name](lr=self.lr_schedule, **params)
+            opt = fused_adam(lr=self.lr_schedule, adam_w_mode=adam_w, **params)
+        else:
+            opt = OPTIMIZER_FACTORIES[name](lr=self.lr_schedule, **params)
+        return self._maybe_loco_wrap(opt)
+
+    def _maybe_loco_wrap(self, opt):
+        """ZeRO++ LoCo (``zeropp_loco_param`` + ``zero_quantized_gradients``):
+        the qgZ gradient wire WITH error feedback — the previous round's
+        quantization error folds back into the gradient before quantizing
+        (ref: runtime/comm/coalesced_collectives.py:81
+        all_to_all_loco_quant_reduce; config key zero/config.py:315).
+
+        Implemented as a state-carrying GradientTransformation so the error
+        tree rides opt_state (sharded/checkpointed like any moment); the
+        update runs INSIDE the manual-DDP shard_map step.  The error is
+        server-side (pmean'd) — replicated state cannot hold per-worker
+        residuals."""
+        loco_cfg = getattr(self._config.zero_config, "zeropp_loco_param", None)
+        qgz_flag = getattr(self._config.zero_config, "zero_quantized_gradients", False)
+        # the 1-bit transport owns the wire (and its unwrapped warmup twin
+        # could not carry the (inner, err) state) — LoCo stands down
+        self._loco_active = bool(loco_cfg is not None and qgz_flag
+                                 and not getattr(self, "_onebit_comm_backend", None)
+                                 and self._manual_ddp_eligible())
+        if not self._loco_active:
+            if loco_cfg is not None:
+                logger.warning("zeropp_loco_param set but LoCo transport needs "
+                               "zero_quantized_gradients plus the manual-DDP "
+                               "requirements (pure-DP mesh, stage 0, gas=1, "
+                               "non-fp16) — ignored")
+            return opt
+
+        from ..comm.mesh import DATA_AXIS
+        from ..ops.optimizer import GradientTransformation, tree_zeros_like
+        from .comm.compressed import padded_quant_allreduce
+        beta = float((loco_cfg or {}).get("err_beta", 0.8))
+        world = self.mesh.shape[DATA_AXIS]
+        clip = self._config.gradient_clipping
+
+        def red(g, e):
+            full, new_err = padded_quant_allreduce(g, DATA_AXIS, world, error=e,
+                                                   err_beta=beta)
+            return full, jax.lax.pmean(new_err, DATA_AXIS)
+
+        def init(params):
+            return (opt.init(params), tree_zeros_like(params, jnp.float32))
+
+        def update(grads, state, params=None):
+            inner, err = state
+            pairs = jax.tree.map(red, grads, err)
+            reduced = jax.tree.map(lambda t: t[0], pairs,
+                                   is_leaf=lambda x: isinstance(x, tuple))
+            new_err = jax.tree.map(lambda t: t[1], pairs,
+                                   is_leaf=lambda x: isinstance(x, tuple))
+            if clip and clip > 0:
+                # clipping belongs to the REDUCED gradient — the engine's
+                # pre-update clip is skipped in loco mode (its local-grad
+                # norm would over-clip by up to sqrt(world) on noisy grads)
+                norm = opt_lib.global_norm(reduced)
+                cs = jnp.minimum(1.0, clip / (norm + 1e-6))
+                reduced = jax.tree.map(lambda g: g * cs, reduced)
+            updates, new_inner = opt.update(reduced, inner, params)
+            return updates, (new_inner, new_err)
+
+        log_dist(f"ZeRO++ LoCo gradient transport active (err_beta={beta})", ranks=[0])
+        return GradientTransformation(init, update)
 
     def _nvme_pipelined_active(self) -> bool:
         """True when optimizer states should live on NVMe with the pipelined
@@ -682,7 +746,11 @@ class DeepSpeedEngine:
                 if not static_unity:
                     found_inf = jax.lax.pmax(found_inf.astype(jnp.int32),
                                              DATA_AXIS).astype(jnp.bool_)
-            if cfg.gradient_clipping and cfg.gradient_clipping > 0:
+            if cfg.gradient_clipping and cfg.gradient_clipping > 0 \
+                    and not (manual and getattr(self, "_loco_active", False)):
+                # LoCo clips inside its optimizer wrapper on the REDUCED
+                # grads; clipping the local grads here against the (noise-
+                # inflated) local norm would over-clip
                 clip_scale = jnp.minimum(1.0, cfg.gradient_clipping / (grad_norm + 1e-6))
                 grads = jax.tree.map(lambda g: g * clip_scale, grads)
 
@@ -856,23 +924,13 @@ class DeepSpeedEngine:
                 return (loss * scale).astype(jnp.float32), loss
 
             grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params, b)
-            if qgz:
-                from .comm.compressed import all_to_all_quant_reduce, quantized_all_gather
+            if qgz and not getattr(self, "_loco_active", False):
+                # (LoCo reduces inside the optimizer update — the error
+                # state rides opt_state)
+                from .comm.compressed import padded_quant_allreduce
                 world = self.mesh.shape[DATA_AXIS]
-
-                def qreduce(g):
-                    flat = g.reshape(-1).astype(jnp.float32)
-                    # pad so both the per-rank split and the 256-blocks line
-                    # up; zero padding is exact under the mean
-                    unit = world * 256
-                    pad = (-flat.size) % unit
-                    if pad:
-                        flat = jnp.concatenate([flat, jnp.zeros((pad, ), flat.dtype)])
-                    shard = all_to_all_quant_reduce(flat, DATA_AXIS, bits=8, block=256)
-                    full = quantized_all_gather(shard, DATA_AXIS, bits=8, block=256)
-                    return full[:g.size].reshape(g.shape).astype(g.dtype)
-
-                grads = jax.tree.map(qreduce, grads)
+                grads = jax.tree.map(
+                    lambda g: padded_quant_allreduce(g, DATA_AXIS, world), grads)
             elif warmup:
                 # warmup stage: full-precision gradient allreduce, exactly
                 # the reference backend pre-freeze (fp16/onebit/adam.py) —

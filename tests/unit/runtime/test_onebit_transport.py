@@ -104,6 +104,37 @@ def test_qgz_gradient_transport_end_to_end():
     np.testing.assert_allclose(losses, base, rtol=5e-2, atol=5e-2)
 
 
+def test_loco_qgz_transport_with_error_feedback():
+    """ZeRO++ LoCo (zeropp_loco_param + zero_quantized_gradients): the qgZ
+    wire with error feedback — the error tree rides opt_state and the
+    trajectory tracks the fp32 wire at least as well as plain qgZ
+    (ref: runtime/comm/coalesced_collectives.py:81)."""
+    mesh = create_mesh(MeshSpec(data=8), devices=jax.devices()[:8])
+
+    def train(zero, steps=6):
+        engine, _, _, _ = ds.initialize(
+            model=LlamaForCausalLM(CFG), mesh=mesh, dist_init_required=False,
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "zero_optimization": zero, "steps_per_print": 0})
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 256, (8, 32)).astype(np.int32)
+        return engine, [float(engine.train_batch(batch={"input_ids": ids, "labels": ids}))
+                        for _ in range(steps)]
+
+    engine, losses = train({"stage": 0, "zero_quantized_gradients": True,
+                            "zeropp_loco_param": {"err_beta": 0.8}})
+    assert engine._loco_active
+    assert all(np.isfinite(losses))
+    # the error-feedback tree rides opt_state: (inner_adam_state, error_tree)
+    inner, err = engine.state.opt_state
+    assert jax.tree.structure(err) == jax.tree.structure(engine.state.params)
+    # error is nonzero after compressed steps (feedback is live)
+    assert any(float(np.abs(np.asarray(e)).max()) > 0 for e in jax.tree.leaves(err))
+    _, base = train({"stage": 0})
+    np.testing.assert_allclose(losses, base, rtol=5e-2, atol=5e-2)
+
+
 def test_transport_falls_back_without_data_axis():
     onebit = {"type": "OneBitAdam",
               "params": {"lr": 1e-3, "freeze_step": 4, "comm_backend_name": "nccl"}}
