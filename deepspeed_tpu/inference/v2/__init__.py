@@ -2,4 +2,4 @@ from .ragged import (BlockedAllocator, BlockedKVCache, RaggedBatch, SequenceDesc
                      StateManager)
 from .scheduler import SchedulerConfig, SplitFuseScheduler, StepPlan  # noqa: F401
 from .engine_v2 import (InferenceEngineV2, RaggedInferenceEngineConfig,  # noqa: F401
-                        build_engine)
+                        build_engine, compile_aot_serving)

@@ -111,26 +111,42 @@ def _gqa_native_ok(d, h, hk):
     """GQA-native blocks put all rep = h//hk query heads sharing a kv block
     into ONE invocation, so scratch and q/o/lse blocks scale with P·d.
     Mainstream GQA (rep ≤ 8) fits easily; MQA-extreme shapes (e.g. Falcon's
-    71q/1kv) would blow VMEM — those fall back to repeated KV."""
-    P = _pack_width(d, hk) * (h // hk)
+    71q/1kv) would blow VMEM — those fall back to repeated KV.  Judged on
+    the NARROWEST tile-legal width (the packing heuristic can always fall
+    back to it)."""
+    rep = h // hk
+    min_legal = min(p for p in range(1, hk + 1)
+                    if hk % p == 0 and ((p * d) % LANE == 0 or p == hk))
     # ≈2 MB f32 accumulator scratch at bq=512, plus three P-wide q/o/do
     # blocks and a P-wide lse block in the backward — mainstream GQA
     # (rep ≤ 8 at d=128) stays native, Falcon-style 71q/1kv falls back
-    return P * d <= 1024
+    return min_legal * rep * d <= 1024
 
 
-def _pack_width(d, h):
-    """Heads per block so the packed minor dim is tile-legal: either a
-    multiple of the 128-lane width (d=64 → 2 heads, d=32 → 4) or — when no
-    divisor of ``h`` gets there (e.g. tiny test models with h·d < 128) —
-    ALL heads, since a block equal to the full array minor dim is always
-    accepted by the tiling rules."""
-    if d % LANE == 0:
-        return 1
-    for p in range(1, h):
-        if h % p == 0 and (p * d) % LANE == 0:
-            return p
-    return h
+import os
+
+# Widest packed block (query heads x head_dim lanes) the packing heuristic
+# targets.  r5: the r4 kernels used the MINIMAL tile-legal width (2 heads at
+# d=64), leaving the grid many small steps.  Measured on v5e at bench shapes
+# (B24 S1024 H12 D64, fwd+bwd, dispatch amortized in-program): Pk=2 8.22 ms,
+# Pk=4 7.86, Pk=6 7.77 (-5.5%), Pk=12 OOMs scoped VMEM (17.2M > 16M limit)
+# and Pk=12@bq256 8.27.  384 lanes → Pk=6 at d=64 while d=128 shapes keep
+# their r4 geometry (a 512-lane q block would need the target at 512+, which
+# re-OOMs the unrolled in-kernel head loop's scratch).
+PACK_TARGET = int(os.environ.get("DS_FLASH_PACK_TARGET", "384"))
+
+
+def _pack_width(d, h, rep=1):
+    """KV heads per block.  The packed minor dim must be tile-legal: a
+    multiple of the 128-lane width (or ALL heads — a block equal to the
+    full array minor dim is always accepted).  Among the legal widths,
+    take the LARGEST whose query-side lane width (rep x kv heads x d)
+    stays within PACK_TARGET — per-grid-step work scales with the width
+    while per-step overhead is fixed."""
+    legal = [p for p in range(1, h + 1)
+             if h % p == 0 and ((p * d) % LANE == 0 or p == h)]
+    fitting = [p for p in legal if p * rep * d <= PACK_TARGET]
+    return max(fitting) if fitting else min(legal)
 
 
 def _fwd2_kernel(tab_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale, bq, bk, P, d, rep):
@@ -185,7 +201,7 @@ def _flash_fwd2(q, k, v, *, h, hk, causal, block_q, block_k, interpret, emit_lse
     _, sk, _ = k.shape
     d = hd // h
     rep = h // hk
-    Pk = _pack_width(d, hk)  # kv heads per block (tile-legal kv minor dim)
+    Pk = _pack_width(d, hk, rep)  # kv heads per block (tile-legal kv minor dim)
     P = Pk * rep  # query heads per block — contiguous in the packed layout
     # clamp to a divisor: gcd keeps blocks maximal for seq lens that are
     # 128-multiples but not block-multiples (e.g. sq=768 with block 512 → 256)
@@ -316,7 +332,7 @@ def _flash_bwd2(q, k, v, o, lse, do, *, h, hk, causal, block_q, block_k, interpr
     _, sk, _ = k.shape
     d = hd // h
     rep = h // hk
-    Pk = _pack_width(d, hk)
+    Pk = _pack_width(d, hk, rep)
     P = Pk * rep
     # clamp to a divisor: gcd keeps blocks maximal for seq lens that are
     # 128-multiples but not block-multiples (e.g. sq=768 with block 512 → 256)

@@ -83,6 +83,44 @@ def _paged_kernel(bt_ref, sp_ref, q_ref, pg_ref, o_ref, *scr, page_size, max_pag
             o_ref[0, hh] = (accs[hh][:] / jnp.maximum(ls[hh][:], 1e-30)).astype(o_ref.dtype)
 
 
+def _paged_sharded(q, pages, block_table, start_pos, chunk_lens, page_size, interpret, mesh):
+    """Run the paged kernel inside shard_map over the governing (trace) mesh.
+
+    Mosaic custom calls cannot be auto-partitioned by GSPMD — the TP-sharded
+    serving engine (inference/v2) traces this under a tensor-axis mesh, so the
+    kernel wraps itself the way ``flash_attention._flash_sharded`` does.
+    Attention is head-local: q shards on H, the page arena on its n_kv dim,
+    block tables/positions replicate, and no collective is needed inside —
+    the o_proj allreduce after it is GSPMD's to insert.  A tensor degree that
+    does not divide n_kv replicates (correct, just not distributed)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..comm.mesh import TENSOR_AXIS
+    h, n_kv = q.shape[2], pages.shape[3]
+    tp = mesh.shape.get(TENSOR_AXIS, 1)
+    head_axes = (TENSOR_AXIS, ) if tp > 1 and n_kv % tp == 0 and h % tp == 0 else ()
+    qspec = P(None, None, head_axes or None, None)
+    pspec = P(None, None, None, head_axes or None, None)
+    if chunk_lens is None:
+        fn = jax.shard_map(
+            lambda q_, pg_, bt_, sp_: paged_attention_pallas(
+                q_, pg_, bt_, sp_, None, page_size, interpret=interpret),
+            mesh=mesh,
+            in_specs=(qspec, pspec, P(None, None), P(None)),
+            out_specs=qspec,
+            check_vma=False)
+        return fn(q, pages, block_table, start_pos)
+    fn = jax.shard_map(
+        lambda q_, pg_, bt_, sp_, cl_: paged_attention_pallas(
+            q_, pg_, bt_, sp_, cl_, page_size, interpret=interpret),
+        mesh=mesh,
+        in_specs=(qspec, pspec, P(None, None), P(None), P(None)),
+        out_specs=qspec,
+        # pallas_call out_shapes carry no varying-mesh-axes annotation
+        check_vma=False)
+    return fn(q, pages, block_table, start_pos, chunk_lens)
+
+
 def paged_attention_pallas(q, pages, block_table, start_pos, chunk_lens, page_size,
                            *, interpret: Optional[bool] = None):
     """Drop-in twin of ``models/llama_cache.paged_attention`` (jnp golden).
@@ -90,8 +128,16 @@ def paged_attention_pallas(q, pages, block_table, start_pos, chunk_lens, page_si
     q: [B, C, H, D]; pages: [P, page, 2, n_kv, D] (chunk K/V already
     written); block_table: [B, max_pages]; start_pos/chunk_lens: [B].
     """
+    from ..comm.mesh import get_trace_mesh, in_manual_mesh
     if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
+        tm = get_trace_mesh()
+        dev = tm.devices.flat[0] if tm is not None else jax.devices()[0]
+        interpret = getattr(dev, "platform", "") != "tpu"
+    if isinstance(q, jax.core.Tracer) and not in_manual_mesh():
+        mesh = get_trace_mesh()
+        if mesh is not None and mesh.size > 1:
+            return _paged_sharded(q, pages, block_table, start_pos, chunk_lens, page_size,
+                                  interpret, mesh)
     b, c, h, d = q.shape
     n_kv = pages.shape[3]
     max_pages = block_table.shape[1]

@@ -1,6 +1,10 @@
 """Utils (ref: deepspeed/utils/): logging, timers, groups, nvtx,
-zero_to_fp32."""
+zero_to_fp32, tensor_fragment."""
 
 from .logging import LoggerFactory, log_dist, logger
 from .nvtx import instrument_w_nvtx
+from .tensor_fragment import (safe_get_full_fp32_param, safe_get_full_grad,  # noqa: F401
+                              safe_get_full_optimizer_state, safe_get_local_fp32_param,
+                              safe_get_local_grad, safe_get_local_optimizer_state,
+                              safe_set_full_fp32_param, safe_set_full_optimizer_state)
 from .timer import SynchronizedWallClockTimer, ThroughputTimer

@@ -1,0 +1,259 @@
+"""Debug-side access to partitioned params, grads and optimizer states.
+
+Parity for the reference's tensor-fragment API
+(ref: deepspeed/utils/tensor_fragment.py:132 ``safe_get_full_fp32_param``,
+``:148 safe_set_full_fp32_param``, ``:199 safe_get_full_grad``, and the
+``safe_{get,set}_{full,local}_optimizer_state`` family) — the supported way
+to inspect or patch a model mid-training regardless of how ZeRO/TP scattered
+it.  There, fragments live on ``param.ds_tensor``/``param._hp_mapping`` and
+gathers walk process groups.  Here the TrainState is a sharded pytree, so:
+
+  * **get full** — resolve the leaf by name-path and pull it to host;
+    materializing a sharded ``jax.Array`` as numpy IS the all-gather
+    (XLA assembles the addressable shards).
+  * **set full** — ``jax.device_put`` the new value against the leaf's
+    recorded ``NamedSharding`` (the resharding write-back), rebuilding the
+    immutable TrainState around it.  In mixed precision both the fp32
+    master AND the compute-dtype param copy are written, like the
+    reference's hp→lp sync (tensor_fragment.py ``safe_set_full_fp32_param``
+    updates hp and marks lp dirty).
+  * **get full grad** — grads never outlive the fused step program (XLA
+    consumed them in the optimizer fusion), so the accessor RECOMPUTES the
+    grad of the engine's last batch on demand via the engine's own
+    accumulation program, then unscales — same numbers the step saw, at the
+    cost of one fwd+bwd, paid only when asked.
+  * **local** variants — the fragment resident on the first addressable
+    device (the "my rank's shard" analog in single-process SPMD).
+
+Paths name pytree keys separated by ``/`` (or ``.``): e.g.
+``model/layers/self_attn/q_proj/kernel``.  With scan-stacked layers the
+leaf carries the leading L dim.  The top-level ``params`` collection key is
+optional.
+"""
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .logging import logger
+
+PathLike = Union[str, Sequence[str]]
+
+
+def _split(path: PathLike) -> Tuple[str, ...]:
+    if isinstance(path, str):
+        return tuple(k for k in path.replace(".", "/").split("/") if k)
+    return tuple(path)
+
+
+def _resolve(tree, keys: Tuple[str, ...], what: str):
+    """Walk dict keys; the top-level 'params' wrapper may be elided."""
+    if isinstance(tree, dict) and "params" in tree and keys and keys[0] != "params":
+        tree = tree["params"]
+    node = tree
+    for i, k in enumerate(keys):
+        if not isinstance(node, dict) or k not in node:
+            avail = sorted(node) if isinstance(node, dict) else type(node).__name__
+            raise KeyError(f"{what}: no key {'/'.join(keys[:i + 1])!r} "
+                           f"(available at that level: {avail})")
+        node = node[k]
+    return node
+
+
+def _set_in(tree, keys: Tuple[str, ...], value):
+    if isinstance(tree, dict) and "params" in tree and keys and keys[0] != "params":
+        return {**tree, "params": _set_in(tree["params"], keys, value)}
+    if not keys:
+        return value
+    k = keys[0]
+    if not isinstance(tree, dict) or k not in tree:
+        raise KeyError(f"no key {k!r} while writing")
+    return {**tree, k: _set_in(tree[k], keys[1:], value)}
+
+
+def _unbox(leaf):
+    from flax import linen as nn
+    return nn.meta.unbox(leaf)
+
+
+def _master_tree(engine):
+    """The fp32 source of truth: ``state.master`` in mixed precision,
+    ``state.params`` when compute dtype is fp32 (master aliased)."""
+    m = engine.state.master
+    use_master = not (isinstance(m, tuple) and len(m) == 0)
+    return (m if use_master else engine.state.params), use_master
+
+
+# ------------------------------------------------------------------ params
+
+def safe_get_full_fp32_param(engine, path: PathLike) -> np.ndarray:
+    """Full (gathered) fp32 value of a param, whatever its ZeRO-3/TP
+    sharding (ref: tensor_fragment.py:132)."""
+    tree, _ = _master_tree(engine)
+    leaf = _unbox(_resolve(tree, _split(path), "param"))
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_get_local_fp32_param(engine, path: PathLike) -> np.ndarray:
+    """This worker's resident fragment (first addressable shard) of the
+    fp32 param (ref: safe_get_local_fp32_param)."""
+    tree, _ = _master_tree(engine)
+    leaf = _unbox(_resolve(tree, _split(path), "param"))
+    return np.asarray(leaf.addressable_shards[0].data, dtype=np.float32)
+
+
+def safe_set_full_fp32_param(engine, path: PathLike, value) -> None:
+    """Write a full fp32 value back, resharding to the leaf's recorded
+    NamedSharding; in mixed precision the compute-dtype copy is updated too
+    (ref: tensor_fragment.py:148 — hp write + lp sync)."""
+    keys = _split(path)
+    state = engine.state
+    master_tree, use_master = _master_tree(engine)
+    old = _unbox(_resolve(master_tree, keys, "param"))
+    value = jnp.asarray(value, old.dtype)
+    if value.shape != old.shape:
+        raise ValueError(f"shape mismatch writing {'/'.join(keys)}: "
+                         f"{value.shape} vs {old.shape}")
+    sh_tree = engine.state_shardings.master if use_master else engine.state_shardings.params
+    sharding = _resolve(sh_tree, keys, "param sharding")
+    new_master_leaf = jax.device_put(value, sharding)
+    if use_master:
+        new_master = _set_in(state.master, keys, new_master_leaf)
+        p_old = _unbox(_resolve(state.params, keys, "param"))
+        p_sh = _resolve(engine.state_shardings.params, keys, "param sharding")
+        new_p_leaf = jax.device_put(value.astype(p_old.dtype), p_sh)
+        new_params = _set_in(state.params, keys, new_p_leaf)
+        engine.state = state._replace(params=new_params, master=new_master)
+    else:
+        engine.state = state._replace(params=_set_in(state.params, keys, new_master_leaf))
+
+
+# safe_set_local_fp32_param: a per-shard write would race the SPMD layout
+# (every process here addresses all shards); patch the full value instead.
+
+
+# ------------------------------------------------------------------- grads
+
+def _recompute_grads(engine, batch):
+    key = ("_tensor_fragment_grads", engine._batch_key(batch))
+    cache = getattr(engine, "_tf_grad_cache", None)
+    if cache is None:
+        cache = engine._tf_grad_cache = {}
+    if key not in cache:
+
+        def grads_fn(state, b):
+            grads, _ = engine._grads_for_batch(state, b)
+            # _grads_for_batch returns loss-scaled SUMMED grads over gas —
+            # unscale exactly as _apply_grads does (incl. predivide) so these
+            # ARE the step's effective pre-clip grads
+            inv = 1.0 / (state.scaler.cur_scale * engine.gas)
+            pdf = getattr(engine._config, "gradient_predivide_factor", 1.0) or 1.0
+            if pdf != 1.0:
+                inv = inv / pdf
+            return jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+
+        # land the grads in the step's own layout (ZeRO grad partitioning)
+        # so the local accessor returns a true fragment
+        out_sh = getattr(engine, "_grad_shardings", None)
+        cache[key] = jax.jit(grads_fn, out_shardings=out_sh)
+    from ..comm import mesh as mesh_lib
+    # the trace happens at the CALL (jit is lazy) — it must see the mesh so
+    # self-sharding Pallas kernels shard_map-wrap themselves
+    with mesh_lib.trace_mesh(engine.mesh):
+        return cache[key](engine.state, batch)
+
+
+def safe_get_full_grad(engine, path: PathLike, batch=None) -> np.ndarray:
+    """Full fp32 grad of a param for ``batch`` (default: the engine's last
+    trained batch), recomputed on demand (ref: tensor_fragment.py:199 — the
+    reference returns the grad stashed by the last backward; a fused XLA
+    step leaves no stash, so the accessor re-derives it)."""
+    batch = batch if batch is not None else getattr(engine, "last_batch", None)
+    if batch is None:
+        raise RuntimeError("safe_get_full_grad: no batch — train a step first or pass batch=")
+    grads = _recompute_grads(engine, batch)
+    leaf = _resolve(grads, _split(path), "grad")
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_get_local_grad(engine, path: PathLike, batch=None) -> np.ndarray:
+    """This worker's fragment of the (recomputed) grad."""
+    batch = batch if batch is not None else getattr(engine, "last_batch", None)
+    if batch is None:
+        raise RuntimeError("safe_get_local_grad: no batch — train a step first or pass batch=")
+    grads = _recompute_grads(engine, batch)
+    leaf = _resolve(grads, _split(path), "grad")
+    return np.asarray(leaf.addressable_shards[0].data, dtype=np.float32)
+
+
+# --------------------------------------------------------- optimizer state
+
+_STATE_ALIASES = {"exp_avg": ("exp_avg", "mu", "m"),
+                  "exp_avg_sq": ("exp_avg_sq", "nu", "v"),
+                  "momentum": ("momentum", "trace", "exp_avg")}
+
+
+def _locate_moments(opt_state, state_name: str):
+    """Find the (container, field) carrying the per-param moment tree named
+    ``state_name`` anywhere in the optimizer-state structure (fused
+    optimizers are NamedTuples; chained/wrapped ones nest them)."""
+    names = _STATE_ALIASES.get(state_name, (state_name, ))
+
+    def walk(node, rebuild):
+        if hasattr(node, "_fields"):
+            for cand in names:
+                if cand in node._fields:
+                    return node, cand, rebuild
+            for f in node._fields:
+                found = walk(getattr(node, f),
+                             lambda v, n=node, f=f, rb=rebuild: rb(n._replace(**{f: v})))
+                if found is not None:
+                    return found
+        elif isinstance(node, (tuple, list)):
+            for i, child in enumerate(node):
+                found = walk(child,
+                             lambda v, n=node, i=i, rb=rebuild:
+                             rb(type(n)(list(n[:i]) + [v] + list(n[i + 1:]))))
+                if found is not None:
+                    return found
+        return None
+
+    found = walk(opt_state, lambda v: v)
+    if found is None:
+        raise KeyError(f"optimizer state has no field {state_name!r} "
+                       f"(structure: {jax.tree.structure(opt_state)})")
+    return found
+
+
+def safe_get_full_optimizer_state(engine, path: PathLike, state_name: str) -> np.ndarray:
+    """Full (gathered) fp32 optimizer state of a param — e.g. ``exp_avg`` /
+    ``exp_avg_sq`` (ref: safe_get_full_optimizer_state)."""
+    container, field, _ = _locate_moments(engine.state.opt_state, state_name)
+    leaf = _resolve(getattr(container, field), _split(path), f"optimizer state {state_name}")
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_get_local_optimizer_state(engine, path: PathLike, state_name: str) -> np.ndarray:
+    """This worker's fragment of the optimizer state."""
+    container, field, _ = _locate_moments(engine.state.opt_state, state_name)
+    leaf = _resolve(getattr(container, field), _split(path), f"optimizer state {state_name}")
+    return np.asarray(leaf.addressable_shards[0].data, dtype=np.float32)
+
+
+def safe_set_full_optimizer_state(engine, path: PathLike, value, state_name: str) -> None:
+    """Write a full optimizer-state value back with resharding
+    (ref: safe_set_full_optimizer_state)."""
+    keys = _split(path)
+    container, field, rebuild = _locate_moments(engine.state.opt_state, state_name)
+    moments = getattr(container, field)
+    old = _resolve(moments, keys, f"optimizer state {state_name}")
+    value = jnp.asarray(value, old.dtype)
+    if value.shape != old.shape:
+        raise ValueError(f"shape mismatch writing {state_name} {'/'.join(keys)}: "
+                         f"{value.shape} vs {old.shape}")
+    sharding = old.sharding if hasattr(old, "sharding") else None
+    new_leaf = jax.device_put(value, sharding) if sharding is not None else value
+    new_opt = rebuild(container._replace(**{field: _set_in(moments, keys, new_leaf)}))
+    engine.state = engine.state._replace(opt_state=new_opt)

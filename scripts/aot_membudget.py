@@ -152,6 +152,46 @@ def llama3_8b_zero3_v5p64():
         backend=backend, zero_stage=3)
 
 
+def _serving_budget(tp, topology):
+    """FastGen-v2 serving step, TP-sharded over a v5p slice (the reference's
+    headline serving mode: deepspeed/inference/v2/engine_v2.py:118 honors
+    tp_size; blogs/deepspeed-fastgen serves Llama-2-70B at TP4).  Compiles
+    BOTH hot programs of the SplitFuse loop — a 64-seq decode round and an
+    8-seq × 256-token prefill chunk — and budgets the worst case."""
+    import dataclasses
+    import jax
+    from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, compile_aot_serving
+    from deepspeed_tpu.models.llama import PRESETS
+    from deepspeed_tpu.models.llama_cache import PagedKVConfig
+
+    mesh, backend = _mesh(tp, topology=topology, data=1, tensor=tp)
+    on_tpu = backend.startswith("v5")
+    cfg = dataclasses.replace(PRESETS["llama3-8b"],
+                              attention_impl="flash" if on_tpu else "reference",
+                              scan_layers=True, remat=False)
+    # 2048 pages x 128 tokens = 262k KV tokens (64 concurrent seqs @ 4k ctx),
+    # 34 GB of bf16 KV total -> /tp per chip
+    kv = PagedKVConfig(num_pages=2048, page_size=128, max_pages_per_seq=32)
+    eng_cfg = RaggedInferenceEngineConfig(kv=kv)
+    metas = {}
+    for phase, (batch, chunk) in (("decode", (64, 1)), ("prefill", (8, 256))):
+        compiled, n_params = compile_aot_serving(cfg, mesh, eng_cfg, batch=batch, chunk=chunk)
+        ma = compiled.memory_analysis()
+        metas[phase] = ma
+    return metas, n_params, dict(
+        model="llama3-8b", mode="serving", tensor_parallel=tp, backend=backend,
+        kv_tokens=kv.num_pages * kv.page_size, kv_dtype="bfloat16",
+        decode_batch=64, prefill_chunk=256)
+
+
+def llama3_8b_serving_tp4():
+    return _serving_budget(4, "v5p:2x2x1")
+
+
+def llama3_8b_serving_tp8():
+    return _serving_budget(8, "v5p:2x2x2")
+
+
 CONFIGS = {
     "llama3_8b_zero3_v5p16": llama3_8b_zero3_v5p16,
     "llama3_8b_ulysses32k": llama3_8b_ulysses32k,
@@ -159,10 +199,42 @@ CONFIGS = {
     "llama3_8b_zero3_v5p64": llama3_8b_zero3_v5p64,
 }
 
+SERVING_CONFIGS = {
+    "llama3_8b_serving_tp4": llama3_8b_serving_tp4,
+    "llama3_8b_serving_tp8": llama3_8b_serving_tp8,
+}
+
+
+def analyze_serving(name):
+    import numpy as np
+    t0 = time.time()
+    metas, n_params, meta = SERVING_CONFIGS[name]()
+    phases = {}
+    peak = arg = temp = 0
+    for phase, ma in metas.items():
+        p = int(ma.peak_memory_in_bytes)
+        phases[phase] = dict(argument=int(ma.argument_size_in_bytes),
+                             temp=int(ma.temp_size_in_bytes), peak=p)
+        peak = max(peak, p)
+        arg = max(arg, int(ma.argument_size_in_bytes))
+        temp = max(temp, int(ma.temp_size_in_bytes))
+    return dict(
+        meta,
+        n_params=n_params,
+        per_device_bytes=phases,
+        weights_kv_gb=round(arg / 1e9, 2),
+        peak_gb=round(peak / 1e9, 2),
+        v5p_hbm_gb=round(V5P_HBM_BYTES / 1e9, 2),
+        fits_v5p=bool(max(peak, arg + temp) <= V5P_HBM_BYTES),
+        compile_seconds=round(time.time() - t0, 1),
+    )
+
 
 def analyze(name):
     import jax
     import numpy as np
+    if name in SERVING_CONFIGS:
+        return analyze_serving(name)
     build = CONFIGS[name]
     t0 = time.time()
     engine, batch, meta = build()
@@ -192,7 +264,7 @@ def analyze(name):
 
 
 def main():
-    names = sys.argv[1:] or list(CONFIGS)
+    names = sys.argv[1:] or (list(CONFIGS) + list(SERVING_CONFIGS))
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "MEMBUDGET.json")
     results = {}
     if os.path.exists(out_path):
