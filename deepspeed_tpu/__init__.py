@@ -57,6 +57,16 @@ def initialize(args=None,
 
     init_distributed(distributed_port=distributed_port, dist_init_required=dist_init_required)
 
+    if mpu is not None and mesh is None:
+        # External Megatron-style mpu honored end-to-end (ref:
+        # deepspeed/runtime/engine.py reads mpu.get_model_parallel_world_size
+        # etc. to build its process groups; module_inject/containers/
+        # megatron_gpt.py:14 consumes the mp group).  Here the grid maps onto
+        # mesh axes: TP -> 'tensor', PP -> 'pipe', DP -> 'data'; the sharding
+        # rules then place params exactly where the mpu's groups would.
+        from .comm.mesh import mesh_from_mpu
+        mesh = mesh_from_mpu(mpu)
+
     ds_config = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(config, mpu=mpu)
     from .runtime.pipe.engine import PipelineEngine
     from .runtime.pipe.module import PipelineModule

@@ -108,6 +108,45 @@ def has_global_mesh() -> bool:
 _TRACE_MESH: Optional[Mesh] = None
 
 
+def _mpu_degree(mpu, names, default=1) -> int:
+    """First present-and-callable accessor wins (Megatron renamed these
+    across versions: get_model_parallel_world_size →
+    get_tensor_model_parallel_world_size)."""
+    for n in names:
+        fn = getattr(mpu, n, None)
+        if callable(fn):
+            return int(fn())
+    return default
+
+
+def mesh_from_mpu(mpu) -> Mesh:
+    """Map an external Megatron-style mpu grid onto the named mesh.
+
+    ref: the reference engine consumes ``mpu.get_{model,data}_parallel_*``
+    to build its NCCL groups (deepspeed/runtime/engine.py _configure_
+    distributed_model; utils/groups.py honors an external mpu everywhere).
+    Here the same degrees select mesh-axis sizes — TP → 'tensor',
+    PP → 'pipe', DP → 'data' — and GSPMD derives every group from the axis
+    names, so AutoTP rules, ZeRO partitioning and collectives all follow
+    the external grid without translating its process groups."""
+    tp = _mpu_degree(mpu, ("get_tensor_model_parallel_world_size",
+                           "get_model_parallel_world_size"))
+    pp = _mpu_degree(mpu, ("get_pipeline_model_parallel_world_size",
+                           "get_pipe_parallel_world_size"))
+    dp = _mpu_degree(mpu, ("get_data_parallel_world_size", ), default=-1)
+    need = tp * pp * (dp if dp > 0 else 1)
+    n = len(jax.devices())
+    if need > n:
+        raise ValueError(f"mpu grid tp={tp} pp={pp} dp={dp} needs {need} devices, "
+                         f"have {n}")
+    if dp <= 0:
+        dp = n // (tp * pp)
+    mesh = create_mesh(MeshSpec(pipe=pp, data=dp, tensor=tp),
+                       devices=jax.devices()[:tp * pp * dp])
+    logger.info(f"mesh_from_mpu: tp={tp} pp={pp} dp={dp}")
+    return mesh
+
+
 @contextlib.contextmanager
 def trace_mesh(mesh: Optional[Mesh]):
     """Context manager marking *which mesh governs the computation being

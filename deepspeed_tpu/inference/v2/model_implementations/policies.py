@@ -1071,7 +1071,124 @@ class QwenV1Policy(InferenceV2Policy):
         return params
 
 
+class MegatronGPTPolicy(InferenceV2Policy):
+    """ref: module_inject/containers/megatron_gpt.py (MegatronLayerPolicy,
+    megatron_v2) — Megatron-LM GPT checkpoints: fused biased query_key_value,
+    sequential residual, rotary positions (the NeoX lineage IS megatron-
+    derived, so the NeoX flax model is the structural twin; classic
+    megatron-v1 learned-position checkpoints are rejected with a clear
+    error).  Both state-dict namings are honored:
+    ``language_model.encoder.layers.*`` (modern M-LM) and
+    ``transformer.layers.*`` (legacy), with ``self_attention``/``attention``
+    module names (ref: megatron_gpt.py version switch)."""
+    model_type = "megatron-gpt"
+
+    def build_config(self, cfg):
+        from ....models.gpt_family import GPTNeoXConfig
+        g = lambda *names, d=None: next((getattr(cfg, n) for n in names if hasattr(cfg, n)), d)
+        return GPTNeoXConfig(
+            vocab_size=g("padded_vocab_size", "vocab_size", d=50432),
+            hidden_size=g("hidden_size", d=64),
+            intermediate_size=g("ffn_hidden_size", "intermediate_size",
+                                d=4 * g("hidden_size", d=64)),
+            num_hidden_layers=g("num_layers", "num_hidden_layers", d=2),
+            num_attention_heads=g("num_attention_heads", d=8),
+            rotary_pct=g("rotary_percent", "rotary_pct", d=1.0),
+            use_parallel_residual=False)  # megatron residual is sequential
+
+    def build_model(self, cfg):
+        from ....models.gpt_family import GPTNeoXForCausalLM
+        return GPTNeoXForCausalLM(cfg)
+
+    def _layer_fmt(self, sd):
+        for enc, attn in (("language_model.encoder.layers", "self_attention"),
+                          ("transformer.layers", "attention"),
+                          ("transformer.layers", "self_attention")):
+            if any(k.startswith(f"{enc}.0.{attn}.query_key_value") for k in sd):
+                return enc, attn
+        raise KeyError("state dict has no recognizable Megatron layer naming "
+                       "(language_model.encoder.layers / transformer.layers)")
+
+    def convert(self, sd, cfg):
+        if any("position_embeddings" in k for k in sd):
+            raise ValueError(
+                "classic megatron-v1 checkpoints with learned position embeddings "
+                "are not supported — the serving twin is rotary (megatron_v2)")
+        H = cfg.num_attention_heads
+        D = cfg.hidden_size // H
+        E = cfg.hidden_size
+        L = cfg.num_hidden_layers
+        enc, attn = self._layer_fmt(sd)
+        get = lambda name: _get(sd, name)
+        stack = lambda fmt, conv=(lambda w: w): _stack(sd, f"{enc}." + fmt, L, conv)
+        ln = lambda fmt: {"scale": stack(fmt + ".weight"), "bias": stack(fmt + ".bias")}
+        embed = get("language_model.embedding.word_embeddings.weight"
+                    if enc.startswith("language_model") else
+                    "transformer.word_embeddings.weight")[:cfg.vocab_size]
+        out_name = ("language_model.output_layer.weight"
+                    if enc.startswith("language_model") else "lm_head.weight")
+        # megatron ties by default; _get handles torch bf16 checkpoints
+        out_w = _get(sd, out_name) if out_name in sd else embed
+        final_ln = ("language_model.encoder.final_layernorm"
+                    if enc.startswith("language_model") else "transformer.final_layernorm")
+        params = {
+            "embed_in": {"embedding": embed},
+            "final_layer_norm": {"scale": get(final_ln + ".weight"),
+                                 "bias": get(final_ln + ".bias")},
+            "embed_out": {"kernel": _t(out_w)[:, :cfg.vocab_size]},
+            "layers": {
+                "input_layernorm": ln("{i}.input_layernorm"),
+                "post_attention_layernorm": ln("{i}.post_attention_layernorm"),
+                # megatron_v2 fused qkv [H·3·D, E]: per-head [q_h | k_h | v_h]
+                # — the SAME interleave NeoX uses (ref: features/megatron.py
+                # qkv_copy transposes only for v1)
+                "query_key_value": {
+                    "kernel": stack(f"{{i}}.{attn}.query_key_value.weight",
+                                    lambda w: _t(w).reshape(E, H, 3, D)),
+                    "bias": stack(f"{{i}}.{attn}.query_key_value.bias",
+                                  lambda b: b.reshape(H, 3, D))},
+                "dense": {"kernel": stack(f"{{i}}.{attn}.dense.weight",
+                                          lambda w: _t(w).reshape(H, D, E)),
+                          "bias": stack(f"{{i}}.{attn}.dense.bias")},
+                "dense_h_to_4h": {"kernel": stack("{i}.mlp.dense_h_to_4h.weight", _t),
+                                  "bias": stack("{i}.mlp.dense_h_to_4h.bias")},
+                "dense_4h_to_h": {"kernel": stack("{i}.mlp.dense_4h_to_h.weight", _t),
+                                  "bias": stack("{i}.mlp.dense_4h_to_h.bias")},
+            },
+        }
+        return params
+
+
+class MegatronGPTMoEPolicy(MegatronGPTPolicy):
+    """ref: module_inject/containers/megatron_gpt_moe.py — megatron layers
+    whose MLP is a DeepSpeed-MoE expert bank
+    (``mlp.deepspeed_moe.experts.deepspeed_experts.{e}.dense_*``).  The
+    expert weights translate into the stacked-experts layout our MoE layer
+    scans over ([L, NE, ...], moe/experts.py); the dense trunk follows the
+    parent policy."""
+    model_type = "megatron-gpt-moe"
+
+    def convert_experts(self, sd, cfg, num_experts: int):
+        L = cfg.num_hidden_layers
+        enc, _ = self._layer_fmt(sd)
+        moe = "mlp.deepspeed_moe.experts.deepspeed_experts"
+
+        def bank(fmt, conv):
+            return np.stack([
+                np.stack([conv(_get(sd, f"{enc}.{i}.{moe}.{e}.{fmt}"))
+                          for e in range(num_experts)]) for i in range(L)])
+
+        return {
+            "wi": bank("dense_h_to_4h.weight", lambda w: w.T),   # [L, NE, E, F]
+            "wi_bias": bank("dense_h_to_4h.bias", lambda b: b),  # [L, NE, F]
+            "wo": bank("dense_4h_to_h.weight", lambda w: w.T),   # [L, NE, F, E]
+            "wo_bias": bank("dense_4h_to_h.bias", lambda b: b),  # [L, NE, E]
+        }
+
+
 POLICY_REGISTRY = {
+    "megatron-gpt": MegatronGPTPolicy(),
+    "megatron-gpt-moe": MegatronGPTMoEPolicy(),
     "llama": LlamaPolicy(),
     "mistral": MistralPolicy(),
     "qwen2": Qwen2Policy(),

@@ -1,5 +1,6 @@
 """Module injection: TP sharding rules + HF model replacement policies
 (ref: deepspeed/module_inject/)."""
 
+from .diffusers_policies import UNetPolicy, VAEPolicy, diffusers_attention  # noqa: F401
 from .replace_module import replace_module, replace_transformer_layer
 from .tp_rules import make_logical_rules, logical_to_sharding, param_shardings

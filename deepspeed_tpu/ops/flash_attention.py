@@ -63,21 +63,24 @@ LANE = 128  # TPU lane width: per-row scalars are stored lane-broadcast
 # ---------------------------------------------------------------------------
 
 
-def _tri_table(nq, nk, bq, bk, causal, transpose=False):
+def _tri_table(nq, nk, bq, bk, causal, transpose=False, q_offset=0):
     """Flattened block schedule. Rows: 0=iq, 1=ik, 2=first, 3=last, 4=diag.
 
     ``transpose=False``: row-major sweep (for each q block, its admitted kv
     blocks) — the fwd/dq accumulation order.  ``transpose=True``:
     column-major (for each kv block, its admitted q blocks) — the dk/dv
     order.  first/last flag the accumulation-window boundaries in either
-    order."""
+    order.  ``q_offset`` (static) shifts the queries' GLOBAL positions:
+    query row r sits at position q_offset + r — the FPDT staged path runs
+    one triangular kernel call per (q group x kv prefix) with the group's
+    offset, keeping causality exact without a merge pass."""
     import numpy as np
     cols = []
     if not transpose:
         for i in range(nq):
-            hi = min(nk, -(-((i + 1) * bq) // bk)) if causal else nk
+            hi = min(nk, -(-(q_offset + (i + 1) * bq) // bk)) if causal else nk
             for j in range(hi):
-                diag = 1 if (causal and (j + 1) * bk - 1 > i * bq) else 0
+                diag = 1 if (causal and (j + 1) * bk - 1 > q_offset + i * bq) else 0
                 cols.append((i, j, 1 if j == 0 else 0, 1 if j == hi - 1 else 0, diag))
     else:
         for j in range(nk):
@@ -86,22 +89,22 @@ def _tri_table(nq, nk, bq, bk, causal, transpose=False):
             # visited block is then fully masked, p ≡ 0, and the dk/dv
             # output block is correctly written as zeros instead of left
             # uninitialized
-            lo = min((j * bk) // bq, nq - 1) if causal else 0
+            lo = min(max(0, (j * bk - q_offset) // bq), nq - 1) if causal else 0
             rows = list(range(lo, nq))
             for n, i in enumerate(rows):
-                diag = 1 if (causal and (j + 1) * bk - 1 > i * bq) else 0
+                diag = 1 if (causal and (j + 1) * bk - 1 > q_offset + i * bq) else 0
                 cols.append((i, j, 1 if n == 0 else 0, 1 if n == len(rows) - 1 else 0, diag))
     tab = np.asarray(cols, dtype=np.int32).T  # [5, T]
     return tab
 
 
-def _mask_if_diag(s, tab_ref, t, bq, bk):
+def _mask_if_diag(s, tab_ref, t, bq, bk, q_offset=0):
     """Causal mask, no-op'd via the table's diag flag for fully-visible
     blocks.  Measured on v5e: a real lax.cond branch around the masking
     costs ~13% step time (78 vs 69 ms at bench shapes) — the branch breaks
     Mosaic's software pipelining — so the select runs unconditionally and
     the diag flag just widens ``keep`` to all-true."""
-    qpos = tab_ref[0, t] * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    qpos = q_offset + tab_ref[0, t] * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     kpos = tab_ref[1, t] * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     keep = (qpos >= kpos) | (tab_ref[4, t] == 0)
     return jnp.where(keep, s, DEFAULT_MASK_VALUE)
@@ -149,7 +152,7 @@ def _pack_width(d, h, rep=1):
     return max(fitting) if fitting else min(legal)
 
 
-def _fwd2_kernel(tab_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale, bq, bk, P, d, rep):
+def _fwd2_kernel(tab_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale, bq, bk, P, d, rep, q_offset):
     lse_ref = rest[0] if len(rest) % 3 == 1 else None
     scr = rest[1:] if lse_ref is not None else rest
     ms, ls, accs = scr[:P], scr[P:2 * P], scr[2 * P:3 * P]
@@ -173,7 +176,7 @@ def _fwd2_kernel(tab_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale, bq, bk, P, d
             q = q_ref[0, :, p * d:(p + 1) * d]  # [bq, d]
             s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                     preferred_element_type=jnp.float32) * scale
-            s = _mask_if_diag(s, tab_ref, t, bq, bk)
+            s = _mask_if_diag(s, tab_ref, t, bq, bk, q_offset)
             m_prev = ms[p][:]
             l_prev = ls[p][:]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -194,7 +197,7 @@ def _fwd2_kernel(tab_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale, bq, bk, P, d
                                                  lse_ref[0, p].shape).astype(lse_ref.dtype)
 
 
-def _flash_fwd2(q, k, v, *, h, hk, causal, block_q, block_k, interpret, emit_lse=True):
+def _flash_fwd2(q, k, v, *, h, hk, causal, block_q, block_k, interpret, emit_lse=True, q_offset=0):
     # q [B, Sq, H·D], k/v [B, Sk, HK·D] (GQA-native: kv at its real width)
     # → o [B, Sq, H·D], lse [B, H, Sq, LANE]
     b, sq, hd = q.shape
@@ -211,10 +214,11 @@ def _flash_fwd2(q, k, v, *, h, hk, causal, block_q, block_k, interpret, emit_lse
     assert h % P == 0 and hk % Pk == 0, (h, hk, P, Pk)
     nq, nk = sq // bq, sk // bk
     scale = 1.0 / (d**0.5)
-    tab = _tri_table(nq, nk, bq, bk, causal)
+    tab = _tri_table(nq, nk, bq, bk, causal, q_offset=q_offset)
     grid = (b, hk // Pk, tab.shape[1])
 
-    kernel = functools.partial(_fwd2_kernel, scale=scale, bq=bq, bk=bk, P=P, d=d, rep=rep)
+    kernel = functools.partial(_fwd2_kernel, scale=scale, bq=bq, bk=bk, P=P, d=d, rep=rep,
+                               q_offset=q_offset)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
@@ -248,7 +252,7 @@ def _flash_fwd2(q, k, v, *, h, hk, causal, block_q, block_k, interpret, emit_lse
     return (out[0], out[1]) if emit_lse else (out[0], None)
 
 
-def _bwd2_block(tab_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *, scale, bq, bk, P, d, p, rep):
+def _bwd2_block(tab_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *, scale, bq, bk, P, d, p, rep, q_offset):
     """Shared per-(block, sub-head) backward math: returns (pr, ds).
 
     ``p`` indexes the query head within the block; its kv head is
@@ -265,7 +269,7 @@ def _bwd2_block(tab_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *, scale, 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=1, keepdims=True)
     s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-    s = _mask_if_diag(s, tab_ref, t, bq, bk)
+    s = _mask_if_diag(s, tab_ref, t, bq, bk, q_offset)
     pr = jnp.exp(s - lse)
     dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -274,7 +278,7 @@ def _bwd2_block(tab_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *, scale, 
 
 
 def _dq2_kernel(tab_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *scr,
-                scale, bq, bk, P, d, rep):
+                scale, bq, bk, P, d, rep, q_offset):
     t = pl.program_id(2)
 
     @pl.when(tab_ref[2, t] == 1)
@@ -284,7 +288,8 @@ def _dq2_kernel(tab_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *s
 
     for p in range(P):
         _, k, _, _, ds = _bwd2_block(tab_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                                     scale=scale, bq=bq, bk=bk, P=P, d=d, p=p, rep=rep)
+                                     scale=scale, bq=bq, bk=bk, P=P, d=d, p=p, rep=rep,
+                                     q_offset=q_offset)
         scr[p][:] += jax.lax.dot_general(ds, k, (((1, ), (0, )), ((), ())),
                                          preferred_element_type=jnp.float32)
 
@@ -295,7 +300,7 @@ def _dq2_kernel(tab_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *s
 
 
 def _dkv2_kernel(tab_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref, *scr,
-                 scale, bq, bk, P, d, rep):
+                 scale, bq, bk, P, d, rep, q_offset):
     t = pl.program_id(2)
     Pk = P // rep
     dk_scr, dv_scr = scr[:Pk], scr[Pk:]
@@ -311,7 +316,8 @@ def _dkv2_kernel(tab_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, d
     for p in range(P):
         pk = p // rep
         q, _, do, pr, ds = _bwd2_block(tab_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                                       scale=scale, bq=bq, bk=bk, P=P, d=d, p=p, rep=rep)
+                                       scale=scale, bq=bq, bk=bk, P=P, d=d, p=p, rep=rep,
+                                       q_offset=q_offset)
         dv_scr[pk][:] += jax.lax.dot_general(pr, do, (((0, ), (0, )), ((), ())),
                                              preferred_element_type=jnp.float32)
         dk_scr[pk][:] += jax.lax.dot_general(ds, q, (((0, ), (0, )), ((), ())),
@@ -324,7 +330,7 @@ def _dkv2_kernel(tab_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, d
             dv_ref[0, :, pk * d:(pk + 1) * d] = dv_scr[pk][:].astype(dv_ref.dtype)
 
 
-def _flash_bwd2(q, k, v, o, lse, do, *, h, hk, causal, block_q, block_k, interpret):
+def _flash_bwd2(q, k, v, o, lse, do, *, h, hk, causal, block_q, block_k, interpret, q_offset=0):
     # packed q/o/do [B, Sq, H·D], k/v [B, Sk, HK·D] (GQA-native); dk/dv
     # returned at the real HK width — the group-sum over the rep query
     # heads sharing a kv head happens inside the dkv kernel's scratch
@@ -351,9 +357,10 @@ def _flash_bwd2(q, k, v, o, lse, do, *, h, hk, causal, block_q, block_k, interpr
             pl.BlockSpec((1, P, bq, LANE), lambda b, hh, t, tab: (b, hh, tab[0, t], 0)),
         ]
 
-    tab_r = _tri_table(nq, nk, bq, bk, causal)
+    tab_r = _tri_table(nq, nk, bq, bk, causal, q_offset=q_offset)
     dq = pl.pallas_call(
-        functools.partial(_dq2_kernel, scale=scale, bq=bq, bk=bk, P=P, d=d, rep=rep),
+        functools.partial(_dq2_kernel, scale=scale, bq=bq, bk=bk, P=P, d=d, rep=rep,
+                          q_offset=q_offset),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, hk // Pk, tab_r.shape[1]),
@@ -367,9 +374,10 @@ def _flash_bwd2(q, k, v, o, lse, do, *, h, hk, causal, block_q, block_k, interpr
         interpret=interpret,
     )(tab_r, q, k, v, o, do, lse)
 
-    tab_c = _tri_table(nq, nk, bq, bk, causal, transpose=True)
+    tab_c = _tri_table(nq, nk, bq, bk, causal, transpose=True, q_offset=q_offset)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv2_kernel, scale=scale, bq=bq, bk=bk, P=P, d=d, rep=rep),
+        functools.partial(_dkv2_kernel, scale=scale, bq=bq, bk=bk, P=P, d=d, rep=rep,
+                          q_offset=q_offset),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, hk // Pk, tab_c.shape[1]),
@@ -391,13 +399,13 @@ def _flash_bwd2(q, k, v, o, lse, do, *, h, hk, causal, block_q, block_k, interpr
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret, emit_lse=False)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, causal, block_q, block_k, interpret, q_offset=0):
+    out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret, q_offset, emit_lse=False)
     return out
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret, emit_lse=True):
+def _fwd(q, k, v, causal, block_q, block_k, interpret, q_offset=0, emit_lse=True):
     # [B, S, H, D] in/out; kernels run on the packed [B, S, H·D] view
     # (a FREE reshape — same memory layout, no transpose).  GQA-native:
     # kv stays at its real HK width — the kernels pair each kv-head block
@@ -415,7 +423,8 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret, emit_lse=True):
     kp = k.reshape(b, sk, hk * d)
     vp = v.reshape(b, sk, hk * d)
     out, lse = _flash_fwd2(qp, kp, vp, h=h, hk=hk, causal=causal, block_q=block_q,
-                           block_k=block_k, interpret=interpret, emit_lse=emit_lse)
+                           block_k=block_k, interpret=interpret, emit_lse=emit_lse,
+                           q_offset=q_offset)
     if emit_lse:
         # named so remat policies can SAVE the kernel outputs (see
         # models/llama._resolve_remat_policy 'flash_saveable'): without
@@ -428,11 +437,12 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret, emit_lse=True):
     return out.reshape(b, sq, h, d), res
 
 
-def _bwd(causal, block_q, block_k, interpret, res, g):
+def _bwd(causal, block_q, block_k, interpret, q_offset, res, g):
     qp, kp, vp, out, lse, (b, sq, sk, h, hk, hk_real, d) = res
     do = g.reshape(b, sq, h * d)
     dq, dk, dv = _flash_bwd2(qp, kp, vp, out, lse, do, h=h, hk=hk, causal=causal,
-                             block_q=block_q, block_k=block_k, interpret=interpret)
+                             block_q=block_q, block_k=block_k, interpret=interpret,
+                             q_offset=q_offset)
     dq = dq.reshape(b, sq, h, d)
     dk = dk.reshape(b, sk, hk, d)
     dv = dv.reshape(b, sk, hk, d)
@@ -447,14 +457,14 @@ def _bwd(causal, block_q, block_k, interpret, res, g):
     return dq, dk, dv
 
 
-def _flash_fwd_with_res(q, k, v, causal, block_q, block_k, interpret):
-    return _fwd(q, k, v, causal, block_q, block_k, interpret)
+def _flash_fwd_with_res(q, k, v, causal, block_q, block_k, interpret, q_offset=0):
+    return _fwd(q, k, v, causal, block_q, block_k, interpret, q_offset)
 
 
 _flash_attention.defvjp(_flash_fwd_with_res, _bwd)
 
 
-def _flash_sharded(q, k, v, causal, block_q, block_k, interpret, mesh):
+def _flash_sharded(q, k, v, causal, block_q, block_k, interpret, mesh, q_offset=0):
     """Run the kernels inside shard_map over the governing (trace) mesh.
 
     Mosaic custom calls cannot be auto-partitioned by GSPMD — a multi-device
@@ -477,7 +487,8 @@ def _flash_sharded(q, k, v, causal, block_q, block_k, interpret, mesh):
         head_axes = ()
     spec = P(batch_axes or None, None, head_axes or None, None)
     fn = jax.shard_map(
-        lambda q_, k_, v_: _flash_attention(q_, k_, v_, causal, block_q, block_k, interpret),
+        lambda q_, k_, v_: _flash_attention(q_, k_, v_, causal, block_q, block_k, interpret,
+                                            q_offset),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         # pallas_call out_shapes carry no varying-mesh-axes annotation
         check_vma=False)
@@ -493,7 +504,8 @@ def flash_attention(q,
                     sliding_window: int = 0,
                     block_q: int = 512,
                     block_k: int = 512,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    q_position_offset: int = 0):
     """Flash attention over [batch, seq, heads, head_dim] tensors.
 
     GQA (fewer kv heads) is kernel-native: kv blocks stay at the real kv
@@ -507,6 +519,9 @@ def flash_attention(q,
             or q.shape[1] % LANE != 0 or k.shape[1] % LANE != 0):
         # packed-sequence masking in-kernel is a follow-up; ragged lengths
         # would force sub-128 blocks that violate TPU tiling
+        if q_position_offset:
+            raise ValueError("q_position_offset requires 128-aligned seq lens and no "
+                             "segment/window masks (the chunked fallback has no offset)")
         from ..models.llama import chunked_attention
         return chunked_attention(q, k, v, causal=causal, segment_ids=segment_ids,
                                  sliding_window=sliding_window)
@@ -521,5 +536,6 @@ def flash_attention(q,
     if isinstance(q, jax.core.Tracer) and not in_manual_mesh():
         mesh = get_trace_mesh()
         if mesh is not None and mesh.size > 1:
-            return _flash_sharded(q, k, v, causal, block_q, block_k, interpret, mesh)
-    return _flash_attention(q, k, v, causal, block_q, block_k, interpret)
+            return _flash_sharded(q, k, v, causal, block_q, block_k, interpret, mesh,
+                                  q_position_offset)
+    return _flash_attention(q, k, v, causal, block_q, block_k, interpret, q_position_offset)

@@ -131,8 +131,18 @@ def zero_one_adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0, compr
         def upd(g, m, v, e, p):
             g = g.astype(jnp.float32)
             m_new = b1 * m + (1 - b1) * g
-            v_new = jnp.where(var_due, b2 * v + (1 - b2) * g * g, v)
             comp, e_new = (compress_fn or _sign_compress_ef)(m_new, e)
+            if compress_fn is not None:
+                # WIRE transport: the local grad differs per worker, so a
+                # variance update from it would fork exp_avg_sq (and then
+                # params) across ranks.  Reconstruct the globally-averaged
+                # gradient from the post-exchange momentum — identical on
+                # every worker — the 0/1 Adam paper's compression-stage
+                # variance source (ref: zoadam.py step)
+                g_var = (comp - b1 * m) / (1 - b1)
+            else:
+                g_var = g
+            v_new = jnp.where(var_due, b2 * v + (1 - b2) * g_var * g_var, v)
             bc1 = 1 - b1**count.astype(jnp.float32)
             bc2 = 1 - b2**jnp.maximum(var_updates, 1).astype(jnp.float32)
             step = (comp / bc1) / (jnp.sqrt(v_new / bc2) + eps)

@@ -238,17 +238,18 @@ class DeepSpeedEngine:
         # through the REAL compressed wire (runtime/comm/compressed.py) inside
         # a shard_map training step — see _build_compressed_train_step
         backend = params.pop("comm_backend_name", None)
-        if backend is not None and name == ZERO_ONE_ADAM_OPTIMIZER:
-            # 0/1 Adam keeps updating variance on a LOCAL-gradient schedule
-            # until var_freeze_step — per-worker exp_avg_sq would fork params
-            # under the transport's local-grad regime
-            logger.warning("ZeroOneAdam does not support compressed transport "
-                           "(variance schedule needs globally-averaged grads); "
-                           "using local compression numerics")
-            backend = None
-        if name in (ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER):
+        if name in (ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
             self._onebit_comm_backend = backend
-            self._onebit_freeze_step = int(params.get("freeze_step", 100))
+            if name == ZERO_ONE_ADAM_OPTIMIZER:
+                # 0/1 Adam has NO warmup — the momentum rides the compressed
+                # wire from step 0 (ref: zoadam.py), and its variance schedule
+                # is made wire-safe by updating exp_avg_sq from the
+                # POST-exchange reconstructed gradient (m_t - b1*m_{t-1})/(1-b1)
+                # — globally identical across workers — instead of the local
+                # grad (see ops/onebit.zero_one_adam)
+                self._onebit_freeze_step = 0
+            else:
+                self._onebit_freeze_step = int(params.get("freeze_step", 100))
             if self._compressed_transport_active():
                 from .comm.compressed import compressed_allreduce
                 from ..comm.mesh import DATA_AXIS
@@ -342,16 +343,11 @@ class DeepSpeedEngine:
         log_dist(f"ZeRO++ LoCo gradient transport active (err_beta={beta})", ranks=[0])
         return GradientTransformation(init, update)
 
-    def _nvme_pipelined_active(self) -> bool:
-        """True when optimizer states should live on NVMe with the pipelined
-        double-buffered swap (ref: swap_tensor/pipelined_optimizer_swapper.py):
-        offload_optimizer device=nvme + nvme_path, an Adam-family optimizer,
-        static-unity scaling and a single-device mesh (the per-group update
-        streams through host memory; the sharded multi-chip answer is ZeRO)."""
-        off = self._config.zero_config.offload_optimizer
-        if off is None or str(getattr(off, "device", "")) != "nvme" \
-                or not getattr(off, "nvme_path", None):
-            return False
+    def _streamed_offload_ok(self, what: str) -> bool:
+        """Shared eligibility for the DISPATCH-streamed offload tiers
+        (NVMe swap / host grouped): single-device mesh, Adam-family
+        optimizer, non-fp16 static-unity scaling — the per-group update
+        orchestration owns the step; the sharded multi-chip answer is ZeRO."""
         from .fp16.loss_scaler import StaticLossScaler
         name = (self._config.optimizer_config.type or "").lower() \
             if self._config.optimizer_config else "adamw"
@@ -361,11 +357,36 @@ class DeepSpeedEngine:
               and float(self.loss_scaler.init_scale) == 1.0
               and self.compute_dtype != jnp.float16)
         if not ok:
-            logger.warning("offload_optimizer device=nvme: pipelined swap needs a "
+            logger.warning(f"offload_optimizer {what}: the streamed update needs a "
                            "single-device mesh, Adam-family optimizer and non-fp16 "
                            "static-unity scaling — falling back to host memory-kind "
                            "offload")
         return ok
+
+    def _nvme_pipelined_active(self) -> bool:
+        """True when optimizer states should live on NVMe with the pipelined
+        double-buffered swap (ref: swap_tensor/pipelined_optimizer_swapper.py):
+        offload_optimizer device=nvme + nvme_path."""
+        off = self._config.zero_config.offload_optimizer
+        if off is None or str(getattr(off, "device", "")) != "nvme" \
+                or not getattr(off, "nvme_path", None):
+            return False
+        return self._streamed_offload_ok("device=nvme")
+
+    def _host_streamed_active(self) -> bool:
+        """True when optimizer states should live in TPU-host pinned memory
+        with the GROUPED multi-dispatch update (swap_tensor/
+        host_streamed_optimizer.py).  Selected by device=cpu +
+        pipeline_read/pipeline_write (the reference's pipelined-offload
+        knobs, ref: runtime/zero/offload_config.py:78) — the plain
+        device=cpu path keeps the single-program compute_on update, whose
+        HBM staging XLA does not bound (docs/PERF.md r4 receipts)."""
+        off = self._config.zero_config.offload_optimizer
+        if off is None or str(getattr(off, "device", "")) != "cpu" \
+                or not (getattr(off, "pipeline_read", False)
+                        or getattr(off, "pipeline_write", False)):
+            return False
+        return self._streamed_offload_ok("device=cpu pipelined")
 
     def _compressed_transport_active(self) -> bool:
         """True when the 1-bit momentum exchange should ride the compressed
@@ -458,12 +479,14 @@ class DeepSpeedEngine:
                                                    zero_axes=state_axes)
 
         nvme_pipe_early = self._nvme_pipelined_active()
+        host_stream_early = self._host_streamed_active() and not nvme_pipe_early
 
         @partial(jax.jit, out_shardings=None)
         def build_state(p):
-            if nvme_pipe_early:
-                # pipelined NVMe offload: master + moments live on DISK
-                # (PipelinedNVMeOptimizer); the device state is params-only
+            if nvme_pipe_early or host_stream_early:
+                # dispatch-streamed offload: master + moments live on DISK
+                # (PipelinedNVMeOptimizer) or in host pinned memory
+                # (HostStreamedOptimizer); the device state is params-only
                 master, opt_state = (), ()
             else:
                 master = jax.tree.map(lambda x: x.astype(jnp.float32), p) if use_master else ()
@@ -508,7 +531,8 @@ class DeepSpeedEngine:
 
         offload = self._config.zero_config.offload_optimizer
         nvme_pipe = nvme_pipe_early  # computed once above (warns on fallback)
-        if offload is not None and offload.device in ("cpu", "nvme") and not nvme_pipe:
+        streamed = nvme_pipe or host_stream_early
+        if offload is not None and offload.device in ("cpu", "nvme") and not streamed:
             if use_master:
                 master_sh, opt_sh = try_host_offload("offload_optimizer", master_sh, opt_sh)
             else:
@@ -524,7 +548,7 @@ class DeepSpeedEngine:
         self.state_shardings = TrainState(
             step=repl,
             params=param_sh,
-            master=master_sh if use_master and not nvme_pipe else (),
+            master=master_sh if use_master and not streamed else (),
             opt_state=opt_sh,
             scaler=jax.tree.map(lambda _: repl, abs_state.scaler),
             skipped_steps=repl,
@@ -544,6 +568,16 @@ class DeepSpeedEngine:
                 self.opt, jax.tree.leaves(self.state.params),
                 self._config.zero_config.offload_optimizer.nvme_path,
                 compute_dtype=self.compute_dtype)
+        elif host_stream_early and not abstract and getattr(self, "_nvme_opt", None) is None:
+            # same orchestration (_nvme_train_step), host-memory storage tier;
+            # buffer_count sizes the partition exactly as it does for the
+            # NVMe tier (ref: offload_config.py buffer_count) — more groups
+            # = smaller HBM staging per dispatch
+            from .swap_tensor.host_streamed_optimizer import HostStreamedOptimizer
+            self._nvme_opt = HostStreamedOptimizer(
+                self.opt, jax.tree.leaves(self.state.params),
+                n_groups=max(1, self._config.zero_config.offload_optimizer.buffer_count),
+                compute_dtype=self.compute_dtype, mesh=self.mesh)
         n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(abs_params))
         log_dist(f"Initialized TrainState: {n_params/1e6:.1f}M params, zero_stage={self.zero_stage}"
                  f"{' (abstract)' if abstract else ''}", ranks=[0])
@@ -1043,7 +1077,8 @@ class DeepSpeedEngine:
 
     def _build_train_step(self, batch):
         if getattr(self, "_nvme_opt", None) is not None or \
-                (getattr(self, "_abstract_state", False) and self._nvme_pipelined_active()):
+                (getattr(self, "_abstract_state", False)
+                 and (self._nvme_pipelined_active() or self._host_streamed_active())):
             # abstract (compile_aot) engines build the nvme grad-step program
             # too: the normal path would feed the () opt_state to opt.update
             return self._build_nvme_train_step(batch)
@@ -1399,16 +1434,28 @@ class DeepSpeedEngine:
         self._offloaded = {}
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True, exclude_frozen_parameters=False):
-        if getattr(self, "_nvme_opt", None) is not None:
-            # the optimizer state lives on NVMe; the checkpoint captures
-            # params + step, and resume re-reads the swap files at
-            # nvme_path (they are flushed durable here)
-            self._nvme_opt.swapper.flush_writes()
+        from .swap_tensor.host_streamed_optimizer import HostStreamedOptimizer
+        nv = getattr(self, "_nvme_opt", None)
+        if nv is not None and not isinstance(nv, HostStreamedOptimizer):
+            # NVMe tier: optimizer state lives on disk already; the
+            # checkpoint captures params + step, and resume re-reads the
+            # swap files at nvme_path (they are flushed durable here)
+            nv.swapper.flush_writes()
             logger.warning("save_checkpoint with pipelined NVMe offload: optimizer "
                            "moments stay in the nvme_path swap files — keep that "
                            "directory alongside the checkpoint to resume exactly")
         from ..checkpoint.engine import save_checkpoint as _save
-        return _save(self, save_dir, tag=tag, client_state=client_state or {}, save_latest=save_latest)
+        out = _save(self, save_dir, tag=tag, client_state=client_state or {}, save_latest=save_latest)
+        if isinstance(nv, HostStreamedOptimizer):
+            # host tier: state is process RAM — persist it INTO the tag dir
+            # (unlike NVMe swap files, nothing else makes it durable); the
+            # default tag here matches checkpoint/engine.save_checkpoint's
+            import os
+            tag_dir = os.path.join(os.path.abspath(save_dir),
+                                   str(tag) if tag is not None
+                                   else f"global_step{self.global_steps}")
+            nv.save_state(tag_dir)
+        return out
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
@@ -1416,16 +1463,31 @@ class DeepSpeedEngine:
         out = _load(self, load_dir, tag=tag, load_optimizer_states=load_optimizer_states,
                     load_module_only=load_module_only)
         if getattr(self, "_nvme_opt", None) is not None and self.state is not None:
-            # the disk-resident fp32 master must correspond to the restored
+            from .swap_tensor.host_streamed_optimizer import HostStreamedOptimizer
+            nv = self._nvme_opt
+            if isinstance(nv, HostStreamedOptimizer) and load_optimizer_states:
+                # host tier: restore the group state persisted into the tag
+                # dir by save_checkpoint
+                import os
+                resolved = tag
+                if resolved is None:
+                    latest = os.path.join(os.path.abspath(load_dir), "latest")
+                    if os.path.exists(latest):
+                        with open(latest) as f:
+                            resolved = f.read().strip()
+                tag_dir = os.path.join(os.path.abspath(load_dir), str(resolved))
+                if nv.load_state(tag_dir):
+                    return out
+            # the offloaded fp32 master must correspond to the restored
             # params — otherwise the first step would silently revert the
-            # loaded weights to whatever the swap files held (e.g. the
-            # random init written at materialization)
+            # loaded weights to whatever the store held (e.g. the random
+            # init written at materialization)
             leaves = jax.tree.leaves(self.state.params)
-            if not self._nvme_opt.master_matches_params(leaves, self.compute_dtype):
-                logger.warning("pipelined NVMe offload: swap files do not match the "
-                               "loaded checkpoint — reinitializing disk master from "
-                               "the restored weights (Adam moments reset to zero)")
-                self._nvme_opt.resync_master_from_params(leaves)
+            if not nv.master_matches_params(leaves, self.compute_dtype):
+                logger.warning("streamed optimizer offload: stored state does not match "
+                               "the loaded checkpoint — reinitializing master from the "
+                               "restored weights (Adam moments reset to zero)")
+                nv.resync_master_from_params(leaves)
         return out
 
     # ------------------------------------------------------------- properties

@@ -1,0 +1,233 @@
+"""Grouped host-streamed optimizer states (ZeRO-Infinity CPU tier).
+
+Reference: ``deepspeed/runtime/zero/stage_1_and_2.py`` CPU offload +
+``csrc/adam/cpu_adam_impl.cpp`` — fp32 master/moments live in host memory
+and the update touches them in bounded pieces, never materializing the
+whole state beside the model.
+
+TPU-native problem this solves (r4's receipts, docs/PERF.md): XLA will not
+bound HBM staging for host-resident state inside ONE program — a
+whole-tree update against ``pinned_host`` gets every host→HBM pull
+hoisted to the program top, ``optimization_barrier`` chains are ignored
+by buffer assignment, and ``compute_on("device_host")`` still stages its
+I/O through HBM.  So the bounding is done at the DISPATCH level instead:
+the fp32 master + Adam moments are partitioned into byte-balanced leaf
+groups held as ``pinned_host`` jax Arrays (resident in the TPU host's
+RAM — transfers never cross a client tunnel), and each training step runs
+one small jitted update program per group with the host buffers donated.
+Per-dispatch HBM staging is bounded by the group's bytes; dispatches are
+async, so group g+1's host→HBM pull overlaps group g's compute (the
+pipelined-swapper overlap, with XLA's transfer engine in place of aio
+threads).
+
+Interface-compatible with ``PipelinedNVMeOptimizer`` so the engine's
+``_nvme_train_step`` orchestration (fwd/bwd program + grouped update loop)
+drives either storage tier.  Selected by
+``offload_optimizer: {device: cpu, pipeline_read: true}`` on a
+single-device mesh (the multi-chip answer is ZeRO sharding, not offload).
+"""
+
+from collections import deque
+from typing import Any, Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.logging import log_dist
+
+
+class _NoopSwapper:
+    """Duck-typed stand-in for the NVMe swapper's flush surface: host
+    arrays are always durable (nothing is in flight on aio threads)."""
+
+    def flush_writes(self):
+        pass
+
+    def teardown(self):
+        pass
+
+
+class HostStreamedOptimizer:
+    """fp32 master + Adam moments in TPU-host pinned memory, updated by
+    per-group dispatches with donated host buffers."""
+
+    def __init__(self, opt, param_leaves, n_groups: int = 8,
+                 compute_dtype=jnp.bfloat16, mesh=None):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ...comm.mesh import get_global_mesh
+        self.opt = opt
+        self.compute_dtype = compute_dtype
+        mesh = mesh if mesh is not None else get_global_mesh()
+        self._dev_sh = NamedSharding(mesh, P())
+        self._host_sh = self._dev_sh.with_memory_kind("pinned_host")
+        try:  # same probe as the engine's try_host_offload: CPU test
+            # backends have no pinned_host memory kind — the grouped
+            # dispatch structure (and its numerics) is identical, the
+            # state just stays in device space there
+            jax.jit(lambda x: x, out_shardings=self._host_sh) \
+                .lower(jax.ShapeDtypeStruct((1, ), jnp.float32)).compile()
+        except Exception:
+            log_dist("HostStreamedOptimizer: pinned_host unsupported on this "
+                     "backend; grouped state stays in device memory", ranks=[0])
+            self._host_sh = self._dev_sh
+        self.swapper = _NoopSwapper()
+        self.events = deque(maxlen=512)
+        self._update_fns: Dict[int, Callable] = {}
+
+        # byte-balanced contiguous leaf partition (same policy as the NVMe
+        # swapper so group sizes, and therefore the HBM staging bound, are
+        # predictable: ~total_fp32_bytes x 3 / n_groups per dispatch)
+        sizes = [int(np.prod(l.shape)) * 4 for l in param_leaves]
+        target = max(1, sum(sizes) // max(1, n_groups))
+        self.groups: List[List[int]] = []
+        cur, acc = [], 0
+        for i, s in enumerate(sizes):
+            cur.append(i)
+            acc += s
+            if acc >= target and len(self.groups) < n_groups - 1:
+                self.groups.append(cur)
+                cur, acc = [], 0
+        if cur:
+            self.groups.append(cur)
+        self.n_groups = len(self.groups)
+
+        # initialize host-resident state leaf-by-leaf: the fp32 master is
+        # cast on device and streamed out (one leaf of HBM at a time, never
+        # the whole fp32 tree); moments are born in host space
+        to_host_f32 = jax.jit(lambda p: p.astype(jnp.float32), out_shardings=self._host_sh)
+        self._master: List[List[Any]] = []
+        self._mu: List[List[Any]] = []
+        self._nu: List[List[Any]] = []
+        for idxs in self.groups:
+            ms, mus, nus = [], [], []
+            for i in idxs:
+                p = param_leaves[i]
+                ms.append(to_host_f32(p))
+                zeros = jax.jit(lambda p=p: jnp.zeros(p.shape, jnp.float32),
+                                out_shardings=self._host_sh)()
+                mus.append(zeros)
+                nus.append(jax.jit(lambda p=p: jnp.zeros(p.shape, jnp.float32),
+                                   out_shardings=self._host_sh)())
+            self._master.append(ms)
+            self._mu.append(mus)
+            self._nu.append(nus)
+        jax.block_until_ready(self._master[-1][-1])
+        gb = sum(sizes) * 3 / 1e9
+        log_dist(f"HostStreamedOptimizer: {len(param_leaves)} leaves in "
+                 f"{self.n_groups} groups, {gb:.1f} GB fp32 state in host memory, "
+                 f"~{gb / self.n_groups:.1f} GB HBM staging per dispatch", ranks=[0])
+
+    def _group_update(self, g: int):
+        if g not in self._update_fns:
+            from ...ops.adam import AdamState
+            n = len(self.groups[g])
+            host, dev = self._host_sh, self._dev_sh
+
+            def upd(master, mu, nu, grads, count, scale):
+                # explicit host→HBM pulls INSIDE the program (mixed host/
+                # device operands are rejected by the compute ops); bounded
+                # to this group's bytes — the whole point of the dispatch
+                # split
+                pull = lambda xs: [jax.device_put(x, dev) for x in xs]
+                master, mu, nu = pull(master), pull(mu), pull(nu)
+                g32 = [x.astype(jnp.float32) * scale for x in grads]
+                updates, st = self.opt.update(g32, AdamState(count, mu, nu), master)
+                new_master = [m + u for m, u in zip(master, updates)]
+                new_params = [m.astype(self.compute_dtype) for m in new_master]
+                return new_master, st.exp_avg, st.exp_avg_sq, new_params
+
+            self._update_fns[g] = jax.jit(
+                upd,
+                donate_argnums=(0, 1, 2),
+                in_shardings=([host] * n, [host] * n, [host] * n, [dev] * n, dev, dev),
+                out_shardings=([host] * n, [host] * n, [host] * n, [dev] * n))
+        return self._update_fns[g]
+
+    def pending_writes(self) -> int:
+        return 0  # host buffers: nothing in flight past dispatch
+
+    def step(self, grad_leaves, count, clip_scale):
+        """Per-group update sweep.  Returns new compute-dtype param leaves
+        (device), original leaf order.  Dispatches are async: group g+1's
+        host pulls overlap group g's compute on the transfer engine."""
+        new_params: List[Any] = [None] * sum(len(g) for g in self.groups)
+        for g, idxs in enumerate(self.groups):
+            self.events.append(("prefetch_issue", g))  # dispatch == prefetch here
+            nm, nmu, nnu, np_leaves = self._group_update(g)(
+                self._master[g], self._mu[g], self._nu[g],
+                [grad_leaves[i] for i in idxs], count, clip_scale)
+            self.events.append(("update_done", g))
+            self._master[g], self._mu[g], self._nu[g] = nm, nmu, nnu
+            self.events.append(("writeback_issue", g))
+            for i, p in zip(idxs, np_leaves):
+                new_params[i] = p
+        return new_params
+
+    # ------------------------------------------------- checkpoint surface
+
+    def master_matches_params(self, param_leaves, compute_dtype) -> bool:
+        """One representative leaf per group, compared in compute dtype
+        (params were cast from exactly this master on a true resume)."""
+        for g, idxs in enumerate(self.groups):
+            disk = np.asarray(jax.device_get(self._master[g][0]),
+                              np.float32).astype(compute_dtype)
+            live = np.asarray(jax.device_get(param_leaves[idxs[0]]))
+            if disk.shape != live.shape or not np.array_equal(disk, live):
+                return False
+        return True
+
+    def resync_master_from_params(self, param_leaves):
+        to_host_f32 = jax.jit(lambda p: p.astype(jnp.float32), out_shardings=self._host_sh)
+        zeros_like_host = jax.jit(lambda p: jnp.zeros_like(p, jnp.float32),
+                                  out_shardings=self._host_sh)
+        for g, idxs in enumerate(self.groups):
+            self._master[g] = [to_host_f32(param_leaves[i]) for i in idxs]
+            self._mu[g] = [zeros_like_host(param_leaves[i]) for i in idxs]
+            self._nu[g] = [zeros_like_host(param_leaves[i]) for i in idxs]
+
+    def state_dict_host(self):
+        out = []
+        for g in range(self.n_groups):
+            out.append({"master": [np.asarray(jax.device_get(x)) for x in self._master[g]],
+                        "mu": [np.asarray(jax.device_get(x)) for x in self._mu[g]],
+                        "nu": [np.asarray(jax.device_get(x)) for x in self._nu[g]]})
+        return out
+
+    # checkpoint persistence: UNLIKE the NVMe tier (whose swap files are
+    # already durable on disk), host-tier state lives in process RAM — the
+    # engine persists it into the checkpoint tag directory
+    def save_state(self, directory: str):
+        import os
+        for g in range(self.n_groups):
+            arrs = {}
+            for name, store in (("master", self._master), ("mu", self._mu), ("nu", self._nu)):
+                for i, x in enumerate(store[g]):
+                    arrs[f"{name}_{i}"] = np.asarray(jax.device_get(x))
+            np.savez(os.path.join(directory, f"host_opt_group{g}.npz"), **arrs)
+
+    def load_state(self, directory: str) -> bool:
+        """Restore group state saved by ``save_state``; False when the files
+        are absent or shaped for a different partitioning."""
+        import os
+        loads = []
+        for g in range(self.n_groups):
+            path = os.path.join(directory, f"host_opt_group{g}.npz")
+            if not os.path.exists(path):
+                return False
+            with np.load(path) as z:
+                grp = {name: [z[f"{name}_{i}"] for i in range(len(self.groups[g]))]
+                       for name in ("master", "mu", "nu")}
+            if any(g_arr.shape != np.asarray(jax.device_get(cur)).shape
+                   for g_arr, cur in zip(grp["master"], self._master[g])):
+                return False
+            loads.append(grp)
+        for g, grp in enumerate(loads):
+            self._master[g] = [jax.device_put(x, self._host_sh) for x in grp["master"]]
+            self._mu[g] = [jax.device_put(x, self._host_sh) for x in grp["mu"]]
+            self._nu[g] = [jax.device_put(x, self._host_sh) for x in grp["nu"]]
+        return True
+
+    def teardown(self):
+        self._master = self._mu = self._nu = []

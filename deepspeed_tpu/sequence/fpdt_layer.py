@@ -46,15 +46,22 @@ def update_out_and_lse(out, lse, block_out, block_lse):
     return out_new, lse_new
 
 
-def _chunk_partials(q32, k_chunk, v_chunk, q_pos, k_pos, scale, causal):
+def _chunk_partials(q, k_chunk, v_chunk, q_pos, k_pos, scale, causal):
     """(out, lse) partials of one q-block × kv-chunk product.
-    q32: [B, Sq, H, D]; k/v_chunk: [B, C, Hkv, D] → out [B,H,Sq,D], lse [B,H,Sq]."""
-    nh, nkv = q32.shape[2], k_chunk.shape[2]
+    q: [B, Sq, H, D]; k/v_chunk: [B, C, Hkv, D] → out [B,H,Sq,D], lse [B,H,Sq].
+
+    The matmuls keep their STORAGE dtype operands with f32 accumulation —
+    bf16 inputs run the MXU at full rate; the r4 version upcast q AND k to
+    f32 first, running both einsums at ~1/8 MXU throughput, which is most
+    of why FPDT measured 3.95x slower than flash at 32k (BENCH_LONGCTX r4).
+    The softmax bookkeeping (max/exp/log) stays f32."""
+    nh, nkv = q.shape[2], k_chunk.shape[2]
     if nkv != nh:
         rep = nh // nkv
         k_chunk = jnp.repeat(k_chunk, rep, axis=2)
         v_chunk = jnp.repeat(v_chunk, rep, axis=2)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_chunk.astype(jnp.float32)) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_chunk,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]
         s = jnp.where(mask[None, None], s, _NEG_INF)
@@ -63,7 +70,8 @@ def _chunk_partials(q32, k_chunk, v_chunk, q_pos, k_pos, scale, causal):
     if causal:
         p = jnp.where(mask[None, None], p, 0.0)
     l = jnp.sum(p, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bhqd", p, v_chunk.astype(jnp.float32))
+    out = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v_chunk.dtype), v_chunk,
+                     preferred_element_type=jnp.float32)
     # normalise to a (out, lse) pair: out already implicitly scaled by exp(m)
     lse = m + jnp.log(jnp.maximum(l, 1e-30))
     out = out / jnp.maximum(l, 1e-30)[..., None]
@@ -83,7 +91,6 @@ def chunked_attention(q, k, v, *, chunk_size: int, causal: bool = True,
     assert sk % chunk_size == 0, f"Sk={sk} not divisible by chunk_size={chunk_size}"
     n_chunks = sk // chunk_size
     scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
-    q32 = q.astype(jnp.float32)
     q_pos = q_offset + jnp.arange(sq)
 
     k_chunks = k.reshape(b, n_chunks, chunk_size, *k.shape[2:]).swapaxes(0, 1)
@@ -103,23 +110,74 @@ def chunked_attention(q, k, v, *, chunk_size: int, causal: bool = True,
         out, lse = carry
         idx, k_c, v_c = inputs
         k_pos = k_offset + idx * chunk_size + jnp.arange(chunk_size)
-        c_out, c_lse = partials(q32, k_c, v_c, q_pos, k_pos)
+        c_out, c_lse = partials(q, k_c, v_c, q_pos, k_pos)
         return update_out_and_lse(out, lse, c_out, c_lse), None
+        # (a lax.cond skip of above-diagonal chunks was measured SLOWER on
+        # v5e — 441 vs 334 ms at S=32k attention fwd+bwd, the branch breaks
+        # the scan's software pipelining despite halving FLOPs; triangular
+        # savings come from the STAGED flash path in fpdt_attention instead)
 
     (out, lse), _ = jax.lax.scan(step, (out0, lse0),
                                  (jnp.arange(n_chunks), k_chunks, v_chunks))
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
+def _flash_group_ok(q, k, sq, sk):
+    """Staged-flash eligibility: the kernel path needs 128-aligned seq lens
+    and a TPU-lowerable environment; GQA handled kernel-natively."""
+    from ..ops.flash_attention import LANE
+    return sq % LANE == 0 and sk % LANE == 0
+
+
 def fpdt_attention(q, k, v, *, causal: bool = True, segment_ids=None,
                    query_chunk_size: int = 512, kv_chunk_size: int = 512,
-                   q_offset: int = 0, k_offset: int = 0):
-    """Double-chunked attention: outer scan over query chunks, inner scan
+                   q_offset: int = 0, k_offset: int = 0, use_flash: Optional[bool] = None,
+                   flash_groups: int = 8):
+    """Double-chunked attention: outer loop over query chunks, inner sweep
     over KV chunks (ref: FPDT_Attention:971 — both loops, minus the manual
-    host staging which remat/offload policies supply declaratively)."""
+    host staging which remat/offload policies supply declaratively).
+
+    STAGED-FLASH path (r5, default on TPU when shapes allow): the query
+    sequence splits into ``flash_groups`` groups and each group runs ONE
+    triangular Pallas flash call against its visible kv PREFIX
+    (``q_position_offset`` keeps causality exact in-kernel), wrapped in
+    ``jax.checkpoint`` so only the group OUTPUTS survive to the backward —
+    the FPDT memory profile at kernel-grade FLOPs.  The per-group prefix
+    also realises the triangle structurally: total work is
+    (G+1)/2G of the full square (a lax.cond skip inside the jnp scan was
+    measured SLOWER — it breaks scan pipelining).  The jnp double-scan
+    remains the fallback (CPU tests, ragged shapes, explicit
+    use_flash=False)."""
     if segment_ids is not None:
         raise NotImplementedError("fpdt_attention does not support segment_ids yet")
     b, sq, nh, hd = q.shape
+    sk = k.shape[1]
+    eligible = (causal and q_offset == 0 and k_offset == 0 and sq == sk
+                and _flash_group_ok(q, k, sq, sk))
+    if use_flash and not eligible:
+        # an explicit request must not silently drop offsets / assume sq==sk
+        raise ValueError(
+            "use_flash=True requires causal self-attention with q_offset=0, "
+            f"k_offset=0, sq == sk and 128-aligned lengths (got causal={causal}, "
+            f"q_offset={q_offset}, k_offset={k_offset}, sq={sq}, sk={sk})")
+    if use_flash is None:
+        use_flash = eligible
+    if use_flash:
+        from ..ops.flash_attention import flash_attention
+        G = flash_groups
+        while G > 1 and (sq % G or (sq // G) % 128):
+            G //= 2
+        glen = sq // G
+        outs = []
+        for g in range(G):
+            q_grp = jax.lax.slice_in_dim(q, g * glen, (g + 1) * glen, axis=1)
+            k_pfx = jax.lax.slice_in_dim(k, 0, (g + 1) * glen, axis=1)
+            v_pfx = jax.lax.slice_in_dim(v, 0, (g + 1) * glen, axis=1)
+            grp = jax.checkpoint(
+                lambda q_, k_, v_, off=g * glen: flash_attention(
+                    q_, k_, v_, causal=True, q_position_offset=off))
+            outs.append(grp(q_grp, k_pfx, v_pfx))
+        return jnp.concatenate(outs, axis=1)
     qc = min(query_chunk_size, sq)
     assert sq % qc == 0, f"Sq={sq} not divisible by query_chunk_size={qc}"
     n_q = sq // qc
@@ -178,7 +236,6 @@ def fpdt_host_offload_attention(q, k, v, *, chunk_size: int = 512, causal: bool 
     assert sk % chunk_size == 0, f"Sk={sk} not divisible by chunk_size={chunk_size}"
     n_chunks = sk // chunk_size
     scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
-    q32 = q.astype(jnp.float32)
     q_pos = q_offset + jnp.arange(sq)
     dev = _current_sharding(k.ndim, "device")
 
@@ -198,7 +255,7 @@ def fpdt_host_offload_attention(q, k, v, *, chunk_size: int = 512, causal: bool 
         k_c = jax.device_put(k_c, dev)   # host → HBM, one chunk
         v_c = jax.device_put(v_c, dev)
         k_pos = k_offset + idx * chunk_size + jnp.arange(chunk_size)
-        c_out, c_lse = partials(q32, k_c, v_c, q_pos, k_pos)
+        c_out, c_lse = partials(q, k_c, v_c, q_pos, k_pos)
         return update_out_and_lse(out, lse, c_out, c_lse), None
 
     (out, lse), _ = jax.lax.scan(step, (out0, lse0), jnp.arange(n_chunks))

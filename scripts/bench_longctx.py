@@ -90,6 +90,25 @@ def main():
             "distributed_32k_compile_proof": "MEMBUDGET.json:llama3_8b_ulysses32k",
         },
     }
+
+    # FPDT-only deep-context leg (r5): a context flash CANNOT reach on this
+    # chip.  flash at S=131072 OOMs at compile (flash_only remat still keeps
+    # every layer's kernel out+lse residuals: ~S*H*(D+128)*2B*L); FPDT's
+    # staged groups are jax.checkpoint'd so only group OUTPUTS survive to
+    # the backward — it trains where flash cannot.
+    s131 = 131072
+    try:
+        run("flash", s131, 1, steps=1, windows=1)
+        flash_131k = "unexpectedly fit"
+    except Exception as e:
+        flash_131k = f"OOM ({str(e)[:80]})"
+    tps_131k, _, _, loss_131k = run("fpdt", s131, 1, steps=1, windows=1)
+    out["extra"]["fpdt_only_131k"] = {
+        "seq": s131,
+        "fpdt_tokens_per_sec_per_chip": round(tps_131k / jax.device_count(), 1),
+        "loss_finite": bool(np.isfinite(loss_131k)),
+        "flash_at_131k": flash_131k,
+    }
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "BENCH_LONGCTX.json"), "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))

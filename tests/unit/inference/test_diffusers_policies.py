@@ -1,0 +1,107 @@
+"""unet/vae injection policies (ref: module_inject/containers/unet.py:13
+UNetPolicy, containers/vae.py VAEPolicy) — r4 verdict missing #5: the
+stable-diffusion corner of the container matrix."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.module_inject import UNetPolicy, VAEPolicy, diffusers_attention
+
+
+def _unet_sd(E=64, E_ctx=96, rng=None):
+    rng = rng or np.random.default_rng(0)
+    r = lambda *s: rng.normal(size=s).astype(np.float32) * 0.05
+    sd = {}
+    for block, kdim in (("down_blocks.0.attentions.0.transformer_blocks.0.attn1", E),
+                        ("down_blocks.0.attentions.0.transformer_blocks.0.attn2", E_ctx)):
+        sd[f"{block}.to_q.weight"] = r(E, E)
+        sd[f"{block}.to_k.weight"] = r(E, kdim)
+        sd[f"{block}.to_v.weight"] = r(E, kdim)
+        sd[f"{block}.to_out.0.weight"] = r(E, E)
+        sd[f"{block}.to_out.0.bias"] = r(E)
+    return sd
+
+
+def test_unet_policy_finds_and_classifies_blocks():
+    sd = _unet_sd()
+    # SD1.x-style: the head count comes from the caller (diffusers keeps it
+    # in module config, not in the weights)
+    blocks = UNetPolicy(num_heads=8).find_attention_blocks(sd)
+    assert len(blocks) == 2
+    a1 = blocks["down_blocks.0.attentions.0.transformer_blocks.0.attn1"]
+    a2 = blocks["down_blocks.0.attentions.0.transformer_blocks.0.attn2"]
+    # attn1 = self (fused qkv available), attn2 = cross (context K/V width)
+    assert a1["is_cross_attention"] is False and "query_key_value" in a1
+    assert a2["is_cross_attention"] is True and "query_key_value" not in a2
+    assert a1["query_key_value"]["kernel"].shape == (64, 8, 3, 8)
+    assert a2["k_proj"]["kernel"].shape == (96, 8, 8)
+
+
+def test_unet_attention_matches_naive_reference():
+    """The translated tree must compute EXACTLY what the diffusers weights
+    compute — transposes/reshapes verified by value, not just shape."""
+    rng = np.random.default_rng(1)
+    sd = _unet_sd(rng=rng)
+    blocks = UNetPolicy(num_heads=8).find_attention_blocks(sd)
+    prefix = "down_blocks.0.attentions.0.transformer_blocks.0.attn2"
+    tree = blocks[prefix]
+    B, N, M, E, E_ctx, H = 2, 6, 5, 64, 96, 8
+    x = rng.normal(size=(B, N, E)).astype(np.float32)
+    ctx = rng.normal(size=(B, M, E_ctx)).astype(np.float32)
+    got = np.asarray(diffusers_attention(tree, jnp.asarray(x), jnp.asarray(ctx)))
+
+    # naive torch-layout reference: y = softmax(q k^T / sqrt(d)) v, per head
+    D = E // H
+    q = (x @ sd[f"{prefix}.to_q.weight"].T).reshape(B, N, H, D)
+    k = (ctx @ sd[f"{prefix}.to_k.weight"].T).reshape(B, M, H, D)
+    v = (ctx @ sd[f"{prefix}.to_v.weight"].T).reshape(B, M, H, D)
+    s = np.einsum("bnhd,bmhd->bhnm", q, k) / np.sqrt(D)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    o = np.einsum("bhnm,bmhd->bnhd", p, v).reshape(B, N, E)
+    want = o @ sd[f"{prefix}.to_out.0.weight"].T + sd[f"{prefix}.to_out.0.bias"]
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_unet_policy_head_dim_convention_and_errors():
+    """SD2-style default: H = E // 64; indivisible dims raise instead of
+    silently mis-grouping heads."""
+    import pytest
+    rng = np.random.default_rng(3)
+    r = lambda *s: rng.normal(size=s).astype(np.float32)
+    E = 128
+    sd = {"mid.attn1.to_q.weight": r(E, E), "mid.attn1.to_k.weight": r(E, E),
+          "mid.attn1.to_v.weight": r(E, E), "mid.attn1.to_out.0.weight": r(E, E)}
+    blocks = UNetPolicy().find_attention_blocks(sd)  # head_dim=64 default
+    assert blocks["mid.attn1"]["q_proj"]["kernel"].shape == (E, 2, 64)
+    with pytest.raises(ValueError, match="head_dim"):
+        UNetPolicy(head_dim=48).find_attention_blocks(sd)
+    with pytest.raises(ValueError):
+        UNetPolicy(num_heads=48, head_dim=64)
+
+
+def test_vae_policy_both_namings():
+    rng = np.random.default_rng(2)
+    r = lambda *s: rng.normal(size=s).astype(np.float32) * 0.05
+    E = 32
+    legacy = {"encoder.mid_block.attentions.0.query.weight": r(E, E),
+              "encoder.mid_block.attentions.0.key.weight": r(E, E),
+              "encoder.mid_block.attentions.0.value.weight": r(E, E),
+              "encoder.mid_block.attentions.0.proj_attn.weight": r(E, E),
+              "encoder.mid_block.attentions.0.proj_attn.bias": r(E)}
+    modern = {"encoder.mid_block.attentions.0.to_q.weight": legacy["encoder.mid_block.attentions.0.query.weight"],
+              "encoder.mid_block.attentions.0.to_k.weight": legacy["encoder.mid_block.attentions.0.key.weight"],
+              "encoder.mid_block.attentions.0.to_v.weight": legacy["encoder.mid_block.attentions.0.value.weight"],
+              "encoder.mid_block.attentions.0.to_out.0.weight": legacy["encoder.mid_block.attentions.0.proj_attn.weight"],
+              "encoder.mid_block.attentions.0.to_out.0.bias": legacy["encoder.mid_block.attentions.0.proj_attn.bias"]}
+    pol = VAEPolicy()
+    b_old = pol.find_attention_blocks(legacy)
+    b_new = pol.find_attention_blocks(modern)
+    assert len(b_old) == 1 and len(b_new) == 1
+    t_old = list(b_old.values())[0]
+    t_new = list(b_new.values())[0]
+    # same weights through either naming → identical attention output
+    x = rng.normal(size=(1, 4, E)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(diffusers_attention(t_old, jnp.asarray(x))),
+                               np.asarray(diffusers_attention(t_new, jnp.asarray(x))),
+                               rtol=1e-6, atol=1e-6)

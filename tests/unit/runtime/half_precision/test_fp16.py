@@ -176,3 +176,216 @@ def test_fp16_gas_accumulates_in_fp32():
     l1 = [float(e1.train_batch(batch=b)) for _ in range(2)]
     l2 = [float(e2.train_batch(batch=b)) for _ in range(2)]
     np.testing.assert_allclose(l1, l2, rtol=3e-2, atol=3e-2)
+
+
+# --------------------------------------------------------------------------
+# r5 depth: mirror the reference's fused/unfused x optimizer x GAS x clip x
+# overflow-sequence matrix (ref tests/unit/runtime/half_precision/
+# test_fp16.py — 38 scenarios)
+
+
+def test_fp16_overflow_then_recovery_applies_next_step():
+    """After a skipped overflow step the NEXT finite step must apply: the
+    params change and skipped_steps stays at 1 (ref: fused_optimizer.py —
+    the skip must not wedge the optimizer)."""
+    engine = _engine(fp16={"enabled": True, "initial_scale_power": 20, "hysteresis": 1})
+    b = _batch()
+    engine._ensure_ready(b)
+    engine.train_batch(batch=b)
+    if int(engine.state.skipped_steps) == 0:
+        pytest.skip("2^20 scale did not overflow on this platform")
+    before = [np.asarray(l) for l in jax.tree.leaves(engine.state.params)]
+    # scale keeps halving on further overflows until grads turn finite
+    for _ in range(6):
+        engine.train_batch(batch=b)
+    after = jax.tree.leaves(engine.state.params)
+    assert any(not np.array_equal(x, np.asarray(y)) for x, y in zip(before, after)), \
+        "no step ever applied after the overflow"
+
+
+def test_fp16_hysteresis_delays_scale_drop():
+    """hysteresis=3: the first overflows consume hysteresis instead of
+    halving the scale (ref: DynamicLossScaler.delayed_shift)."""
+    e_h1 = _engine(fp16={"enabled": True, "initial_scale_power": 24, "hysteresis": 1})
+    e_h3 = _engine(fp16={"enabled": True, "initial_scale_power": 24, "hysteresis": 3})
+    b = _batch()
+    e_h1.train_batch(batch=b)
+    e_h3.train_batch(batch=b)
+    if int(e_h1.state.skipped_steps) == 0:
+        pytest.skip("no overflow at 2^24 on this platform")
+    # h1 halved immediately; h3 still at the initial scale after 1 overflow
+    assert float(e_h1.state.scaler.cur_scale) == 2.0**23
+    assert float(e_h3.state.scaler.cur_scale) == 2.0**24
+
+
+@pytest.mark.parametrize("zero", [1, 2])
+def test_fp16_static_scale_across_zero_stages(zero):
+    """ref TestZeroStaticScale: static scale x ZeRO stages trains finite
+    and the scale never moves."""
+    engine = _engine(fp16={"enabled": True, "loss_scale": 64.0}, zero=zero)
+    b = _batch()
+    losses = [float(engine.train_batch(batch=b)) for _ in range(3)]
+    assert all(np.isfinite(losses))
+    assert float(engine.state.scaler.cur_scale) == 64.0
+
+
+@pytest.mark.parametrize("opt", [
+    {"type": "AdamW", "params": {"lr": 1e-3}},
+    {"type": "FusedAdam", "params": {"lr": 1e-3}},
+    {"type": "Adagrad", "params": {"lr": 1e-2}},
+])
+def test_fp16_more_optimizer_combos(opt):
+    """ref TestFP16AdamTypes / TestAdamwFP16Basic: the fp16 wrapper works
+    for every fused optimizer family."""
+    engine = _engine(opt=opt)
+    b = _batch()
+    losses = [float(engine.train_batch(batch=b)) for _ in range(3)]
+    assert all(np.isfinite(losses)), (opt, losses)
+    assert losses[-1] < losses[0] + 0.1
+
+
+@pytest.mark.parametrize("zero", [1, 2])
+def test_fp16_cpu_offload_trains(zero):
+    """ref use_cpu_offload matrix legs: offload_optimizer device=cpu under
+    fp16 — the update pulls host states leaf-wise (ZeRO-Infinity streaming)
+    and still steps/skip-handles correctly."""
+    engine = _engine(zero=zero,
+                     extra={"zero_optimization": {"stage": zero,
+                                                  "offload_optimizer": {"device": "cpu"}}})
+    b = _batch()
+    losses = [float(engine.train_batch(batch=b)) for _ in range(3)]
+    assert all(np.isfinite(losses))
+
+
+def test_fp16_lamb_fp32_grad_clip_analog():
+    """ref TestLambFP32GradClip: Lamb + clipping in FULL precision trains
+    finite (the clip path must not assume a scaler exists)."""
+    config = {"train_batch_size": 8,
+              "optimizer": {"type": "Lamb", "params": {"lr": 1e-3}},
+              "gradient_clipping": 0.1,
+              "fp16": {"enabled": False}}
+    engine, _, _, _ = ds.initialize(model=LlamaForCausalLM(CFG), config=config)
+    b = _batch()
+    losses = [float(engine.train_batch(batch=b)) for _ in range(3)]
+    assert all(np.isfinite(losses))
+
+
+@pytest.mark.parametrize("gas", [2, 4])
+def test_fp16_clip_with_gas_matches_gas1(gas):
+    """clip x GAS cell of the matrix: clipping operates on the gas-summed,
+    unscaled grads, so trajectories match gas=1 on the same global batch
+    (fp16 noise compounds with gas — the tolerance covers re-chunked
+    half-precision accumulation, not algorithmic drift)."""
+    rng = np.random.default_rng(3)
+    bs = 8 * gas  # divisible by gas x dp(8) on the 8-device mesh
+    ids = rng.integers(0, 128, (bs, 16)).astype(np.int32)
+    b = {"input_ids": ids, "labels": ids}
+    e1 = _engine(fp16={"enabled": True, "loss_scale": 8.0},
+                 extra={"train_batch_size": bs, "gradient_clipping": 0.05})
+    eg = _engine(fp16={"enabled": True, "loss_scale": 8.0},
+                 extra={"train_batch_size": bs, "gradient_clipping": 0.05,
+                        "gradient_accumulation_steps": gas})
+    l1 = [float(e1.train_batch(batch=b)) for _ in range(2)]
+    lg = [float(eg.train_batch(batch=b)) for _ in range(2)]
+    np.testing.assert_allclose(l1, lg, rtol=6e-2, atol=6e-2)
+
+
+def test_fp16_predivide_factor_neutral_on_trajectory():
+    """gradient_predivide_factor pre-scales then the update math compensates
+    — same trajectory as without it (ref: config predivide semantics)."""
+    b = _batch()
+    e1 = _engine(fp16={"enabled": True, "loss_scale": 8.0})
+    e2 = _engine(fp16={"enabled": True, "loss_scale": 8.0},
+                 extra={"gradient_predivide_factor": 4.0})
+    l1 = [float(e1.train_batch(batch=b)) for _ in range(3)]
+    l2 = [float(e2.train_batch(batch=b)) for _ in range(3)]
+    # predivide rescales grads INTO the optimizer: Adam is scale-invariant
+    # up to eps, so early losses agree to fp noise
+    np.testing.assert_allclose(l1, l2, rtol=3e-2, atol=3e-2)
+
+
+def test_fp16_scheduler_compatibility():
+    """ref TestAdamFP16ZeroOneCycleCompatibility: an LR schedule under fp16
+    + ZeRO steps the LR while training stays finite."""
+    config = {"train_batch_size": 8,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "scheduler": {"type": "WarmupLR",
+                            "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-3,
+                                       "warmup_num_steps": 4}},
+              "zero_optimization": {"stage": 2},
+              "fp16": {"enabled": True}}
+    engine, _, _, _ = ds.initialize(model=LlamaForCausalLM(CFG), config=config)
+    b = _batch()
+    losses = [float(engine.train_batch(batch=b)) for _ in range(4)]
+    assert all(np.isfinite(losses))
+
+
+def test_fp16_loss_scale_zero_means_dynamic():
+    """ref config semantics: fp16.loss_scale == 0 selects DYNAMIC scaling."""
+    engine = _engine(fp16={"enabled": True, "loss_scale": 0,
+                           "initial_scale_power": 6})
+    engine.train_batch(batch=_batch())
+    from deepspeed_tpu.runtime.fp16.loss_scaler import DynamicLossScaler
+    assert isinstance(engine.loss_scaler, DynamicLossScaler)
+    assert float(engine.state.scaler.cur_scale) in (2.0**6, 2.0**5)
+
+
+def test_fp16_eval_forward_runs_half():
+    """the eval path under the fp16 engine returns a finite UNSCALED loss
+    (ref: engine.forward eval path shares the jitted fn, no loss scaling)."""
+    engine = _engine(fp16={"enabled": True, "loss_scale": 64.0})
+    b = _batch()
+    train_loss = float(engine.train_batch(batch=b))
+    eval_loss = float(engine.eval_batch(batch=b))
+    assert np.isfinite(eval_loss)
+    # eval loss is the raw loss, not the scaled one (64x would be obvious)
+    assert abs(eval_loss - train_loss) < 0.5 * abs(train_loss)
+
+
+def test_fp16_tensor_fragment_roundtrip():
+    """the r5 debug API under fp16: set_full writes master AND syncs the
+    fp16 compute copy."""
+    from deepspeed_tpu.utils import safe_get_full_fp32_param, safe_set_full_fp32_param
+    engine = _engine()
+    b = _batch()
+    engine.train_batch(batch=b)
+    path = "model/layers/self_attn/q_proj/kernel"
+    v = safe_get_full_fp32_param(engine, path)
+    safe_set_full_fp32_param(engine, path, v * 2.0)
+    got = safe_get_full_fp32_param(engine, path)
+    np.testing.assert_allclose(got, v * 2.0)
+    p16 = np.asarray(
+        jax.tree.leaves({"k": engine.state.params})[0]["model"]["layers"]["self_attn"]
+        ["q_proj"]["kernel"] if False else
+        engine.state.params["model"]["layers"]["self_attn"]["q_proj"]["kernel"],
+        np.float32)
+    np.testing.assert_allclose(p16, v * 2.0, rtol=1e-2, atol=1e-2)
+    loss = engine.train_batch(batch=b)
+    assert np.isfinite(float(loss))
+
+
+def test_fp16_skipped_steps_do_not_advance_optimizer_count():
+    """a skipped step must not advance the Adam bias-correction counter
+    (ref: fused_optimizer skips optimizer.step entirely on overflow)."""
+    engine = _engine(fp16={"enabled": True, "initial_scale_power": 20, "hysteresis": 1})
+    b = _batch()
+    for _ in range(2):
+        engine.train_batch(batch=b)
+    skipped = int(engine.state.skipped_steps)
+    if skipped == 0:
+        pytest.skip("no overflow at 2^20 on this platform")
+    count = int(np.asarray(jax.tree.leaves(
+        {"c": engine.state.opt_state.step if hasattr(engine.state.opt_state, "step")
+         else engine.state.opt_state[0]})[0]))
+    assert count == 2 - skipped
+
+
+def test_fp16_consecutive_hysteresis_restores():
+    """consecutive_hysteresis=True: a clean step restores the hysteresis
+    budget (ref: DynamicLossScaler.consecutive_hysteresis)."""
+    engine = _engine(fp16={"enabled": True, "initial_scale_power": 4,
+                           "hysteresis": 2, "consecutive_hysteresis": True})
+    b = _batch()
+    for _ in range(3):  # finite steps at a tiny scale — no overflow
+        engine.train_batch(batch=b)
+    assert int(engine.state.scaler.cur_hysteresis) == 2
