@@ -152,7 +152,7 @@ def llama3_8b_zero3_v5p64():
         backend=backend, zero_stage=3)
 
 
-def _serving_budget(tp, topology):
+def _serving_budget(tp, topology, preset="llama3-8b"):
     """FastGen-v2 serving step, TP-sharded over a v5p slice (the reference's
     headline serving mode: deepspeed/inference/v2/engine_v2.py:118 honors
     tp_size; blogs/deepspeed-fastgen serves Llama-2-70B at TP4).  Compiles
@@ -164,13 +164,19 @@ def _serving_budget(tp, topology):
     from deepspeed_tpu.models.llama import PRESETS
     from deepspeed_tpu.models.llama_cache import PagedKVConfig
 
+    import jax.numpy as jnp
     mesh, backend = _mesh(tp, topology=topology, data=1, tensor=tp)
     on_tpu = backend.startswith("v5")
-    cfg = dataclasses.replace(PRESETS["llama3-8b"],
+    cfg = dataclasses.replace(PRESETS[preset],
                               attention_impl="flash" if on_tpu else "reference",
+                              # serving holds bf16 weights (the live engine
+                              # casts at load); fp32 param_dtype would double
+                              # the budgeted weight bytes
+                              dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
                               scan_layers=True, remat=False)
-    # 2048 pages x 128 tokens = 262k KV tokens (64 concurrent seqs @ 4k ctx),
-    # 34 GB of bf16 KV total -> /tp per chip
+    # 2048 pages x 128 tokens = 262k KV tokens (64 concurrent seqs @ 4k ctx);
+    # bf16 K+V bytes = tokens x L x n_kv x hd x 2 x 2 (8B: 34 GB; 70B GQA
+    # 8 kv heads x 80 layers x 128 hd: 86 GB) -> /tp per chip
     kv = PagedKVConfig(num_pages=2048, page_size=128, max_pages_per_seq=32)
     eng_cfg = RaggedInferenceEngineConfig(kv=kv)
     metas = {}
@@ -179,7 +185,7 @@ def _serving_budget(tp, topology):
         ma = compiled.memory_analysis()
         metas[phase] = ma
     return metas, n_params, dict(
-        model="llama3-8b", mode="serving", tensor_parallel=tp, backend=backend,
+        model=preset, mode="serving", tensor_parallel=tp, backend=backend,
         kv_tokens=kv.num_pages * kv.page_size, kv_dtype="bfloat16",
         decode_batch=64, prefill_chunk=256)
 
@@ -192,6 +198,12 @@ def llama3_8b_serving_tp8():
     return _serving_budget(8, "v5p:2x2x2")
 
 
+def llama2_70b_serving_tp8():
+    """The reference FastGen HEADLINE workload (blogs/deepspeed-fastgen
+    serves Llama-2-70B TP-sharded): 70B over a v5p-8 slice."""
+    return _serving_budget(8, "v5p:2x2x2", preset="llama2-70b")
+
+
 CONFIGS = {
     "llama3_8b_zero3_v5p16": llama3_8b_zero3_v5p16,
     "llama3_8b_ulysses32k": llama3_8b_ulysses32k,
@@ -202,6 +214,7 @@ CONFIGS = {
 SERVING_CONFIGS = {
     "llama3_8b_serving_tp4": llama3_8b_serving_tp4,
     "llama3_8b_serving_tp8": llama3_8b_serving_tp8,
+    "llama2_70b_serving_tp8": llama2_70b_serving_tp8,
 }
 
 

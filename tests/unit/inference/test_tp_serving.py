@@ -102,6 +102,29 @@ def test_tensor_parallel_config_builds_mesh(trained_params):
     assert outs == single
 
 
+def test_compile_aot_serving_budget(trained_params):
+    """The no-hardware serving budget path (scripts/aot_membudget.py's
+    engine): AOT-compiles the TP-sharded step from ShapeDtypeStructs and
+    reports per-device memory — at tiny scale on the CPU mesh here, at
+    Llama-3-8B/TP8/v5p in MEMBUDGET.json."""
+    from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, compile_aot_serving
+    kv = PagedKVConfig(num_pages=64, page_size=8, max_pages_per_seq=8)
+    mesh = _tp_mesh(2)
+    compiled, n_params = compile_aot_serving(
+        CFG, mesh, RaggedInferenceEngineConfig(kv=kv, kv_dtype=jnp.float32),
+        batch=4, chunk=1)
+    ma = compiled.memory_analysis()
+    assert n_params > 0
+    # per-device argument bytes sit strictly BETWEEN half the unsharded
+    # total (everything halved would undershoot: norms/tables replicate)
+    # and the full total (nothing sharded) — the sharding is real
+    arena = CFG.num_hidden_layers * kv.num_pages * kv.page_size * 2 * \
+        CFG.num_key_value_heads * (CFG.hidden_size // CFG.num_attention_heads) * 4
+    total_unsharded = n_params * 4 + arena
+    assert total_unsharded / 2 < int(ma.argument_size_in_bytes) < total_unsharded
+    assert int(ma.peak_memory_in_bytes) > 0
+
+
 def test_tp2_continuous_batching_join_mid_flight(trained_params):
     """Scheduler/state manager must be oblivious to sharding: admit a new
     sequence while another decodes, both match single-device output."""
