@@ -25,6 +25,7 @@ copies.
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -125,8 +126,6 @@ def _gqa_native_ok(d, h, hk):
     # (rep ≤ 8 at d=128) stays native, Falcon-style 71q/1kv falls back
     return min_legal * rep * d <= 1024
 
-
-import os
 
 # Widest packed block (query heads x head_dim lanes) the packing heuristic
 # targets.  r5: the r4 kernels used the MINIMAL tile-legal width (2 heads at
