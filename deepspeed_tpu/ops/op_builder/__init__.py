@@ -83,10 +83,11 @@ class QuantizerBuilder(PallasOpBuilder):
 
 
 class FPQuantizerBuilder(PallasOpBuilder):
-    """ref: op_builder/fp_quantizer.py (csrc/fp_quantizer)."""
+    """ref: op_builder/fp_quantizer.py (csrc/fp_quantizer) — the e3m2/e5m6
+    bit-packing lives in linear/quantization.py."""
     BUILD_VAR = "DS_BUILD_FP_QUANTIZER"
     NAME = "fp_quantizer"
-    MODULE = "ops.fp_quantizer"
+    MODULE = "linear.quantization"
 
 
 class FlashAttnBuilder(PallasOpBuilder):
@@ -131,3 +132,18 @@ ALL_OPS = {
               CPUAdagradBuilder, QuantizerBuilder, FPQuantizerBuilder, FlashAttnBuilder, RaggedOpsBuilder,
               SparseAttnBuilder, RandomLTDBuilder)
 }
+
+
+_OP_NAME_ALIASES = {"async_io": "ds_aio"}  # upstream op name → ours
+
+
+def get_builder(class_name: str):
+    """Resolve a builder CLASS by its class name ('AsyncIOBuilder') or op
+    name ('ds_aio'; upstream's 'async_io' aliased) — the accelerator
+    interface's get_op_builder indirection (ref:
+    accelerator/cuda_accelerator.py get_op_builder importing from
+    op_builder per vendor dir)."""
+    for b in ALL_OPS.values():
+        if b.__name__ == class_name:
+            return b
+    return ALL_OPS.get(_OP_NAME_ALIASES.get(class_name, class_name))
