@@ -204,6 +204,13 @@ def llama2_70b_serving_tp8():
     return _serving_budget(8, "v5p:2x2x2", preset="llama2-70b")
 
 
+def llama2_70b_serving_tp4():
+    """The reference headline VERBATIM: Llama-2-70B over FOUR devices
+    (blogs/deepspeed-fastgen/README.md — 70B on 4xA100-80G; here 4 v5p
+    chips)."""
+    return _serving_budget(4, "v5p:2x2x1", preset="llama2-70b")
+
+
 CONFIGS = {
     "llama3_8b_zero3_v5p16": llama3_8b_zero3_v5p16,
     "llama3_8b_ulysses32k": llama3_8b_ulysses32k,
@@ -215,6 +222,7 @@ SERVING_CONFIGS = {
     "llama3_8b_serving_tp4": llama3_8b_serving_tp4,
     "llama3_8b_serving_tp8": llama3_8b_serving_tp8,
     "llama2_70b_serving_tp8": llama2_70b_serving_tp8,
+    "llama2_70b_serving_tp4": llama2_70b_serving_tp4,
 }
 
 
