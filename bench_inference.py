@@ -108,11 +108,19 @@ def main():
         if len(window_tps) >= 3 and abs(window_tps[-1] - window_tps[-2]) <= 0.1 * window_tps[-1]:
             stable = True
             break
-    agreed = [w for w in window_tps
-              if abs(w - window_tps[-1]) <= 0.1 * window_tps[-1]] if stable else window_tps
+    # same protocol as bench.py: once two consecutive windows agree, report
+    # ONLY the windows agreeing with the final one (a transient early
+    # slowdown must not drag the median); totals follow the same selection
+    # so the two headline numbers come from the same windows
+    if stable:
+        agreed_idx = [i for i, w in enumerate(window_tps)
+                      if abs(w - window_tps[-1]) <= 0.1 * window_tps[-1]]
+    else:
+        agreed_idx = list(range(len(window_tps)))
+    agreed = [window_tps[i] for i in agreed_idx]
     decode_tps = statistics.median(agreed)
     spread = (max(agreed) - min(agreed)) / decode_tps
-    total_tps = statistics.median(totals)
+    total_tps = statistics.median([totals[i] for i in agreed_idx])
 
     landmark = load_landmark("decode_tokens_per_sec")
     degraded_env = bool(landmark and decode_tps < 0.5 * landmark)
@@ -160,6 +168,7 @@ def main():
             "decode_s": round(dt, 3), "wall_s": round(wall, 3),
             "windows": [round(w, 1) for w in window_tps],
             "spread": round(spread, 3),
+            "unstable": not stable,
             "landmark": landmark,
             "degraded_env": degraded_env,
             "n_devices": jax.device_count(),

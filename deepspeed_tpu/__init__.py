@@ -10,6 +10,7 @@ Public API parity with the reference (``deepspeed/__init__.py``):
 
 __version__ = "0.1.0"
 
+from .utils import jax_compat  # noqa: F401  (must precede any jax-using submodule)
 from . import comm  # noqa: F401
 from .comm.comm import init_distributed  # noqa: F401
 from .runtime import zero  # noqa: F401  (ds.zero.Init / GatheredParameters parity)

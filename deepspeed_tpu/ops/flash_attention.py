@@ -128,23 +128,31 @@ def _gqa_native_ok(d, h, hk):
 
 
 # Widest packed block (query heads x head_dim lanes) the packing heuristic
-# targets.  r5: the r4 kernels used the MINIMAL tile-legal width (2 heads at
-# d=64), leaving the grid many small steps.  Measured on v5e at bench shapes
-# (B24 S1024 H12 D64, fwd+bwd, dispatch amortized in-program): Pk=2 8.22 ms,
-# Pk=4 7.86, Pk=6 7.77 (-5.5%), Pk=12 OOMs scoped VMEM (17.2M > 16M limit)
-# and Pk=12@bq256 8.27.  384 lanes → Pk=6 at d=64 while d=128 shapes keep
-# their r4 geometry (a 512-lane q block would need the target at 512+, which
-# re-OOMs the unrolled in-kernel head loop's scratch).
+# targets for SUB-LANE head dims (d < 128, where a single head is not
+# tile-legal on its own).  r5: the r4 kernels used the MINIMAL tile-legal
+# width (2 heads at d=64), leaving the grid many small steps.  Measured on
+# v5e at bench shapes (B24 S1024 H12 D64, fwd+bwd, dispatch amortized
+# in-program): Pk=2 8.22 ms, Pk=4 7.86, Pk=6 7.77 (-5.5%), Pk=12 OOMs
+# scoped VMEM (17.2M > 16M limit) and Pk=12@bq256 8.27.  384 lanes → Pk=6
+# at d=64.  Lane-aligned head dims (d % 128 == 0, e.g. d=128) bypass the
+# target entirely and keep their measured r4 geometry Pk=1 — widening them
+# to Pk=2/3 is an UNMEASURED shape class (and the in-kernel head loop's
+# scratch re-OOMs well before the wider block pays off).
 PACK_TARGET = int(os.environ.get("DS_FLASH_PACK_TARGET", "384"))
 
 
 def _pack_width(d, h, rep=1):
     """KV heads per block.  The packed minor dim must be tile-legal: a
     multiple of the 128-lane width (or ALL heads — a block equal to the
-    full array minor dim is always accepted).  Among the legal widths,
-    take the LARGEST whose query-side lane width (rep x kv heads x d)
-    stays within PACK_TARGET — per-grid-step work scales with the width
-    while per-step overhead is fixed."""
+    full array minor dim is always accepted).  Lane-aligned head dims take
+    the Pk=1 fast path: one head is already tile-legal, and that is the
+    geometry every d=128 measurement (r4/r5) was taken at — the PACK_TARGET
+    widening below is only measured for sub-lane dims.  Among the legal
+    sub-lane widths, take the LARGEST whose query-side lane width
+    (rep x kv heads x d) stays within PACK_TARGET — per-grid-step work
+    scales with the width while per-step overhead is fixed."""
+    if d % LANE == 0:
+        return 1
     legal = [p for p in range(1, h + 1)
              if h % p == 0 and ((p * d) % LANE == 0 or p == h)]
     fitting = [p for p in legal if p * rep * d <= PACK_TARGET]

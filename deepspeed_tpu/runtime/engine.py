@@ -1049,13 +1049,21 @@ class DeepSpeedEngine:
 
     def _nvme_train_step(self, batch):
         """Host-orchestrated step: device fwd/bwd (async), then the
-        double-buffered per-group update.  Step N's tail disk writes drain
+        double-buffered per-group update.  Step N's tail write-backs drain
         while step N+1's fwd/bwd dispatches (the overlap the reference gets
-        from its swap pipeline)."""
+        from its swap pipeline), and the FIRST groups' state uploads/reads
+        are issued here, right after the fwd/bwd dispatch, so they ride the
+        transfer engine (or aio threads) under the backward itself."""
         nv = self._nvme_opt
         nv.events.append(("step_entry_pending_writes", nv.pending_writes()))
         state = self.state
         grads, loss, gnorm = self._train_step_fn(state, batch)
+        # backward-phase prefetch: fwd/bwd is dispatched but (async) still
+        # running — stage the first groups now instead of at step boundary
+        mode = getattr(self, "_nvme_step_mode", None)
+        if mode != "serialize":
+            nv.prefetch(0)
+            nv.prefetch(1)
         inv = 1.0 / self.gas
         cfg = self._config
         if cfg.gradient_predivide_factor != 1.0:
@@ -1063,8 +1071,11 @@ class DeepSpeedEngine:
         scale = jnp.asarray(inv, jnp.float32)
         if cfg.gradient_clipping and cfg.gradient_clipping > 0:
             scale = scale * jnp.minimum(1.0, cfg.gradient_clipping / (gnorm + 1e-6))
+        self.timers(STEP_GLOBAL_TIMER).start()
         new_leaves = nv.step(jax.tree.leaves(grads), jnp.asarray(self.global_steps, jnp.int32),
-                             scale)
+                             scale, serialize=(mode == "serialize"),
+                             flush=(mode == "flush"))
+        self.timers(STEP_GLOBAL_TIMER).stop()
         tdef = jax.tree.structure(state.params)
         new_state = state._replace(params=jax.tree.unflatten(tdef, new_leaves),
                                    step=state.step + 1)
@@ -1267,6 +1278,27 @@ class DeepSpeedEngine:
         self._maybe_print(metrics)
         return metrics.loss
 
+    def measure_stream_overlap(self, batch, pipelined_steps: int = 1):
+        """Measure the streamed-optimizer pipeline's transfer/compute
+        overlap on real steps and return the artifact dict (see
+        overlap_instrumentation.report): per-group upload/compute/download
+        seconds, the aggregate overlap fraction, and the transfer-/compute-
+        bound floor.  Runs ``pipelined_steps`` normal (flushed) steps plus
+        one serialized probe step — these are REAL training steps (state
+        advances).  Requires an active streamed offload tier."""
+        assert getattr(self, "_nvme_opt", None) is not None, (
+            "measure_stream_overlap needs an active streamed optimizer tier "
+            "(offload_optimizer device=cpu+pipeline_read or device=nvme)")
+        try:
+            self._nvme_step_mode = "flush"
+            for _ in range(max(1, pipelined_steps)):
+                self.train_batch(batch=batch)
+            self._nvme_step_mode = "serialize"
+            self.train_batch(batch=batch)
+        finally:
+            self._nvme_step_mode = None
+        return self._nvme_opt.overlap_report()
+
     def _build_eval_fn(self):
         if self._eval_fn is None:
             def eval_loss(state, b):
@@ -1364,6 +1396,20 @@ class DeepSpeedEngine:
                 ("Train/Samples/lr", float(metrics.lr), self.global_samples),
                 ("Train/Samples/loss_scale", float(metrics.loss_scale), self.global_samples),
             ]
+            nv = getattr(self, "_nvme_opt", None)
+            ver = getattr(getattr(nv, "instrumentation", None), "version", 0)
+            if nv is not None and hasattr(nv, "overlap_report") \
+                    and ver != getattr(self, "_stream_report_ver", 0):
+                # streamed-optimizer overlap metrics: emitted once per FRESH
+                # measurement (probe/flushed step), not re-sent every step
+                rep = nv.overlap_report()
+                if rep is not None:
+                    for key in ("upload_s", "compute_s", "download_s",
+                                "overlap_fraction", "pipelined_wall_s"):
+                        if rep.get(key) is not None:
+                            events.append((f"Train/Samples/stream_{key}",
+                                           float(rep[key]), self.global_samples))
+                self._stream_report_ver = ver
             self.monitor.write_events(events)
 
     def _maybe_print(self, metrics):
@@ -1477,6 +1523,18 @@ class DeepSpeedEngine:
                             resolved = f.read().strip()
                 tag_dir = os.path.join(os.path.abspath(load_dir), str(resolved))
                 if nv.load_state(tag_dir):
+                    # a same-shaped host_opt_group*.npz from a DIFFERENT run
+                    # loads cleanly but its master would silently revert the
+                    # restored params on the first step — probe one leaf per
+                    # group and resync (moments reset, warned) on mismatch
+                    leaves = jax.tree.leaves(self.state.params)
+                    if not nv.master_matches_params(leaves, self.compute_dtype):
+                        logger.warning(
+                            "host-streamed offload: restored host_opt_group*.npz "
+                            "state does not correspond to the loaded checkpoint's "
+                            "params (same shapes, different run?) — reinitializing "
+                            "master from the restored weights (Adam moments reset)")
+                        nv.resync_master_from_params(leaves)
                     return out
             # the offloaded fp32 master must correspond to the restored
             # params — otherwise the first step would silently revert the

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...utils.logging import log_dist
+from .overlap_instrumentation import OverlapInstrumentation, now
 from .swapper import AioSwapConfig, PartitionedOptimizerSwapper
 
 
@@ -31,7 +32,10 @@ class PipelinedNVMeOptimizer:
     byte-balanced sub-groups of parameter leaves; ``step`` runs the
     double-buffered update loop.  ``events`` records the issue order
     (prefetch/update/writeback) so tests can assert the overlap structure
-    without depending on disk timing."""
+    without depending on disk timing; ``instrumentation`` additionally
+    timestamps every phase and ``step(serialize=True)`` runs the fenced
+    probe sweep that turns the overlap claim into measured per-group
+    read/compute/write seconds (same surface as HostStreamedOptimizer)."""
 
     def __init__(self, opt, param_leaves, nvme_path: str, n_groups: int = 4,
                  compute_dtype=jnp.bfloat16, aio: AioSwapConfig = AioSwapConfig()):
@@ -41,6 +45,7 @@ class PipelinedNVMeOptimizer:
         # bounded instrumentation ring (tests assert the double-buffer issue
         # order; production steps must not accumulate host memory)
         self.events = deque(maxlen=512)
+        self.instrumentation = OverlapInstrumentation()
         self._update_fns: Dict[int, Callable] = {}
 
         # byte-balanced contiguous leaf partition
@@ -165,33 +170,83 @@ class PipelinedNVMeOptimizer:
                 return False
         return True
 
-    def step(self, grad_leaves, count, clip_scale):
+    def prefetch(self, g: int) -> bool:
+        """Issue group ``g``'s disk read on the aio threads (idempotent —
+        the swapper tracks pending reads).  The engine calls this right
+        after dispatching the fwd/bwd program so the first groups' reads
+        overlap the BACKWARD instead of starting at the step boundary."""
+        if not (0 <= g < self.n_groups) or g in self.swapper._pending_in:
+            return False
+        self.events.append(("prefetch_issue", g))
+        self.instrumentation.record("upload_issue", g)
+        self.swapper.prefetch_group(g)
+        return True
+
+    def step(self, grad_leaves, count, clip_scale, serialize: bool = False,
+             flush: bool = False):
         """Double-buffered update sweep.  Returns the new compute-dtype
-        param leaves (device), in original leaf order."""
+        param leaves (device), in original leaf order.
+
+        ``serialize=True`` runs the instrumentation probe (fence after
+        every phase, blocking writes) attributing per-group read/compute/
+        write seconds; ``flush=True`` drains the tail writes and records
+        the pipelined wall time for measurement."""
+        if grad_leaves and (serialize or flush):
+            jax.block_until_ready(grad_leaves)
+        t0 = now()
         new_params: List[Optional[Any]] = [None] * sum(len(g) for g in self.groups)
-        self.swapper.prefetch_group(0)
-        self.events.append(("prefetch_issue", 0))
+        per_group = []
+        if not serialize:  # probe mode keeps reads sequential for attribution
+            self.prefetch(0)
         for g, idxs in enumerate(self.groups):
-            if g + 1 < self.n_groups:
+            if not serialize:
                 # next group's disk read rides the aio threads WHILE this
                 # group's update computes (the double buffer)
-                self.swapper.prefetch_group(g + 1)
-                self.events.append(("prefetch_issue", g + 1))
+                self.prefetch(g + 1)
+            tg0 = now()
+            # read stall: time the host actually waits on the aio threads —
+            # ~0 when the prefetch fully hid the read behind prior compute
             sub = self.swapper.swap_in_group(g)
+            tg1 = self.instrumentation.record("upload_done", g)
+            self.instrumentation.record("compute_issue", g)
             nm, nmu, nnu, np_leaves = self._group_update(g)(
                 sub["master"], sub["mu"], sub["nu"],
                 [grad_leaves[i] for i in idxs], count, clip_scale)
-            self.events.append(("update_done", g))
+            if serialize:
+                jax.block_until_ready(np_leaves)
+                tg2 = self.instrumentation.record("compute_done", g)
             for i, p in zip(idxs, np_leaves):
                 new_params[i] = p
+            # the device_get is this tier's natural compute fence (outputs
+            # stream d2h for the disk write)
             host_sub = {"master": [np.asarray(x) for x in jax.device_get(nm)],
                         "mu": [np.asarray(x) for x in jax.device_get(nmu)],
                         "nu": [np.asarray(x) for x in jax.device_get(nnu)]}
+            if not serialize:
+                tg2 = self.instrumentation.record("compute_done", g)
+            self.events.append(("update_done", g))
             # async write-back: drains while group g+1 updates — and the
             # LAST groups' writes drain while the next step's fwd/bwd runs
-            self.swapper.swap_out_group(g, host_sub, blocking=False)
+            self.swapper.swap_out_group(g, host_sub, blocking=serialize)
             self.events.append(("writeback_issue", g))
+            tg3 = self.instrumentation.record("download_issue", g)
+            if serialize:
+                per_group.append({"upload_s": tg1 - tg0, "compute_s": tg2 - tg1,
+                                  "download_s": tg3 - tg2})
+        if serialize:
+            self.instrumentation.set_probe(per_group, wall_s=now() - t0)
+        elif flush:
+            self.swapper.flush_writes()
+            done = self.instrumentation.events_of("compute_done")
+            self.instrumentation.set_step(
+                now() - t0,
+                compute_done_ts=[done[g] for g in range(self.n_groups) if g in done])
         return new_params
+
+    def overlap_report(self):
+        """Measured-overlap artifact; None until a ``serialize=True`` probe
+        sweep has run."""
+        return self.instrumentation.report()
 
     def state_dict_host(self):
         """Materialize the full optimizer state on host (checkpointing)."""

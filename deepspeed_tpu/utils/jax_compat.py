@@ -1,0 +1,37 @@
+"""Version compatibility shims for the installed jax.
+
+The codebase targets the current jax API surface; older installs (e.g.
+0.4.x) keep ``shard_map`` under ``jax.experimental``.  Importing this
+module (done at ``deepspeed_tpu`` package init, before any submodule
+touches jax) aliases the experimental symbol onto the top-level namespace
+so both ``jax.shard_map(...)`` and ``from jax import shard_map`` work
+everywhere, tests included.
+"""
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    try:
+        import functools
+
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        @functools.wraps(_shard_map)
+        def _shard_map_compat(*args, **kwargs):
+            # current jax names the replication check ``check_vma``; the
+            # experimental version called it ``check_rep``
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            return _shard_map(*args, **kwargs)
+
+        jax.shard_map = _shard_map_compat
+    except ImportError:  # pragma: no cover - nothing to shim against
+        pass
+
+if not hasattr(jax.lax, "axis_size"):
+    def _axis_size(axis_name):
+        # the classic idiom predating jax.lax.axis_size: a psum of a
+        # constant 1 over the named axis
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = _axis_size

@@ -31,6 +31,10 @@ if os.environ.get("DS_TPU_TESTS") != "1":
 else:
     import jax  # noqa: E402
 
+# older jax installs keep shard_map under jax.experimental; alias it before
+# any test module does `from jax import shard_map`
+from deepspeed_tpu.utils import jax_compat  # noqa: E402,F401
+
 import pytest  # noqa: E402
 
 

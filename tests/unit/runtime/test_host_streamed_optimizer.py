@@ -91,13 +91,20 @@ def test_step_and_events_order():
     from deepspeed_tpu.ops.adam import fused_adam
     opt = HostStreamedOptimizer(fused_adam(lr=1e-2), leaves, n_groups=2)
     grads = [jnp.ones_like(l) for l in leaves]
-    new = opt.step(grads, jnp.asarray(0, jnp.int32), jnp.asarray(1.0, jnp.float32))
+    new = opt.step(grads, jnp.asarray(0, jnp.int32), jnp.asarray(1.0, jnp.float32),
+                   flush=True)
     assert len(new) == 4 and all(p.dtype == jnp.bfloat16 for p in new)
     # params moved against the positive grads
     assert all(float(jnp.mean(n.astype(jnp.float32) - l.astype(jnp.float32))) < 0
                for n, l in zip(new, leaves))
-    kinds = [e[0] for e in opt.events]
-    assert kinds == ["prefetch_issue", "update_done", "writeback_issue"] * 2
+    # double-buffered pipeline issue order: BOTH uploads are issued before
+    # group 0's compute is dispatched (upload g+1 rides under compute g),
+    # download g before compute g+1, fences trail one group behind
+    kinds = [(e[0], e[1]) for e in opt.events]
+    assert kinds == [("upload_issue", 0), ("upload_issue", 1),
+                     ("compute_issue", 0), ("download_issue", 0),
+                     ("compute_issue", 1), ("download_issue", 1),
+                     ("update_done", 0), ("update_done", 1)]
 
 
 def test_engine_checkpoint_roundtrip_preserves_moments(tmp_path):
