@@ -183,9 +183,28 @@ def main():
     }
     print(json.dumps(result))
     # driver-visible artifact so serving perf is tracked round-over-round
-    # alongside BENCH_r{N}.json (VERDICT r2 weakness 6)
+    # alongside BENCH_r{N}.json (VERDICT r2 weakness 6).  r6: the file is
+    # owned by the SLA harness (scripts/bench_serving.py, schema v2) — this
+    # raw-throughput record rides in its "engine_throughput" section rather
+    # than clobbering the latency sweep
+    try:
+        with open("BENCH_SERVING.json") as f:
+            existing = json.load(f)
+    except Exception:
+        existing = None
+    if isinstance(existing, dict) and existing.get("schema_version", 0) >= 2:
+        existing["engine_throughput"] = result
+        payload = existing
+    else:
+        # legacy-shaped fallback: the tier-1 schema gate
+        # (scripts/check_bench_schema.py) will fail on it BY DESIGN — the
+        # fix is regenerating the sweep, not weakening the gate
+        print("# WARNING: no schema-v2 BENCH_SERVING.json found — wrote a legacy "
+              "record; run `python scripts/bench_serving.py` to regenerate the "
+              "SLA sweep (tier-1 schema check fails until then)", flush=True)
+        payload = result
     with open("BENCH_SERVING.json", "w") as f:
-        json.dump(result, f, indent=1)
+        json.dump(payload, f, indent=1)
 
 
 if __name__ == "__main__":

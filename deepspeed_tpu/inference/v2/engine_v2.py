@@ -311,6 +311,26 @@ class InferenceEngineV2:
         self.state.flush(uid)
         self._max_new.pop(uid, None)
 
+    def preempt(self, uid: int):
+        """Evict one sequence under KV pressure (serving frontend): pages
+        released, descriptor returned for requeue-with-tokens-preserved.
+        Unlike ``flush`` the uid must exist — preempting a finished/unknown
+        sequence is a frontend bug, not a no-op."""
+        self._max_new.pop(uid, None)
+        return self.state.preempt(uid)
+
+    def single_step_page_demand(self, plan: Optional[StepPlan] = None) -> int:
+        """KV pages the NEXT step needs beyond what its sequences hold, at
+        the guaranteed-progress rung (decode k=1 — the fused multi-decode
+        path already self-shrinks k under pressure in ``step``).  The
+        serving frontend preflights this against ``allocator.free_pages``
+        and preempts until the step fits, instead of letting ``pack`` raise
+        mid-step."""
+        if plan is None:
+            plan = self.scheduler.plan(self.state)
+        return (sum(self.kv.pages_needed(s, 1) for s in plan.decode) +
+                sum(self.kv.pages_needed(s, n) for s, n in plan.prefill))
+
     # --------------------------------------------------------------- step
 
     def _jit_kwargs(self):
@@ -411,12 +431,15 @@ class InferenceEngineV2:
         q = self.econfig.scheduler.decode_bucket
         return min(self.state.max_batch, -(-n // q) * q)
 
-    def step(self) -> Dict[int, List[int]]:
+    def step(self, plan: Optional[StepPlan] = None) -> Dict[int, List[int]]:
         """Run one scheduled step; returns {uid: [new tokens]} for
         sequences that produced tokens this call — one token per uid on
         the single-step path, up to ``decode_steps_per_dispatch`` on the
-        fused decode path."""
-        plan: StepPlan = self.scheduler.plan(self.state)
+        fused decode path.  ``plan`` lets a caller that already planned
+        (the serving frontend's KV-pressure preflight) skip the re-plan;
+        it must have been computed against the CURRENT state."""
+        if plan is None:
+            plan = self.scheduler.plan(self.state)
         k_cfg = self.econfig.decode_steps_per_dispatch
         if k_cfg > 1 and plan.decode and not plan.prefill:
             # OVERSHOOT policy (r4): always run the full k rung and discard

@@ -304,6 +304,17 @@ class StateManager:
         if seq is not None:
             self.kv.release(seq)
 
+    def preempt(self, uid: int) -> SequenceDescriptor:
+        """KV-pressure eviction: release ``uid``'s pages and drop its state,
+        returning the descriptor so the serving frontend can requeue the
+        request with its generated tokens preserved.  Full pages the
+        sequence published to the prefix cache keep the cache's refcount and
+        survive — a resume-prefill of the same token history reattaches them
+        via ``match()`` instead of recomputing their KV."""
+        seq = self.seqs.pop(uid)
+        self.kv.release(seq)
+        return seq
+
     def pack(self, work: List[Tuple[SequenceDescriptor, int]], chunk: int,
              pad_to: Optional[int] = None) -> RaggedBatch:
         """Pack (seq, n_tokens) work items into fixed [B, chunk] buffers.

@@ -37,15 +37,32 @@ class SplitFuseScheduler:
 
     def __init__(self, config: SchedulerConfig):
         self.config = config
+        # optional ordering hook (the serving frontend installs FCFS-with-
+        # aging here): ``order_key(seq) -> sortable``, lowest served first.
+        # None keeps dict-insertion (put) order — the historical behaviour
+        # for direct engine users.
+        self.order_key = None
 
     def plan(self, manager: StateManager) -> StepPlan:
         cfg = self.config
         running = [s for s in manager.seqs.values() if not s.done]
+        if self.order_key is not None:
+            running.sort(key=self.order_key)
         decodes = [s for s in running if s.in_decode]
         prefills = [s for s in running if s.in_prefill and not s.in_decode]
 
         decodes = decodes[:cfg.max_seqs]
-        budget = cfg.token_budget - len(decodes)
+        # TOKEN BUDGET charges the BUCKETED decode count: the compiled step
+        # pads the batch to a decode_bucket multiple, and the padded rows
+        # flow through the whole program whether or not they carry tokens.
+        # The SEQUENCE-SLOT bound below keeps the RAW count — the engine
+        # buckets the COMBINED decode+prefill work (_bucket_batch), so a
+        # prefill can ride in a padding slot; charging bucketed decode there
+        # would starve prefill whenever decode_bucket approaches max_seqs
+        n_bucketed = min(cfg.max_seqs,
+                         -(-len(decodes) // cfg.decode_bucket) * cfg.decode_bucket) \
+            if decodes else 0
+        budget = cfg.token_budget - n_bucketed
 
         plan_prefill: List[Tuple[SequenceDescriptor, int]] = []
         for seq in prefills:
@@ -53,7 +70,11 @@ class SplitFuseScheduler:
                 break
             n = min(seq.remaining_prefill, cfg.prefill_chunk, budget)
             if n <= 0:
-                break
+                # defensive: unreachable under the current filters (prefills
+                # all have remaining_prefill >= 1, budget > 0 checked above)
+                # — but a zero-work seq must SKIP, not break: breaking would
+                # starve every sequence queued behind it
+                continue
             plan_prefill.append((seq, n))
             budget -= n
         return StepPlan(decode=decodes, prefill=plan_prefill)

@@ -99,13 +99,26 @@ class ZeroOneAdamState(NamedTuple):
 
 
 def zero_one_adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0, compress_fn=None,
+                  var_allreduce_fn=None,
                   var_freeze_step: int = 100000, var_update_scaler: int = 16,
                   local_step_scaler: int = 32678, local_step_clipper: int = 16,
                   **_ignored) -> GradientTransformation:
     """ref: runtime/fp16/onebit/zoadam.py:14 ZeroOneAdam (0/1 Adam) — the
     variance is updated only at exponentially-spaced intervals (doubling
     every ``var_update_scaler`` updates) until ``var_freeze_step``, and the
-    momentum is always error-feedback compressed (no warmup)."""
+    momentum is always error-feedback compressed (no warmup).
+
+    ``var_allreduce_fn(grad) -> global mean grad``: the reference updates
+    ``exp_avg_sq`` from the UNCOMPRESSED allreduced gradient on var-interval
+    steps (zoadam.py exchanges the raw grad there).  When the wire transport
+    is active the engine passes an fp32 pmean here; it runs under
+    ``lax.cond`` so the uncompressed exchange is only paid on the
+    exponentially-rare var-due steps.  Without it (wire active but no
+    allreduce handle) the variance falls back to the gradient reconstructed
+    from the post-exchange momentum, (m_t - b1·m_{t-1})/(1-b1) — still
+    globally identical across workers, but it folds the sign-quantization /
+    error-feedback noise into the squared term, biasing exp_avg_sq upward
+    (an ACCEPTED deviation when no uncompressed wire exists)."""
     b1, b2 = betas
 
     def init(params):
@@ -132,13 +145,20 @@ def zero_one_adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0, compr
             g = g.astype(jnp.float32)
             m_new = b1 * m + (1 - b1) * g
             comp, e_new = (compress_fn or _sign_compress_ef)(m_new, e)
-            if compress_fn is not None:
-                # WIRE transport: the local grad differs per worker, so a
-                # variance update from it would fork exp_avg_sq (and then
-                # params) across ranks.  Reconstruct the globally-averaged
-                # gradient from the post-exchange momentum — identical on
-                # every worker — the 0/1 Adam paper's compression-stage
-                # variance source (ref: zoadam.py step)
+            if var_allreduce_fn is not None:
+                # reference numerics (zoadam.py): var-due steps use the
+                # UNCOMPRESSED allreduced grad.  cond-gated so the fp32
+                # exchange only executes on the (exponentially rare) due
+                # steps; the false branch's local g is never consumed —
+                # v_new selects the old v when ~var_due
+                g_var = jax.lax.cond(var_due, var_allreduce_fn, lambda x: x, g)
+            elif compress_fn is not None:
+                # WIRE transport without an uncompressed allreduce handle:
+                # the local grad differs per worker, so a variance update
+                # from it would fork exp_avg_sq (and then params) across
+                # ranks.  Reconstruct the globally-averaged gradient from
+                # the post-exchange momentum — identical on every worker —
+                # at the cost of the documented upward sign-noise bias
                 g_var = (comp - b1 * m) / (1 - b1)
             else:
                 g_var = g

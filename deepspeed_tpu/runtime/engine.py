@@ -242,11 +242,10 @@ class DeepSpeedEngine:
             self._onebit_comm_backend = backend
             if name == ZERO_ONE_ADAM_OPTIMIZER:
                 # 0/1 Adam has NO warmup — the momentum rides the compressed
-                # wire from step 0 (ref: zoadam.py), and its variance schedule
-                # is made wire-safe by updating exp_avg_sq from the
-                # POST-exchange reconstructed gradient (m_t - b1*m_{t-1})/(1-b1)
-                # — globally identical across workers — instead of the local
-                # grad (see ops/onebit.zero_one_adam)
+                # wire from step 0 (ref: zoadam.py), and on var-interval
+                # steps exp_avg_sq updates from the UNCOMPRESSED allreduced
+                # grad like the reference (var_allreduce_fn below, cond-gated
+                # to the rare due steps; see ops/onebit.zero_one_adam)
                 self._onebit_freeze_step = 0
             else:
                 self._onebit_freeze_step = int(params.get("freeze_step", 100))
@@ -266,13 +265,20 @@ class DeepSpeedEngine:
                     return avg, jax.lax.pmean(e_new, DATA_AXIS)
 
                 params["compress_fn"] = exchange
+                if name == ZERO_ONE_ADAM_OPTIMIZER:
+                    # reference variance source (zoadam.py): var-due steps
+                    # exchange the raw fp32 grad; lax.cond in the optimizer
+                    # keeps it off the wire on every other step
+                    params["var_allreduce_fn"] = \
+                        lambda g: jax.lax.pmean(g, DATA_AXIS)
                 # warmup-phase twin WITHOUT the exchange: its compressed
                 # result is discarded anyway (frozen=False selects the exact
                 # momentum), so tracing the collectives into the warmup
                 # program would be pure wasted wire every pre-freeze step
                 self._opt_warmup = OPTIMIZER_FACTORIES[name](
                     lr=self.lr_schedule, **{k: v for k, v in params.items()
-                                            if k != "compress_fn"})
+                                            if k not in ("compress_fn",
+                                                         "var_allreduce_fn")})
         if name in (ADAM_OPTIMIZER, FUSED_ADAM_OPTIMIZER, "cpuadam"):
             # the reference's adam_w_mode flag (ops/adam/fused_adam.py)
             adam_w = params.pop("adam_w_mode", True)

@@ -1,0 +1,58 @@
+"""Clock abstraction for the serving loop.
+
+The frontend never calls ``time`` directly: all timestamps (arrival,
+deadline, TTFT/TPOT) come from a clock object, so the SAME loop runs in
+two modes:
+
+* :class:`WallClock` — real serving: ``now()`` is monotonic wall time and
+  engine steps take however long they take.
+* :class:`VirtualClock` — deterministic CPU tests and the load harness's
+  ``--dryrun``: time advances only when the loop says so (one configurable
+  cost unit per engine step), so percentile latencies are reproducible
+  bit-for-bit across runs and machines.  This is what lets the SLA harness
+  be a tier-1 CPU test instead of a flaky timing test.
+"""
+
+import time
+
+
+class VirtualClock:
+    """Deterministic logical time; the serving loop advances it explicitly."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> None:
+        assert dt >= 0, f"virtual clock cannot go backwards (dt={dt})"
+        self._now += dt
+
+    def wait_until(self, ts: float) -> None:
+        """Jump to ``ts`` (idle gap between arrivals); never rewinds."""
+        self._now = max(self._now, ts)
+
+    def on_step(self, cost: float) -> None:
+        """One engine step consumed ``cost`` virtual seconds."""
+        self.advance(cost)
+
+
+class WallClock:
+    """Monotonic wall time (zeroed at construction so timestamps are small
+    and comparable with VirtualClock-based configs)."""
+
+    def __init__(self):
+        self._t0 = time.monotonic()
+
+    def now(self) -> float:
+        return time.monotonic() - self._t0
+
+    def wait_until(self, ts: float) -> None:
+        delta = ts - self.now()
+        if delta > 0:
+            time.sleep(delta)
+
+    def on_step(self, cost: float) -> None:
+        # real time already passed during the step
+        pass

@@ -1,0 +1,373 @@
+"""ServingEngine: the SLA-aware frontend over :class:`InferenceEngineV2`.
+
+Reference: FastGen's serving methodology (``blogs/deepspeed-fastgen`` —
+Poisson-arrival load, first-token + per-token SLAs) and Orca-style
+iteration-level scheduling.  The v2 engine exposes ``put()``/``step()``
+over *sequences*; this layer adds what "serving" means:
+
+* a bounded request QUEUE with admission control (reject/backpressure at
+  the request boundary instead of crashing mid-step — admission.py);
+* FCFS-with-aging ordering, installed into ``SplitFuseScheduler.order_key``
+  so step planning follows request priority/arrival, not dict-iteration
+  order (priority classes age toward urgent so nothing starves);
+* KV-pressure preemption (kv_pressure.py): the youngest sequence is
+  evicted — pages released, generated tokens preserved on the request —
+  and requeued for recompute-on-resume, instead of the step raising;
+* deadlines: expired requests (queued or running) are timed out and their
+  capacity reclaimed; goodput counts only deadline-met completions;
+* per-request TTFT/TPOT/queue-wait accounting streamed through the
+  existing ``monitor`` event surface (``write_events`` tuples), plus
+  per-token delivery callbacks as tokens land.
+
+The loop is clock-driven (clock.py): identical code serves wall-clock
+traffic and deterministic virtual-clock CPU tests / the load harness.
+"""
+
+import dataclasses
+import itertools
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..utils.logging import logger
+from .admission import AdmissionConfig, AdmissionController
+from .clock import VirtualClock, WallClock  # noqa: F401  (re-exported convenience)
+from .kv_pressure import KVPressureManager
+from .metrics import ServingStats
+from .request import RequestState, ServingRequest
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    admission: AdmissionConfig = AdmissionConfig()
+    # deadline policy: True kills expired requests (queued or running) and
+    # reclaims their capacity; False lets them finish late (still counted
+    # against goodput — they missed the SLA either way)
+    kill_on_deadline: bool = True
+    # FCFS-with-aging: a request's priority class improves by one full class
+    # per ``aging_interval`` seconds waited, so low-priority work cannot
+    # starve behind a stream of urgent arrivals.  0 disables aging (pure
+    # priority-then-FCFS).
+    aging_interval: float = 0.0
+    # VirtualClock cost model: seconds one engine step takes, as a function
+    # of the planned token count (decodes + prefill chunk tokens).  None →
+    # every step costs 1.0 virtual second (pure step-count latency).
+    step_cost: Optional[Callable[[int], float]] = None
+
+
+class ServingEngine:
+    """Drives an :class:`InferenceEngineV2` as a servable endpoint."""
+
+    def __init__(self, engine, clock=None, config: ServingConfig = None, monitor=None):
+        self.engine = engine
+        self.clock = clock if clock is not None else VirtualClock()
+        self.config = config or ServingConfig()
+        self.monitor = monitor
+        self.admission = AdmissionController(self.config.admission, engine)
+        self.kvp = KVPressureManager(engine, youth_key=self._youth_key)
+        self.stats = ServingStats()
+        self._queue: List[ServingRequest] = []
+        self._active: Dict[int, ServingRequest] = {}
+        self._requests: Dict[int, ServingRequest] = {}
+        self._uids = itertools.count(max(engine.state.seqs.keys(), default=-1) + 1)
+        self._events_step = 0
+        self._t0 = self.clock.now()
+        if isinstance(self.clock, VirtualClock) and \
+                engine.econfig.decode_steps_per_dispatch > 1:
+            # the fused decode path delivers up to k tokens per tick while
+            # the virtual clock advances one step_cost — TTFT/TPOT would be
+            # per-DISPATCH quantities, understated up to k-fold
+            logger.warning(
+                f"ServingEngine on a VirtualClock with decode_steps_per_dispatch="
+                f"{engine.econfig.decode_steps_per_dispatch}: per-token latency "
+                "metrics are quantized to fused-dispatch granularity; build the "
+                "engine with decode_steps_per_dispatch=1 for SLA measurement")
+        # step planning follows request priority/arrival instead of
+        # dict-iteration (put) order — see SplitFuseScheduler.order_key
+        if engine.scheduler.order_key is not None:
+            logger.warning("ServingEngine: replacing an existing scheduler order_key "
+                           "(another frontend on this engine? call close() on it first)")
+        engine.scheduler.order_key = self._seq_order_key
+
+    # ---------------------------------------------------------------- keys
+
+    def _priority_key(self, req: ServingRequest, now: float):
+        cls = req.priority
+        if self.config.aging_interval > 0:
+            cls -= (now - req.arrival_ts) / self.config.aging_interval
+        return (cls, req.arrival_ts, req.uid)
+
+    def _seq_order_key(self, seq):
+        req = self._requests.get(seq.uid)
+        if req is None:  # non-serving sequence (direct engine.put user): first
+            return (float("-inf"), -1.0, seq.uid)
+        return self._priority_key(req, self.clock.now())
+
+    def _youth_key(self, uid: int):
+        """Preemption victim order: least-urgent class first, then youngest
+        arrival (least sunk work, weakest FCFS claim).  Uses the SAME aged
+        priority as admission — a request that aged into urgency and got
+        admitted must not then be the perpetual eviction victim on its raw
+        class (admit/preempt ping-pong would undo the anti-starvation)."""
+        req = self._requests.get(uid)
+        if req is None:
+            return (float("-inf"), float("-inf"), uid)
+        return self._priority_key(req, self.clock.now())
+
+    # -------------------------------------------------------------- submit
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: Optional[int] = None,
+               deadline: Optional[float] = None, arrival_ts: Optional[float] = None,
+               priority: float = 0.0, stream: Optional[Callable] = None) -> ServingRequest:
+        """Enqueue one request.  NEVER raises on overload: the returned
+        request's state is REJECTED (with ``reject_reason``) when admission
+        refuses it — callers inspect, the serving loop keeps running."""
+        now = self.clock.now() if arrival_ts is None else float(arrival_ts)
+        if max_new_tokens is None:
+            max_new_tokens = self.engine.econfig.max_new_tokens
+        if max_new_tokens <= 0:
+            raise ValueError(f"max_new_tokens must be positive, got {max_new_tokens}")
+        uid = next(self._uids)
+        while uid in self.engine.state.seqs:
+            # a direct engine.put() caller (mixed use) claimed this uid after
+            # the counter was snapshotted — skip past, never alias their
+            # sequence (get_or_create would EXTEND its token list)
+            uid = next(self._uids)
+        req = ServingRequest(
+            uid=uid, prompt=list(prompt), arrival_ts=now,
+            max_new_tokens=max_new_tokens,
+            deadline=deadline, priority=priority, stream=stream)
+        self._requests[req.uid] = req
+        self.stats.submitted += 1
+        ok, reason = self.admission.submit_ok(req, len(self._queue))
+        if not ok:
+            req.reject_reason = reason
+            req.to(RequestState.REJECTED, now)
+            self.stats.record_reject(reason)
+            self.stats.record_terminal(req)
+            self._requests.pop(req.uid, None)
+            self._emit([("serving/rejected", 1.0, self._next_event_step())])
+            return req
+        self._queue.append(req)
+        return req
+
+    # ---------------------------------------------------------------- tick
+
+    def tick(self) -> Dict[int, List[int]]:
+        """One serving iteration: expire deadlines, admit, resolve KV
+        pressure, run one engine step, deliver tokens.  Returns the engine
+        step's {uid: [tokens]} (empty when nothing was runnable)."""
+        now = self.clock.now()
+        self._expire(now)
+        self._admit(now)
+        if not self._active:
+            return {}
+        evicted, plan = self.kvp.resolve()
+        for seq in evicted:
+            self._on_preempted(seq, now)
+        if not self._active:  # everything runnable got preempted/expired
+            return {}
+        cost = 1.0
+        if self.config.step_cost is not None:
+            cost = self.config.step_cost(len(plan.decode) + sum(n for _, n in plan.prefill))
+        out = self.engine.step(plan)
+        self.clock.on_step(cost)
+        self._deliver(out, self.clock.now())
+        return out
+
+    def _expire(self, now: float) -> None:
+        if not self.config.kill_on_deadline:
+            return
+        for req in [r for r in self._queue if r.deadline is not None and now > r.deadline]:
+            self._queue.remove(req)
+            self._finish(req, RequestState.TIMED_OUT, now)
+        for uid in [u for u, r in self._active.items()
+                    if r.deadline is not None and now > r.deadline]:
+            req = self._active.pop(uid)
+            self.engine.flush(uid)  # reclaim KV pages + engine state
+            self._finish(req, RequestState.TIMED_OUT, now)
+
+    def _admit(self, now: float) -> None:
+        """FCFS-with-aging head-of-line admission: the queue is served in
+        priority order and stops at the first request that does not fit —
+        skipping ahead would starve large requests behind a stream of small
+        ones (the aging mechanism exists to prevent exactly that)."""
+        self._queue.sort(key=lambda r: self._priority_key(r, now))
+        reserved = 0  # pages promised to this tick's earlier admissions
+        while self._queue:
+            req = self._queue[0]
+            if not self.admission.can_start(req, reserved_pages=reserved):
+                break
+            self._queue.pop(0)
+            assert req.remaining_new_tokens > 0, req
+            assert req.uid not in self.engine.state.seqs, (
+                f"uid {req.uid} already live in the engine (direct put() "
+                "collision) — cannot admit")
+            self.engine.put([req.uid], [req.engine_tokens()],
+                            max_new_tokens=req.remaining_new_tokens)
+            if req.admitted_ts is None:
+                req.admitted_ts = now
+            req.to(RequestState.PREFILL, now)
+            self._active[req.uid] = req
+            reserved += self.admission._start_pages(req)
+
+    def _on_preempted(self, seq, now: float) -> None:
+        req = self._active.pop(seq.uid, None)
+        if req is None:
+            # a sequence put() directly on the engine by some other caller
+            # (mixed use is allowed — _seq_order_key/_youth_key rank such
+            # sequences so they are preempted only as a last resort).  Its
+            # pages are already released; there is no request to requeue —
+            # warn so the owner knows their sequence is gone
+            logger.warning(f"KV pressure evicted non-frontend sequence uid={seq.uid} "
+                           f"({len(seq.generated)} generated tokens lost to this "
+                           "serving loop; re-put() it to resume)")
+            self.stats.preemptions += 1
+            return
+        # every token the evicted sequence generated was already delivered to
+        # req.tokens at the tick it was sampled — the descriptor can be
+        # dropped without losing output
+        req.to(RequestState.EVICTED, now)
+        req.preemptions += 1
+        self.stats.preemptions += 1
+        self._emit([("serving/preempted", 1.0, self._next_event_step())])
+        req.to(RequestState.QUEUED, now)
+        self._queue.append(req)
+
+    def _deliver(self, out: Dict[int, List[int]], now: float) -> None:
+        for uid in sorted(out):
+            toks = out[uid]
+            req = self._active.get(uid)
+            if req is None or not toks:
+                continue
+            if req.first_token_ts is None:
+                req.first_token_ts = now
+            if req.state is RequestState.PREFILL:
+                req.to(RequestState.DECODE, now)
+            req.tokens.extend(int(t) for t in toks)
+            if req.stream is not None:
+                try:
+                    req.stream(req, [int(t) for t in toks], now)
+                except Exception as e:
+                    # one client's broken delivery sink (closed socket, ...)
+                    # must not take down every other in-flight request; the
+                    # request itself keeps generating — same stance as _emit
+                    logger.warning(f"stream callback failed for uid={uid}: {e}")
+                    req.stream = None
+            seq = self.engine.state.seqs.get(uid)
+            if seq is not None and seq.done:
+                req.finish_ts = now
+                self.engine.flush(uid)
+                del self._active[uid]
+                self._finish(req, RequestState.DONE, now)
+
+    def _finish(self, req: ServingRequest, state: RequestState, now: float) -> None:
+        req.to(state, now)
+        self.stats.record_terminal(req)
+        # terminal requests leave the lookup table (their engine sequence is
+        # gone; keys here must not grow without bound in a long-lived
+        # server) — the caller's handle and stats.finished keep the record
+        self._requests.pop(req.uid, None)
+        step = self._next_event_step()
+        events = [("serving/e2e_latency", now - req.arrival_ts, step),
+                  ("serving/preemptions", float(req.preemptions), step)]
+        if state is RequestState.DONE:
+            if req.ttft is not None:
+                events.append(("serving/ttft", req.ttft, step))
+            if req.tpot is not None:
+                events.append(("serving/tpot", req.tpot, step))
+            if req.queue_wait is not None:
+                events.append(("serving/queue_wait", req.queue_wait, step))
+            events.append(("serving/deadline_met", 1.0 if req.met_deadline else 0.0, step))
+        else:
+            events.append(("serving/timed_out", 1.0, step))
+        self._emit(events)
+
+    # ---------------------------------------------------------------- loop
+
+    def drain(self, max_ticks: int = 1_000_000) -> None:
+        """Run ticks until queue + active are empty."""
+        self._loop(pending_arrival=lambda: None, max_ticks=max_ticks)
+
+    def loop(self, feed=None, max_ticks: int = 1_000_000) -> None:
+        """Generic stall-guarded driver for callers that generate load
+        dynamically (e.g. closed-loop benchmarking): ``feed()`` runs at the
+        top of every iteration, may submit new requests, and returns the
+        next known FUTURE arrival timestamp (or None).  Terminates when
+        feed() has nothing pending and queue + active are empty; raises on
+        a stall instead of spinning."""
+        self._loop(pending_arrival=feed or (lambda: None), max_ticks=max_ticks)
+
+    def run(self, arrivals: List[dict], max_ticks: int = 1_000_000) -> List[ServingRequest]:
+        """Open-loop driver: ``arrivals`` is a list of submit() kwarg dicts,
+        each with an ``arrival_ts``; requests are submitted as the clock
+        passes their arrival time, idle gaps are skipped (VirtualClock) or
+        slept (WallClock).  Returns the request objects in arrival order."""
+        pending = sorted(arrivals, key=lambda a: a["arrival_ts"])
+        reqs: List[ServingRequest] = []
+        i = 0
+
+        def feed():
+            nonlocal i
+            while i < len(pending) and pending[i]["arrival_ts"] <= self.clock.now():
+                reqs.append(self.submit(**pending[i]))
+                i += 1
+            return pending[i]["arrival_ts"] if i < len(pending) else None
+
+        self._loop(pending_arrival=feed, max_ticks=max_ticks)
+        return reqs
+
+    def _loop(self, pending_arrival, max_ticks: int) -> None:
+        for _ in range(max_ticks):
+            next_arrival = pending_arrival()
+            if not self._queue and not self._active:
+                if next_arrival is None:
+                    return
+                self.clock.wait_until(next_arrival)
+                continue
+            marker = self._progress_marker()
+            self.tick()
+            if self._progress_marker() == marker:
+                # nothing moved: only the passage of time can help (a future
+                # arrival, or a queued deadline expiring — the latter only
+                # when expiry is actually enforced) — jump to it
+                waits = [r.deadline for r in self._queue if r.deadline is not None] \
+                    if self.config.kill_on_deadline else []
+                if next_arrival is not None:
+                    waits.append(next_arrival)
+                if not waits:
+                    raise RuntimeError(
+                        f"serving loop stalled: {len(self._queue)} queued, "
+                        f"{len(self._active)} active, no admissible work and no "
+                        "future event to wait for")
+                self.clock.wait_until(min(waits) + 1e-9)
+        raise RuntimeError(f"serving loop exceeded max_ticks={max_ticks}")
+
+    def _progress_marker(self):
+        return (len(self.stats.finished), self.stats.preemptions,
+                len(self._queue), len(self._active),
+                sum(s.seen_tokens for s in self.engine.state.seqs.values()),
+                sum(len(r.tokens) for r in self._active.values()))
+
+    def close(self) -> None:
+        """Detach from the engine: restore dict-insertion step ordering and
+        release the scheduler's reference to this frontend (a long-lived
+        engine must not keep a discarded frontend — and its per-request
+        stats log — reachable through order_key)."""
+        if self.engine.scheduler.order_key is self._seq_order_key:
+            self.engine.scheduler.order_key = None
+
+    # ------------------------------------------------------------- metrics
+
+    def summary(self) -> dict:
+        return self.stats.summary(elapsed=self.clock.now() - self._t0)
+
+    def _next_event_step(self) -> int:
+        self._events_step += 1
+        return self._events_step
+
+    def _emit(self, events) -> None:
+        if self.monitor is None or not getattr(self.monitor, "enabled", True):
+            return
+        try:
+            self.monitor.write_events(events)
+        except Exception as e:  # monitoring must never take down serving
+            logger.warning(f"serving monitor write failed: {e}")

@@ -1,0 +1,128 @@
+"""Request lifecycle: the unit the SLA frontend schedules.
+
+Reference: FastGen's serving methodology (``blogs/deepspeed-fastgen`` —
+first-token + per-token SLAs under Poisson-arrival load) and Orca-style
+iteration-level scheduling.  The v2 engine itself only knows *sequences*
+(``inference/v2/ragged.py SequenceDescriptor``); a :class:`ServingRequest`
+is the envelope around one — arrival time, deadline, output budget, and a
+state machine the frontend drives:
+
+    QUEUED → PREFILL → DECODE → DONE
+       │        │         │
+       │        └→ EVICTED ┘→ QUEUED   (KV-pressure preemption; resume
+       │                                recomputes the generated tokens'
+       │                                KV from the extended prompt)
+       └→ REJECTED                      (admission: queue full / infeasible)
+    any non-terminal → TIMED_OUT        (deadline passed)
+
+Terminal states: DONE, TIMED_OUT, REJECTED.  EVICTED is transient — the
+frontend immediately requeues (or times out) the victim; it appears in the
+history so preemption events are auditable per request.
+"""
+
+import dataclasses
+import enum
+from typing import Callable, List, Optional, Sequence, Tuple
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    DONE = "done"
+    EVICTED = "evicted"
+    TIMED_OUT = "timed_out"
+    REJECTED = "rejected"
+
+    @property
+    def terminal(self) -> bool:
+        return self in (RequestState.DONE, RequestState.TIMED_OUT, RequestState.REJECTED)
+
+
+_ALLOWED = {
+    RequestState.QUEUED: {RequestState.PREFILL, RequestState.TIMED_OUT, RequestState.REJECTED},
+    RequestState.PREFILL: {RequestState.DECODE, RequestState.EVICTED, RequestState.TIMED_OUT},
+    RequestState.DECODE: {RequestState.DONE, RequestState.EVICTED, RequestState.TIMED_OUT},
+    RequestState.EVICTED: {RequestState.QUEUED, RequestState.TIMED_OUT},
+    RequestState.DONE: set(),
+    RequestState.TIMED_OUT: set(),
+    RequestState.REJECTED: set(),
+}
+
+
+@dataclasses.dataclass
+class ServingRequest:
+    """One user request moving through the frontend.
+
+    ``tokens`` accumulates every generated token across preemptions: on
+    eviction the engine-side sequence (and its KV pages) is destroyed, but
+    the request keeps what it already produced and resumes by prefilling
+    ``prompt + tokens`` — greedy decode then continues with the identical
+    next token, so a preempted request's final output equals an
+    unpreempted run's.
+    """
+    uid: int
+    prompt: List[int]
+    arrival_ts: float
+    max_new_tokens: int
+    deadline: Optional[float] = None          # absolute timestamp, clock domain
+    priority: float = 0.0                     # lower = more urgent; FCFS within a class
+    stream: Optional[Callable] = None         # stream(request, new_tokens, ts)
+    state: RequestState = RequestState.QUEUED
+    admitted_ts: Optional[float] = None       # first admission only (queue-wait metric)
+    first_token_ts: Optional[float] = None
+    finish_ts: Optional[float] = None
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    preemptions: int = 0
+    reject_reason: Optional[str] = None
+    history: List[Tuple[RequestState, float]] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        self.prompt = list(self.prompt)
+        self.history.append((self.state, self.arrival_ts))
+
+    def to(self, state: RequestState, ts: float) -> None:
+        if state not in _ALLOWED[self.state]:
+            raise ValueError(f"request {self.uid}: illegal transition "
+                             f"{self.state.value} -> {state.value}")
+        self.state = state
+        self.history.append((state, ts))
+
+    # ------------------------------------------------------------- metrics
+
+    @property
+    def remaining_new_tokens(self) -> int:
+        return max(0, self.max_new_tokens - len(self.tokens))
+
+    @property
+    def ttft(self) -> Optional[float]:
+        """Time to first token, from ARRIVAL (queue wait included — the
+        user-visible latency, the quantity FastGen's first-token SLA bounds)."""
+        if self.first_token_ts is None:
+            return None
+        return self.first_token_ts - self.arrival_ts
+
+    @property
+    def tpot(self) -> Optional[float]:
+        """Time per output token after the first (the per-token SLA)."""
+        if self.first_token_ts is None or self.finish_ts is None or len(self.tokens) < 2:
+            return None
+        return (self.finish_ts - self.first_token_ts) / (len(self.tokens) - 1)
+
+    @property
+    def queue_wait(self) -> Optional[float]:
+        if self.admitted_ts is None:
+            return None
+        return self.admitted_ts - self.arrival_ts
+
+    @property
+    def met_deadline(self) -> bool:
+        """Completed AND within deadline — the goodput numerator."""
+        if self.state is not RequestState.DONE:
+            return False
+        return self.deadline is None or self.finish_ts <= self.deadline
+
+    def engine_tokens(self) -> List[int]:
+        """The token list to (re)admit into the engine: original prompt plus
+        everything generated before any preemption (recompute-on-resume)."""
+        return list(self.prompt) + list(self.tokens)

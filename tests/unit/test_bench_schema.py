@@ -1,0 +1,55 @@
+"""Tier-1 guard against bench-artifact schema drift (r5 ADVICE: the
+README-vs-artifact drift class).  Every committed BENCH_*.json must match
+its registered schema in scripts/check_bench_schema.py — a bench script
+whose output format changed without regenerating the committed artifact
+(or without registering the new schema) fails here, not in a later round's
+review."""
+
+import importlib.util
+import os
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _load_checker():
+    path = os.path.join(REPO_ROOT, "scripts", "check_bench_schema.py")
+    spec = importlib.util.spec_from_file_location("check_bench_schema", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_all_bench_artifacts_match_schema():
+    mod = _load_checker()
+    errors = mod.validate_all(REPO_ROOT)
+    assert not errors, "\n".join(errors)
+
+
+def test_checker_catches_drift(tmp_path):
+    """The checker itself must detect the drift classes it exists for:
+    wrong type, missing field, unordered percentiles, unregistered file."""
+    import json
+    mod = _load_checker()
+    # seed a valid serving doc, then break it one way at a time
+    with open(os.path.join(REPO_ROOT, "BENCH_SERVING.json")) as f:
+        good = json.load(f)
+
+    def errors_for(doc, name="BENCH_SERVING.json"):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        errs = mod.validate_all(str(tmp_path))
+        p.unlink()
+        return errs
+
+    assert not errors_for(good)
+    bad = json.loads(json.dumps(good))
+    bad["value"] = "fast"                        # type drift
+    assert any("value" in e for e in errors_for(bad))
+    bad = json.loads(json.dumps(good))
+    del bad["sweep"]                             # missing field
+    assert any("sweep" in e for e in errors_for(bad))
+    bad = json.loads(json.dumps(good))
+    bad["sweep"][0]["ttft"]["p50"] = 1e9         # unordered percentiles
+    assert any("out of order" in e for e in errors_for(bad))
+    assert any("no schema registered" in e
+               for e in errors_for({"x": 1}, name="BENCH_MYSTERY.json"))
