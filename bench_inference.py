@@ -203,8 +203,8 @@ def main():
               "record; run `python scripts/bench_serving.py` to regenerate the "
               "SLA sweep (tier-1 schema check fails until then)", flush=True)
         payload = result
-    with open("BENCH_SERVING.json", "w") as f:
-        json.dump(payload, f, indent=1)
+    from deepspeed_tpu.resilience.atomic_io import atomic_write_json
+    atomic_write_json("BENCH_SERVING.json", payload, indent=1)
 
 
 if __name__ == "__main__":

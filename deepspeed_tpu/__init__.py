@@ -18,6 +18,7 @@ from .runtime.config import DeepSpeedConfig  # noqa: F401
 from .runtime.engine import DeepSpeedEngine  # noqa: F401
 from .runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader  # noqa: F401
 from .utils.logging import logger  # noqa: F401
+from . import resilience  # noqa: F401  (fault injection / crash-safe I/O surface)
 
 
 def initialize(args=None,

@@ -171,10 +171,11 @@ def convert_to_universal(input_folder: str,
     if os.path.exists(src_meta):
         with open(src_meta) as f:
             meta["source_meta"] = json.load(f)
-    with open(os.path.join(dst, "universal_meta.json"), "w") as f:
-        json.dump(meta, f, indent=2)
-    with open(os.path.join(os.path.abspath(output_folder), "latest_universal"), "w") as f:
-        f.write(str(tag))
+    from ..resilience.atomic_io import atomic_write_json, atomic_write_text
+    atomic_write_json(os.path.join(dst, "universal_meta.json"), meta, indent=2)
+    # same publication discipline as `latest`: the pointer lands atomically
+    # after the converted checkpoint it names is fully on disk
+    atomic_write_text(os.path.join(os.path.abspath(output_folder), "latest_universal"), str(tag))
     logger.info(f"universal checkpoint written: {dst} ({len(weights)} params, atoms={meta['atoms']})")
     return dst
 

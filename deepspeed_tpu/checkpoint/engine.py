@@ -1,4 +1,4 @@
-"""Sharded checkpoint save/load over orbax.
+"""Sharded checkpoint save/load over orbax — crash-safe.
 
 TPU-native analog of the reference checkpoint path
 (ref: runtime/engine.py:3274 save_checkpoint / :2928 load_checkpoint and the
@@ -14,27 +14,177 @@ pluggable ``runtime/checkpoint_engine/``).  Key differences by design:
   tiered/async engine's role (ref: deepspeed/nebula/).
 
 Layout: ``<save_dir>/<tag>/state`` (orbax tree) + ``<save_dir>/<tag>/meta.json``
-+ ``<save_dir>/latest`` tag file (same contract as the reference's `latest`).
++ ``<save_dir>/<tag>/manifest.json`` (crc32 of every file in the tag) +
+``<save_dir>/latest`` tag file (same contract as the reference's `latest`).
+
+Durability contract (docs/RESILIENCE.md) — the save sequence is ordered so
+a crash at ANY point leaves a loadable directory:
+
+  1. state tree            → ``<tag>/state``       (orbax; maybe async)
+  2. meta.json             → atomic write           [site ckpt.meta_write]
+  3. extra state (host-tier ``host_opt_group*.npz``) into the tag dir
+  4. FENCE: the async (nebula-style) background write is committed durable
+  5. manifest.json         → atomic write           [site ckpt.manifest_write]
+  6. latest                → atomic publish         [site ckpt.latest_publish]
+  7. retention: keep-last-K older tags pruned
+
+``latest`` is published strictly post-fence: a crash before (6) leaves the
+previous checkpoint published and the new tag either complete-but-unlinked
+or detectably torn.  ``load_checkpoint`` validates the tag the ``latest``
+file points at (exists + meta parses + manifest verifies) and falls back
+to the newest VALID tag with a warning — never an opaque orbax error.
 """
 
 import json
 import os
-from typing import Optional
+import shutil
+from typing import Callable, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
+from ..resilience import atomic_io, events
+from ..resilience import fault_injection as fi
+from ..resilience.retry import RetryPolicy, retry_call
 from ..utils.logging import log_dist, logger
+
+# checkpoint metadata writes are tiny and latency-insensitive: retry hard
+_CKPT_RETRY = RetryPolicy(max_attempts=4, base_delay_s=0.02, max_delay_s=0.5,
+                          budget_s=5.0)
 
 
 def _tag_path(save_dir, tag):
     return os.path.join(os.path.abspath(save_dir), str(tag))
 
 
-def save_checkpoint(engine, save_dir, tag=None, client_state=None, save_latest=True):
+# ------------------------------------------------------------ tag validity
+
+def read_meta(tag_dir: str) -> Optional[dict]:
+    meta_path = os.path.join(tag_dir, "meta.json")
+    try:
+        with open(meta_path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def checkpoint_tag_valid(save_dir: str, tag: str,
+                         crc_scope: str = "all") -> Tuple[bool, str]:
+    """Is ``<save_dir>/<tag>`` a loadable checkpoint?  Requires the tag
+    directory and orbax state tree to exist, ``meta.json`` to parse, and —
+    when a manifest was written — checksums to verify per ``crc_scope``:
+
+    * ``"all"``  — every file incl. the orbax state tree (the load-path
+      default: detecting silent state rot costs one extra read).
+    * ``"meta"`` — manifest files OUTSIDE ``state/`` only (meta.json,
+      host_opt npz): the ``verify_checksums_on_load=False`` opt-out for
+      very large checkpoints.
+    * ``"none"`` — structure only: used by retention, which must not
+      re-read every byte of every retained checkpoint on each save."""
+    path = _tag_path(save_dir, tag)
+    if not os.path.isdir(path):
+        return False, "tag directory missing"
+    if not os.path.isdir(os.path.join(path, "state")):
+        return False, "state tree missing"
+    if read_meta(path) is None:
+        return False, "meta.json missing or unparseable"
+    if crc_scope != "none":
+        match = None if crc_scope == "all" else \
+            (lambda rel: not rel.replace(os.sep, "/").startswith("state/"))
+        errors = atomic_io.verify_manifest(path, match=match)
+        if errors:
+            return False, f"manifest verification failed: {errors[0]}" + \
+                (f" (+{len(errors) - 1} more)" if len(errors) > 1 else "")
+    return True, "ok"
+
+
+def list_tags(save_dir: str) -> List[str]:
+    """Candidate tag directories, newest first (by recorded global_steps,
+    falling back to directory mtime)."""
+    save_dir = os.path.abspath(save_dir)
+    if not os.path.isdir(save_dir):
+        return []
+
+    def order(tag):
+        path = _tag_path(save_dir, tag)
+        meta = read_meta(path)
+        steps = meta.get("global_steps", -1) if meta else -1
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            mtime = 0.0
+        return (steps, mtime)
+
+    tags = [d for d in os.listdir(save_dir)
+            if os.path.isdir(os.path.join(save_dir, d, "state"))
+            or os.path.exists(os.path.join(save_dir, d, "meta.json"))]
+    return sorted(tags, key=order, reverse=True)
+
+
+def find_newest_valid_tag(save_dir: str, exclude=(),
+                          crc_scope: str = "all") -> Optional[str]:
+    for tag in list_tags(save_dir):
+        if tag in exclude:
+            continue
+        ok, _why = checkpoint_tag_valid(save_dir, tag, crc_scope=crc_scope)
+        if ok:
+            return tag
+    return None
+
+
+def _read_latest_tag(save_dir: str) -> Optional[str]:
+    try:
+        with open(os.path.join(save_dir, "latest")) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def _apply_retention(save_dir: str, keep_last_n: Optional[int], current_tag: str):
+    """Keep-last-K: prune the oldest tag directories beyond ``keep_last_n``.
+    The just-written tag AND the tag ``latest`` currently points at are
+    always kept (they can differ under ``save_latest=False`` — deleting the
+    published target would leave the pointer dangling).  Only VALID tags
+    count toward the budget — a torn tag is deleted outright rather than
+    occupying a retention slot while being unloadable."""
+    if not keep_last_n or keep_last_n <= 0:
+        return
+    protected = {str(current_tag), _read_latest_tag(save_dir)}
+    tags = list_tags(save_dir)
+    kept = 0
+    for tag in tags:  # newest first
+        if tag in protected:
+            kept += 1
+            continue
+        # structure-only validity: a crc sweep here would re-read every
+        # byte of every retained checkpoint on each save
+        ok, why = checkpoint_tag_valid(save_dir, tag, crc_scope="none")
+        if ok and kept < keep_last_n:
+            kept += 1
+            continue
+        path = _tag_path(save_dir, tag)
+        try:
+            shutil.rmtree(path)
+        except OSError as e:
+            logger.warning(f"checkpoint retention: could not delete {path}: {e}")
+            continue
+        events.emit("resilience/ckpt_retention_delete")
+        log_dist(f"checkpoint retention (keep_last_n={keep_last_n}): deleted "
+                 f"{'invalid ' if not ok else ''}tag {path}", ranks=[0])
+
+
+# ------------------------------------------------------------------- save
+
+def save_checkpoint(engine, save_dir, tag=None, client_state=None, save_latest=True,
+                    extra_state_cb: Optional[Callable[[str], None]] = None):
+    """Crash-safe save (ordering in the module docstring).  ``extra_state_cb``
+    runs with the tag directory AFTER the state save is issued and BEFORE
+    the manifest/latest publication — the engine uses it to persist the
+    host-tier optimizer npz files inside the same durability fence."""
     assert engine.state is not None, "engine has no state to checkpoint yet"
     if tag is None:
         tag = f"global_step{engine.global_steps}"
+    save_dir = os.path.abspath(save_dir)
     path = _tag_path(save_dir, tag)
     os.makedirs(path, exist_ok=True)
 
@@ -48,13 +198,17 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None, save_latest=T
     }
     # pluggable engine (ref: runtime/checkpoint_engine/ + nebula async):
     # "nebula": {"enabled": true} or checkpoint.checkpoint_engine "async" →
-    # the save streams in the background (singleton checkpointer); training
-    # continues immediately and the write is fenced at the next save/load
+    # the save streams in the background (singleton checkpointer) and is
+    # fenced durable below, before `latest` is published
     from ..runtime.checkpoint_engine import make_checkpoint_engine
     pd = engine._config._param_dict
+    # the VALIDATED config (pydantic-coerced types), not the raw dict — a
+    # json "keep_last_n": "3" must not crash retention at save time
+    ckpt_cfg = getattr(engine._config, "checkpoint_config", None)
     kind = "async" if pd.get("nebula", {}).get("enabled", False) else \
-        pd.get("checkpoint", {}).get("checkpoint_engine", "orbax")
+        (getattr(ckpt_cfg, "checkpoint_engine", None) or "orbax")
     ck = make_checkpoint_engine(kind)
+    # [site ckpt.state_save] is polled inside the engine's retried save
     ck.save(state_dict, os.path.join(path, "state"))
 
     meta = {
@@ -66,26 +220,76 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None, save_latest=T
         "client_state": client_state or {},
     }
     if jax.process_index() == 0:
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=2, default=str)
+        retry_call(
+            lambda: atomic_io.atomic_write_json(
+                os.path.join(path, "meta.json"), meta, site="ckpt.meta_write",
+                indent=2, default=str),
+            _CKPT_RETRY, site="ckpt.meta_write")
+    if extra_state_cb is not None:
+        extra_state_cb(path)
+    # FENCE: an async (nebula-style) background write must be durable
+    # before the checkpoint is checksummed and published — this is the
+    # ordering fix for the crash window where `latest` named a checkpoint
+    # whose array data was still streaming
+    ck.commit(tag)
+    if jax.process_index() == 0:
+        retry_call(lambda: atomic_io.write_manifest(path), _CKPT_RETRY,
+                   site="ckpt.manifest_write")
         if save_latest:
-            with open(os.path.join(os.path.abspath(save_dir), "latest"), "w") as f:
-                f.write(str(tag))
+            retry_call(
+                lambda: atomic_io.atomic_write_text(
+                    os.path.join(save_dir, "latest"), str(tag),
+                    site="ckpt.latest_publish"),
+                _CKPT_RETRY, site="ckpt.latest_publish")
+            events.emit("resilience/ckpt_published")
+        _apply_retention(save_dir, getattr(ckpt_cfg, "keep_last_n", None), str(tag))
     log_dist(f"saved checkpoint {path}", ranks=[0])
     return True
+
+
+# ------------------------------------------------------------------- load
+
+def _resolve_tag(load_dir: str, tag, from_latest: bool, crc_scope: str = "all"):
+    """Validate the requested tag; when it came from ``latest`` and is
+    invalid (torn save, corrupt file, deleted directory), fall back to the
+    newest valid tag instead of surfacing an opaque orbax error."""
+    ok, why = checkpoint_tag_valid(load_dir, tag, crc_scope=crc_scope)
+    if ok:
+        return tag
+    events.emit("resilience/ckpt_invalid_tag")
+    if not from_latest:
+        # an EXPLICITLY requested tag is never silently substituted
+        raise FileNotFoundError(
+            f"checkpoint tag '{tag}' at {load_dir} is not loadable ({why})")
+    # the fallback scan honors the same crc scope the primary tag got —
+    # an opt-out deployment must not pay (or be failed by) state/-tree
+    # checksums it asked to skip
+    fallback = find_newest_valid_tag(load_dir, exclude={str(tag)}, crc_scope=crc_scope)
+    if fallback is None:
+        raise FileNotFoundError(
+            f"'latest' points at tag '{tag}' which is not loadable ({why}), "
+            f"and no valid fallback tag exists under {load_dir}")
+    logger.warning(f"'latest' points at tag '{tag}' which is not loadable "
+                   f"({why}); falling back to newest valid tag '{fallback}'")
+    events.emit("resilience/ckpt_fallback")
+    return fallback
 
 
 def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True, load_module_only=False):
     from ..runtime.checkpoint_engine import wait_for_pending_saves
     wait_for_pending_saves()  # fence any in-flight async (nebula-style) save
     load_dir = os.path.abspath(load_dir)
-    if tag is None:
+    from_latest = tag is None
+    if from_latest:
         latest = os.path.join(load_dir, "latest")
         if not os.path.exists(latest):
             logger.warning(f"no 'latest' file at {load_dir}; nothing restored")
             return None, {}
         with open(latest) as f:
             tag = f.read().strip()
+    cc = getattr(engine._config, "checkpoint_config", None)
+    crc_scope = "all" if getattr(cc, "verify_checksums_on_load", True) else "meta"
+    tag = _resolve_tag(load_dir, tag, from_latest, crc_scope=crc_scope)
     path = _tag_path(load_dir, tag)
     if engine.state is None:
         raise RuntimeError("Engine state must be materialized before load_checkpoint "
@@ -103,8 +307,12 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True, load
         "scaler": _abstract_like(engine.state.scaler._asdict(), engine.state_shardings.scaler._asdict()),
         "skipped_steps": _abstract_like(engine.state.skipped_steps, engine.state_shardings.skipped_steps),
     }
-    with ocp.StandardCheckpointer() as ckptr:
-        restored = ckptr.restore(os.path.join(path, "state"), target)
+    def _restore():
+        fi.check("ckpt.state_restore")
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(os.path.join(path, "state"), target)
+
+    restored = retry_call(_restore, _CKPT_RETRY, site="ckpt.state_restore")
 
     from ..runtime.engine import TrainState
     from ..runtime.fp16.loss_scaler import LossScalerState
@@ -121,10 +329,8 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True, load
     engine.state = new_state
 
     client_state = {}
-    meta_path = os.path.join(path, "meta.json")
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
+    meta = read_meta(path)
+    if meta is not None:
         engine.global_steps = meta.get("global_steps", 0)
         engine.global_samples = meta.get("global_samples", 0)
         client_state = meta.get("client_state", {})

@@ -50,9 +50,10 @@ def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str,
         import torch
         torch.save({k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()}, output_file)
     else:
-        np.savez(output_file, **sd)
         if not output_file.endswith(".npz"):
             output_file += ".npz"
+        from ..resilience.atomic_io import atomic_savez
+        atomic_savez(output_file, dict(sd))
     logger.info(f"consolidated fp32 state dict: {output_file} ({len(sd)} tensors)")
     return output_file
 

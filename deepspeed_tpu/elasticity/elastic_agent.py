@@ -25,6 +25,9 @@ automatically when a step raises a device-loss error.
 import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from ..resilience import events as res_events
+from ..resilience.fault_injection import DeviceLossError
+from ..resilience.watchdog import StepHungError, StepWatchdog
 from ..utils.logging import logger
 from .elasticity import compute_elastic_config, elasticity_enabled
 from .config import ElasticityIncompatibleWorldSize
@@ -55,7 +58,8 @@ class DSElasticAgent:
                  checkpoint_dir: str,
                  devices_fn: Optional[Callable[[], List[Any]]] = None,
                  max_restarts: int = 100,
-                 ds_version: str = "0.16.8"):
+                 ds_version: str = "0.16.8",
+                 watchdog_timeout: Optional[float] = None):
         import jax
         self.engine_factory = engine_factory
         self.ds_config = ds_config
@@ -67,6 +71,12 @@ class DSElasticAgent:
         self.engine = None
         self._devices: List[Any] = []
         self._last_batch = None  # shape donor for post-rendezvous state init
+        # hung-step watchdog: a step that exceeds the deadline is classified
+        # as device loss and takes the SAME recovery path (a wedged
+        # collective never raises on its own).  Size the timeout as a
+        # generous multiple of the worst-case step INCLUDING compiles —
+        # resilience/watchdog.py documents the abandoned-thread caveat
+        self.watchdog = StepWatchdog(watchdog_timeout) if watchdog_timeout else None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -105,6 +115,8 @@ class DSElasticAgent:
 
     @staticmethod
     def _is_device_loss(err: Exception) -> bool:
+        if isinstance(err, (StepHungError, DeviceLossError)):
+            return True
         msg = str(err)
         return any(m in msg for m in _DEVICE_LOSS_MARKERS)
 
@@ -138,6 +150,7 @@ class DSElasticAgent:
         self._devices = list(devices)
         self.state.restarts += 1
         self.state.world_size = n
+        res_events.emit("resilience/rendezvous")
         logger.info(f"DSElasticAgent: resumed on {n} devices "
                     f"(restart {self.state.restarts}/{self.max_restarts}, "
                     f"step {int(self.engine.state.step)})")
@@ -159,17 +172,27 @@ class DSElasticAgent:
     def save(self, tag=None):
         self.engine.save_checkpoint(self.checkpoint_dir, tag=tag)
 
+    def _step(self, *args, **kwargs):
+        """One engine step, under the hung-step watchdog when configured
+        (a step that never completes becomes a StepHungError, classified
+        as device loss below)."""
+        if self.watchdog is not None:
+            return self.watchdog.run(self.engine.train_batch, *args, **kwargs)
+        return self.engine.train_batch(*args, **kwargs)
+
     def train_batch(self, *args, **kwargs):
-        """One engine step with device-loss recovery: on a device-loss error,
+        """One engine step with device-loss recovery: on a device-loss error
+        (raised by the step OR synthesized by the watchdog from a hang),
         re-probe membership, rendezvous, and re-run the step on the new
         mesh."""
         if "batch" in kwargs and kwargs["batch"] is not None:
             self._last_batch = kwargs["batch"]
         try:
-            return self.engine.train_batch(*args, **kwargs)
+            return self._step(*args, **kwargs)
         except Exception as e:
             if not self._is_device_loss(e):
                 raise
+            res_events.emit("resilience/device_loss")
             logger.warning(f"DSElasticAgent: step failed with device loss ({e}); re-rendezvousing")
             self._rendezvous(list(self.devices_fn()))
-            return self.engine.train_batch(*args, **kwargs)
+            return self._step(*args, **kwargs)

@@ -14,7 +14,14 @@ nebula_checkpoint_engine.py NebulaCheckpointEngine} + deepspeed/nebula/.
 import os
 from typing import Any, Optional
 
+from ..resilience.retry import RetryPolicy, retry_call
 from ..utils.logging import log_dist, logger
+
+# transient-I/O absorption for the sharded tree writes (NFS hiccups, EIO);
+# InjectedCrash — simulated process death — is NOT an OSError and passes
+# straight through (resilience/retry.py)
+_IO_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.05, max_delay_s=1.0,
+                        budget_s=5.0)
 
 
 class CheckpointEngine:
@@ -44,14 +51,24 @@ class OrbaxCheckpointEngine(CheckpointEngine):
 
     def save(self, state_dict, path: str):
         import orbax.checkpoint as ocp
-        with ocp.StandardCheckpointer() as c:
-            c.save(path, state_dict, force=True)
+
+        def _save():
+            from ..resilience import fault_injection as fi
+            fi.check("ckpt.state_save")
+            with ocp.StandardCheckpointer() as c:
+                c.save(path, state_dict, force=True)
+
+        retry_call(_save, _IO_RETRY, site="ckpt.state_save")
         return path
 
     def load(self, path: str, target=None, map_location=None):
         import orbax.checkpoint as ocp
-        with ocp.StandardCheckpointer() as c:
-            return c.restore(path, target) if target is not None else c.restore(path)
+
+        def _load():
+            with ocp.StandardCheckpointer() as c:
+                return c.restore(path, target) if target is not None else c.restore(path)
+
+        return retry_call(_load, _IO_RETRY, site="ckpt.state_restore")
 
 
 class AsyncCheckpointEngine(CheckpointEngine):
@@ -70,7 +87,13 @@ class AsyncCheckpointEngine(CheckpointEngine):
 
     def save(self, state_dict, path: str):
         import orbax.checkpoint as ocp
-        self._ensure().save(path, args=ocp.args.StandardSave(state_dict), force=True)
+
+        def _issue():
+            from ..resilience import fault_injection as fi
+            fi.check("ckpt.state_save")
+            self._ensure().save(path, args=ocp.args.StandardSave(state_dict), force=True)
+
+        retry_call(_issue, _IO_RETRY, site="ckpt.state_save")
         return path  # returns immediately; write streams in background
 
     def load(self, path: str, target=None, map_location=None):

@@ -263,6 +263,16 @@ class CheckpointConfig(DeepSpeedConfigModel):
     use_node_local_storage: bool = False
     parallel_write: Dict[str, Any] = {}
     writer: Optional[Dict[str, Any]] = None
+    # pluggable engine: "orbax" sync / "async"-"nebula" background stream
+    checkpoint_engine: str = "orbax"
+    # keep-last-K retention: prune oldest (and invalid/torn) tags after each
+    # successful publish; None/0 keeps everything (docs/RESILIENCE.md)
+    keep_last_n: Optional[int] = None
+    # crc32-verify the WHOLE tag (orbax state tree included) before restore;
+    # detection of silent state rot costs one extra read of the checkpoint —
+    # very large deployments may opt out and keep manifest checks for
+    # metadata/npz only (docs/RESILIENCE.md durability contract)
+    verify_checksums_on_load: bool = True
 
     @model_validator(mode="after")
     def _check_tag(self):

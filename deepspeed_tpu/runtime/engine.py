@@ -415,7 +415,13 @@ class DeepSpeedEngine:
     def _build_monitor(self):
         try:
             from ..monitor.monitor import MonitorMaster
-            return MonitorMaster(self._config.monitor_config)
+            monitor = MonitorMaster(self._config.monitor_config)
+            if monitor.enabled:
+                # resilience/* events (injected faults, retries, checkpoint
+                # fallbacks, watchdog trips) ride the same writer surface
+                from ..resilience import events as res_events
+                res_events.attach_monitor(monitor)
+            return monitor
         except Exception as e:  # monitor must never break training
             logger.debug(f"monitor disabled: {e}")
             return None
@@ -1242,6 +1248,11 @@ class DeepSpeedEngine:
         # (elasticity/elastic_agent.py) — host arrays, one batch, cheap
         self.last_batch = batch
         self._ensure_ready(batch)
+        # named chaos site around the step dispatch: injects device loss
+        # (drives DSElasticAgent recovery), stragglers (drives the step
+        # watchdog) or transient errors; a single `is None` test when unarmed
+        from ..resilience import fault_injection as _fi
+        _fi.check("engine.step")
         prof_cfg = self._config.flops_profiler_config
         profiling_now = (self.flops_profiler is not None and self.global_steps == prof_cfg.profile_step)
         if profiling_now:
@@ -1497,17 +1508,15 @@ class DeepSpeedEngine:
                            "moments stay in the nvme_path swap files — keep that "
                            "directory alongside the checkpoint to resume exactly")
         from ..checkpoint.engine import save_checkpoint as _save
-        out = _save(self, save_dir, tag=tag, client_state=client_state or {}, save_latest=save_latest)
-        if isinstance(nv, HostStreamedOptimizer):
-            # host tier: state is process RAM — persist it INTO the tag dir
-            # (unlike NVMe swap files, nothing else makes it durable); the
-            # default tag here matches checkpoint/engine.save_checkpoint's
-            import os
-            tag_dir = os.path.join(os.path.abspath(save_dir),
-                                   str(tag) if tag is not None
-                                   else f"global_step{self.global_steps}")
-            nv.save_state(tag_dir)
-        return out
+        # host tier: state is process RAM — persist it INTO the tag dir
+        # (unlike NVMe swap files, nothing else makes it durable).  Passed
+        # as the extra-state callback so the npz files land INSIDE the
+        # durability fence: covered by the tag manifest and written before
+        # `latest` is published (a crash mid-npz leaves the previous
+        # checkpoint published, not a half-restorable new one)
+        extra = nv.save_state if isinstance(nv, HostStreamedOptimizer) else None
+        return _save(self, save_dir, tag=tag, client_state=client_state or {},
+                     save_latest=save_latest, extra_state_cb=extra)
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
@@ -1517,17 +1526,14 @@ class DeepSpeedEngine:
         if getattr(self, "_nvme_opt", None) is not None and self.state is not None:
             from .swap_tensor.host_streamed_optimizer import HostStreamedOptimizer
             nv = self._nvme_opt
-            if isinstance(nv, HostStreamedOptimizer) and load_optimizer_states:
+            loaded_path = out[0] if isinstance(out, tuple) else None
+            if isinstance(nv, HostStreamedOptimizer) and load_optimizer_states \
+                    and loaded_path is not None:
                 # host tier: restore the group state persisted into the tag
-                # dir by save_checkpoint
-                import os
-                resolved = tag
-                if resolved is None:
-                    latest = os.path.join(os.path.abspath(load_dir), "latest")
-                    if os.path.exists(latest):
-                        with open(latest) as f:
-                            resolved = f.read().strip()
-                tag_dir = os.path.join(os.path.abspath(load_dir), str(resolved))
+                # dir by save_checkpoint.  The tag dir is the PATH THE LOAD
+                # RESOLVED (returned above) — re-reading `latest` here would
+                # point at the corrupt tag the loader just fell back FROM
+                tag_dir = loaded_path
                 if nv.load_state(tag_dir):
                     # a same-shaped host_opt_group*.npz from a DIFFERENT run
                     # loads cleanly but its master would silently revert the

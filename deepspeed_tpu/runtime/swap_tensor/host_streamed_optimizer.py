@@ -367,28 +367,69 @@ class HostStreamedOptimizer:
 
     # checkpoint persistence: UNLIKE the NVMe tier (whose swap files are
     # already durable on disk), host-tier state lives in process RAM — the
-    # engine persists it into the checkpoint tag directory
+    # engine persists it into the checkpoint tag directory (as the
+    # extra-state callback inside save_checkpoint's durability fence, so
+    # the npz files are covered by the tag's crc32 manifest and written
+    # BEFORE `latest` is published)
     def save_state(self, directory: str):
         import os
+
+        from ...resilience.atomic_io import atomic_savez
         for g in range(self.n_groups):
             arrs = {}
             for name, store in (("master", self._master), ("mu", self._mu), ("nu", self._nu)):
                 for i, x in enumerate(store[g]):
                     arrs[f"{name}_{i}"] = np.asarray(jax.device_get(x))
-            np.savez(os.path.join(directory, f"host_opt_group{g}.npz"), **arrs)
+            atomic_savez(os.path.join(directory, f"host_opt_group{g}.npz"), arrs,
+                         site="host_opt.save")
 
     def load_state(self, directory: str) -> bool:
         """Restore group state saved by ``save_state``; False when the files
-        are absent or shaped for a different partitioning."""
+        are absent, torn/corrupt (checksum manifest or archive read fails —
+        rejected up front, never mid-restore), or shaped for a different
+        partitioning.  The live state is only replaced once EVERY group
+        verified and loaded."""
         import os
+        import zipfile
+
+        from ...resilience import events
+        from ...resilience import fault_injection as fi
+        from ...resilience.atomic_io import verify_manifest
+        from ...resilience.retry import RetryPolicy, retry_call
+        from ...utils.logging import logger
+        # transient read errors at the load entry are retryable (the
+        # os_error taxonomy contract); archive-level failures below degrade
+        # to a False return instead
+        retry_call(lambda: fi.check("host_opt.load"),
+                   RetryPolicy(max_attempts=3, base_delay_s=0.01, max_delay_s=0.25,
+                               budget_s=2.0),
+                   site="host_opt.load")
+        if not all(os.path.exists(os.path.join(directory, f"host_opt_group{g}.npz"))
+                   for g in range(self.n_groups)):
+            return False
+        # the tag-level resilience manifest (written post-fence by
+        # save_checkpoint) pins every npz to its crc32; a tag saved before
+        # the manifest existed falls through to the archive-read guard
+        errors = verify_manifest(directory,
+                                 match=lambda rel: rel.startswith("host_opt_group"))
+        if errors:
+            logger.warning("host-streamed offload: rejecting host_opt_group*.npz "
+                           f"state at {directory} — checksum manifest failed: "
+                           f"{errors[0]}")
+            events.emit("resilience/host_opt_reject")
+            return False
         loads = []
         for g in range(self.n_groups):
             path = os.path.join(directory, f"host_opt_group{g}.npz")
-            if not os.path.exists(path):
+            try:
+                with np.load(path) as z:
+                    grp = {name: [z[f"{name}_{i}"] for i in range(len(self.groups[g]))]
+                           for name in ("master", "mu", "nu")}
+            except (OSError, ValueError, KeyError, zipfile.BadZipFile, EOFError) as e:
+                logger.warning(f"host-streamed offload: rejecting truncated/corrupt "
+                               f"{path}: {e}")
+                events.emit("resilience/host_opt_reject")
                 return False
-            with np.load(path) as z:
-                grp = {name: [z[f"{name}_{i}"] for i in range(len(self.groups[g]))]
-                       for name in ("master", "mu", "nu")}
             if any(g_arr.shape != np.asarray(jax.device_get(cur)).shape
                    for g_arr, cur in zip(grp["master"], self._master[g])):
                 return False

@@ -80,9 +80,9 @@ class PipelinedNVMeOptimizer:
                    "mu": [np.zeros_like(m) for m in master],
                    "nu": [np.zeros_like(m) for m in master]}
             self.swapper.swap_out_group(g, sub, blocking=True)
-        import json
-        with open(meta_path, "w") as f:
-            json.dump({"groups": shapes}, f)
+        # atomic: resume must never see a half-written partitioning manifest
+        from ...resilience.atomic_io import atomic_write_json
+        atomic_write_json(str(meta_path), {"groups": shapes})
         log_dist(f"PipelinedNVMeOptimizer: {len(param_leaves)} leaves in "
                  f"{self.n_groups} sub-groups on {nvme_path}", ranks=[0])
 

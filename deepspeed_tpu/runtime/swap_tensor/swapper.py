@@ -24,7 +24,14 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
+from ...resilience import fault_injection as fi
+from ...resilience.retry import RetryPolicy, retry_call
 from ...utils.logging import logger
+
+# swap I/O sits on the training critical path: retries are short and few —
+# a persistently failing NVMe should surface fast, not stall the step
+_SWAP_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.01, max_delay_s=0.25,
+                          budget_s=2.0)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,7 +95,7 @@ class TensorSwapper:
         a = 4096
         return -(-n // a) * a
 
-    def swap_out_async(self, key: str, tree) -> SwapOutHandle:
+    def swap_out_async(self, key: str, tree, _retry: bool = True) -> SwapOutHandle:
         leaves = jax.tree.leaves(tree)
         treedef = jax.tree.structure(tree)
         np_leaves = [np.ascontiguousarray(jax.device_get(l)) for l in leaves]
@@ -102,28 +109,61 @@ class TensorSwapper:
             "dtypes": [str(l.dtype) for l in np_leaves],
             "offsets": offsets,
         }
-        aio = self._aio_factory()
         path = self._path(key)
-        for l, o in zip(np_leaves, offsets):
-            aio.async_pwrite(l.reshape(-1), path, o)
+
+        def _issue_writes():
+            # ISSUE-time transients retried with backoff (the re-issue
+            # rewrites every leaf region, so a half-issued first attempt is
+            # harmless).  Failures surfacing later in the handle's wait()
+            # propagate on the ASYNC path — the blocking swap_out wrapper
+            # retries the whole issue+wait cycle instead
+            fi.check("swap.write")
+            aio = self._aio_factory()
+            for l, o in zip(np_leaves, offsets):
+                aio.async_pwrite(l.reshape(-1), path, o)
+            return aio
+
+        aio = retry_call(_issue_writes, _SWAP_RETRY, site="swap.write") if _retry \
+            else _issue_writes()
         return SwapOutHandle(aio)
 
     def swap_out(self, key: str, tree) -> None:
-        self.swap_out_async(key, tree).wait()
+        # blocking path: transient wait-side failures (EIO surfaced at
+        # completion) are absorbed by re-running the WHOLE issue+wait
+        # cycle — every leaf region is rewritten, so it is idempotent.
+        # The inner issue retry is disabled here: ONE policy governs the
+        # attempt count (nested retries would multiply to 3x3 and make
+        # chaos-plan hit counts unpredictable)
+        retry_call(lambda: self.swap_out_async(key, tree, _retry=False).wait(),
+                   _SWAP_RETRY, site="swap.write")
 
-    def swap_in_async(self, key: str) -> SwapInHandle:
+    def swap_in_async(self, key: str, _retry: bool = True) -> SwapInHandle:
         m = self._manifests[key]
-        aio = self._aio_factory()
         path = self._path(key)
-        buffers = []
-        for shape, dtype, off in zip(m["shapes"], m["dtypes"], m["offsets"]):
-            buf = np.empty(int(np.prod(shape)) if shape else 1, dtype=np.dtype(dtype))
-            aio.async_pread(buf, path, off)
-            buffers.append(buf)
+
+        def _issue_reads():
+            # issue-time transients only (see _issue_writes); fresh buffers
+            # per attempt so a torn first attempt cannot leak into the
+            # returned handle
+            fi.check("swap.read")
+            aio = self._aio_factory()
+            buffers = []
+            for shape, dtype, off in zip(m["shapes"], m["dtypes"], m["offsets"]):
+                buf = np.empty(int(np.prod(shape)) if shape else 1, dtype=np.dtype(dtype))
+                aio.async_pread(buf, path, off)
+                buffers.append(buf)
+            return aio, buffers
+
+        aio, buffers = retry_call(_issue_reads, _SWAP_RETRY, site="swap.read") if _retry \
+            else _issue_reads()
         return SwapInHandle(aio, buffers, m["treedef"], m["shapes"], m["dtypes"])
 
     def swap_in(self, key: str):
-        return self.swap_in_async(key).wait()
+        # blocking path: issue+wait retried end-to-end (fresh handle and
+        # buffers per attempt; inner issue retry disabled — one policy
+        # governs the attempt count)
+        return retry_call(lambda: self.swap_in_async(key, _retry=False).wait(),
+                          _SWAP_RETRY, site="swap.read")
 
     def release(self, key: str) -> None:
         self._manifests.pop(key, None)

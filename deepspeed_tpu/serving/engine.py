@@ -116,10 +116,23 @@ class ServingEngine:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: Optional[int] = None,
                deadline: Optional[float] = None, arrival_ts: Optional[float] = None,
-               priority: float = 0.0, stream: Optional[Callable] = None) -> ServingRequest:
+               priority: float = 0.0, stream: Optional[Callable] = None,
+               retry_policy=None) -> ServingRequest:
         """Enqueue one request.  NEVER raises on overload: the returned
         request's state is REJECTED (with ``reject_reason``) when admission
-        refuses it — callers inspect, the serving loop keeps running."""
+        refuses it — callers inspect, the serving loop keeps running.
+
+        ``retry_policy`` (a resilience ``RetryPolicy``): back off on the
+        clock and re-probe admission while the rejection is TRANSIENT
+        (``queue_full`` — pressure that drains), within the policy's
+        attempt/time budget; structural rejections (infeasible request)
+        are final immediately.  Each backoff probe runs one ``tick()`` so
+        the loop makes real progress while the submitter waits (in a
+        single-threaded clock-driven driver nothing else would drain the
+        queue); deadlines that expire during the wait expire because time
+        — and engine work — genuinely passed."""
+        from ..resilience import fault_injection as _fi
+        _fi.check("serving.admit")  # chaos site: admission stragglers/faults
         now = self.clock.now() if arrival_ts is None else float(arrival_ts)
         if max_new_tokens is None:
             max_new_tokens = self.engine.econfig.max_new_tokens
@@ -138,6 +151,22 @@ class ServingEngine:
         self._requests[req.uid] = req
         self.stats.submitted += 1
         ok, reason = self.admission.submit_ok(req, len(self._queue))
+        if not ok and reason == "queue_full" and retry_policy is not None:
+            from ..resilience.retry import backoff_until
+
+            def _probe():
+                self.tick()  # drain queued work: backoff must be able to succeed
+                got, why = self.admission.submit_ok(req, len(self._queue))
+                return got, why == "queue_full"
+
+            if backoff_until(_probe, retry_policy, self.clock, site="serving.admit"):
+                ok, reason = True, None
+            else:
+                ok, reason = self.admission.submit_ok(req, len(self._queue))
+            # the clock advanced (and the engine ticked) during the
+            # backoff — a terminal transition stamped with the stale
+            # pre-backoff `now` would erase the wait the request lived
+            now = self.clock.now()
         if not ok:
             req.reject_reason = reason
             req.to(RequestState.REJECTED, now)

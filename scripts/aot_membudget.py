@@ -296,8 +296,8 @@ def main():
         rec = analyze(name)
         results[name] = rec
         print(json.dumps(rec, indent=2), flush=True)
-        with open(out_path, "w") as f:
-            json.dump(results, f, indent=2)
+        from deepspeed_tpu.resilience.atomic_io import atomic_write_json
+        atomic_write_json(out_path, results, indent=2)
     print(f"wrote {os.path.normpath(out_path)}")
 
 

@@ -109,8 +109,9 @@ def main():
         "loss_finite": bool(np.isfinite(loss_131k)),
         "flash_at_131k": flash_131k,
     }
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "BENCH_LONGCTX.json"), "w") as f:
-        json.dump(out, f, indent=2)
+    from deepspeed_tpu.resilience.atomic_io import atomic_write_json
+    atomic_write_json(os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "BENCH_LONGCTX.json"),
+                      out, indent=2)
     print(json.dumps(out))
 
 

@@ -236,8 +236,9 @@ def main():
     if leg is None:
         leg = {"error": (proc.stderr or proc.stdout)[-400:]}
     out["extra"]["host_streamed_1p6b"] = leg
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "BENCH_SCALE.json"), "w") as f:
-        json.dump(out, f, indent=2)
+    from deepspeed_tpu.resilience.atomic_io import atomic_write_json
+    atomic_write_json(os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "BENCH_SCALE.json"),
+                      out, indent=2)
     print(json.dumps(out))
 
 

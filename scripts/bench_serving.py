@@ -242,8 +242,8 @@ def main():
     }
     print(json.dumps({k: result[k] for k in ("metric", "value", "unit")} |
                      {"sweep_rates": rates}))
-    with open(args.out, "w") as f:
-        json.dump(result, f, indent=1)
+    from deepspeed_tpu.resilience.atomic_io import atomic_write_json
+    atomic_write_json(args.out, result, indent=1)
 
 
 if __name__ == "__main__":

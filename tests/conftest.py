@@ -88,6 +88,7 @@ SLOW_TESTS = {
     "compression/test_compression.py::test_engine_trains_with_compression",
     "elasticity/test_elastic_agent.py::test_agent_rejects_incompatible_world",
     "elasticity/test_elastic_agent.py::test_agent_survives_world_shrink",
+    "elasticity/test_elastic_agent_faults.py::test_injected_device_loss_real_engine",
     "inference/test_hf_factory.py::test_build_hf_engine_generates",
     "inference/test_hf_factory.py::test_hf_logits_parity",
     "inference/test_hf_factory.py::test_mistral_sliding_window_masks",

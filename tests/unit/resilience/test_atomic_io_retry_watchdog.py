@@ -1,0 +1,205 @@
+"""Atomic-write/manifest primitives, the budgeted retry, the clock-driven
+admission backoff, and the step watchdog (r7 tentpole,
+resilience/{atomic_io,retry,watchdog}.py)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.resilience import events
+from deepspeed_tpu.resilience.atomic_io import (
+    MANIFEST_NAME, atomic_savez, atomic_write_json, atomic_write_text,
+    crc32_file, npz_array_crcs, verify_manifest, write_manifest)
+from deepspeed_tpu.resilience.fault_injection import (
+    InjectedCrash, InjectedTransientError, configure_fault_injection)
+from deepspeed_tpu.resilience.retry import RetryPolicy, backoff_until, retry_call
+from deepspeed_tpu.resilience.watchdog import StepHungError, StepWatchdog
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    configure_fault_injection(None)
+    events.clear()
+
+
+# -------------------------------------------------------------- atomic I/O
+
+def test_atomic_write_publishes_and_leaves_no_debris(tmp_path):
+    p = tmp_path / "meta.json"
+    atomic_write_json(str(p), {"a": 1}, indent=2)
+    assert json.loads(p.read_text()) == {"a": 1}
+    assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+
+
+def test_torn_write_preserves_old_content(tmp_path):
+    p = tmp_path / "latest"
+    atomic_write_text(str(p), "good", site="ckpt.latest_publish")
+    configure_fault_injection(
+        {"sites": [{"site": "ckpt.latest_publish", "kind": "torn_write", "at": 1}]})
+    with pytest.raises(InjectedCrash):
+        atomic_write_text(str(p), "bad_tag_that_is_longer", site="ckpt.latest_publish")
+    # the crash-safety property: the published path still holds the OLD value
+    assert p.read_text() == "good"
+    # ... and the simulated death left temp debris, which readers ignore
+    assert any(".tmp." in f for f in os.listdir(tmp_path))
+
+
+def test_torn_write_on_fresh_path_leaves_it_absent(tmp_path):
+    p = tmp_path / "meta.json"
+    configure_fault_injection(
+        {"sites": [{"site": "ckpt.meta_write", "kind": "torn_write", "at": 1}]})
+    with pytest.raises(InjectedCrash):
+        atomic_write_json(str(p), {"a": 1}, site="ckpt.meta_write")
+    assert not p.exists()
+
+
+def test_corrupt_kind_flips_published_bytes(tmp_path):
+    p = tmp_path / "latest"
+    configure_fault_injection(
+        {"sites": [{"site": "ckpt.latest_publish", "kind": "corrupt", "at": 1}]})
+    atomic_write_text(str(p), "good_tag", site="ckpt.latest_publish")  # no raise
+    assert p.exists() and p.read_bytes() != b"good_tag"
+
+
+def test_manifest_roundtrip_and_corruption_detection(tmp_path):
+    atomic_write_json(str(tmp_path / "meta.json"), {"step": 4})
+    atomic_savez(str(tmp_path / "host_opt_group0.npz"),
+                 {"master_0": np.arange(64, dtype=np.float32)})
+    manifest = write_manifest(str(tmp_path), site=None)
+    assert set(manifest["files"]) == {"meta.json", "host_opt_group0.npz"}
+    assert "master_0" in manifest["files"]["host_opt_group0.npz"]["arrays"]
+    assert verify_manifest(str(tmp_path)) == []
+    # flip one byte inside the npz → the per-file crc must catch it
+    path = tmp_path / "host_opt_group0.npz"
+    raw = bytearray(path.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    errors = verify_manifest(str(tmp_path))
+    assert errors and "host_opt_group0.npz" in errors[0]
+    # match= restricts what is verified
+    assert verify_manifest(str(tmp_path), match=lambda rel: rel == "meta.json") == []
+
+
+def test_verify_manifest_missing_is_legacy_ok_unless_required(tmp_path):
+    atomic_write_json(str(tmp_path / "meta.json"), {})
+    assert verify_manifest(str(tmp_path)) == []
+    assert verify_manifest(str(tmp_path), require=True) != []
+
+
+def test_manifest_ignores_tmp_debris(tmp_path):
+    atomic_write_json(str(tmp_path / "meta.json"), {})
+    (tmp_path / f"meta.json.tmp.{os.getpid()}").write_text("debris")
+    manifest = write_manifest(str(tmp_path), site=None)
+    assert list(manifest["files"]) == ["meta.json"]
+    assert verify_manifest(str(tmp_path)) == []
+
+
+# ------------------------------------------------------------------- retry
+
+def test_retry_absorbs_transients_then_succeeds():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    slept = []
+    out = retry_call(flaky, RetryPolicy(max_attempts=4, base_delay_s=0.01, jitter=0.0),
+                     site="swap.write", sleep=slept.append)
+    assert out == "ok" and calls["n"] == 3
+    assert slept == [0.01, 0.02]  # exponential, jitter off
+    assert len(events.recent("resilience/retry")) == 2
+
+
+def test_retry_exhausts_and_reraises():
+    def always_fails():
+        raise OSError("persistent")
+
+    with pytest.raises(OSError, match="persistent"):
+        retry_call(always_fails, RetryPolicy(max_attempts=3, base_delay_s=0.001),
+                   sleep=lambda _d: None)
+    assert len(events.recent("resilience/retry_exhausted")) == 1
+
+
+def test_retry_never_absorbs_injected_crash():
+    calls = {"n": 0}
+
+    def dies():
+        calls["n"] += 1
+        raise InjectedCrash("simulated process death")
+
+    with pytest.raises(InjectedCrash):
+        retry_call(dies, RetryPolicy(max_attempts=5, base_delay_s=0.001),
+                   sleep=lambda _d: None)
+    assert calls["n"] == 1  # no second attempt: the 'process' is dead
+
+
+def test_retry_respects_time_budget():
+    def always_fails():
+        raise OSError("x")
+
+    slept = []
+    with pytest.raises(OSError):
+        retry_call(always_fails,
+                   RetryPolicy(max_attempts=10, base_delay_s=1.0, jitter=0.0,
+                               multiplier=1.0, budget_s=2.5),
+                   sleep=slept.append)
+    assert slept == [1.0, 1.0]  # third 1.0s sleep would breach the 2.5s budget
+
+
+def test_delays_are_site_deterministic():
+    p = RetryPolicy(max_attempts=5, seed=1)
+    assert list(p.delays("swap.read")) == list(p.delays("swap.read"))
+    assert list(p.delays("swap.read")) != list(p.delays("swap.write"))
+
+
+def test_backoff_until_on_virtual_clock():
+    from deepspeed_tpu.serving.clock import VirtualClock
+    clock = VirtualClock()
+    probes = {"n": 0}
+
+    def check():
+        probes["n"] += 1
+        return probes["n"] >= 2, True  # transient until the 2nd probe
+
+    policy = RetryPolicy(max_attempts=5, base_delay_s=1.0, jitter=0.0,
+                         multiplier=2.0, budget_s=100.0)
+    assert backoff_until(check, policy, clock) is True
+    assert probes["n"] == 2
+    assert clock.now() == pytest.approx(3.0)  # waited 1s + 2s of virtual time
+    assert len(events.recent("resilience/admission_retry")) == 2
+
+
+def test_backoff_until_gives_up_on_structural_failure():
+    from deepspeed_tpu.serving.clock import VirtualClock
+    clock = VirtualClock()
+    assert backoff_until(lambda: (False, False),
+                         RetryPolicy(max_attempts=5, base_delay_s=1.0),
+                         clock) is False
+    assert clock.now() <= 2.0  # one probe after the first wait, then done
+
+
+# ---------------------------------------------------------------- watchdog
+
+def test_watchdog_passes_through_results_and_errors():
+    wd = StepWatchdog(5.0)
+    assert wd.run(lambda: 42) == 42
+    with pytest.raises(ValueError, match="boom"):
+        wd.run(lambda: (_ for _ in ()).throw(ValueError("boom")))
+    assert wd.hangs == 0
+
+
+def test_watchdog_classifies_hang_as_device_loss():
+    wd = StepWatchdog(0.1, name="step")
+    t0 = time.monotonic()
+    with pytest.raises(StepHungError, match="DEVICE_LOST"):
+        wd.run(time.sleep, 1.0)
+    assert time.monotonic() - t0 < 0.9  # raised at the deadline, not after
+    assert wd.hangs == 1
+    assert len(events.recent("resilience/watchdog_hang")) == 1

@@ -1,0 +1,281 @@
+"""Chaos capstone (r7 acceptance): kill-or-corrupt a run at each
+checkpoint-path injection site mid-save, resume from the directory, and
+assert the resumed loss trajectory is IDENTICAL to the uninterrupted run —
+plus auto-fallback to the newest valid tag when the published checkpoint
+is corrupt, with no manual intervention.
+
+Efficiency structure (tier-1 budget): ONE victim engine trains
+``TOTAL_STEPS`` uninterrupted (its losses ARE the baseline — crashed save
+attempts never mutate training state) while writing a clean 'good'
+checkpoint at step ``GOOD_AT`` and attempting a faulted 'bad' save at step
+``BAD_AT`` into a per-scenario directory; ONE resumer engine is reloaded
+per scenario (load_checkpoint fully resets it)."""
+
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.llama import LlamaForCausalLM
+from deepspeed_tpu.resilience import events
+from deepspeed_tpu.resilience.fault_injection import (InjectedCrash,
+                                                      configure_fault_injection)
+
+from simple_model import TINY, base_config, random_batch
+
+TOTAL_STEPS = 6
+GOOD_AT = 2   # clean checkpoint after this step
+BAD_AT = 4    # faulted save attempt after this step
+
+# (scenario key, fault kind) — every save-path injection site is killed
+CRASH_SITES = [
+    ("ckpt.state_save", "crash"),
+    ("ckpt.meta_write", "torn_write"),
+    ("ckpt.manifest_write", "torn_write"),
+    ("ckpt.latest_publish", "torn_write"),
+]
+# scenarios where the BAD save completes and the directory is vandalized
+# afterwards (silent corruption / operator damage)
+POST_HOC = ["corrupt_latest", "corrupt_state", "deleted_tag", "no_valid"]
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    configure_fault_injection(None)
+
+
+def _make_engine():
+    engine, _, _, _ = ds.initialize(model=LlamaForCausalLM(TINY),
+                                    config=base_config())
+    return engine
+
+
+@pytest.fixture(scope="module")
+def chaos(tmp_path_factory):
+    batch = random_batch()
+    dirs = {key: str(tmp_path_factory.mktemp(key.replace(".", "_")))
+            for key, _kind in CRASH_SITES}
+    dirs.update({key: str(tmp_path_factory.mktemp(key)) for key in POST_HOC})
+    engine = _make_engine()
+    losses, crash_errors = [], {}
+    for step in range(TOTAL_STEPS):
+        losses.append(float(engine.train_batch(batch=batch)))
+        if step + 1 == GOOD_AT:
+            for d in dirs.values():
+                engine.save_checkpoint(d, tag="good")
+        if step + 1 == BAD_AT:
+            for site, kind in CRASH_SITES:
+                configure_fault_injection(
+                    {"sites": [{"site": site, "kind": kind, "at": 1}]})
+                try:
+                    engine.save_checkpoint(dirs[site], tag="bad")
+                    crash_errors[site] = None
+                except Exception as e:  # the injected kill
+                    crash_errors[site] = e
+                finally:
+                    configure_fault_injection(None)
+            for key in POST_HOC:
+                engine.save_checkpoint(dirs[key], tag="bad")
+    return {"dirs": dirs, "losses": losses, "batch": batch,
+            "crash_errors": crash_errors}
+
+
+@pytest.fixture(scope="module")
+def resumer(chaos):
+    engine = _make_engine()
+    # materialize state AND diverge it, so only a real restore can explain
+    # trajectory equality
+    engine.train_batch(batch=random_batch(seed=99))
+    return engine
+
+
+def _resume_and_check(resumer, chaos, ckpt_dir, expect_step, expect_tag):
+    path, _ = resumer.load_checkpoint(ckpt_dir)
+    assert path is not None and os.path.basename(path) == expect_tag
+    loaded = int(resumer.state.step)
+    assert loaded == expect_step, \
+        f"resumed at step {loaded}, expected {expect_step}"
+    resumed = [float(resumer.train_batch(batch=chaos["batch"]))
+               for _ in range(TOTAL_STEPS - loaded)]
+    np.testing.assert_allclose(resumed, chaos["losses"][loaded:],
+                               rtol=0, atol=1e-4)
+
+
+@pytest.mark.parametrize("site,kind", CRASH_SITES)
+def test_kill_during_save_resumes_identical_trajectory(site, kind, chaos, resumer):
+    """A kill at ANY save-path site leaves `latest` pointing at the intact
+    'good' checkpoint; resume reproduces the uninterrupted trajectory."""
+    err = chaos["crash_errors"][site]
+    assert err is not None, f"injected {kind} at {site} did not surface"
+    assert isinstance(err, (InjectedCrash, OSError)), err
+    # the torn 'bad' publication never went live
+    latest = os.path.join(chaos["dirs"][site], "latest")
+    assert open(latest).read().strip() == "good"
+    _resume_and_check(resumer, chaos, chaos["dirs"][site],
+                      expect_step=GOOD_AT, expect_tag="good")
+
+
+def test_corrupt_published_state_auto_falls_back(chaos, resumer):
+    """`latest` → 'bad', but a state file rotted after publication: the
+    manifest check invalidates 'bad' and the loader falls back to 'good'
+    with no manual intervention."""
+    d = chaos["dirs"]["corrupt_state"]
+    state_dir = os.path.join(d, "bad", "state")
+    victim_file = None
+    for dirpath, _dn, filenames in os.walk(state_dir):
+        for fn in filenames:
+            full = os.path.join(dirpath, fn)
+            if os.path.getsize(full) > 0:
+                victim_file = full
+                break
+        if victim_file:
+            break
+    assert victim_file, "no state file to corrupt"
+    raw = bytearray(open(victim_file, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(victim_file, "rb+").write(raw)
+    events.clear()
+    _resume_and_check(resumer, chaos, d, expect_step=GOOD_AT, expect_tag="good")
+    assert events.recent("resilience/ckpt_fallback")
+
+
+def test_corrupt_latest_pointer_falls_back_to_newest_valid(chaos, resumer):
+    """`latest` contains garbage: the loader picks the newest VALID tag —
+    here the fully-published 'bad' at step 4."""
+    d = chaos["dirs"]["corrupt_latest"]
+    open(os.path.join(d, "latest"), "w").write("no_such_tag")
+    _resume_and_check(resumer, chaos, d, expect_step=BAD_AT, expect_tag="bad")
+
+
+def test_latest_pointing_at_deleted_tag_falls_back(chaos, resumer):
+    """Satellite 1: a deleted tag dir behind `latest` degrades to a clear
+    warning + newest-valid fallback, not an opaque orbax error."""
+    d = chaos["dirs"]["deleted_tag"]
+    shutil.rmtree(os.path.join(d, "bad"))
+    _resume_and_check(resumer, chaos, d, expect_step=GOOD_AT, expect_tag="good")
+
+
+def test_no_valid_checkpoint_raises_clear_error(chaos, resumer):
+    d = chaos["dirs"]["no_valid"]
+    shutil.rmtree(os.path.join(d, "good"))
+    os.unlink(os.path.join(d, "bad", "meta.json"))
+    with pytest.raises(FileNotFoundError, match="no valid fallback"):
+        resumer.load_checkpoint(d)
+
+
+def test_explicit_tag_is_never_silently_substituted(chaos, resumer):
+    d = chaos["dirs"]["deleted_tag"]  # 'bad' was rmtree'd above
+    with pytest.raises(FileNotFoundError, match="not loadable"):
+        resumer.load_checkpoint(d, tag="bad")
+
+
+def test_transient_write_errors_are_absorbed_by_retry(chaos, resumer, tmp_path):
+    """os_error (unlike a kill) is retryable: the save completes, publishes
+    a VALID checkpoint, and leaves a resilience/retry event."""
+    events.clear()
+    configure_fault_injection(
+        {"sites": [{"site": "ckpt.meta_write", "kind": "os_error", "at": 1}]})
+    assert resumer.save_checkpoint(str(tmp_path), tag="t") is True
+    configure_fault_injection(None)
+    from deepspeed_tpu.checkpoint.engine import checkpoint_tag_valid
+    ok, why = checkpoint_tag_valid(str(tmp_path), "t")
+    assert ok, why
+    assert events.recent("resilience/retry")
+
+
+def test_host_tier_npz_torn_save_then_resume(tmp_path):
+    """The host-streamed tier's npz persistence lives INSIDE the durability
+    fence: a kill mid-`host_opt_group*.npz` write leaves 'good' published,
+    and resume (params + fp32 master + Adam moments from the npz) replays
+    the uninterrupted trajectory."""
+    import jax
+
+    from deepspeed_tpu.comm.mesh import MeshSpec, create_mesh
+
+    def host_engine():
+        mesh = create_mesh(MeshSpec(data=1), devices=jax.devices()[:1])
+        cfg = base_config(**{
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2,
+                                  "offload_optimizer": {"device": "cpu",
+                                                        "pipeline_read": True}},
+            "bf16": {"enabled": True}})
+        engine, _, _, _ = ds.initialize(model=LlamaForCausalLM(TINY), config=cfg,
+                                        mesh=mesh, dist_init_required=False)
+        assert engine._host_streamed_active(), "host-streamed tier not active"
+        return engine
+
+    batch = random_batch()
+    a = host_engine()
+    losses = [float(a.train_batch(batch=batch)) for _ in range(2)]
+    a.save_checkpoint(str(tmp_path), tag="good")
+    losses += [float(a.train_batch(batch=batch)) for _ in range(2)]
+    configure_fault_injection(
+        {"sites": [{"site": "host_opt.save", "kind": "torn_write", "at": 1}]})
+    with pytest.raises(InjectedCrash):
+        a.save_checkpoint(str(tmp_path), tag="bad")
+    configure_fault_injection(None)
+    losses += [float(a.train_batch(batch=batch)) for _ in range(2)]
+
+    b = host_engine()
+    b.train_batch(batch=random_batch(seed=99))
+    path, _ = b.load_checkpoint(str(tmp_path))
+    assert path is not None and os.path.basename(path) == "good"
+    assert int(b.state.step) == 2
+    resumed = [float(b.train_batch(batch=batch)) for _ in range(4)]
+    np.testing.assert_allclose(resumed, losses[2:], rtol=2e-3)
+
+
+def test_host_opt_load_rejects_torn_and_corrupt_npz(tmp_path):
+    """Satellite 3: load_state refuses a truncated archive up front (no
+    mid-restore raise) and, when the tag manifest is present, refuses a
+    checksum-corrupt one."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.adam import fused_adam
+    from deepspeed_tpu.resilience.atomic_io import write_manifest
+    from deepspeed_tpu.runtime.swap_tensor.host_streamed_optimizer import \
+        HostStreamedOptimizer
+
+    rng = np.random.default_rng(0)
+    leaves = [jnp.asarray(rng.normal(size=(8, 8)), jnp.float32) for _ in range(4)]
+    opt = HostStreamedOptimizer(fused_adam(lr=1e-2), leaves, n_groups=2)
+    opt.save_state(str(tmp_path))
+    assert opt.load_state(str(tmp_path)) is True
+
+    p = tmp_path / "host_opt_group0.npz"
+    raw = p.read_bytes()
+    p.write_bytes(raw[:len(raw) // 2])  # truncated (torn non-atomic copy)
+    assert opt.load_state(str(tmp_path)) is False
+
+    opt.save_state(str(tmp_path))
+    write_manifest(str(tmp_path), site=None)
+    raw = bytearray(p.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF  # same size, silent bit rot
+    p.write_bytes(bytes(raw))
+    events.clear()
+    assert opt.load_state(str(tmp_path)) is False
+    assert events.recent("resilience/host_opt_reject")
+
+
+def test_keep_last_k_retention(resumer, tmp_path):
+    """checkpoint.keep_last_n prunes the oldest tags after a successful
+    publish; `latest` always names a surviving, valid tag.  (Retention
+    reads the VALIDATED CheckpointConfig, not the raw param dict.)"""
+    cc = resumer._config.checkpoint_config
+    cc.keep_last_n = 2
+    try:
+        for i in range(4):
+            resumer.save_checkpoint(str(tmp_path), tag=f"t{i}")
+    finally:
+        cc.keep_last_n = None
+    tags = sorted(d for d in os.listdir(tmp_path)
+                  if os.path.isdir(os.path.join(tmp_path, d)))
+    assert tags == ["t2", "t3"]
+    assert open(os.path.join(tmp_path, "latest")).read().strip() == "t3"

@@ -1,0 +1,121 @@
+"""Deterministic fault injector: exact-count and seeded-probabilistic
+firing, the fault taxonomy's exception classes, env-driven arming, and the
+``resilience/*`` event trail (r7 tentpole, resilience/fault_injection.py)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from deepspeed_tpu.resilience import events
+from deepspeed_tpu.resilience.fault_injection import (
+    ENV_PLAN_VAR, INJECTION_SITES, DeviceLossError, FaultInjector, FaultSpec,
+    InjectedCrash, InjectedTransientError, configure_fault_injection,
+    fault_injector)
+from deepspeed_tpu.resilience import fault_injection as fi
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    os.environ.pop(ENV_PLAN_VAR, None)
+    configure_fault_injection(None)
+    events.clear()
+
+
+def test_count_triggered_fires_exactly_on_nth_hit():
+    inj = FaultInjector([FaultSpec("host_opt.load", "os_error", at=3, times=2)])
+    inj.check("host_opt.load")
+    inj.check("host_opt.load")
+    with pytest.raises(InjectedTransientError):
+        inj.check("host_opt.load")  # hit 3
+    with pytest.raises(InjectedTransientError):
+        inj.check("host_opt.load")  # hit 4 (times=2)
+    inj.check("host_opt.load")      # hit 5: spent
+    inj.check("host_opt.load")
+
+
+def test_sites_are_independent_counters():
+    inj = FaultInjector([FaultSpec("swap.read", "os_error", at=2)])
+    inj.check("swap.write")  # other sites never advance swap.read's count
+    inj.check("swap.read")
+    inj.check("swap.write")
+    with pytest.raises(InjectedTransientError):
+        inj.check("swap.read")
+
+
+def test_probabilistic_is_seed_deterministic():
+    def pattern(seed):
+        inj = FaultInjector([FaultSpec("engine.step", "os_error", p=0.5, times=100)],
+                            seed=seed)
+        fired = []
+        for _ in range(32):
+            try:
+                inj.check("engine.step")
+                fired.append(False)
+            except InjectedTransientError:
+                fired.append(True)
+        return fired
+
+    assert pattern(7) == pattern(7)
+    assert any(pattern(7)) and not all(pattern(7))
+    assert pattern(7) != pattern(8)  # different seed, different schedule
+
+
+def test_fault_taxonomy_exception_classes():
+    inj = FaultInjector([FaultSpec("engine.step", "device_loss", at=1),
+                         FaultSpec("engine.step", "crash", at=2)])
+    with pytest.raises(DeviceLossError, match="DEVICE_LOST"):
+        inj.check("engine.step")
+    with pytest.raises(InjectedCrash) as ei:
+        inj.check("engine.step")
+    # a simulated process death must never look like a retryable I/O error
+    assert not isinstance(ei.value, OSError)
+
+
+def test_latency_kind_sleeps():
+    inj = FaultInjector([FaultSpec("serving.admit", "latency", at=1, delay_s=0.05)])
+    t0 = time.monotonic()
+    inj.check("serving.admit")
+    assert time.monotonic() - t0 >= 0.045
+    inj.check("serving.admit")  # subsequent hits are free
+
+
+def test_unknown_site_and_kind_fail_loudly():
+    with pytest.raises(ValueError, match="unknown injection site"):
+        FaultSpec("ckpt.typo", "os_error")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSpec("ckpt.meta_write", "explode")
+    inj = FaultInjector([])
+    with pytest.raises(ValueError, match="unknown injection site"):
+        inj.check("not.a.site")
+
+
+def test_module_level_check_is_noop_when_unarmed():
+    configure_fault_injection(None)
+    assert fault_injector() is None
+    for site in INJECTION_SITES:
+        fi.check(site)  # never raises
+
+
+def test_env_plan_arming():
+    os.environ[ENV_PLAN_VAR] = json.dumps(
+        {"seed": 3, "sites": [{"site": "ckpt.state_save", "kind": "os_error", "at": 1}]})
+    inj = fi.arm_from_env()  # the import-time hook
+    assert inj is not None and inj.seed == 3
+    with pytest.raises(InjectedTransientError):
+        fi.check("ckpt.state_save")
+    # disarm means disarm — even with the env plan still exported
+    configure_fault_injection(None)
+    assert fi.fault_injector() is None
+    assert fi.arm_from_env() is not None  # only the explicit hook re-arms
+
+
+def test_writer_fault_returns_tear_spec_and_emits_event():
+    events.clear()
+    inj = FaultInjector([FaultSpec("ckpt.meta_write", "torn_write", at=1, fraction=0.25)])
+    spec = inj.writer_fault("ckpt.meta_write")
+    assert spec is not None and spec.kind == "torn_write" and spec.fraction == 0.25
+    assert inj.writer_fault("ckpt.meta_write") is None
+    assert len(events.recent("resilience/fault_injected")) == 1
