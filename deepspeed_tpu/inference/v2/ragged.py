@@ -136,23 +136,33 @@ class PrefixCacheManager:
             h = hash((h, tuple(tokens[i * self.page_size:(i + 1) * self.page_size])))
             yield h, i
 
-    def match(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
-        """Longest run of cached pages covering a prefix of ``tokens``,
-        plus the chain hash at the match boundary (the caller seeds the
-        sequence's register() cursor with it).  Caps at len(tokens)-1 so
-        the engine still computes at least one prompt token (the last
-        one's logits seed generation).  Returned pages are retained on
-        behalf of the caller."""
-        matched: List[int] = []
-        h_end = self._SEED
+    def _walk(self, tokens: Sequence[int]):
+        """Yield ``(chain_hash, page_id)`` for the longest run of cached
+        full pages covering a prefix of ``tokens`` — the ONE matching rule
+        (chain walk, token verification, last-token cap) shared by the
+        mutating :meth:`match` and the read-only :meth:`lookup_depth`, so
+        routing warmth can never desynchronize from what a subsequent
+        match() actually attaches.  Caps at len(tokens)-1: the engine must
+        still compute at least one prompt token (its logits seed
+        generation)."""
         usable = len(tokens) - 1
         for h, i in self._chain(tokens):
             if (i + 1) * self.page_size > usable:
-                break
+                return
             entry = self._pages.get(h)
             if entry is None or entry[1] != tuple(tokens[i * self.page_size:(i + 1) * self.page_size]):
-                break
-            matched.append(entry[0])
+                return
+            yield h, entry[0]
+
+    def match(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest run of cached pages covering a prefix of ``tokens``,
+        plus the chain hash at the match boundary (the caller seeds the
+        sequence's register() cursor with it).  Returned pages are retained
+        on behalf of the caller."""
+        matched: List[int] = []
+        h_end = self._SEED
+        for h, page in self._walk(tokens):
+            matched.append(page)
             h_end = h
             self._lru.move_to_end(h)  # whole chain refreshed root→leaf
         if matched:
@@ -161,6 +171,17 @@ class PrefixCacheManager:
         elif len(tokens) > self.page_size:
             self.misses += 1
         return matched, h_end
+
+    def lookup_depth(self, tokens: Sequence[int]) -> int:
+        """How many leading FULL pages of ``tokens`` this cache holds —
+        WITHOUT retaining pages, touching the LRU, or counting a hit/miss.
+        The fleet router's prefix-affinity policy probes every replica's
+        cache with this to find the warmest one; a mutating probe would
+        retain pages on replicas that never receive the request (leaking
+        refcounts) and refresh their LRU for traffic they never served.
+        Shares :meth:`match`'s traversal (``_walk``), so the reported
+        warmth is exactly what a subsequent match() would attach."""
+        return sum(1 for _ in self._walk(tokens))
 
     def register(self, seq: "SequenceDescriptor") -> None:
         """Publish ``seq``'s newly-completed full pages, resuming from the

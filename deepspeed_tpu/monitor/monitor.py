@@ -132,10 +132,23 @@ class csvMonitor(Monitor):
 
 
 class MonitorMaster(Monitor):
-    """ref: monitor/monitor.py:30 — routes events to every enabled writer."""
+    """ref: monitor/monitor.py:30 — routes events to every enabled writer.
+
+    Event volume is bounded: past ``monitor_config.max_events`` forwarded
+    events (0 = unbounded), further events are DROPPED and counted in
+    ``dropped_events`` — a fleet simulation fans N replicas' ``serving/*``
+    streams plus ``fleet/*`` routing events through one master, an order of
+    magnitude more than a single engine, and an unbounded CSV/TensorBoard
+    stream would grow without limit.  Each time the drop count crosses a
+    power of two, one ``monitor/dropped_events`` summary event is forwarded
+    (O(log drops) overhead) so the loss is visible on the same surface."""
 
     def __init__(self, monitor_config):
         super().__init__(monitor_config)
+        self.max_events = int(getattr(monitor_config, "max_events", 0) or 0)
+        self.events_written = 0
+        self.dropped_events = 0
+        self._next_drop_notice = 1
         self.monitors = []
         try:
             import jax
@@ -163,5 +176,24 @@ class MonitorMaster(Monitor):
         self.enabled = bool(self.monitors)
 
     def write_events(self, event_list):
+        if self.max_events > 0:
+            room = self.max_events - self.events_written
+            if room <= 0:
+                self._drop(len(event_list))
+                return
+            if len(event_list) > room:
+                self._drop(len(event_list) - room)
+                event_list = event_list[:room]
+        self.events_written += len(event_list)
         for m in self.monitors:
             m.write_events(event_list)
+
+    def _drop(self, n: int) -> None:
+        self.dropped_events += n
+        if self.dropped_events >= self._next_drop_notice:
+            while self._next_drop_notice <= self.dropped_events:
+                self._next_drop_notice *= 2
+            notice = [("monitor/dropped_events", float(self.dropped_events),
+                       self.events_written + self.dropped_events)]
+            for m in self.monitors:
+                m.write_events(notice)

@@ -3,8 +3,8 @@
 The chaos contract this enables (docs/RESILIENCE.md): every I/O or
 state-transition edge that can tear in production — checkpoint writes and
 restores, host-tier ``host_opt_group*.npz`` save/load, NVMe swap I/O, the
-engine's step dispatch, serving admission — is wrapped in a named
-injection site.  A test (or an operator drill, via the environment) arms a
+engine's step dispatch, serving admission, fleet-router dispatch — is
+wrapped in a named injection site.  A test (or an operator drill, via the environment) arms a
 *plan* of :class:`FaultSpec` entries and the exact same code path that
 runs in production fires torn writes, transient ``OSError``\\ s, device
 losses, stragglers, or simulated process death at a deterministic,
@@ -81,6 +81,7 @@ INJECTION_SITES = frozenset({
     "swap.read",            # NVMe/disk swap read issue
     "engine.step",          # training-step dispatch (runtime/engine.py)
     "serving.admit",        # serving request admission (serving/engine.py)
+    "router.dispatch",      # fleet router request dispatch (serving/fleet/router.py)
 })
 
 _RAISING_KINDS = ("os_error", "crash", "device_loss", "latency")

@@ -255,6 +255,11 @@ class DeepSpeedMonitorConfig(DeepSpeedConfigModel):
     comet: CometConfig = CometConfig()
     wandb: WandbConfig = WandbConfig()
     csv_monitor: CSVConfig = CSVConfig()
+    # MonitorMaster caps total buffered/forwarded events at this count and
+    # drops the rest (counted in ``monitor/dropped_events``).  Fleet sims
+    # emit an order of magnitude more events than a single engine; an
+    # unbounded CSV/TB stream would grow without limit.  0 = unbounded.
+    max_events: int = 0
 
 
 class CheckpointConfig(DeepSpeedConfigModel):

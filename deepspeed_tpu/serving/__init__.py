@@ -7,14 +7,15 @@ metric definitions.
 """
 
 from .admission import AdmissionConfig, AdmissionController
-from .clock import VirtualClock, WallClock
+from .clock import ReplicaClockView, VirtualClock, WallClock
 from .engine import ServingConfig, ServingEngine
 from .kv_pressure import KVPressureManager
 from .metrics import ServingStats, percentile_summary
 from .request import RequestState, ServingRequest
 
 __all__ = [
-    "AdmissionConfig", "AdmissionController", "VirtualClock", "WallClock",
+    "AdmissionConfig", "AdmissionController", "ReplicaClockView",
+    "VirtualClock", "WallClock",
     "ServingConfig", "ServingEngine", "KVPressureManager", "ServingStats",
     "percentile_summary", "RequestState", "ServingRequest",
 ]

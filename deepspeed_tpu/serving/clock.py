@@ -25,6 +25,13 @@ class VirtualClock:
     def now(self) -> float:
         return self._now
 
+    def reset(self) -> None:
+        """Re-zero.  Callers that build expensive state (engine warmup)
+        before serving reset the clock so t=0 means 'serving starts', not
+        'process started'; on a virtual clock construction costs nothing so
+        this is a no-op unless time was explicitly advanced."""
+        self._now = 0.0
+
     def advance(self, dt: float) -> None:
         assert dt >= 0, f"virtual clock cannot go backwards (dt={dt})"
         self._now += dt
@@ -33,9 +40,12 @@ class VirtualClock:
         """Jump to ``ts`` (idle gap between arrivals); never rewinds."""
         self._now = max(self._now, ts)
 
-    def on_step(self, cost: float) -> None:
-        """One engine step consumed ``cost`` virtual seconds."""
+    def on_step(self, cost: float) -> float:
+        """One engine step consumed ``cost`` virtual seconds.  Returns the
+        charged duration (clocks that account the cost themselves return it;
+        WallClock returns None and the caller measures real elapsed time)."""
         self.advance(cost)
+        return cost
 
 
 class WallClock:
@@ -48,11 +58,52 @@ class WallClock:
     def now(self) -> float:
         return time.monotonic() - self._t0
 
+    def reset(self) -> None:
+        """Re-zero so t=0 is 'serving starts' (see VirtualClock.reset —
+        engine build/warmup before the drive loop must not age the
+        workload's arrival timestamps and deadlines past before it runs)."""
+        self._t0 = time.monotonic()
+
     def wait_until(self, ts: float) -> None:
         delta = ts - self.now()
         if delta > 0:
             time.sleep(delta)
 
     def on_step(self, cost: float) -> None:
-        # real time already passed during the step
-        pass
+        # real time already passed during the step; None tells the caller
+        # to measure the wall-clock duration itself
+        return None
+
+
+class ReplicaClockView:
+    """Per-replica view of one shared :class:`VirtualClock` for the fleet
+    simulator.
+
+    N replicas of a fleet step CONCURRENTLY in a real deployment, so a
+    simulated round in which every replica runs one tick must advance time
+    by the SLOWEST replica's step cost — not the sum (which would model the
+    replicas taking turns and erase the fleet's throughput scaling).  Each
+    replica's ServingEngine gets a view: ``now()`` reads the shared clock,
+    ``on_step`` RECORDS the cost instead of advancing, and the fleet driver
+    advances the shared clock once per round by ``max(take_cost())`` over
+    the replicas that ticked."""
+
+    def __init__(self, shared: VirtualClock):
+        self.shared = shared
+        self._pending_cost = 0.0
+
+    def now(self) -> float:
+        return self.shared.now()
+
+    def wait_until(self, ts: float) -> None:
+        self.shared.wait_until(ts)
+
+    def on_step(self, cost: float) -> float:
+        self._pending_cost = max(self._pending_cost, cost)
+        return cost
+
+    def take_cost(self) -> float:
+        """Drain the cost recorded since the last take (the fleet driver
+        calls this once per replica per round)."""
+        cost, self._pending_cost = self._pending_cost, 0.0
+        return cost

@@ -70,7 +70,14 @@ class ServingEngine:
         self._uids = itertools.count(max(engine.state.seqs.keys(), default=-1) + 1)
         self._events_step = 0
         self._t0 = self.clock.now()
-        if isinstance(self.clock, VirtualClock) and \
+        # EWMA of clock-seconds per tick-with-work (load_stats input for the
+        # fleet router's least-loaded policy); None until the first step runs
+        self._ewma_step_s: Optional[float] = None
+        # a fleet ReplicaClockView over a shared VirtualClock quantizes
+        # latencies exactly like a bare VirtualClock — unwrap it so the
+        # warning below fires for fleet replicas too
+        base_clock = getattr(self.clock, "shared", self.clock)
+        if isinstance(base_clock, VirtualClock) and \
                 engine.econfig.decode_steps_per_dispatch > 1:
             # the fused decode path delivers up to k tokens per tick while
             # the virtual clock advances one step_cost — TTFT/TPOT would be
@@ -117,10 +124,18 @@ class ServingEngine:
     def submit(self, prompt: Sequence[int], max_new_tokens: Optional[int] = None,
                deadline: Optional[float] = None, arrival_ts: Optional[float] = None,
                priority: float = 0.0, stream: Optional[Callable] = None,
-               retry_policy=None) -> ServingRequest:
+               retry_policy=None, resume_tokens: Optional[Sequence[int]] = None) -> ServingRequest:
         """Enqueue one request.  NEVER raises on overload: the returned
         request's state is REJECTED (with ``reject_reason``) when admission
         refuses it — callers inspect, the serving loop keeps running.
+
+        ``resume_tokens``: tokens this request already generated on ANOTHER
+        engine (fleet failover: its previous replica died mid-decode).  They
+        seed ``req.tokens`` so admission prefills ``prompt + resume_tokens``
+        and greedy decode continues with the identical next token — the same
+        recompute-on-resume contract KV-pressure preemption uses, across
+        replicas.  ``max_new_tokens`` still bounds the TOTAL output (resumed
+        tokens included); it must exceed ``len(resume_tokens)``.
 
         ``retry_policy`` (a resilience ``RetryPolicy``): back off on the
         clock and re-probe admission while the rejection is TRANSIENT
@@ -148,6 +163,13 @@ class ServingEngine:
             uid=uid, prompt=list(prompt), arrival_ts=now,
             max_new_tokens=max_new_tokens,
             deadline=deadline, priority=priority, stream=stream)
+        if resume_tokens:
+            if len(resume_tokens) >= max_new_tokens:
+                raise ValueError(
+                    f"resume_tokens ({len(resume_tokens)}) must leave output budget "
+                    f"under max_new_tokens ({max_new_tokens}) — a fully-generated "
+                    "request has nothing to resume")
+            req.tokens.extend(int(t) for t in resume_tokens)
         self._requests[req.uid] = req
         self.stats.submitted += 1
         ok, reason = self.admission.submit_ok(req, len(self._queue))
@@ -197,8 +219,15 @@ class ServingEngine:
         cost = 1.0
         if self.config.step_cost is not None:
             cost = self.config.step_cost(len(plan.decode) + sum(n for _, n in plan.prefill))
+        t_step = self.clock.now()
         out = self.engine.step(plan)
-        self.clock.on_step(cost)
+        # clock-domain step seconds: clocks that account the cost themselves
+        # (VirtualClock, ReplicaClockView) return it; WallClock returns None
+        # and the real elapsed time is measured
+        charged = self.clock.on_step(cost)
+        dt = charged if charged is not None else self.clock.now() - t_step
+        self._ewma_step_s = dt if self._ewma_step_s is None \
+            else 0.8 * self._ewma_step_s + 0.2 * dt
         self._deliver(out, self.clock.now())
         return out
 
@@ -386,7 +415,44 @@ class ServingEngine:
 
     # ------------------------------------------------------------- metrics
 
+    def load_stats(self) -> dict:
+        """Cheap point-in-time load snapshot — the fleet router's policy
+        input (O(active) dict/list reads, no engine work, safe to call every
+        dispatch):
+
+          queue_depth        — requests QUEUED at this replica (not yet in
+                               the engine)
+          active             — requests live in the engine (PREFILL/DECODE)
+          outstanding_tokens — decode tokens still owed by active requests
+                               (sum of ``remaining_new_tokens``) — the
+                               least-outstanding-tokens policy's key
+          free_kv_pages      — ``BlockedAllocator.free_pages`` right now
+          ewma_step_s        — EWMA (alpha=0.2) of clock-seconds per
+                               tick-with-work; None before the first step
+        """
+        return {
+            "queue_depth": len(self._queue),
+            "active": len(self._active),
+            "outstanding_tokens": sum(r.remaining_new_tokens for r in self._active.values()),
+            "free_kv_pages": self.engine.kv.allocator.free_pages,
+            "ewma_step_s": self._ewma_step_s,
+        }
+
+    def rebase_epoch(self) -> None:
+        """Re-stamp this frontend's epoch at the clock's current reading.
+        Callers that ``reset()`` a shared clock after expensive setup
+        (fleet pool construction + engine warmup) must rebase every
+        frontend built before the reset, or ``summary()``'s elapsed goes
+        negative against the pre-reset ``_t0``."""
+        self._t0 = self.clock.now()
+
     def summary(self) -> dict:
+        """Aggregate stats record over this frontend's lifetime (see
+        ``ServingStats.summary`` for the field definitions).  For a cheap
+        instantaneous *load* snapshot — queue depth, outstanding decode
+        tokens, free KV pages, EWMA step seconds — use :meth:`load_stats`;
+        the fleet router polls that every dispatch, while ``summary()`` is
+        the end-of-run report."""
         return self.stats.summary(elapsed=self.clock.now() - self._t0)
 
     def _next_event_step(self) -> int:

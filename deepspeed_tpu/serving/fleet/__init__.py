@@ -1,0 +1,26 @@
+"""Fleet router: cache-affinity multi-replica serving with health-driven
+failover (docs/SERVING.md "Fleet router").
+
+One ``ServingEngine`` serves one mesh; the fleet layer is the data plane
+above N of them: a :class:`ReplicaPool` (shared clock, health tracking,
+kill/recover/drain lifecycle), a :class:`Router` with pluggable policies
+(round-robin, least-outstanding-tokens, prefix-affinity with least-loaded
+fallback), and a deterministic :class:`FleetSimulator` that replays
+arrivals plus a scripted fault schedule bit-reproducibly on CPU
+(``scripts/bench_router.py`` is the load harness).
+"""
+
+from .health import HealthConfig, HealthTracker, ReplicaState, classify_fatal
+from .policies import (POLICIES, LeastOutstandingPolicy, PrefixAffinityPolicy,
+                       RoundRobinPolicy, RoutingPolicy, make_policy)
+from .pool import Replica, ReplicaPool
+from .router import FleetRequest, FleetState, Router
+from .sim import FleetEvent, FleetSimulator
+
+__all__ = [
+    "HealthConfig", "HealthTracker", "ReplicaState", "classify_fatal",
+    "POLICIES", "LeastOutstandingPolicy", "PrefixAffinityPolicy",
+    "RoundRobinPolicy", "RoutingPolicy", "make_policy",
+    "Replica", "ReplicaPool", "FleetRequest", "FleetState", "Router",
+    "FleetEvent", "FleetSimulator",
+]

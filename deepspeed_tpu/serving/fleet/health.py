@@ -1,0 +1,192 @@
+"""Replica health state machine: HEALTHY → DEGRADED → DEAD → RECOVERING.
+
+The fleet router must keep dispatching while individual replicas misbehave,
+so every replica carries an explicit health state the router's policies
+consult (``dispatchable``) and the pool's failover path keys off
+(``serving``).  Signals come from the resilience layer the training side
+already uses: transient ``OSError``\\ s degrade, repeated ones (or a
+device-loss classification — :class:`~..resilience.watchdog.StepHungError`,
+:class:`~..resilience.fault_injection.DeviceLossError`, any error whose
+message carries the ``DEVICE_LOST`` marker, or
+:class:`~..resilience.fault_injection.InjectedCrash`) kill.
+
+::
+
+    HEALTHY ──errors──▶ DEGRADED ──more errors──▶ DEAD
+       ▲  ▲              │    │                    │
+       │  └──successes───┘    └───────fatal────────┤
+       │                                           ▼
+       └───────── probe ticks ────────────── RECOVERING
+                                                   │ (probe failure)
+                                                   ▼
+                                                  DEAD
+
+    HEALTHY | DEGRADED ──drain()──▶ DRAINING ──restart──▶ RECOVERING
+    (DRAINING keeps serving its in-flight work but receives no new
+     dispatches; a kill during DRAINING still goes to DEAD)
+
+Transitions are validated — an illegal one is a tracker bug and raises —
+and every transition is recorded in ``history`` and emitted as a
+``fleet/health/<state>`` monitor event, so a fleet sim's failover timeline
+is auditable on the surface operators already watch.
+"""
+
+import dataclasses
+import enum
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ...utils.logging import logger
+
+
+class ReplicaState(enum.Enum):
+    HEALTHY = "healthy"
+    DEGRADED = "degraded"     # serving, but deprioritized for new dispatch
+    DRAINING = "draining"     # serving in-flight work only (rolling restart)
+    DEAD = "dead"             # gone: in-flight requests must fail over
+    RECOVERING = "recovering" # fresh engine warming; probe ticks decide
+
+    @property
+    def serving(self) -> bool:
+        """May this replica run ticks (in-flight work keeps moving)?"""
+        return self in (ReplicaState.HEALTHY, ReplicaState.DEGRADED,
+                        ReplicaState.DRAINING, ReplicaState.RECOVERING)
+
+    @property
+    def dispatchable(self) -> bool:
+        """May the router hand this replica NEW work?"""
+        return self in (ReplicaState.HEALTHY, ReplicaState.DEGRADED)
+
+
+_ALLOWED = {
+    ReplicaState.HEALTHY: {ReplicaState.DEGRADED, ReplicaState.DRAINING, ReplicaState.DEAD},
+    ReplicaState.DEGRADED: {ReplicaState.HEALTHY, ReplicaState.DRAINING, ReplicaState.DEAD},
+    ReplicaState.DRAINING: {ReplicaState.RECOVERING, ReplicaState.DEAD},
+    ReplicaState.DEAD: {ReplicaState.RECOVERING},
+    ReplicaState.RECOVERING: {ReplicaState.HEALTHY, ReplicaState.DEAD},
+}
+
+
+def classify_fatal(exc: BaseException) -> bool:
+    """Device-loss classification, mirroring ``DSElasticAgent``'s: hung
+    steps, injected/real device losses and simulated process death are
+    fatal to the replica; plain transient ``OSError``\\ s are not."""
+    from ...resilience.fault_injection import DeviceLossError, InjectedCrash
+    from ...resilience.watchdog import StepHungError
+    if isinstance(exc, (DeviceLossError, StepHungError, InjectedCrash)):
+        return True
+    return "DEVICE_LOST" in str(exc)
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    # consecutive transient errors before HEALTHY degrades
+    degrade_after: int = 1
+    # consecutive transient errors before a (degraded) replica is declared
+    # dead — repeated I/O failure on every tick is indistinguishable from a
+    # lost host to the fleet
+    dead_after: int = 3
+    # consecutive successful ticks for DEGRADED to heal back to HEALTHY
+    heal_after: int = 2
+    # successful probe ticks for RECOVERING to graduate to HEALTHY
+    recover_probe_ticks: int = 1
+
+
+class HealthTracker:
+    """Per-replica health states + validated transitions for one fleet."""
+
+    def __init__(self, replica_ids, config: HealthConfig = None,
+                 emit: Optional[Callable[[str, float], None]] = None,
+                 clock=None):
+        self.config = config or HealthConfig()
+        self._emit = emit
+        self._clock = clock
+        self._state: Dict[int, ReplicaState] = {r: ReplicaState.HEALTHY for r in replica_ids}
+        self._errors: Dict[int, int] = {r: 0 for r in replica_ids}      # consecutive
+        self._successes: Dict[int, int] = {r: 0 for r in replica_ids}   # consecutive
+        #: (rid, from, to, ts, reason) — the auditable failover timeline
+        self.history: List[Tuple[int, ReplicaState, ReplicaState, float, str]] = []
+
+    # ------------------------------------------------------------- queries
+
+    def state(self, rid: int) -> ReplicaState:
+        return self._state[rid]
+
+    def serving(self, rid: int) -> bool:
+        return self._state[rid].serving
+
+    def dispatchable(self, rid: int) -> bool:
+        return self._state[rid].dispatchable
+
+    def replicas_in(self, *states: ReplicaState) -> List[int]:
+        return sorted(r for r, s in self._state.items() if s in states)
+
+    # --------------------------------------------------------- transitions
+
+    def _now(self) -> float:
+        return self._clock.now() if self._clock is not None else 0.0
+
+    def _to(self, rid: int, state: ReplicaState, reason: str) -> None:
+        cur = self._state[rid]
+        if state is cur:
+            return
+        if state not in _ALLOWED[cur]:
+            raise ValueError(f"replica {rid}: illegal health transition "
+                             f"{cur.value} -> {state.value} ({reason})")
+        ts = self._now()
+        self._state[rid] = state
+        self._errors[rid] = 0
+        self._successes[rid] = 0
+        self.history.append((rid, cur, state, ts, reason))
+        logger.info(f"fleet health: replica {rid} {cur.value} -> {state.value} ({reason})")
+        if self._emit is not None:
+            self._emit(f"fleet/health/{state.value}", float(rid))
+
+    # ------------------------------------------------------------- signals
+
+    def record_success(self, rid: int) -> None:
+        """One successful tick: heals DEGRADED after a streak, graduates
+        RECOVERING after its probe quota."""
+        self._errors[rid] = 0
+        self._successes[rid] += 1
+        cur = self._state[rid]
+        if cur is ReplicaState.DEGRADED and self._successes[rid] >= self.config.heal_after:
+            self._to(rid, ReplicaState.HEALTHY, "success streak")
+        elif cur is ReplicaState.RECOVERING and \
+                self._successes[rid] >= self.config.recover_probe_ticks:
+            self._to(rid, ReplicaState.HEALTHY, "probe ticks passed")
+
+    def record_error(self, rid: int, exc: BaseException) -> ReplicaState:
+        """Classify one tick failure; returns the resulting state (the pool
+        checks for DEAD to trigger failover)."""
+        if classify_fatal(exc):
+            if self._state[rid] is ReplicaState.RECOVERING:
+                self._to(rid, ReplicaState.DEAD, f"probe failure: {exc}")
+            else:
+                self._to(rid, ReplicaState.DEAD, f"device loss: {exc}")
+            return self._state[rid]
+        self._successes[rid] = 0
+        self._errors[rid] += 1
+        cur = self._state[rid]
+        if cur is ReplicaState.RECOVERING:
+            # transient errors during the probe: the fresh engine cannot even
+            # tick — treat as a failed recovery, don't oscillate
+            self._to(rid, ReplicaState.DEAD, f"probe failure: {exc}")
+        elif self._errors[rid] >= self.config.dead_after:
+            self._to(rid, ReplicaState.DEAD,
+                     f"{self._errors[rid]} consecutive transient errors")
+        elif cur is ReplicaState.HEALTHY and self._errors[rid] >= self.config.degrade_after:
+            self._to(rid, ReplicaState.DEGRADED, f"transient error: {exc}")
+        return self._state[rid]
+
+    def kill(self, rid: int, reason: str = "killed") -> None:
+        """Operator/simulator-declared replica loss."""
+        self._to(rid, ReplicaState.DEAD, reason)
+
+    def drain(self, rid: int) -> None:
+        """Stop new dispatches; in-flight work finishes (rolling restart)."""
+        self._to(rid, ReplicaState.DRAINING, "drain requested")
+
+    def recovering(self, rid: int, reason: str = "fresh engine attached") -> None:
+        """A replacement engine is attached (from DEAD, or from a drained
+        DRAINING replica being restarted)."""
+        self._to(rid, ReplicaState.RECOVERING, reason)

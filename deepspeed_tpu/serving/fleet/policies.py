@@ -1,0 +1,121 @@
+"""Pluggable fleet routing policies.
+
+A policy answers one question per request: which dispatchable replica gets
+it.  Input is the candidate list the router assembled — ``(rid, replica,
+stats)`` with ``stats = ServingEngine.load_stats()`` — so policies are pure
+decisions over cheap snapshots and never touch engine internals except the
+read-only prefix-cache warmth probe.
+
+* :class:`RoundRobinPolicy` — rotate over dispatchable replicas; the
+  baseline every serving stack ships.
+* :class:`LeastOutstandingPolicy` — fewest outstanding decode tokens (the
+  actual forward-pass work still owed), queue depth as tie-break: the
+  classic least-loaded estimator for continuous batching, where "requests
+  in flight" under-weights long generations.
+* :class:`PrefixAffinityPolicy` — route to the replica whose
+  ``PrefixCacheManager`` already holds the longest page run of the
+  request's token history (probed via the non-mutating
+  ``lookup_depth``), so shared-prefix traffic (system prompts, few-shot
+  templates, failover resumes) reuses KV instead of recomputing it on a
+  cold replica.  When the warmest replica is saturated — queue at or past
+  ``saturation_queue_depth`` — the policy falls back to least-loaded:
+  cache locality is a latency optimization, never a reason to queue behind
+  a hot spot (the standard prefix-aware routing compromise).
+"""
+
+from typing import List, Optional, Tuple
+
+from ..request import ServingRequest  # noqa: F401  (doc reference)
+
+
+class RoutingPolicy:
+    """Base: ``select`` returns ``(rid, info)``; rid None = nothing
+    eligible (request stays pending).  ``info`` is a small dict of
+    policy-specific facts the router folds into its stats (e.g.
+    ``affinity_hit``)."""
+
+    name = "base"
+
+    def select(self, request, candidates: List[Tuple[int, object, dict]]):
+        raise NotImplementedError
+
+
+class RoundRobinPolicy(RoutingPolicy):
+
+    name = "round_robin"
+
+    def __init__(self):
+        self._turn = 0
+
+    def select(self, request, candidates):
+        if not candidates:
+            return None, {}
+        rids = sorted(rid for rid, _, _ in candidates)
+        rid = rids[self._turn % len(rids)]
+        self._turn += 1
+        return rid, {}
+
+
+class LeastOutstandingPolicy(RoutingPolicy):
+
+    name = "least_outstanding"
+
+    def select(self, request, candidates):
+        if not candidates:
+            return None, {}
+        rid = min(candidates,
+                  key=lambda c: (c[2]["outstanding_tokens"], c[2]["queue_depth"], c[0]))[0]
+        return rid, {}
+
+
+class PrefixAffinityPolicy(RoutingPolicy):
+
+    name = "prefix_affinity"
+
+    def __init__(self, saturation_queue_depth: int = 4):
+        assert saturation_queue_depth >= 1, saturation_queue_depth
+        self.saturation_queue_depth = saturation_queue_depth
+        self._fallback = LeastOutstandingPolicy()
+
+    def _warmth(self, replica, tokens) -> int:
+        pc = replica.serve.engine.kv.prefix_cache if replica.serve is not None else None
+        if pc is None or not tokens:
+            return 0
+        return pc.lookup_depth(tokens)
+
+    def select(self, request, candidates):
+        if not candidates:
+            return None, {}
+        # probe with the full token history (prompt + already-generated):
+        # a failover resume is exactly the traffic whose warm pages matter
+        tokens = list(request.prompt) + list(request.tokens)
+        warmth = {rid: self._warmth(rep, tokens) for rid, rep, _ in candidates}
+        best = max(candidates, key=lambda c: (warmth[c[0]], -c[2]["queue_depth"], -c[0]))
+        rid, _, stats = best
+        if warmth[rid] > 0 and stats["queue_depth"] < self.saturation_queue_depth:
+            return rid, {"affinity_hit": True, "warm_pages": warmth[rid]}
+        # cold everywhere, or the warm target is saturated: least-loaded —
+        # EXCLUDING the saturated warm target when an alternative exists
+        # (falling back onto the hot spot it just rejected would defeat the
+        # fallback; with no alternative it is still the only choice)
+        saturated = warmth[rid] > 0
+        fb_candidates = [c for c in candidates if c[0] != rid] if saturated else candidates
+        if not fb_candidates:
+            fb_candidates = candidates
+        fb_rid, _ = self._fallback.select(request, fb_candidates)
+        # the hit label reports where the request actually LANDED: a
+        # fallback that still reaches a warm cache (e.g. the sole replica)
+        # gets the prefill speedup all the same
+        return fb_rid, {"affinity_hit": warmth.get(fb_rid, 0) > 0,
+                        "warm_pages": warmth.get(fb_rid, 0),
+                        "affinity_saturated": saturated}
+
+
+POLICIES = {p.name: p for p in (RoundRobinPolicy, LeastOutstandingPolicy,
+                                PrefixAffinityPolicy)}
+
+
+def make_policy(name: str, **kwargs) -> RoutingPolicy:
+    if name not in POLICIES:
+        raise ValueError(f"unknown routing policy '{name}'; one of {sorted(POLICIES)}")
+    return POLICIES[name](**kwargs)

@@ -1,0 +1,177 @@
+"""ReplicaPool: N ServingEngine replicas behind one fleet clock.
+
+Each replica owns a FULL serving stack — its own ``InferenceEngineV2``
+(weights, KV arena, prefix cache, scheduler) wrapped by its own
+``ServingEngine`` — exactly the unit a real deployment replicates per
+mesh/host.  The pool adds what a fleet needs around them:
+
+* a shared clock: one ``VirtualClock`` fans out through per-replica
+  :class:`~..clock.ReplicaClockView`\\ s so a deterministic CPU simulation
+  models replicas stepping CONCURRENTLY (the fleet driver advances time
+  once per round by the slowest replica's cost); a ``WallClock`` is shared
+  directly (real time needs no view);
+* a :class:`~.health.HealthTracker` fed from tick outcomes;
+* ``kill()`` — abrupt replica loss: the engine object is dropped and every
+  in-flight ``ServingRequest`` is returned to the caller (the router) for
+  failover re-dispatch onto survivors;
+* ``recover()``/``restart()`` — attach a FRESH engine from the factory
+  (state RECOVERING until its probe ticks pass), modelling a replacement
+  host joining the fleet or a drained replica rebooting.
+"""
+
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+from ...utils.logging import logger
+from ..clock import ReplicaClockView, VirtualClock
+from ..engine import ServingConfig, ServingEngine
+from ..request import ServingRequest
+from .health import HealthConfig, HealthTracker, ReplicaState
+
+
+@dataclasses.dataclass
+class Replica:
+    rid: int
+    serve: Optional[ServingEngine]      # None while DEAD (engine discarded)
+    clock: object                       # ReplicaClockView or the shared clock
+    generation: int = 0                 # bumps on every fresh engine attach
+
+
+class ReplicaPool:
+
+    def __init__(self, engine_factory: Callable[[], object], n_replicas: int,
+                 clock=None, serving_config: ServingConfig = None, monitor=None,
+                 health_config: HealthConfig = None):
+        assert n_replicas >= 1, n_replicas
+        self.engine_factory = engine_factory
+        self.serving_config = serving_config or ServingConfig()
+        self.monitor = monitor
+        self.clock = clock if clock is not None else VirtualClock()
+        self._virtual = isinstance(self.clock, VirtualClock)
+        self.replicas: Dict[int, Replica] = {}
+        self.health = HealthTracker(range(n_replicas), config=health_config,
+                                    emit=self._emit, clock=self.clock)
+        for rid in range(n_replicas):
+            self.replicas[rid] = Replica(rid=rid, serve=None,
+                                         clock=self._make_view())
+            self._attach_engine(rid)
+
+    def _make_view(self):
+        return ReplicaClockView(self.clock) if self._virtual else self.clock
+
+    def _attach_engine(self, rid: int) -> None:
+        rep = self.replicas[rid]
+        rep.serve = ServingEngine(self.engine_factory(), clock=rep.clock,
+                                  config=self.serving_config, monitor=self.monitor)
+        rep.generation += 1
+
+    def _emit(self, name: str, value: float) -> None:
+        if self.monitor is None or not getattr(self.monitor, "enabled", True):
+            return
+        try:
+            self.monitor.write_events([(name, value, len(self.health.history))])
+        except Exception as e:  # observability must never take down the fleet
+            logger.warning(f"fleet monitor write failed: {e}")
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def rids(self) -> List[int]:
+        return sorted(self.replicas)
+
+    def replica(self, rid: int) -> Replica:
+        return self.replicas[rid]
+
+    def is_idle(self, rid: int) -> bool:
+        serve = self.replicas[rid].serve
+        return serve is None or (not serve._queue and not serve._active)
+
+    def load_stats(self) -> Dict[int, dict]:
+        """Per-replica ``ServingEngine.load_stats()`` for every replica that
+        currently has an engine (DEAD replicas are absent)."""
+        return {rid: rep.serve.load_stats()
+                for rid, rep in sorted(self.replicas.items()) if rep.serve is not None}
+
+    # ----------------------------------------------------------- lifecycle
+
+    def rebase_clock(self) -> None:
+        """Re-zero the shared clock so t=0 means 'serving starts' — pool
+        construction builds and warms N engines, which on a WallClock takes
+        long enough to age a workload's arrival timestamps and deadlines
+        past before any request is served.  Every live frontend's epoch is
+        re-stamped along with it (their ``_t0`` predates the reset)."""
+        self.clock.reset()
+        for rep in self.replicas.values():
+            if rep.serve is not None:
+                rep.serve.rebase_epoch()
+
+    def kill(self, rid: int, reason: str = "killed") -> List[ServingRequest]:
+        """Abrupt replica loss: discard the engine and return its in-flight
+        requests (queued + active, in arrival order) for failover.  The
+        returned ``ServingRequest`` objects carry the tokens they already
+        delivered; the router resubmits them to survivors with
+        ``resume_tokens`` so outputs stay recompute-identical."""
+        rep = self.replicas[rid]
+        if self.health.state(rid) is not ReplicaState.DEAD:
+            self.health.kill(rid, reason)
+        victims: List[ServingRequest] = []
+        if rep.serve is not None:
+            victims = sorted(
+                list(rep.serve._queue) + list(rep.serve._active.values()),
+                key=lambda r: (r.arrival_ts, r.uid))
+            rep.serve.close()
+            rep.serve = None
+        return victims
+
+    def recover(self, rid: int) -> None:
+        """Attach a fresh engine to a DEAD replica (replacement host)."""
+        assert self.health.state(rid) is ReplicaState.DEAD, \
+            f"recover() on replica {rid} in state {self.health.state(rid).value}"
+        self._attach_engine(rid)
+        self.health.recovering(rid)
+
+    def drain(self, rid: int) -> None:
+        self.health.drain(rid)
+
+    def restart(self, rid: int) -> None:
+        """Rolling restart of a DRAINED replica: must be idle (the point of
+        draining is that nothing is lost), swaps in a fresh engine."""
+        assert self.health.state(rid) is ReplicaState.DRAINING, \
+            f"restart() on replica {rid} in state {self.health.state(rid).value}"
+        assert self.is_idle(rid), f"restart() on replica {rid} before drained"
+        rep = self.replicas[rid]
+        if rep.serve is not None:
+            rep.serve.close()
+        self._attach_engine(rid)
+        self.health.recovering(rid, "rolling restart")
+
+    # ---------------------------------------------------------------- tick
+
+    def tick(self, rid: int):
+        """One serving iteration on replica ``rid``.  Returns
+        ``(out, victims)``: the engine step's token dict, plus the in-flight
+        requests to fail over when this tick KILLED the replica (transient
+        errors degrade per the health policy; device-loss-class errors and
+        error streaks go DEAD and the engine is discarded).
+
+        :class:`~...resilience.fault_injection.InjectedCrash` is re-raised —
+        it simulates death of THIS driver process, not of one replica, and
+        nothing may absorb it (the resilience-layer contract)."""
+        from ...resilience.fault_injection import InjectedCrash
+        if not self.health.serving(rid):
+            return {}, []
+        rep = self.replicas[rid]
+        if rep.serve is None:
+            return {}, []
+        try:
+            out = rep.serve.tick()
+        except InjectedCrash:
+            raise
+        except Exception as e:
+            state = self.health.record_error(rid, e)
+            logger.warning(f"fleet: replica {rid} tick failed ({e}); now {state.value}")
+            if state is ReplicaState.DEAD:
+                return {}, self.kill(rid, reason=f"tick failure: {e}")
+            return {}, []
+        self.health.record_success(rid)
+        return out, []

@@ -1,0 +1,412 @@
+"""Fleet router: the data plane above N ServingEngine replicas.
+
+One client-facing request (:class:`FleetRequest`) maps to one-or-more
+per-replica ``ServingRequest`` *attempts*: the router picks a replica via
+its :mod:`policy <.policies>`, submits, and streams delivered tokens into
+the fleet-level record.  When a replica dies (scripted kill, health
+tracker, or an injected ``device_loss`` at the ``router.dispatch`` fault
+site), its in-flight requests are re-queued and re-dispatched onto
+survivors with ``resume_tokens`` — the per-replica recompute-on-resume
+contract, lifted across replicas — so a failed-over request's final token
+output is IDENTICAL to an unperturbed run's.
+
+The router is driver-agnostic: :class:`~.sim.FleetSimulator` drives it
+deterministically on a shared ``VirtualClock`` (tests, ``--dryrun``
+benches); a real deployment would run the same ``dispatch_pending`` /
+``poll`` surface from a wall-clock loop with replicas ticking in threads.
+
+Fleet request lifecycle::
+
+    PENDING → DISPATCHED → DONE
+       ▲          │ (replica died: failover, tokens preserved)
+       └──────────┘
+    PENDING | DISPATCHED → TIMED_OUT     (deadline)
+    PENDING → REJECTED                   (structurally infeasible everywhere)
+
+Terminal states are reached exactly once — ``_finish`` enforces it — which
+is the property the fleet chaos/property tests pin: no request lost,
+duplicated, or served twice through any kill/recover/drain schedule.
+"""
+
+import dataclasses
+import enum
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ...resilience import fault_injection as _fi
+from ...utils.logging import logger
+from ..metrics import percentile_summary
+from ..request import RequestState, ServingRequest
+from .health import ReplicaState
+from .policies import RoutingPolicy
+from .pool import ReplicaPool
+
+
+class FleetState(enum.Enum):
+    PENDING = "pending"        # in the router queue (new, or displaced)
+    DISPATCHED = "dispatched"  # live on a replica
+    DONE = "done"
+    TIMED_OUT = "timed_out"
+    REJECTED = "rejected"
+
+    @property
+    def terminal(self) -> bool:
+        return self in (FleetState.DONE, FleetState.TIMED_OUT, FleetState.REJECTED)
+
+
+@dataclasses.dataclass
+class FleetRequest:
+    """One client request as the FLEET sees it.  ``tokens`` accumulates
+    across replica attempts (stream deliveries + failover resumes) and is
+    the client-visible output; per-attempt ``ServingRequest`` objects are
+    bookkeeping."""
+    fid: int
+    prompt: List[int]
+    max_new_tokens: int
+    arrival_ts: float
+    deadline: Optional[float] = None
+    priority: float = 0.0
+    state: FleetState = FleetState.PENDING
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    first_token_ts: Optional[float] = None
+    finish_ts: Optional[float] = None
+    failovers: int = 0
+    affinity_hits: int = 0
+    reject_reason: Optional[str] = None
+    #: (replica rid, dispatch ts) per attempt
+    dispatches: List[Tuple[int, float]] = dataclasses.field(default_factory=list)
+    history: List[Tuple[FleetState, float]] = dataclasses.field(default_factory=list)
+    _current: Optional[Tuple[int, ServingRequest, int]] = None  # (rid, sr, generation)
+
+    def __post_init__(self):
+        self.prompt = list(self.prompt)
+        self.history.append((self.state, self.arrival_ts))
+
+    @property
+    def ttft(self) -> Optional[float]:
+        return None if self.first_token_ts is None else self.first_token_ts - self.arrival_ts
+
+    @property
+    def tpot(self) -> Optional[float]:
+        if self.first_token_ts is None or self.finish_ts is None or len(self.tokens) < 2:
+            return None
+        return (self.finish_ts - self.first_token_ts) / (len(self.tokens) - 1)
+
+    @property
+    def e2e(self) -> Optional[float]:
+        return None if self.finish_ts is None else self.finish_ts - self.arrival_ts
+
+    @property
+    def met_deadline(self) -> bool:
+        if self.state is not FleetState.DONE:
+            return False
+        return self.deadline is None or self.finish_ts <= self.deadline
+
+
+class Router:
+    """Cache-affinity, health-aware request router over a ReplicaPool."""
+
+    def __init__(self, pool: ReplicaPool, policy: RoutingPolicy, monitor=None):
+        self.pool = pool
+        self.policy = policy
+        self.monitor = monitor
+        self.clock = pool.clock
+        self._fids = itertools.count()
+        self._pending: List[FleetRequest] = []
+        self._dispatched: Dict[int, FleetRequest] = {}
+        self.requests: List[FleetRequest] = []       # every request ever submitted
+        self._t0 = self.clock.now()
+        self._events_step = 0
+        # failover bookkeeping: one record per replica death, closed when
+        # every displaced request has been re-dispatched (or terminated)
+        self.kill_records: List[dict] = []
+        self.stats = {
+            "submitted": 0, "dispatches": 0, "failovers": 0,
+            "affinity_hits": 0, "affinity_misses": 0,
+            "dispatch_faults": 0, "saturated_dispatches": 0,
+        }
+        self.recovery_times: List[float] = []
+
+    # -------------------------------------------------------------- submit
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               deadline: Optional[float] = None, arrival_ts: Optional[float] = None,
+               priority: float = 0.0) -> FleetRequest:
+        now = self.clock.now() if arrival_ts is None else float(arrival_ts)
+        fr = FleetRequest(fid=next(self._fids), prompt=list(prompt),
+                          max_new_tokens=int(max_new_tokens), arrival_ts=now,
+                          deadline=deadline, priority=priority)
+        self.requests.append(fr)
+        self._pending.append(fr)
+        self.stats["submitted"] += 1
+        return fr
+
+    # ------------------------------------------------------------ dispatch
+
+    def _candidates(self):
+        out = []
+        for rid in self.pool.rids:
+            if not self.pool.health.dispatchable(rid):
+                continue
+            rep = self.pool.replica(rid)
+            if rep.serve is None:
+                continue
+            out.append((rid, rep, rep.serve.load_stats()))
+        return out
+
+    def dispatch_pending(self, now: Optional[float] = None) -> int:
+        """Try to place every pending request on a replica (FCFS by
+        arrival).  Returns how many dispatched.  Saturation (per-replica
+        ``queue_full`` rejection) leaves a request pending for the next
+        round; a structural rejection (infeasible on this engine geometry —
+        identical across replicas) is terminal."""
+        now = self.clock.now() if now is None else now
+        # priority class (lower = more urgent) then FCFS — the fleet queue
+        # must honor the priority submit() accepts, or urgent work waits
+        # behind bulk arrivals exactly when every replica is saturated;
+        # anti-starvation aging applies per replica once dispatched
+        self._pending.sort(key=lambda r: (r.priority, r.arrival_ts, r.fid))
+        # expire FIRST, for every pending request — expiry must not depend
+        # on dispatchable capacity existing (with all replicas dead, expired
+        # work still has to reach TIMED_OUT or the driver would stall on a
+        # deadline that already passed)
+        for fr in list(self._pending):
+            if fr.deadline is not None and now > fr.deadline:
+                self._pending.remove(fr)
+                self._finish(fr, FleetState.TIMED_OUT, now)
+        placed = 0
+        # one candidate snapshot per round, refreshed incrementally: a full
+        # rebuild (load_stats on every replica) per pending request would be
+        # O(pending x replicas) per round for state that only changes where
+        # a request just landed (or a replica just died)
+        candidates = self._candidates()
+        for fr in list(self._pending):
+            if not candidates:
+                break
+            rid, info = self.policy.select(fr, candidates)
+            if rid is None:
+                continue
+            try:
+                _fi.check("router.dispatch")
+            except _fi.DeviceLossError as e:
+                # the dispatch found its target's device gone — the fleet
+                # treats that exactly like a scripted kill of that replica
+                self.on_replica_dead(rid, now, reason=str(e))
+                self.stats["dispatch_faults"] += 1
+                candidates = self._candidates()
+                continue   # fr stays pending
+            except OSError as e:
+                # transient dispatch-path failure (RPC hiccup): the request
+                # stays pending and the next round retries
+                self.stats["dispatch_faults"] += 1
+                logger.warning(f"router.dispatch transient fault for fid={fr.fid}: {e}")
+                continue
+            if self._dispatch_to(fr, rid, info, now):
+                placed += 1
+                candidates = [(r, rp, rp.serve.load_stats() if r == rid else st)
+                              for r, rp, st in candidates]
+        return placed
+
+    def _dispatch_to(self, fr: FleetRequest, rid: int, info: dict, now: float) -> bool:
+        rep = self.pool.replica(rid)
+        if len(fr.tokens) >= fr.max_new_tokens:
+            # a victim displaced with its output already complete (killed in
+            # the same tick it finished): nothing to resume — close it out
+            self._pending.remove(fr)
+            fr.finish_ts = fr.finish_ts if fr.finish_ts is not None else now
+            self._finish(fr, FleetState.DONE, now)
+            return False
+        sr = rep.serve.submit(
+            fr.prompt, max_new_tokens=fr.max_new_tokens, deadline=fr.deadline,
+            arrival_ts=fr.arrival_ts, priority=fr.priority,
+            stream=self._make_stream(fr, rep.generation),
+            resume_tokens=list(fr.tokens) or None)
+        if sr.state is RequestState.REJECTED:
+            if sr.reject_reason == "queue_full":
+                self.stats["saturated_dispatches"] += 1
+                return False            # transient: stays pending
+            self._pending.remove(fr)
+            fr.reject_reason = sr.reject_reason
+            self._finish(fr, FleetState.REJECTED, now)
+            return False
+        self._pending.remove(fr)
+        fr._current = (rid, sr, rep.generation)
+        fr.dispatches.append((rid, now))
+        fr.state = FleetState.DISPATCHED
+        fr.history.append((FleetState.DISPATCHED, now))
+        self._dispatched[fr.fid] = fr
+        self.stats["dispatches"] += 1
+        if "affinity_hit" in info:
+            key = "affinity_hits" if info["affinity_hit"] else "affinity_misses"
+            self.stats[key] += 1
+            if info["affinity_hit"]:
+                fr.affinity_hits += 1
+        self._emit([("fleet/dispatch", float(rid), self._next_event_step())])
+        return True
+
+    def _make_stream(self, fr: FleetRequest, generation: int):
+        def on_tokens(sr: ServingRequest, toks: List[int], ts: float) -> None:
+            cur = fr._current
+            if cur is None or cur[1] is not sr or cur[2] != generation:
+                return  # stale attempt (replica since failed over) — drop
+            if fr.first_token_ts is None and toks:
+                fr.first_token_ts = ts
+            fr.tokens.extend(toks)
+        return on_tokens
+
+    # ---------------------------------------------------------------- poll
+
+    def poll(self, now: Optional[float] = None) -> None:
+        """Fold per-replica terminal states up into fleet terminal states."""
+        now = self.clock.now() if now is None else now
+        for fr in list(self._dispatched.values()):
+            rid, sr, _gen = fr._current
+            if sr.state is RequestState.DONE:
+                del self._dispatched[fr.fid]
+                fr._current = None
+                fr.finish_ts = sr.finish_ts if sr.finish_ts is not None else now
+                self._finish(fr, FleetState.DONE, now)
+            elif sr.state is RequestState.TIMED_OUT:
+                del self._dispatched[fr.fid]
+                fr._current = None
+                self._finish(fr, FleetState.TIMED_OUT, now)
+
+    # ------------------------------------------------------------ failover
+
+    def on_replica_dead(self, rid: int, now: Optional[float] = None,
+                        reason: str = "killed") -> List[FleetRequest]:
+        """Replica loss entry point (scripted kill, health-declared death,
+        or an injected dispatch-time device loss): discards the replica's
+        engine and moves every displaced fleet request back to PENDING with
+        its delivered tokens preserved.  Idempotent per death."""
+        now = self.clock.now() if now is None else now
+        # pool.tick's health path may have killed the replica already (engine
+        # discarded) — the fleet-side victims still need requeuing; only a
+        # death with neither an engine to kill NOR displaced requests is a
+        # true duplicate notification
+        was_dead = self.pool.health.state(rid) is ReplicaState.DEAD \
+            and self.pool.replica(rid).serve is None
+        if not was_dead:
+            self.pool.kill(rid, reason=reason)
+        victims: List[FleetRequest] = []
+        for fr in list(self._dispatched.values()):
+            if fr._current is not None and fr._current[0] == rid:
+                del self._dispatched[fr.fid]
+                fr._current = None
+                fr.failovers += 1
+                fr.state = FleetState.PENDING
+                fr.history.append((FleetState.PENDING, now))
+                self._pending.append(fr)
+                victims.append(fr)
+                self.stats["failovers"] += 1
+        if was_dead and not victims:
+            return []
+        record = {"rid": rid, "ts": now, "reason": reason,
+                  "victims": {fr.fid for fr in victims},
+                  "n_victims": len(victims), "recovered_ts": None}
+        if not victims:
+            record["recovered_ts"] = now   # nothing displaced: recovery is free
+            self.recovery_times.append(0.0)
+        self.kill_records.append(record)
+        self._emit([("fleet/replica_dead", float(rid), self._next_event_step()),
+                    ("fleet/failover_requeued", float(len(victims)),
+                     self._next_event_step())])
+        return victims
+
+    def _note_victim_resolved(self, fr: FleetRequest, now: float) -> None:
+        """Failover recovery time: a kill record closes when the LAST
+        displaced request reaches a terminal state — the displaced work is
+        fully re-served (or definitively expired), not merely back in a
+        queue.  Re-dispatch alone would read ~0 whenever survivors have
+        queue capacity and hide the recompute cost failover actually pays."""
+        for rec in self.kill_records:
+            if rec["recovered_ts"] is None and fr.fid in rec["victims"]:
+                rec["victims"].discard(fr.fid)
+                if not rec["victims"]:
+                    rec["recovered_ts"] = now
+                    self.recovery_times.append(now - rec["ts"])
+
+    def _finish(self, fr: FleetRequest, state: FleetState, now: float) -> None:
+        assert not fr.state.terminal, \
+            f"fleet request {fr.fid} reached a second terminal state " \
+            f"({fr.state.value} then {state.value})"
+        fr.state = state
+        fr.history.append((state, now))
+        self._note_victim_resolved(fr, now)
+        self._emit([(f"fleet/{state.value}", 1.0, self._next_event_step())])
+
+    # ----------------------------------------------------------- lifecycle
+
+    def kill_replica(self, rid: int, reason: str = "scripted kill") -> List[FleetRequest]:
+        return self.on_replica_dead(rid, reason=reason)
+
+    def recover_replica(self, rid: int) -> None:
+        self.pool.recover(rid)
+
+    def drain(self, rid: int) -> None:
+        """Rolling-restart entry: no NEW dispatches to ``rid``; its
+        in-flight work runs to completion (``pool.is_idle`` then gates
+        ``pool.restart``)."""
+        self.pool.drain(rid)
+
+    # ------------------------------------------------------------- metrics
+
+    @property
+    def outstanding(self) -> int:
+        return len(self._pending) + len(self._dispatched)
+
+    def pending_timestamps(self) -> List[float]:
+        """Future timestamps that could unblock progress (pending
+        deadlines) — the simulator's idle-jump input."""
+        return [fr.deadline for fr in self._pending if fr.deadline is not None] + \
+               [fr.deadline for fr in self._dispatched.values() if fr.deadline is not None]
+
+    def summary(self) -> dict:
+        done = [r for r in self.requests if r.state is FleetState.DONE]
+        met = [r for r in done if r.met_deadline]
+        elapsed = max(self.clock.now() - self._t0, 1e-9)
+        hits, misses = self.stats["affinity_hits"], self.stats["affinity_misses"]
+        return {
+            "policy": self.policy.name,
+            "n_replicas": len(self.pool.replicas),
+            "submitted": self.stats["submitted"],
+            "completed": len(done),
+            "timed_out": sum(1 for r in self.requests if r.state is FleetState.TIMED_OUT),
+            "rejected": sum(1 for r in self.requests if r.state is FleetState.REJECTED),
+            "dispatches": self.stats["dispatches"],
+            "failovers": self.stats["failovers"],
+            "dispatch_faults": self.stats["dispatch_faults"],
+            "saturated_dispatches": self.stats["saturated_dispatches"],
+            "deadline_met": len(met),
+            "goodput_rps": round(len(met) / elapsed, 6),
+            "completed_rps": round(len(done) / elapsed, 6),
+            "tokens_generated": sum(len(r.tokens) for r in self.requests),
+            "elapsed": round(elapsed, 6),
+            "affinity": {
+                "hits": hits, "misses": misses,
+                "hit_rate": round(hits / (hits + misses), 4) if hits + misses else None,
+            },
+            "failover": {
+                "kills": len(self.kill_records),
+                "requeued": self.stats["failovers"],
+                "recovery_times": [round(t, 6) for t in self.recovery_times],
+                "unrecovered": sum(1 for r in self.kill_records
+                                   if r["recovered_ts"] is None),
+            },
+            "ttft": percentile_summary([r.ttft for r in done if r.ttft is not None]),
+            "tpot": percentile_summary([r.tpot for r in done if r.tpot is not None]),
+            "e2e": percentile_summary([r.e2e for r in done if r.e2e is not None]),
+            "health_transitions": len(self.pool.health.history),
+        }
+
+    def _next_event_step(self) -> int:
+        self._events_step += 1
+        return self._events_step
+
+    def _emit(self, events) -> None:
+        if self.monitor is None or not getattr(self.monitor, "enabled", True):
+            return
+        try:
+            self.monitor.write_events(events)
+        except Exception as e:  # monitoring must never take down routing
+            logger.warning(f"fleet monitor write failed: {e}")

@@ -1,0 +1,181 @@
+"""Deterministic fleet simulator: arrivals + a scripted fault schedule.
+
+Drives a :class:`~.router.Router` + :class:`~.pool.ReplicaPool` on the
+pool's ONE shared clock in discrete *rounds* that model the fleet's
+replicas stepping concurrently (``VirtualClock``: deterministic CPU
+simulation; ``WallClock``: the same loop with real time — bench wall mode
+reuses it rather than re-implementing the round structure):
+
+  1. apply due schedule events (kill / recover / drain / restart);
+  2. submit due arrivals, time out expired pending work, dispatch;
+  3. tick every serving-capable replica once (each records its step cost
+     into its :class:`~..clock.ReplicaClockView` instead of advancing);
+  4. advance the shared clock by the MAX recorded cost — the round takes
+     as long as its slowest replica, not the sum (that is what makes a
+     4-replica fleet 4x the throughput of 1 in the simulation, as in
+     life);
+  5. fold per-replica completions up into fleet terminal states.
+
+Everything is seeded/ordered deterministically (sorted replica order,
+list-ordered arrivals and schedule, greedy decode), so the same inputs
+produce bit-identical outputs on every run and machine — the property the
+``bench_router.py --dryrun`` artifact and the chaos tests pin.
+
+Token timestamps within a round are stamped at round START (the shared
+clock advances only at step 4); latencies are therefore quantized to
+round granularity — consistent across policies and replica counts, which
+is what the comparisons need.
+
+Schedule entries: ``(ts, action, rid)`` with action one of ``kill``,
+``recover``, ``drain``, ``restart``.  ``restart`` of a DRAINING replica
+defers until the replica is idle — the point of draining is that nothing
+in flight is lost.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from .health import ReplicaState
+from .router import Router
+
+_ACTIONS = ("kill", "recover", "drain", "restart")
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetEvent:
+    ts: float
+    action: str
+    rid: int
+
+    def __post_init__(self):
+        assert self.action in _ACTIONS, f"unknown fleet event action '{self.action}'"
+
+
+class FleetSimulator:
+
+    def __init__(self, router: Router, max_rounds: int = 200_000):
+        self.router = router
+        self.pool = router.pool
+        self.clock = router.clock
+        # VirtualClock: deterministic rounds, time advances by max recorded
+        # cost.  WallClock: the same round structure with real time (ticks
+        # advance the clock themselves and there are no cost views to
+        # drain, so the advance step below never fires), letting wall-mode
+        # drivers reuse — instead of drift from — this loop.
+        self.max_rounds = max_rounds
+        self.rounds = 0
+
+    def run(self, arrivals: List[dict],
+            schedule: Optional[List[Tuple[float, str, int]]] = None) -> List:
+        """``arrivals``: router ``submit()`` kwarg dicts, each with an
+        ``arrival_ts``.  ``schedule``: ``(ts, action, rid)`` tuples.  Runs
+        rounds until all arrivals are submitted, all schedule events
+        applied, and every request is terminal.  Returns the
+        ``FleetRequest`` objects in arrival order."""
+        router, pool, clock = self.router, self.pool, self.clock
+        pending_arrivals = sorted(arrivals, key=lambda a: (a["arrival_ts"],))
+        events = sorted([e if isinstance(e, FleetEvent) else FleetEvent(*e)
+                         for e in (schedule or [])], key=lambda e: (e.ts,))
+        deferred_restarts: List[int] = []
+        reqs = []
+        a_i = e_i = 0
+
+        for _ in range(self.max_rounds):
+            self.rounds += 1
+            now = clock.now()
+
+            # 1. scripted fleet events due now
+            while e_i < len(events) and events[e_i].ts <= now:
+                ev = events[e_i]
+                e_i += 1
+                self._apply(ev, deferred_restarts)
+            for rid in list(deferred_restarts):
+                if pool.health.state(rid) is not ReplicaState.DRAINING:
+                    # killed (or otherwise transitioned) while waiting to
+                    # drain: the restart is moot — recovery owns it now
+                    deferred_restarts.remove(rid)
+                elif pool.is_idle(rid):
+                    deferred_restarts.remove(rid)
+                    pool.restart(rid)
+
+            # 2. arrivals + dispatch
+            while a_i < len(pending_arrivals) and \
+                    pending_arrivals[a_i]["arrival_ts"] <= now:
+                reqs.append(router.submit(**pending_arrivals[a_i]))
+                a_i += 1
+            router.dispatch_pending(now)
+
+            # 3. one concurrent tick across the fleet
+            marker = self._marker(a_i, e_i)
+            costs = []
+            for rid in pool.rids:
+                if not pool.health.serving(rid):
+                    continue
+                _out, victims = pool.tick(rid)
+                if victims:
+                    router.on_replica_dead(rid, reason="health-declared death")
+                view = pool.replica(rid).clock
+                cost = view.take_cost() if hasattr(view, "take_cost") else 0.0
+                if cost > 0:
+                    costs.append(cost)
+
+            # 4. the round took as long as its slowest replica
+            if costs:
+                clock.advance(max(costs))
+
+            # 5. completions
+            router.poll(clock.now())
+
+            if a_i >= len(pending_arrivals) and e_i >= len(events) \
+                    and not deferred_restarts and router.outstanding == 0:
+                return reqs
+
+            if not costs and self._marker(a_i, e_i) == marker:
+                # nothing moved: only the passage of time can help — jump to
+                # the next known event, or fail loudly instead of spinning
+                waits = router.pending_timestamps()
+                if a_i < len(pending_arrivals):
+                    waits.append(pending_arrivals[a_i]["arrival_ts"])
+                if e_i < len(events):
+                    waits.append(events[e_i].ts)
+                if not waits:
+                    raise RuntimeError(
+                        f"fleet simulation stalled at t={now}: "
+                        f"{router.outstanding} outstanding request(s), "
+                        f"replicas {[(r, pool.health.state(r).value) for r in pool.rids]}, "
+                        "no future arrival/schedule/deadline to wait for")
+                clock.wait_until(min(waits) + 1e-9)
+        raise RuntimeError(f"fleet simulation exceeded max_rounds={self.max_rounds}")
+
+    def _apply(self, ev: FleetEvent, deferred_restarts: List[int]) -> None:
+        pool, router = self.pool, self.router
+        state = pool.health.state(ev.rid)
+        if ev.action == "kill":
+            router.on_replica_dead(ev.rid, reason="scheduled kill")
+        elif ev.action == "recover":
+            if state is ReplicaState.DEAD:
+                pool.recover(ev.rid)
+            # recovering a live replica is a schedule no-op, not an error —
+            # chaos schedules are random and may recover before the kill
+        elif ev.action == "drain":
+            if state.dispatchable:
+                pool.drain(ev.rid)
+        elif ev.action == "restart":
+            if state is ReplicaState.DRAINING:
+                if pool.is_idle(ev.rid):
+                    pool.restart(ev.rid)
+                else:
+                    deferred_restarts.append(ev.rid)
+
+    def _marker(self, a_i: int, e_i: int):
+        router = self.router
+        # engine-side seen_tokens is part of progress: a multi-chunk
+        # prefill advances for whole rounds without delivering a token, and
+        # on a WallClock there are no step costs to prove the round worked
+        seen = sum(s.seen_tokens
+                   for rep in self.pool.replicas.values() if rep.serve is not None
+                   for s in rep.serve.engine.state.seqs.values())
+        return (a_i, e_i, len(router.requests), router.outstanding,
+                router.stats["dispatches"], router.stats["failovers"],
+                sum(len(r.tokens) for r in router.requests), seen,
+                len(self.pool.health.history))

@@ -55,6 +55,48 @@ _SWEEP_POINT = {
 
 _LEGACY_THROUGHPUT = {"metric": STR, "value": NUM, "unit": STR, "extra": DICT}
 
+_ROUTER_POINT = {
+    "policy": STR, "n_replicas": INT, "arrival_rate": NUM, "offered_rps": NUM,
+    "submitted": INT, "completed": INT, "timed_out": INT, "rejected": INT,
+    "dispatches": INT, "failovers": INT, "deadline_met": INT, "goodput_rps": NUM,
+    "affinity": {"hits": INT, "misses": INT, "hit_rate": ("nullable", NUM)},
+    "failover": {"kills": INT, "requeued": INT, "recovery_times": [NUM],
+                 "unrecovered": INT},
+    "ttft": _pct_ordered, "tpot": _pct_ordered, "e2e": _pct_ordered,
+}
+
+
+def _router_sweep_invariants(v):
+    """The fleet bench's acceptance receipts: >= 3 points, the
+    prefix_affinity policy actually hit its cache somewhere, and every
+    scripted kill recovered in finite time."""
+    import math
+    if not isinstance(v, list) or len(v) < 3:
+        return "sweep must cover >= 3 (replica count x policy) points"
+    aff = [p for p in v if isinstance(p, dict) and p.get("policy") == "prefix_affinity"]
+    if not aff:
+        return "sweep must include the prefix_affinity policy"
+    if not any(((p.get("affinity") or {}).get("hit_rate") or 0) > 0 for p in aff):
+        return "prefix_affinity sweep points record no affinity hits (hit_rate > 0)"
+    kills = 0
+    for p in v:
+        fo = p.get("failover") if isinstance(p, dict) else None
+        if not isinstance(fo, dict):
+            continue
+        kills += fo.get("kills", 0)
+        if fo.get("unrecovered", 0):
+            return f"unrecovered failover at policy={p.get('policy')} " \
+                   f"n_replicas={p.get('n_replicas')}"
+        times = fo.get("recovery_times", [])
+        if fo.get("kills", 0) and (len(times) != fo["kills"] or
+                                   any(not (isinstance(t, (int, float)) and math.isfinite(t))
+                                       for t in times)):
+            return f"kill without a finite recovery time at policy={p.get('policy')} " \
+                   f"n_replicas={p.get('n_replicas')}: {times}"
+    if kills == 0:
+        return "no sweep point exercised the kill schedule"
+    return None
+
 SCHEMAS = {
     # per-round driver transcripts
     "BENCH_r*.json": {"n": INT, "cmd": STR, "rc": INT, "tail": STR, "?parsed": DICT},
@@ -77,6 +119,19 @@ SCHEMAS = {
                            if k not in ("arrival_rate", "offered_rps")},
                         "concurrency": INT},
         "engine_throughput": ("nullable", _LEGACY_THROUGHPUT),
+    },
+    # the fleet router harness (scripts/bench_router.py, schema v1)
+    "BENCH_ROUTER.json": {
+        "metric": STR, "value": NUM, "unit": STR,
+        "schema_version": lambda v: None if v == 1 else f"schema_version {v} != 1",
+        "sla": {"ttft_budget": NUM, "tpot_budget": NUM},
+        "workload": {"n_requests": INT, "seed": INT, "arrival_rate": NUM,
+                     "prefix_groups": INT, "prefix_pages": INT, "dryrun": BOOL,
+                     "virtual_clock": BOOL, "kv": DICT, "scheduler": DICT},
+        "replica_counts": [INT],
+        "policies": [STR],
+        "sweep": _router_sweep_invariants,
+        "sweep[]": [_ROUTER_POINT],
     },
 }
 

@@ -1,0 +1,126 @@
+"""Property test: random replica death/recovery/drain schedules over random
+Poisson arrivals — no request is lost, duplicated, or served twice, and
+every request reaches exactly one terminal state exactly once.  Completed
+requests' outputs must equal the unperturbed single-engine goldens
+(recompute-on-resume across arbitrary failover chains)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, build_engine
+from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.models.llama_cache import PagedKVConfig
+from deepspeed_tpu.serving import VirtualClock
+from deepspeed_tpu.serving.fleet import (FleetSimulator, FleetState, ReplicaPool,
+                                         Router, make_policy)
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128,
+                  rope_theta=1e4, dtype=jnp.float32, scan_layers=True, remat=False)
+
+
+@pytest.fixture(scope="module")
+def trained_params():
+    model = LlamaForCausalLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def _factory(trained_params):
+    def make():
+        kv = PagedKVConfig(num_pages=64, page_size=8, max_pages_per_seq=8)
+        sched = SchedulerConfig(token_budget=64, max_seqs=4, prefill_chunk=8,
+                                decode_bucket=4)
+        return build_engine(CFG, trained_params, RaggedInferenceEngineConfig(
+            kv=kv, scheduler=sched, kv_dtype=jnp.float32, decode_steps_per_dispatch=1))
+    return make
+
+
+@pytest.fixture(scope="module")
+def goldens(trained_params):
+    """Unperturbed outputs keyed by (prompt tuple, max_new): the oracle for
+    'served exactly once with the right result'."""
+    cache = {}
+    eng = _factory(trained_params)()
+
+    def get(prompt, max_new):
+        key = (tuple(prompt), max_new)
+        if key not in cache:
+            cache[key] = eng.generate([list(prompt)], max_new_tokens=max_new)[0]
+        return cache[key]
+    return get
+
+
+def _random_workload(rng, n_requests):
+    t = 0.0
+    arrivals = []
+    for _ in range(n_requests):
+        t += float(rng.exponential(1.2))
+        p_len = int(rng.integers(3, 14))
+        o_len = int(rng.integers(2, 9))
+        arrivals.append({
+            "arrival_ts": round(t, 6),
+            "prompt": [int(x) for x in rng.integers(1, CFG.vocab_size, p_len)],
+            "max_new_tokens": o_len,
+            # deadlines guarantee termination even through a schedule that
+            # kills every replica: pending work expires instead of stalling
+            "deadline": round(t + 80.0, 6),
+        })
+    return arrivals
+
+
+def _random_schedule(rng, n_replicas, horizon):
+    """1-2 kill/recover pairs plus maybe a drain/restart pair, on random
+    replicas at random times (recover strictly after its kill)."""
+    schedule = []
+    for _ in range(int(rng.integers(1, 3))):
+        rid = int(rng.integers(0, n_replicas))
+        t_kill = round(float(rng.uniform(1.0, horizon)), 6)
+        t_rec = round(t_kill + float(rng.uniform(2.0, 12.0)), 6)
+        schedule += [(t_kill, "kill", rid), (t_rec, "recover", rid)]
+    if rng.random() < 0.5:
+        rid = int(rng.integers(0, n_replicas))
+        t_drain = round(float(rng.uniform(1.0, horizon)), 6)
+        schedule += [(t_drain, "drain", rid),
+                     (round(t_drain + float(rng.uniform(1.0, 6.0)), 6), "restart", rid)]
+    return schedule
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_random_fault_schedules_lose_nothing(trained_params, goldens, seed):
+    rng = np.random.default_rng(seed)
+    n_replicas = int(rng.integers(2, 4))
+    policy = ["round_robin", "least_outstanding", "prefix_affinity"][seed % 3]
+    arrivals = _random_workload(rng, n_requests=10)
+    schedule = _random_schedule(rng, n_replicas, horizon=arrivals[-1]["arrival_ts"])
+
+    pool = ReplicaPool(_factory(trained_params), n_replicas, clock=VirtualClock())
+    router = Router(pool, make_policy(policy))
+    reqs = FleetSimulator(router).run(arrivals, schedule=schedule)
+
+    # nothing lost: every submitted request exists and is terminal
+    assert len(reqs) == len(arrivals) == len(router.requests)
+    assert all(r.state.terminal for r in reqs)
+    assert router.outstanding == 0
+
+    for r in reqs:
+        # ... and reached exactly ONE terminal state exactly once
+        terminals = [st for st, _ in r.history if st.terminal]
+        assert terminals == [r.state], (r.fid, r.history)
+        # never served twice: the output never exceeds its budget, and a
+        # DONE request's tokens are exactly the unperturbed golden (no
+        # duplicated resume segments, no replica's partial output counted
+        # twice)
+        assert len(r.tokens) <= r.max_new_tokens
+        if r.state is FleetState.DONE:
+            assert r.tokens == goldens(r.prompt, r.max_new_tokens), \
+                (r.fid, r.failovers, r.dispatches)
+
+    # conservation: terminal counts partition the submitted set
+    by_state = {s: sum(1 for r in reqs if r.state is s) for s in FleetState}
+    assert by_state[FleetState.DONE] + by_state[FleetState.TIMED_OUT] \
+        + by_state[FleetState.REJECTED] == len(arrivals)
+    # failover accounting closed out: every kill record resolved
+    assert router.summary()["failover"]["unrecovered"] == 0

@@ -102,3 +102,36 @@ def test_comet_monitor_disabled_without_package():
     from deepspeed_tpu.runtime.config import CometConfig
     m = CometMonitor(CometConfig(enabled=True))
     assert not m.enabled  # comet_ml not installed → disabled, no crash
+
+
+def test_monitor_master_caps_event_volume(tmp_path):
+    """max_events bounds forwarded volume (fleet sims emit an order of
+    magnitude more events than one engine); overflow is dropped, counted in
+    dropped_events, and surfaced as monitor/dropped_events on the backends."""
+    cfg = _monitor_config(tmp_path)
+    cfg.max_events = 5
+    master = MonitorMaster(cfg)
+    assert master.enabled and master.max_events == 5
+    master.write_events([(f"serving/ttft", 0.1 * i, i) for i in range(3)])
+    assert master.events_written == 3 and master.dropped_events == 0
+    # crosses the cap mid-batch: head forwarded, tail dropped
+    master.write_events([(f"fleet/dispatch", float(i), i) for i in range(4)])
+    assert master.events_written == 5 and master.dropped_events == 2
+    master.write_events([("fleet/done", 1.0, 9)])
+    assert master.events_written == 5 and master.dropped_events == 3
+    files = {f for root, _, fs in os.walk(tmp_path) for f in fs if f.endswith(".csv")}
+    assert "monitor_dropped_events.csv" in files
+    # exactly max_events real events reached the backend
+    real_rows = 0
+    for root, _, fs in os.walk(tmp_path):
+        for f in fs:
+            if f.endswith(".csv") and "dropped_events" not in f:
+                real_rows += sum(1 for _ in csv.reader(open(os.path.join(root, f)))) - 1
+    assert real_rows == 5
+
+
+def test_monitor_master_unbounded_by_default(tmp_path):
+    master = MonitorMaster(_monitor_config(tmp_path))
+    assert master.max_events == 0
+    master.write_events([("a/b", float(i), i) for i in range(300)])
+    assert master.events_written == 300 and master.dropped_events == 0
