@@ -92,7 +92,19 @@ class FlopsProfiler:
         self.ds_engine = ds_engine
         self.recompute_fwd_factor = recompute_fwd_factor
         self.started = False
+        self.metrics_registry = None
         self.reset_profile()
+
+    def attach_metrics(self, registry) -> "FlopsProfiler":
+        """Publish each profile's numbers into a telemetry
+        ``MetricsRegistry`` (docs/OBSERVABILITY.md): gauges
+        ``profiler/flops_per_step``, ``profiler/macs_per_step``,
+        ``profiler/params``, ``profiler/bytes_per_step`` and
+        ``profiler/step_duration_s`` are set every time ``stop_profile``
+        collects — the bridge from the one-shot profile printout to the
+        always-on metrics surface."""
+        self.metrics_registry = registry
+        return self
 
     # -- lifecycle (ref: profiler.py:74 start_profile / :134 stop / :203 end)
 
@@ -161,6 +173,13 @@ class FlopsProfiler:
         self._bytes = int(ca.get("bytes accessed", 0))
         if self.ds_engine is not None and self.ds_engine.state is not None:
             self._params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(self.ds_engine.state.params))
+        if self.metrics_registry is not None:
+            reg = self.metrics_registry
+            reg.gauge("profiler/flops_per_step").set(self._flops)
+            reg.gauge("profiler/macs_per_step").set(self._macs)
+            reg.gauge("profiler/params").set(self._params)
+            reg.gauge("profiler/bytes_per_step").set(self._bytes)
+            reg.gauge("profiler/step_duration_s").set(self._duration)
 
     # -- getters (ref: profiler.py:232-279)
 

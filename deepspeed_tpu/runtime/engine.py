@@ -172,6 +172,14 @@ class DeepSpeedEngine:
             self.flops_profiler = FlopsProfiler(model=self.module, ds_engine=self,
                                                 recompute_fwd_factor=config.flops_profiler_config.recompute_fwd_factor)
 
+        # ---- telemetry (deepspeed_tpu/telemetry, docs/OBSERVABILITY.md):
+        # per-step traces (engine/step -> fwd_bwd/optim, plus the streamed
+        # optimizer's upload/compute/download child spans) and a metrics
+        # registry; disabled (null, allocation-free) until set_telemetry()
+        from ..telemetry.trace import NULL_TRACER
+        self.tracer = NULL_TRACER
+        self.metrics_registry = None
+
         # ---- compression-aware training (ref: compression/compress.py
         # init_compression; applied as a param transform inside the loss)
         self._compression_fn = None
@@ -1069,7 +1077,11 @@ class DeepSpeedEngine:
         nv = self._nvme_opt
         nv.events.append(("step_entry_pending_writes", nv.pending_writes()))
         state = self.state
-        grads, loss, gnorm = self._train_step_fn(state, batch)
+        step_span = getattr(self, "_step_span", None)
+        with self.tracer.span("engine/fwd_bwd", parent=step_span, track="engine"):
+            # span covers the DISPATCH; the async program keeps running —
+            # the wait for grads shows up inside the optim span (bwd_wait)
+            grads, loss, gnorm = self._train_step_fn(state, batch)
         # backward-phase prefetch: fwd/bwd is dispatched but (async) still
         # running — stage the first groups now instead of at step boundary
         mode = getattr(self, "_nvme_step_mode", None)
@@ -1084,9 +1096,32 @@ class DeepSpeedEngine:
         if cfg.gradient_clipping and cfg.gradient_clipping > 0:
             scale = scale * jnp.minimum(1.0, cfg.gradient_clipping / (gnorm + 1e-6))
         self.timers(STEP_GLOBAL_TIMER).start()
-        new_leaves = nv.step(jax.tree.leaves(grads), jnp.asarray(self.global_steps, jnp.int32),
-                             scale, serialize=(mode == "serialize"),
-                             flush=(mode == "flush"))
+        opt_span = self.tracer.start_span("engine/optim", parent=step_span,
+                                          track="engine")
+        if self.tracer.enabled:
+            # clock-domain anchor: instrumentation timestamps are absolute
+            # perf_counter; map them into the tracer's clock by offset
+            from ..runtime.swap_tensor.overlap_instrumentation import now as _perf_now
+            anchor_perf, anchor_trace = _perf_now(), self.tracer.now()
+        try:
+            new_leaves = nv.step(jax.tree.leaves(grads), jnp.asarray(self.global_steps, jnp.int32),
+                                 scale, serialize=(mode == "serialize"),
+                                 flush=(mode == "flush"))
+        except Exception as e:
+            # the failed steps are exactly the ones an operator reads the
+            # trace for — tag and close instead of dropping the open span
+            opt_span.set(error=f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            if self.tracer.enabled and getattr(nv, "instrumentation", None) is not None:
+                # lift this step's upload/compute/download pipeline events
+                # into real child spans of the optim span (paired
+                # issue->done become spans; unpaired issues — async tails
+                # left in flight — become span events on the optim span)
+                nv.instrumentation.lift_spans(
+                    self.tracer, opt_span, track="stream",
+                    since_ts=anchor_perf, offset=anchor_trace - anchor_perf)
+            self.tracer.end(opt_span)
         self.timers(STEP_GLOBAL_TIMER).stop()
         tdef = jax.tree.structure(state.params)
         new_state = state._replace(params=jax.tree.unflatten(tdef, new_leaves),
@@ -1261,11 +1296,22 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).start()
         import time as _time
         _step_t0 = _time.time()
-        with mesh_lib.trace_mesh(self.mesh):  # first call traces model code
-            if getattr(self, "_nvme_opt", None) is not None:
-                self.state, metrics = self._nvme_train_step(batch)
-            else:
-                self.state, metrics = self._train_step_fn(self.state, batch)
+        # one trace per training step; phases land as child spans (the
+        # null tracer makes this whole block allocation-free when off)
+        self._step_span = self.tracer.start_span(
+            "engine/step", track="engine",
+            attrs={"global_step": self.global_steps} if self.tracer.enabled else None)
+        try:
+            with mesh_lib.trace_mesh(self.mesh):  # first call traces model code
+                if getattr(self, "_nvme_opt", None) is not None:
+                    self.state, metrics = self._nvme_train_step(batch)
+                else:
+                    with self.tracer.span("engine/fused_step",
+                                          parent=self._step_span, track="engine"):
+                        self.state, metrics = self._train_step_fn(self.state, batch)
+        finally:
+            self.tracer.end(self._step_span)
+            self._step_span = None
         if getattr(self, "_compressed_wire_bytes", None) \
                 and self.global_steps >= getattr(self, "_onebit_freeze_step", 0) \
                 and not self._rebuilt_this_step:
@@ -1405,6 +1451,24 @@ class DeepSpeedEngine:
         self._micro_step_count = 0
 
     # ------------------------------------------------------------- monitoring
+
+    def set_telemetry(self, tracer=None, metrics=None):
+        """Attach a telemetry ``Tracer`` and/or ``MetricsRegistry``
+        (deepspeed_tpu/telemetry).  Every subsequent ``train_batch`` emits
+        one ``engine/step`` trace with ``fwd_bwd``/``optim`` child spans
+        (streamed-optimizer tiers additionally lift their per-group
+        upload/compute/download phases into child spans), and the flops
+        profiler — when enabled — publishes its per-step flops/params
+        gauges into the registry."""
+        from ..telemetry.trace import NULL_TRACER
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.metrics_registry = metrics
+        if self.flops_profiler is not None:
+            # always propagate — set_telemetry() with no registry must
+            # DETACH a previously attached one, or the profiler keeps
+            # publishing into (and pinning) a registry the caller dropped
+            self.flops_profiler.attach_metrics(metrics)
+        return self
 
     def _write_monitor(self, metrics):
         if self.monitor is not None and self.monitor.enabled:

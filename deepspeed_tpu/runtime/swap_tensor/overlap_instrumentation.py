@@ -95,6 +95,44 @@ class OverlapInstrumentation:
         }
         self.version += 1
 
+    # ----------------------------------------------------------- telemetry
+
+    def lift_spans(self, tracer, parent, track: str = "stream",
+                   since_ts: float = 0.0, offset: float = 0.0) -> int:
+        """Lift the phase events recorded since ``since_ts`` (perf-counter
+        domain) into trace spans under ``parent`` (a telemetry Span).
+
+        Paired ``<phase>_issue``/``<phase>_done`` events for a group
+        become a real child span ``<phase> g<N>``; an unpaired issue (an
+        async transfer left in flight by the pipelined sweep — by design)
+        becomes a point event on ``parent``, so the trace never claims a
+        duration nobody measured.  ``offset`` maps perf-counter timestamps
+        into the tracer's clock domain.  Returns how many spans were
+        materialized."""
+        pairs: Dict[tuple, float] = {}   # (phase, group) -> issue ts
+        made = 0
+        for kind, g, t in self.events:
+            if t < since_ts or "_" not in kind:
+                continue
+            phase, _, edge = kind.rpartition("_")
+            if phase not in PHASES:
+                continue
+            if edge == "issue":
+                pairs[(phase, g)] = t
+            elif edge == "done":
+                t0 = pairs.pop((phase, g), None)
+                if t0 is None:
+                    parent.event(f"{phase}_done g{g}", t + offset)
+                    continue
+                tracer.add_span(f"{phase} g{g}", parent.trace_id,
+                                t0 + offset, t + offset,
+                                parent_id=parent.span_id, track=track,
+                                attrs={"group": g, "phase": phase})
+                made += 1
+        for (phase, g), t0 in sorted(pairs.items()):
+            parent.event(f"{phase}_issue g{g}", t0 + offset, {"in_flight": True})
+        return made
+
     # ------------------------------------------------------------- report
 
     def report(self) -> Optional[Dict[str, Any]]:

@@ -33,12 +33,23 @@ class VirtualClock:
         self._now = 0.0
 
     def advance(self, dt: float) -> None:
-        assert dt >= 0, f"virtual clock cannot go backwards (dt={dt})"
+        # explicit raise, not assert: time-domain integrity must hold under
+        # ``python -O`` too — a negative (or NaN) step cost would silently
+        # rewind every timestamp derived from this clock
+        if not dt >= 0:
+            raise ValueError(f"virtual clock cannot go backwards (dt={dt})")
         self._now += dt
 
     def wait_until(self, ts: float) -> None:
-        """Jump to ``ts`` (idle gap between arrivals); never rewinds."""
-        self._now = max(self._now, ts)
+        """Jump to ``ts`` (idle gap between arrivals).  A ``ts`` in the
+        past — a stale deadline, an out-of-order arrival — CLAMPS to
+        ``now()``: the clock never rewinds (telemetry timestamps and
+        latency accounting assume monotonic time).  NaN is rejected."""
+        ts = float(ts)
+        if ts != ts:
+            raise ValueError("wait_until(NaN)")
+        if ts > self._now:
+            self._now = ts
 
     def on_step(self, cost: float) -> float:
         """One engine step consumed ``cost`` virtual seconds.  Returns the
@@ -99,6 +110,10 @@ class ReplicaClockView:
         self.shared.wait_until(ts)
 
     def on_step(self, cost: float) -> float:
+        # same backwards-time stance as VirtualClock.advance: a negative
+        # recorded cost would silently shrink the fleet round
+        if not cost >= 0:
+            raise ValueError(f"replica step cost cannot be negative (cost={cost})")
         self._pending_cost = max(self._pending_cost, cost)
         return cost
 

@@ -25,8 +25,9 @@ traffic and deterministic virtual-clock CPU tests / the load harness.
 
 import dataclasses
 import itertools
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..telemetry.trace import NULL_TRACER
 from ..utils.logging import logger
 from .admission import AdmissionConfig, AdmissionController
 from .clock import VirtualClock, WallClock  # noqa: F401  (re-exported convenience)
@@ -56,11 +57,24 @@ class ServingConfig:
 class ServingEngine:
     """Drives an :class:`InferenceEngineV2` as a servable endpoint."""
 
-    def __init__(self, engine, clock=None, config: ServingConfig = None, monitor=None):
+    def __init__(self, engine, clock=None, config: ServingConfig = None, monitor=None,
+                 tracer=None, metrics=None, trace_track: str = "serving"):
         self.engine = engine
         self.clock = clock if clock is not None else VirtualClock()
         self.config = config or ServingConfig()
         self.monitor = monitor
+        # telemetry (docs/OBSERVABILITY.md): ``tracer`` collects one trace
+        # per request (phase spans derived from the request's state history
+        # at terminal time — the per-token hot path does NO tracer work);
+        # ``metrics`` is a MetricsRegistry for always-on counters/histograms
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.metrics = metrics
+        self.trace_track = trace_track
+        # uid -> (trace_id, parent_span_id, clamp_start): parent_span_id is
+        # the fleet router's attempt span when this frontend is a replica
+        # (phases clamp to the dispatch time so resumed attempts don't
+        # double-count the backdated client arrival); both None standalone
+        self._trace_ctx: Dict[int, Tuple[int, Optional[int], Optional[float]]] = {}
         self.admission = AdmissionController(self.config.admission, engine)
         self.kvp = KVPressureManager(engine, youth_key=self._youth_key)
         self.stats = ServingStats()
@@ -124,7 +138,9 @@ class ServingEngine:
     def submit(self, prompt: Sequence[int], max_new_tokens: Optional[int] = None,
                deadline: Optional[float] = None, arrival_ts: Optional[float] = None,
                priority: float = 0.0, stream: Optional[Callable] = None,
-               retry_policy=None, resume_tokens: Optional[Sequence[int]] = None) -> ServingRequest:
+               retry_policy=None, resume_tokens: Optional[Sequence[int]] = None,
+               trace_id: Optional[int] = None,
+               parent_span_id: Optional[int] = None) -> ServingRequest:
         """Enqueue one request.  NEVER raises on overload: the returned
         request's state is REJECTED (with ``reject_reason``) when admission
         refuses it — callers inspect, the serving loop keeps running.
@@ -136,6 +152,11 @@ class ServingEngine:
         recompute-on-resume contract KV-pressure preemption uses, across
         replicas.  ``max_new_tokens`` still bounds the TOTAL output (resumed
         tokens included); it must exceed ``len(resume_tokens)``.
+
+        ``trace_id`` / ``parent_span_id``: trace propagation (telemetry).
+        A fleet router passes its client trace id plus the per-replica
+        attempt span so this request's phase spans land in the CLIENT's
+        trace; standalone, a fresh trace id is allocated per request.
 
         ``retry_policy`` (a resilience ``RetryPolicy``): back off on the
         clock and re-probe admission while the rejection is TRANSIENT
@@ -172,6 +193,16 @@ class ServingEngine:
             req.tokens.extend(int(t) for t in resume_tokens)
         self._requests[req.uid] = req
         self.stats.submitted += 1
+        if self.tracer.enabled:
+            # fleet mode (parent attempt span given): phases clamp to the
+            # submission instant so a resumed attempt's backdated arrival
+            # doesn't double-count the previous attempt's time
+            self._trace_ctx[req.uid] = (
+                trace_id if trace_id is not None else self.tracer.new_trace_id(),
+                parent_span_id,
+                self.clock.now() if parent_span_id is not None else None)
+        if self.metrics is not None:
+            self.metrics.counter("serving/submitted").inc()
         ok, reason = self.admission.submit_ok(req, len(self._queue))
         if not ok and reason == "queue_full" and retry_policy is not None:
             from ..resilience.retry import backoff_until
@@ -195,6 +226,9 @@ class ServingEngine:
             self.stats.record_reject(reason)
             self.stats.record_terminal(req)
             self._requests.pop(req.uid, None)
+            if self.metrics is not None:
+                self.metrics.counter("serving/rejected").inc()
+            self._trace_terminal(req, now)
             self._emit([("serving/rejected", 1.0, self._next_event_step())])
             return req
         self._queue.append(req)
@@ -286,6 +320,8 @@ class ServingEngine:
         req.to(RequestState.EVICTED, now)
         req.preemptions += 1
         self.stats.preemptions += 1
+        if self.metrics is not None:
+            self.metrics.counter("serving/preemptions").inc()
         self._emit([("serving/preempted", 1.0, self._next_event_step())])
         req.to(RequestState.QUEUED, now)
         self._queue.append(req)
@@ -324,6 +360,8 @@ class ServingEngine:
         # gone; keys here must not grow without bound in a long-lived
         # server) — the caller's handle and stats.finished keep the record
         self._requests.pop(req.uid, None)
+        self._record_terminal_metrics(req, state, now)
+        self._trace_terminal(req, now)
         step = self._next_event_step()
         events = [("serving/e2e_latency", now - req.arrival_ts, step),
                   ("serving/preemptions", float(req.preemptions), step)]
@@ -338,6 +376,57 @@ class ServingEngine:
         else:
             events.append(("serving/timed_out", 1.0, step))
         self._emit(events)
+
+    # ----------------------------------------------------------- telemetry
+
+    def _record_terminal_metrics(self, req: ServingRequest, state: RequestState,
+                                 now: float) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.counter(f"serving/{state.value}").inc()
+        self.metrics.histogram("serving/e2e_s").record(now - req.arrival_ts)
+        if state is RequestState.DONE:
+            if req.ttft is not None:
+                self.metrics.histogram("serving/ttft_s").record(req.ttft)
+            if req.tpot is not None:
+                self.metrics.histogram("serving/tpot_s").record(req.tpot)
+            if req.queue_wait is not None:
+                self.metrics.histogram("serving/queue_wait_s").record(req.queue_wait)
+
+    def _trace_terminal(self, req: ServingRequest, now: float) -> None:
+        """Fold the finished request's state history into trace spans.
+
+        Standalone: a ``request`` root span [arrival, terminal] on this
+        frontend's track, with phase children (queued/prefill/decode) and
+        one ``preempted`` span event per eviction.  Under a fleet router
+        (an attempt parent span was passed at submit): only the phase
+        children are emitted here — the router owns the root and the
+        attempt spans, and phases clamp to the dispatch instant."""
+        ctx = self._trace_ctx.pop(req.uid, None)
+        if ctx is None:
+            return
+        from ..telemetry.spans import emit_attempt_spans
+        trace_id, parent_id, clamp = ctx
+        if parent_id is not None:
+            emit_attempt_spans(self.tracer, req, trace_id, parent_id,
+                               self.trace_track, end_ts=now, clamp_start=clamp)
+            return
+        root_id = self.tracer.reserve_span_id()
+        emit_attempt_spans(self.tracer, req, trace_id, root_id,
+                           self.trace_track, end_ts=now)
+        events = [("preempted", ts, None) for st, ts in req.history
+                  if st is RequestState.EVICTED]
+        self.tracer.add_span(
+            "request", trace_id, req.arrival_ts, now, span_id=root_id,
+            track=self.trace_track, events=events,
+            attrs={"uid": req.uid, "state": req.state.value,
+                   "prompt_len": len(req.prompt), "n_tokens": len(req.tokens),
+                   "preemptions": req.preemptions,
+                   "reject_reason": req.reject_reason,
+                   "ttft": req.ttft, "tpot": req.tpot,
+                   "queue_wait": req.queue_wait,
+                   "e2e": now - req.arrival_ts,
+                   "deadline_met": req.met_deadline})
 
     # ---------------------------------------------------------------- loop
 
@@ -452,8 +541,17 @@ class ServingEngine:
         instantaneous *load* snapshot — queue depth, outstanding decode
         tokens, free KV pages, EWMA step seconds — use :meth:`load_stats`;
         the fleet router polls that every dispatch, while ``summary()`` is
-        the end-of-run report."""
-        return self.stats.summary(elapsed=self.clock.now() - self._t0)
+        the end-of-run report.
+
+        ``monitor_dropped_events`` surfaces the ``MonitorMaster`` drop
+        counter (the ``max_events`` cap): under a fleet's event volume the
+        monitor sheds load silently at its own surface, and a summary that
+        hid the loss would let a truncated metric stream read as a
+        complete one.  ``dropped_spans`` is the tracer's equivalent."""
+        rec = self.stats.summary(elapsed=self.clock.now() - self._t0)
+        rec["monitor_dropped_events"] = int(getattr(self.monitor, "dropped_events", 0) or 0)
+        rec["dropped_spans"] = int(self.tracer.dropped_spans)
+        return rec
 
     def _next_event_step(self) -> int:
         self._events_step += 1

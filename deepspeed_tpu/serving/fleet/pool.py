@@ -41,11 +41,18 @@ class ReplicaPool:
 
     def __init__(self, engine_factory: Callable[[], object], n_replicas: int,
                  clock=None, serving_config: ServingConfig = None, monitor=None,
-                 health_config: HealthConfig = None):
+                 health_config: HealthConfig = None, tracer=None, metrics=None):
         assert n_replicas >= 1, n_replicas
         self.engine_factory = engine_factory
         self.serving_config = serving_config or ServingConfig()
         self.monitor = monitor
+        # telemetry: ONE tracer/metrics registry spans the whole fleet —
+        # every replica frontend traces onto its own track (replica<rid>)
+        # of the same span stream, and a fresh engine attached by
+        # recover()/restart() inherits them (observability survives the
+        # replica, like the clock does)
+        self.tracer = tracer
+        self.metrics = metrics
         self.clock = clock if clock is not None else VirtualClock()
         self._virtual = isinstance(self.clock, VirtualClock)
         self.replicas: Dict[int, Replica] = {}
@@ -62,7 +69,9 @@ class ReplicaPool:
     def _attach_engine(self, rid: int) -> None:
         rep = self.replicas[rid]
         rep.serve = ServingEngine(self.engine_factory(), clock=rep.clock,
-                                  config=self.serving_config, monitor=self.monitor)
+                                  config=self.serving_config, monitor=self.monitor,
+                                  tracer=self.tracer, metrics=self.metrics,
+                                  trace_track=f"replica{rid}")
         rep.generation += 1
 
     def _emit(self, name: str, value: float) -> None:

@@ -34,6 +34,8 @@ import itertools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ...resilience import fault_injection as _fi
+from ...telemetry.spans import emit_attempt_spans
+from ...telemetry.trace import NULL_TRACER
 from ...utils.logging import logger
 from ..metrics import percentile_summary
 from ..request import RequestState, ServingRequest
@@ -77,6 +79,10 @@ class FleetRequest:
     dispatches: List[Tuple[int, float]] = dataclasses.field(default_factory=list)
     history: List[Tuple[FleetState, float]] = dataclasses.field(default_factory=list)
     _current: Optional[Tuple[int, ServingRequest, int]] = None  # (rid, sr, generation)
+    #: telemetry context when the router traces: {"trace_id", "root_id",
+    #: "attempts": [per-attempt dicts], "last_dead": span id of the most
+    #: recently displaced attempt (the next attempt links to it)}
+    trace: Optional[dict] = None
 
     def __post_init__(self):
         self.prompt = list(self.prompt)
@@ -106,10 +112,28 @@ class FleetRequest:
 class Router:
     """Cache-affinity, health-aware request router over a ReplicaPool."""
 
-    def __init__(self, pool: ReplicaPool, policy: RoutingPolicy, monitor=None):
+    def __init__(self, pool: ReplicaPool, policy: RoutingPolicy, monitor=None,
+                 tracer=None):
         self.pool = pool
         self.policy = policy
         self.monitor = monitor
+        # one trace per CLIENT request: the trace_id allocated at submit
+        # propagates through every per-replica attempt and survives
+        # failover (the resumed attempt links to the dead replica's span).
+        # The tracer MUST be the pool's: a router-only tracer would emit
+        # attempt spans whose phase children the (untraced) replica
+        # frontends never produce — a half-instrumented trace that fails
+        # trace_report's tiling invariant by construction.
+        if tracer is not None and getattr(tracer, "enabled", True) \
+                and tracer is not pool.tracer:
+            # a DISABLED tracer (NULL_TRACER) is the documented way to say
+            # "tracing off" and is equivalent to None, not a mismatch
+            raise ValueError(
+                "Router tracer must be the ReplicaPool's: pass tracer= to "
+                "ReplicaPool(...) so the replica frontends emit the phase "
+                "spans (the pool propagates it to every attached engine, "
+                "including recover()/restart() replacements)")
+        self.tracer = pool.tracer if pool.tracer is not None else NULL_TRACER
         self.clock = pool.clock
         self._fids = itertools.count()
         self._pending: List[FleetRequest] = []
@@ -136,6 +160,12 @@ class Router:
         fr = FleetRequest(fid=next(self._fids), prompt=list(prompt),
                           max_new_tokens=int(max_new_tokens), arrival_ts=now,
                           deadline=deadline, priority=priority)
+        if self.tracer.enabled:
+            # reserve the root span id now: attempt/phase children parent
+            # to it long before the root's extent (terminal ts) is known
+            fr.trace = {"trace_id": self.tracer.new_trace_id(),
+                        "root_id": self.tracer.reserve_span_id(),
+                        "attempts": [], "last_dead": None}
         self.requests.append(fr)
         self._pending.append(fr)
         self.stats["submitted"] += 1
@@ -216,20 +246,37 @@ class Router:
             fr.finish_ts = fr.finish_ts if fr.finish_ts is not None else now
             self._finish(fr, FleetState.DONE, now)
             return False
+        att = None
+        if fr.trace is not None:
+            # the attempt span id is reserved BEFORE submit so the replica
+            # frontend can parent this attempt's phase spans to it; the
+            # span itself is materialized when the attempt ends
+            att = {"rid": rid, "span_id": self.tracer.reserve_span_id(),
+                   "dispatch_ts": now, "generation": rep.generation,
+                   "resumed_from": fr.trace["last_dead"],
+                   "resume_tokens": len(fr.tokens), "end_ts": None}
         sr = rep.serve.submit(
             fr.prompt, max_new_tokens=fr.max_new_tokens, deadline=fr.deadline,
             arrival_ts=fr.arrival_ts, priority=fr.priority,
             stream=self._make_stream(fr, rep.generation),
-            resume_tokens=list(fr.tokens) or None)
+            resume_tokens=list(fr.tokens) or None,
+            trace_id=fr.trace["trace_id"] if fr.trace is not None else None,
+            parent_span_id=att["span_id"] if att is not None else None)
         if sr.state is RequestState.REJECTED:
             if sr.reject_reason == "queue_full":
                 self.stats["saturated_dispatches"] += 1
                 return False            # transient: stays pending
             self._pending.remove(fr)
             fr.reject_reason = sr.reject_reason
+            if att is not None:
+                fr.trace["attempts"].append(att)
+                self._close_attempt(fr, "rejected", now)
             self._finish(fr, FleetState.REJECTED, now)
             return False
         self._pending.remove(fr)
+        if att is not None:
+            fr.trace["attempts"].append(att)
+            fr.trace["last_dead"] = None
         fr._current = (rid, sr, rep.generation)
         fr.dispatches.append((rid, now))
         fr.state = FleetState.DISPATCHED
@@ -265,11 +312,18 @@ class Router:
                 del self._dispatched[fr.fid]
                 fr._current = None
                 fr.finish_ts = sr.finish_ts if sr.finish_ts is not None else now
+                self._close_attempt(fr, "done", fr.finish_ts)
                 self._finish(fr, FleetState.DONE, now)
             elif sr.state is RequestState.TIMED_OUT:
                 del self._dispatched[fr.fid]
                 fr._current = None
-                self._finish(fr, FleetState.TIMED_OUT, now)
+                # close at the REPLICA-side timeout instant, not poll-time
+                # now (the shared clock advanced by a round in between):
+                # the root span must end where the phase spans do or the
+                # trace_report tiling invariant breaks by one round
+                t_out = sr.history[-1][1]
+                self._close_attempt(fr, "timed_out", t_out)
+                self._finish(fr, FleetState.TIMED_OUT, t_out)
 
     # ------------------------------------------------------------ failover
 
@@ -292,10 +346,32 @@ class Router:
         for fr in list(self._dispatched.values()):
             if fr._current is not None and fr._current[0] == rid:
                 del self._dispatched[fr.fid]
+                displaced_sr = fr._current[1]
                 fr._current = None
+                if displaced_sr.state.terminal:
+                    # the request reached its terminal state on the replica
+                    # BEFORE the death notice (a wall-clock driver can kill
+                    # between the finishing tick and poll): nothing was
+                    # displaced — resolve exactly as poll() would, with no
+                    # failover charged and the replica-side finish time kept
+                    if displaced_sr.state is RequestState.DONE:
+                        fr.finish_ts = displaced_sr.finish_ts \
+                            if displaced_sr.finish_ts is not None else now
+                        self._close_attempt(fr, "done", fr.finish_ts)
+                        self._finish(fr, FleetState.DONE, now)
+                    else:
+                        t_out = displaced_sr.history[-1][1]
+                        self._close_attempt(fr, displaced_sr.state.value, t_out)
+                        self._finish(fr, FleetState.TIMED_OUT, t_out)
+                    continue
                 fr.failovers += 1
                 fr.state = FleetState.PENDING
                 fr.history.append((FleetState.PENDING, now))
+                # the dead attempt's spans close NOW (its frontend is
+                # discarded, so the router folds the partial history); the
+                # resumed attempt on a survivor will link back to this
+                # span id — the client trace is continuous across the kill
+                self._close_attempt(fr, "displaced", now, displaced_sr=displaced_sr)
                 self._pending.append(fr)
                 victims.append(fr)
                 self.stats["failovers"] += 1
@@ -333,7 +409,80 @@ class Router:
         fr.state = state
         fr.history.append((state, now))
         self._note_victim_resolved(fr, now)
+        if fr.trace is not None:
+            self._trace_finish(fr, state, now)
         self._emit([(f"fleet/{state.value}", 1.0, self._next_event_step())])
+
+    # ----------------------------------------------------------- telemetry
+
+    def _close_attempt(self, fr: FleetRequest, outcome: str, end_ts: float,
+                       displaced_sr: Optional[ServingRequest] = None) -> None:
+        """Materialize the current (last) attempt span.  For a displaced
+        attempt the replica frontend is already discarded, so its partial
+        phase spans are folded here from the ServingRequest history,
+        clamped to the dispatch instant."""
+        tr = fr.trace
+        if tr is None or not tr["attempts"]:
+            return
+        att = tr["attempts"][-1]
+        if att["end_ts"] is not None:  # already closed (duplicate death notice)
+            return
+        att["end_ts"] = end_ts
+        track = f"replica{att['rid']}"
+        if displaced_sr is not None:
+            # fold the dead attempt's PARTIAL history — unless the request
+            # already reached a terminal state on the replica (killed in
+            # the window between its finishing tick and the router's
+            # poll): its frontend emitted the phase spans at _finish, and
+            # re-folding here would double every phase and break the
+            # trace_report tiling invariant
+            if not displaced_sr.state.terminal:
+                emit_attempt_spans(self.tracer, displaced_sr, tr["trace_id"],
+                                   att["span_id"], track, end_ts=end_ts,
+                                   clamp_start=att["dispatch_ts"])
+            tr["last_dead"] = att["span_id"]
+        attrs = {"rid": att["rid"], "generation": att["generation"],
+                 "outcome": outcome, "resume_tokens": att["resume_tokens"]}
+        if att["resumed_from"] is not None:
+            attrs["resumed_from"] = att["resumed_from"]
+        self.tracer.add_span("attempt", tr["trace_id"], att["dispatch_ts"],
+                             end_ts, parent_id=tr["root_id"],
+                             span_id=att["span_id"], track=track, attrs=attrs)
+
+    def _trace_finish(self, fr: FleetRequest, state: FleetState, now: float) -> None:
+        """Materialize the client-request root span plus the router-queue
+        ``phase/pending`` gaps (before first dispatch, between failover
+        displacement and re-dispatch, after the last attempt) so the
+        trace's phase spans tile [arrival, terminal] exactly — the
+        invariant scripts/trace_report.py checks against TTFT/TPOT."""
+        tr = fr.trace
+        trace_id, root_id = tr["trace_id"], tr["root_id"]
+        end = fr.finish_ts if state is FleetState.DONE and fr.finish_ts is not None \
+            else now
+        t = fr.arrival_ts
+        for att in tr["attempts"]:
+            if att["dispatch_ts"] > t:
+                self.tracer.add_span("phase/pending", trace_id, t,
+                                     att["dispatch_ts"], parent_id=root_id,
+                                     track="router")
+            att_end = att["end_ts"] if att["end_ts"] is not None else att["dispatch_ts"]
+            t = max(t, att_end)
+        if end > t:
+            self.tracer.add_span("phase/pending", trace_id, t, end,
+                                 parent_id=root_id, track="router")
+        events = [("dispatch", ts, {"rid": rid}) for rid, ts in fr.dispatches]
+        events += [("failover", ts, None) for st, ts in fr.history[1:]
+                   if st is FleetState.PENDING]
+        events.sort(key=lambda e: e[1])
+        self.tracer.add_span(
+            "request", trace_id, fr.arrival_ts, end, span_id=root_id,
+            track="router", events=events,
+            attrs={"fid": fr.fid, "state": state.value,
+                   "prompt_len": len(fr.prompt), "n_tokens": len(fr.tokens),
+                   "failovers": fr.failovers, "affinity_hits": fr.affinity_hits,
+                   "reject_reason": fr.reject_reason,
+                   "ttft": fr.ttft, "tpot": fr.tpot, "e2e": end - fr.arrival_ts,
+                   "deadline_met": fr.met_deadline})
 
     # ----------------------------------------------------------- lifecycle
 

@@ -1,0 +1,84 @@
+"""Phase-span derivation: turn a request's state history into trace spans.
+
+The serving layers already keep an exact, timestamped state history per
+request (``ServingRequest.history`` / ``FleetRequest.history``) — the
+tracer does not shadow it with live open/close bookkeeping on the hot
+path.  Instead, when a request (or a failed-over replica attempt) ends,
+its history is folded into contiguous **phase spans** here:
+
+    queued   — QUEUED (admission queue, preemption requeue, backoff)
+    prefill  — PREFILL (prompt + recompute-on-resume KV build)
+    decode   — DECODE
+    pending  — fleet-level router queue time (before dispatch, between
+               failover displacement and re-dispatch)
+
+Phase spans TILE the request's lifetime exactly — consecutive history
+entries share boundary timestamps — which is the property
+``scripts/trace_report.py`` verifies against the recorded TTFT/TPOT
+accounting (sum of phases == ttft + tpot*(n-1) == e2e for completed
+requests).  ``clamp_start`` exists for resumed fleet attempts: their
+``ServingRequest.arrival_ts`` is backdated to the CLIENT arrival (so
+replica-side aging/deadlines stay correct), but the attempt's spans must
+start at its dispatch or they would double-count the previous attempt's
+time."""
+
+from typing import List, Optional, Tuple
+
+from ..serving.request import RequestState, ServingRequest
+from .trace import Span, Tracer
+
+__all__ = ["PHASE_OF_STATE", "phase_intervals", "emit_attempt_spans"]
+
+# RequestState -> phase name; EVICTED is transient (the requeue lands at
+# the same timestamp) but named so a non-zero-length eviction window —
+# e.g. a future async release — would still be visible, not silently
+# merged into queue time.
+PHASE_OF_STATE = {
+    RequestState.QUEUED: "queued",
+    RequestState.PREFILL: "prefill",
+    RequestState.DECODE: "decode",
+    RequestState.EVICTED: "evicted",
+}
+
+
+def phase_intervals(history: List[Tuple[RequestState, float]],
+                    end_ts: Optional[float] = None,
+                    clamp_start: Optional[float] = None
+                    ) -> List[Tuple[str, float, float]]:
+    """Fold a state history into ``(phase, t0, t1)`` intervals.
+
+    ``end_ts`` closes the last non-terminal state (required for displaced
+    attempts whose history never reached a terminal entry); terminal
+    entries are points and close the walk.  Zero-length intervals are
+    dropped.  ``clamp_start`` clips every interval's start (see module
+    docstring)."""
+    out: List[Tuple[str, float, float]] = []
+    for i, (state, ts) in enumerate(history):
+        if state.terminal:
+            break
+        if i + 1 < len(history):
+            nxt = history[i + 1][1]
+        elif end_ts is not None:
+            nxt = end_ts
+        else:
+            break  # open-ended non-terminal tail with no close time: skip
+        t0 = ts if clamp_start is None else max(ts, clamp_start)
+        if nxt > t0 and state in PHASE_OF_STATE:
+            out.append((PHASE_OF_STATE[state], t0, nxt))
+    return out
+
+
+def emit_attempt_spans(tracer: Tracer, req: ServingRequest, trace_id: int,
+                       parent_id: Optional[int], track: str,
+                       end_ts: Optional[float] = None,
+                       clamp_start: Optional[float] = None) -> List[Span]:
+    """Materialize one serving attempt's phase spans (children of
+    ``parent_id``) plus its preemption span events.  Used by the serving
+    frontend at request terminal and by the fleet router for the partial
+    attempt a replica death displaced."""
+    spans = []
+    for phase, t0, t1 in phase_intervals(req.history, end_ts=end_ts,
+                                         clamp_start=clamp_start):
+        spans.append(tracer.add_span(f"phase/{phase}", trace_id, t0, t1,
+                                     parent_id=parent_id, track=track))
+    return spans

@@ -109,10 +109,18 @@ def _workload(rng, n_requests, rate, page_size, prefix_groups, prefix_pages,
 
 
 def run_point(factory, clock_factory, policy_name, n_replicas, arrivals, rate,
-              kill_at, recover_at):
+              kill_at, recover_at, trace_path=None):
     from deepspeed_tpu.serving.fleet import (FleetSimulator, ReplicaPool, Router,
                                              make_policy)
-    pool = ReplicaPool(factory, n_replicas, clock=clock_factory())
+    clock = clock_factory()
+    tracer = None
+    if trace_path:
+        # one tracer on the SHARED fleet clock: under --dryrun the exported
+        # Chrome trace is bit-reproducible (deterministic ids + virtual
+        # timestamps) — run twice, byte-compare the artifact
+        from deepspeed_tpu.telemetry import Tracer
+        tracer = Tracer(clock=clock)
+    pool = ReplicaPool(factory, n_replicas, clock=clock, tracer=tracer)
     # pool construction built + warmup-compiled N engines; on a WallClock
     # that took far longer than the arrival horizon — re-zero (and re-stamp
     # every frontend's epoch) so t=0 is 'serving starts' and the
@@ -133,6 +141,16 @@ def run_point(factory, clock_factory, policy_name, n_replicas, arrivals, rate,
     rec["arrival_rate"] = rate
     rec["offered_rps"] = round(len(arrivals) / max(arrivals[-1]["arrival_ts"], 1e-9), 6)
     rec["kill_schedule"] = [[ts, act, rid] for ts, act, rid in schedule]
+    if tracer is not None:
+        from deepspeed_tpu.telemetry import write_chrome_trace
+        write_chrome_trace(trace_path, tracer.spans,
+                           dropped_spans=tracer.dropped_spans,
+                           meta={"source": "bench_router", "policy": policy_name,
+                                 "n_replicas": n_replicas})
+        rec["trace"] = {"path": os.path.basename(trace_path),
+                        "n_spans": len(tracer.spans)}
+        print(f"# trace: {len(tracer.spans)} spans -> {trace_path} "
+              f"(scripts/trace_report.py folds it)", flush=True)
     return rec
 
 
@@ -146,6 +164,11 @@ def main():
                     help="distinct shared prompt prefixes in the workload")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default="BENCH_ROUTER.json")
+    ap.add_argument("--trace", nargs="?", const="BENCH_ROUTER_TRACE.json",
+                    default=None, metavar="PATH",
+                    help="export a Chrome/Perfetto trace of the largest "
+                         "prefix_affinity sweep point (the one with the kill "
+                         "schedule); --dryrun traces are byte-reproducible")
     args = ap.parse_args()
 
     if args.dryrun:
@@ -181,8 +204,11 @@ def main():
             arrivals = _workload(rng, n_requests, rate, kv.page_size,
                                  args.prefix_groups, prefix_pages,
                                  ttft_budget, tpot_budget, vocab)
+            traced = (n_replicas == REPLICA_COUNTS[-1]
+                      and policy == POLICY_NAMES[-1])
             rec = run_point(factory, clock_factory, policy, n_replicas,
-                            arrivals, rate, kill_at, recover_at)
+                            arrivals, rate, kill_at, recover_at,
+                            trace_path=args.trace if traced else None)
             sweep.append(rec)
             print(f"# replicas={n_replicas} policy={policy}: "
                   f"completed={rec['completed']} goodput={rec['goodput_rps']} "

@@ -108,17 +108,31 @@ def _warm(eng, max_seqs):
     eng.generate([[1, 2, 3]] * max_seqs, max_new_tokens=2)
 
 
-def run_open_loop(make_engine, clock_factory, arrivals, rate, max_queue_depth=256):
+def run_open_loop(make_engine, clock_factory, arrivals, rate, max_queue_depth=256,
+                  trace_path=None):
     from deepspeed_tpu.serving import AdmissionConfig, ServingConfig, ServingEngine
     eng = make_engine()
     _warm(eng, eng.econfig.scheduler.max_seqs)
-    serve = ServingEngine(eng, clock=clock_factory(),
+    clock = clock_factory()
+    tracer = None
+    if trace_path:
+        from deepspeed_tpu.telemetry import Tracer
+        tracer = Tracer(clock=clock)  # --dryrun: bit-reproducible trace
+    serve = ServingEngine(eng, clock=clock,
                           config=ServingConfig(
-                              admission=AdmissionConfig(max_queue_depth=max_queue_depth)))
+                              admission=AdmissionConfig(max_queue_depth=max_queue_depth)),
+                          tracer=tracer)
     serve.run(arrivals)
     rec = serve.stats.summary(elapsed=serve.clock.now())
     rec["arrival_rate"] = rate
     rec["offered_rps"] = round(len(arrivals) / max(arrivals[-1]["arrival_ts"], 1e-9), 6)
+    if tracer is not None:
+        from deepspeed_tpu.telemetry import write_chrome_trace
+        write_chrome_trace(trace_path, tracer.spans,
+                           dropped_spans=tracer.dropped_spans,
+                           meta={"source": "bench_serving", "arrival_rate": rate})
+        print(f"# trace: {len(tracer.spans)} spans -> {trace_path} "
+              f"(scripts/trace_report.py folds it)", flush=True)
     return rec
 
 
@@ -163,6 +177,11 @@ def main():
     ap.add_argument("--concurrency", type=int, default=None, help="closed-loop concurrency")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default="BENCH_SERVING.json")
+    ap.add_argument("--trace", nargs="?", const="BENCH_SERVING_TRACE.json",
+                    default=None, metavar="PATH",
+                    help="export a Chrome/Perfetto trace of the highest-rate "
+                         "open-loop point (queueing/preemption visible); "
+                         "--dryrun traces are byte-reproducible")
     args = ap.parse_args()
 
     if args.dryrun:
@@ -195,7 +214,8 @@ def main():
         rng = np.random.default_rng(args.seed)  # same workload at every rate
         arrivals = _workload(rng, n_requests, rate, ttft_budget, tpot_budget, vocab)
         rec = run_open_loop(make_engine, clock_factory, arrivals, rate,
-                            max_queue_depth=max_queue_depth)
+                            max_queue_depth=max_queue_depth,
+                            trace_path=args.trace if rate == rates[-1] else None)
         sweep.append(rec)
         print(f"# rate={rate}: completed={rec['completed']} rejected={rec['rejected']} "
               f"timed_out={rec['timed_out']} preemptions={rec['preemptions']} "

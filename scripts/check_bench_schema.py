@@ -97,9 +97,73 @@ def _router_sweep_invariants(v):
         return "no sweep point exercised the kill schedule"
     return None
 
+_TERMINAL_STATES = {"done", "timed_out", "rejected"}
+
+
+def _validate_trace(doc):
+    """Telemetry trace artifact (deepspeed_tpu.telemetry.write_chrome_trace,
+    Chrome Trace Event Format).  Pins the invariants a trace consumer
+    (Perfetto, scripts/trace_report.py) relies on: well-formed events,
+    per-track monotonic timestamps, every span's parent existing in the
+    same trace, and serving request spans closing in a terminal state."""
+    if not isinstance(doc, dict) or not isinstance(doc.get("traceEvents"), list):
+        return "expected a Chrome-trace object with a traceEvents list"
+    errors = []
+    last_ts = {}                      # (pid, tid) -> last X-event start ts
+    span_ids = {}                     # trace_id -> set of span ids
+    parents = []                      # (trace_id, parent_id, name)
+    for i, ev in enumerate(doc["traceEvents"]):
+        if not isinstance(ev, dict) or ev.get("ph") not in ("M", "X", "i"):
+            errors.append(f"traceEvents[{i}]: unknown/missing ph "
+                          f"{ev.get('ph') if isinstance(ev, dict) else ev!r}")
+            continue
+        if "pid" not in ev or "tid" not in ev or "name" not in ev:
+            errors.append(f"traceEvents[{i}]: missing pid/tid/name")
+            continue
+        if ev["ph"] == "M":
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            errors.append(f"traceEvents[{i}]: non-numeric ts {ts!r}")
+            continue
+        args = ev.get("args") or {}
+        if ev["ph"] == "X":
+            if not (isinstance(ev.get("dur"), (int, float)) and ev["dur"] >= 0):
+                errors.append(f"traceEvents[{i}] ({ev['name']}): bad dur "
+                              f"{ev.get('dur')!r}")
+            track = (ev["pid"], ev["tid"])
+            if ts < last_ts.get(track, float("-inf")):
+                errors.append(f"traceEvents[{i}] ({ev['name']}): ts {ts} goes "
+                              f"BACKWARDS on track {track} (monotonic per-track "
+                              "order violated)")
+            last_ts[track] = ts
+            if "trace_id" not in args or "span_id" not in args:
+                errors.append(f"traceEvents[{i}] ({ev['name']}): span without "
+                              "trace_id/span_id args")
+                continue
+            span_ids.setdefault(args["trace_id"], set()).add(args["span_id"])
+            if args.get("parent_id") is not None:
+                parents.append((args["trace_id"], args["parent_id"], ev["name"]))
+            if ev["name"] == "request" and \
+                    args.get("state") not in _TERMINAL_STATES:
+                errors.append(f"traceEvents[{i}]: request span closed in "
+                              f"non-terminal state {args.get('state')!r}")
+    for trace_id, parent_id, name in parents:
+        if parent_id not in span_ids.get(trace_id, ()):
+            errors.append(f"span {name!r} (trace {trace_id}): parent "
+                          f"{parent_id} does not exist in its trace")
+    if errors:
+        return "; ".join(errors[:8]) + \
+            (f"; ... {len(errors) - 8} more" if len(errors) > 8 else "")
+    return None
+
+
 SCHEMAS = {
     # per-round driver transcripts
     "BENCH_r*.json": {"n": INT, "cmd": STR, "rc": INT, "tail": STR, "?parsed": DICT},
+    # telemetry trace artifacts (scripts/bench_*.py --trace)
+    "BENCH_ROUTER_TRACE.json": _validate_trace,
+    "BENCH_SERVING_TRACE.json": _validate_trace,
     # single-metric bench artifacts (bench.py-style envelope)
     "BENCH_SCALE.json": {"metric": STR, "value": NUM, "unit": STR,
                          "?vs_baseline": NUM, "extra": DICT},

@@ -78,3 +78,33 @@ def test_number_formatting():
     assert number_to_string(1.5e12).startswith("1.50 T")
     assert flops_to_string(2.0e9) == "2.00 GFLOPS"
     assert params_to_string(125e6) == "125.00 M"
+
+
+def test_attach_metrics_publishes_gauges_on_collect():
+    """Satellite (telemetry PR): an enabled profiler bridges its per-step
+    flops/params numbers into a MetricsRegistry as profiler/* gauges every
+    time stop_profile collects."""
+    from deepspeed_tpu.telemetry import MetricsRegistry
+
+    model = TinyMLP()
+    x = jnp.ones((4, 16), jnp.float32)
+    reg = MetricsRegistry()
+    prof = FlopsProfiler(model=model).attach_metrics(reg)
+    prof.start_profile(example_batch=x)
+    prof.stop_profile()
+    prof.end_profile()
+    snap = reg.snapshot()
+    expected_flops = 2 * 4 * (16 * 64 + 64 * 32)
+    assert within_range(snap["profiler/flops_per_step"], expected_flops, tolerance=0.25)
+    assert snap["profiler/macs_per_step"] == prof.get_total_macs()
+    assert snap["profiler/bytes_per_step"] == prof.get_total_bytes()
+    assert snap["profiler/step_duration_s"] > 0
+    # gauges are last-write-wins: a second profile overwrites, not appends
+    prof.start_profile(example_batch=x)
+    prof.stop_profile()
+    assert reg.snapshot()["profiler/flops_per_step"] == snap["profiler/flops_per_step"]
+    # without a registry attached nothing references telemetry at all
+    bare = FlopsProfiler(model=model)
+    bare.start_profile(example_batch=x)
+    bare.stop_profile()
+    assert bare.metrics_registry is None

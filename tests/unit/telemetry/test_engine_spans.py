@@ -1,0 +1,116 @@
+"""Training-engine tracing: every train_batch emits one engine/step trace
+with fwd_bwd/optim children; the host-streamed optimizer's per-group
+upload/compute/download pipeline events are lifted into REAL child spans
+(probe steps pair issue/done for all three phases; pipelined steps pair
+compute and leave async transfer tails as span events); and the enabled
+flops profiler publishes its gauges into the metrics registry."""
+
+import numpy as np
+import jax
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.telemetry import MetricsRegistry, Tracer
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                  max_position_embeddings=64, rope_theta=1e4)
+
+
+def _engine(offload=True, flops_profiler=False):
+    from deepspeed_tpu.comm.mesh import MeshSpec, create_mesh
+    zero = {"stage": 2}
+    if offload:
+        zero["offload_optimizer"] = {"device": "cpu", "pipeline_read": True,
+                                     "buffer_count": 3}
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": zero,
+        "bf16": {"enabled": True},
+    }
+    if flops_profiler:
+        cfg["flops_profiler"] = {"enabled": True, "profile_step": 0,
+                                 "detailed": False}
+    mesh = create_mesh(MeshSpec(data=1), devices=jax.devices()[:1])
+    engine, _, _, _ = ds.initialize(model=LlamaForCausalLM(CFG), config=cfg,
+                                    mesh=mesh, dist_init_required=False)
+    return engine
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 128, (8, 16)).astype(np.int32)
+    return {"input_ids": ids, "labels": ids}
+
+
+def _by_name(tracer):
+    out = {}
+    for s in tracer.spans:
+        out.setdefault(s.name, []).append(s)
+    return out
+
+
+def test_streamed_engine_steps_emit_phase_spans_and_lift_pipeline():
+    engine = _engine(offload=True)
+    engine.train_batch(batch=_batch())  # materialize the streamed tier
+    tracer = Tracer()
+    engine.set_telemetry(tracer=tracer, metrics=MetricsRegistry())
+    # one pipelined (flush) step + one serialized probe step
+    rep = engine.measure_stream_overlap(_batch(), pipelined_steps=1)
+    assert rep is not None and rep["n_groups"] >= 1
+    spans = _by_name(tracer)
+    assert len(spans["engine/step"]) == 2
+    assert len(spans["engine/fwd_bwd"]) == 2 and len(spans["engine/optim"]) == 2
+    for step in spans["engine/step"]:
+        children = [s for s in tracer.spans if s.parent_id == step.span_id]
+        names = {s.name for s in children}
+        assert {"engine/fwd_bwd", "engine/optim"} <= names
+        assert step.attrs["global_step"] >= 0
+        # phases nest inside the step span's extent
+        for c in children:
+            assert step.start_ts - 1e-9 <= c.start_ts
+            assert c.end_ts <= step.end_ts + 1e-9
+    # the PROBE step fences every phase: upload/compute/download all lift
+    # into real spans, one per group, parented to that step's optim span
+    n_groups = rep["n_groups"]
+    for phase in ("upload", "compute", "download"):
+        phase_spans = [s for s in tracer.spans
+                       if s.name.startswith(f"{phase} g")]
+        assert len(phase_spans) >= n_groups, \
+            f"probe must lift {phase} spans for all {n_groups} groups"
+        for s in phase_spans:
+            assert s.track == "stream" and s.duration >= 0
+            assert s.attrs["phase"] == phase
+            parent = next(p for p in tracer.spans if p.span_id == s.parent_id)
+            assert parent.name == "engine/optim"
+            assert parent.trace_id == s.trace_id
+    # the pipelined step leaves async tails in flight — they surface as
+    # in_flight span events on its optim span, never as invented durations
+    optim_events = [n for sp in spans["engine/optim"]
+                    for n, _, _ in sp.events]
+    assert any("download_issue" in n or "upload_issue" in n
+               for n in optim_events), optim_events
+
+
+def test_plain_engine_step_traces_fused_program_and_flops_gauges():
+    engine = _engine(offload=False, flops_profiler=True)
+    tracer, metrics = Tracer(), MetricsRegistry()
+    engine.set_telemetry(tracer=tracer, metrics=metrics)
+    engine.train_batch(batch=_batch())
+    spans = _by_name(tracer)
+    assert len(spans["engine/step"]) == 1
+    fused = spans["engine/fused_step"][0]
+    assert fused.parent_id == spans["engine/step"][0].span_id
+    # profiler ran at profile_step=0 and published into the registry
+    snap = metrics.snapshot()
+    assert snap["profiler/flops_per_step"] > 0
+    assert snap["profiler/params"] > 0
+    assert snap["profiler/step_duration_s"] > 0
+    # disabled telemetry: the next step must not trace, and the profiler
+    # must be DETACHED from the dropped registry (not keep publishing)
+    engine.set_telemetry()
+    assert engine.flops_profiler.metrics_registry is None
+    engine.train_batch(batch=_batch(1))
+    assert len(spans["engine/step"]) == len(_by_name(tracer).get("engine/step", []))

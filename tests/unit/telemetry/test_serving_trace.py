@@ -1,0 +1,235 @@
+"""Serving-layer tracing on the tiny CPU model: one trace per request,
+phase spans tiling [arrival, terminal] against the TTFT/TPOT accounting,
+preemption span events, the dropped-events surfacing satellite, the
+disabled-path zero-allocation contract, and the clock backwards-time
+guards."""
+
+import tracemalloc
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, build_engine
+from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.models.llama_cache import PagedKVConfig
+from deepspeed_tpu.serving import (ReplicaClockView, ServingConfig, ServingEngine,
+                                   VirtualClock)
+from deepspeed_tpu.telemetry import MetricsRegistry, Tracer
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128,
+                  rope_theta=1e4, dtype=jnp.float32, scan_layers=True, remat=False)
+
+
+@pytest.fixture(scope="module")
+def trained_params():
+    model = LlamaForCausalLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def _engine(trained_params, num_pages=64, max_seqs=8, **overrides):
+    kv = PagedKVConfig(num_pages=num_pages, page_size=8, max_pages_per_seq=8)
+    sched = SchedulerConfig(token_budget=64, max_seqs=max_seqs, prefill_chunk=8,
+                            decode_bucket=4)
+    return build_engine(CFG, trained_params, RaggedInferenceEngineConfig(
+        kv=kv, scheduler=sched, kv_dtype=jnp.float32,
+        decode_steps_per_dispatch=1, **overrides))
+
+
+def _serve(trained_params, tracer=None, metrics=None, monitor=None, **eng_kw):
+    return ServingEngine(_engine(trained_params, **eng_kw), clock=VirtualClock(),
+                         config=ServingConfig(), tracer=tracer, metrics=metrics,
+                         monitor=monitor)
+
+
+def _roots(tracer):
+    return [s for s in tracer.spans if s.name == "request"]
+
+
+def _phases(tracer, trace_id):
+    return [s for s in tracer.spans
+            if s.trace_id == trace_id and s.name.startswith("phase/")]
+
+
+# ----------------------------------------------------------------- traces
+
+
+def test_request_trace_phases_tile_and_match_accounting(trained_params):
+    serve = _serve(trained_params, tracer := Tracer(), metrics := MetricsRegistry())
+    tracer.clock = serve.clock  # share the serving clock
+    reqs = [serve.submit([5, 9, 2, 7, 1], max_new_tokens=6),
+            serve.submit([3, 3, 8], max_new_tokens=6, arrival_ts=0.0)]
+    serve.drain()
+    roots = _roots(tracer)
+    assert len(roots) == 2
+    trace_ids = {r.trace_id for r in roots}
+    assert len(trace_ids) == 2, "one trace per request"
+    for root, req in zip(sorted(roots, key=lambda s: s.attrs["uid"]), reqs):
+        assert root.attrs["state"] == "done"
+        assert root.attrs["n_tokens"] == len(req.tokens) == 6
+        assert root.attrs["ttft"] == req.ttft and root.attrs["tpot"] == req.tpot
+        phases = _phases(tracer, root.trace_id)
+        assert all(p.parent_id == root.span_id for p in phases)
+        span_sum = sum(p.duration for p in phases)
+        accounted = req.ttft + req.tpot * (len(req.tokens) - 1)
+        assert abs(span_sum - accounted) < 1e-6, (span_sum, accounted)
+        assert abs(span_sum - root.duration) < 1e-6
+        names = [p.name for p in sorted(phases, key=lambda s: s.start_ts)]
+        assert names[-1] == "phase/decode"
+    # metrics recorded alongside
+    snap = metrics.snapshot()
+    assert snap["serving/submitted"] == 2 and snap["serving/done"] == 2
+    assert snap["serving/ttft_s"]["count"] == 2
+
+
+def test_preempted_request_trace_has_eviction_events_and_still_tiles(trained_params):
+    rng = np.random.default_rng(0)
+    p1 = [int(x) for x in rng.integers(1, 100, 9)]
+    p2 = [int(x) for x in rng.integers(1, 100, 9)]
+    serve = _serve(trained_params, tracer := Tracer(), num_pages=8)
+    tracer.clock = serve.clock
+    r1 = serve.submit(p1, max_new_tokens=20)
+    r2 = serve.submit(p2, max_new_tokens=20)
+    serve.drain()
+    assert serve.stats.preemptions >= 1
+    victim = next(r for r in (r1, r2) if r.preemptions)
+    root = next(s for s in _roots(tracer)
+                if s.attrs["uid"] == victim.uid)
+    assert root.attrs["preemptions"] == victim.preemptions >= 1
+    # preemption/requeue is a span event on the request's root span
+    ev_names = [n for n, _, _ in root.events]
+    assert ev_names.count("preempted") == victim.preemptions
+    # the re-queued + re-prefilled time still tiles exactly
+    phases = _phases(tracer, root.trace_id)
+    span_sum = sum(p.duration for p in phases)
+    assert abs(span_sum - root.duration) < 1e-6
+    # at least two queued and two prefill segments (initial + post-evict),
+    # in both orders of victimhood
+    names = [p.name for p in phases]
+    assert names.count("phase/prefill") >= 2 or names.count("phase/queued") >= 2
+    # trace_report reconstructs the preemption count from the phase
+    # STRUCTURE (queued-after-decode/prefill) — the eviction instant is
+    # zero-length and must not be needed as a span
+    import importlib.util
+    import os
+    from deepspeed_tpu.telemetry import to_chrome_trace
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(os.path.dirname(__file__), "..", "..", "..",
+                                     "scripts", "trace_report.py"))
+    tr_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tr_mod)
+    report = tr_mod.fold(to_chrome_trace(tracer.spans), tol=1e-6)
+    assert report["verification"]["mismatches"] == 0
+    assert report["preemptions"] == serve.stats.preemptions >= 1
+    assert report["retry_queue_s"] > 0, \
+        "preempted requests' requeue time must be attributed as retry cost"
+
+
+def test_rejected_request_gets_terminal_trace(trained_params):
+    serve = _serve(trained_params, tracer := Tracer())
+    tracer.clock = serve.clock
+    req = serve.submit(list(range(1, 60)), max_new_tokens=10)  # infeasible: 69 > 8*8
+    assert req.state.value == "rejected"
+    root = _roots(tracer)[0]
+    assert root.attrs["state"] == "rejected"
+    assert root.attrs["reject_reason"] == req.reject_reason is not None
+    assert root.duration == 0.0
+
+
+def test_disabled_tracer_serving_loop_allocates_nothing_telemetric(trained_params):
+    import os
+    serve = _serve(trained_params)          # NULL_TRACER default
+    assert not serve.tracer.enabled
+
+    def round_trip(tag):
+        serve.submit([5, 9, 2, tag % 100 + 1], max_new_tokens=4)
+        serve.drain()
+
+    round_trip(0)  # warm compile caches
+    tracemalloc.start()
+    try:
+        before = tracemalloc.take_snapshot()
+        for i in range(3):
+            round_trip(i + 1)
+        after = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    pkg = os.path.join("deepspeed_tpu", "telemetry")
+    leaks = [d for d in after.compare_to(before, "lineno")
+             if d.size_diff > 0 and any(pkg in (f.filename or "")
+                                        for f in d.traceback)]
+    # tolerate one-off interpreter noise; a per-token cost would scale
+    # with the ~12 generated tokens x 3 round trips
+    size = sum(d.size_diff for d in leaks)
+    blocks = sum(d.count_diff for d in leaks)
+    assert size < 2048 and blocks < 8, \
+        [(d.traceback, d.size_diff, d.count_diff) for d in leaks]
+    assert serve.stats.summary(elapsed=serve.clock.now())["completed"] == 4
+
+
+# ------------------------------------------------- dropped-events satellite
+
+
+class _CappedMonitor:
+    """Stands in for MonitorMaster's max_events behaviour."""
+    enabled = True
+
+    def __init__(self, cap):
+        self.cap = cap
+        self.events_written = 0
+        self.dropped_events = 0
+
+    def write_events(self, evs):
+        room = max(0, self.cap - self.events_written)
+        self.events_written += min(room, len(evs))
+        self.dropped_events += max(0, len(evs) - room)
+
+
+def test_summary_surfaces_monitor_dropped_events(trained_params):
+    mon = _CappedMonitor(cap=3)
+    serve = _serve(trained_params, monitor=mon)
+    for i in range(3):
+        serve.submit([5, 9, 2 + i], max_new_tokens=3)
+    serve.drain()
+    s = serve.summary()
+    assert mon.dropped_events > 0, "cap must have been exceeded by this load"
+    assert s["monitor_dropped_events"] == mon.dropped_events
+    assert s["dropped_spans"] == 0
+    # no monitor at all -> explicit zero, not a crash
+    assert _serve(trained_params).summary()["monitor_dropped_events"] == 0
+
+
+# ----------------------------------------------------- clock guard satellite
+
+
+def test_virtual_clock_never_rewinds():
+    c = VirtualClock()
+    c.advance(5.0)
+    c.wait_until(2.0)          # past: clamps to now
+    assert c.now() == 5.0
+    c.wait_until(7.5)
+    assert c.now() == 7.5
+    with pytest.raises(ValueError):
+        c.advance(-1.0)
+    with pytest.raises(ValueError):
+        c.advance(float("nan"))
+    with pytest.raises(ValueError):
+        c.wait_until(float("nan"))
+    assert c.now() == 7.5, "failed guards must not move time"
+
+
+def test_replica_clock_view_guards_backwards_time():
+    shared = VirtualClock()
+    view = ReplicaClockView(shared)
+    shared.advance(3.0)
+    view.wait_until(1.0)       # past: clamps (delegates to shared)
+    assert view.now() == shared.now() == 3.0
+    with pytest.raises(ValueError):
+        view.on_step(-0.5)
+    assert view.take_cost() == 0.0, "rejected cost must not be recorded"
+    view.on_step(1.5)
+    view.on_step(1.0)          # max, not sum — and never negative
+    assert view.take_cost() == 1.5

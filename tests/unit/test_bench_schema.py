@@ -53,3 +53,41 @@ def test_checker_catches_drift(tmp_path):
     assert any("out of order" in e for e in errors_for(bad))
     assert any("no schema registered" in e
                for e in errors_for({"x": 1}, name="BENCH_MYSTERY.json"))
+
+
+def test_checker_validates_trace_artifacts(tmp_path):
+    """The telemetry trace artifact (bench_*.py --trace) is schema-checked
+    too: monotonic per-track timestamps, parents existing, serving request
+    spans closing terminal.  Uses the COMMITTED BENCH_ROUTER_TRACE.json as
+    the known-good document and breaks it one way at a time."""
+    import json
+    mod = _load_checker()
+    with open(os.path.join(REPO_ROOT, "BENCH_ROUTER_TRACE.json")) as f:
+        good = json.load(f)
+    assert mod._validate_trace(good) is None
+
+    def errors_for(doc, name="BENCH_ROUTER_TRACE.json"):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        errs = mod.validate_all(str(tmp_path))
+        p.unlink()
+        return errs
+
+    assert not errors_for(good)
+    bad = json.loads(json.dumps(good))
+    req = next(e for e in bad["traceEvents"]
+               if e.get("ph") == "X" and e["name"] == "request")
+    req["args"]["state"] = "decode"              # non-terminal serving span
+    assert any("non-terminal" in e for e in errors_for(bad))
+    bad = json.loads(json.dumps(good))
+    child = next(e for e in bad["traceEvents"]
+                 if e.get("ph") == "X" and "parent_id" in e.get("args", {}))
+    child["args"]["parent_id"] = 10 ** 9         # orphaned span
+    assert any("does not exist" in e for e in errors_for(bad))
+    bad = json.loads(json.dumps(good))
+    xs = [e for e in bad["traceEvents"] if e.get("ph") == "X"]
+    same_track = [e for e in xs if (e["pid"], e["tid"]) == (xs[-1]["pid"], xs[-1]["tid"])]
+    same_track[-1]["ts"] = same_track[0]["ts"] - 1.0   # backwards on a track
+    assert any("BACKWARDS" in e for e in errors_for(bad))
+    # a serving-side trace registers under its own filename too
+    assert not errors_for(good, name="BENCH_SERVING_TRACE.json")
