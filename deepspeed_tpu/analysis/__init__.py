@@ -1,0 +1,26 @@
+"""dslint: unified static analysis enforcing the stack's conventions as
+machine-checked contracts (r11 tentpole).
+
+The determinism / crash-transparency / registry invariants PRs 1-10 stake
+their correctness on were conventions until this package: bit-reproducible
+traces require no wall-clock reads outside the pluggable clock modules,
+chaos tests require ``InjectedCrash`` to never be absorbed by a broad
+``except``, and the fault-site / event-name taxonomies drift silently from
+their call sites.  ``analysis/`` runs every checker in ONE AST walk per
+file, emits deterministic sorted findings (human + JSON), and supports
+per-line suppressions with a mandatory written reason::
+
+    something_flagged()  # dslint-ok(<checker>): <why this is fine>
+
+Entry points: ``scripts/dslint.py`` (CLI, exit 1 on findings) and
+``tests/unit/test_dslint.py`` (tier-1: the repo stays lint-clean).
+
+NOTE this package is import-standalone on purpose: it must never import
+``deepspeed_tpu`` (jax, numpy, ...) so the lint runs in well under the 5 s
+budget.  ``scripts/dslint.py`` imports it as the top-level package
+``analysis`` by putting the ``deepspeed_tpu/`` directory itself on
+``sys.path`` — keep all internal imports relative.
+"""
+
+from .core import Finding, Runner, collect_files  # noqa: F401
+from .checkers import all_checkers, checker_names  # noqa: F401

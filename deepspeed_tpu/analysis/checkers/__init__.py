@@ -1,0 +1,26 @@
+"""Checker registry.  Add a checker: subclass ``core.Checker`` in a module
+here, then list it in ``ALL`` (docs/ANALYSIS.md walks through an example)."""
+
+from .atomic_write import AtomicWriteChecker
+from .bench_schema import BenchSchemaChecker
+from .crash_transparency import CrashTransparencyChecker
+from .determinism import DeterminismChecker
+from .event_registry import EventRegistryChecker
+from .fault_sites import FaultSiteChecker
+
+ALL = (
+    DeterminismChecker,
+    CrashTransparencyChecker,
+    FaultSiteChecker,
+    EventRegistryChecker,
+    AtomicWriteChecker,
+    BenchSchemaChecker,
+)
+
+
+def all_checkers():
+    return [cls() for cls in ALL]
+
+
+def checker_names():
+    return [cls.name for cls in ALL]

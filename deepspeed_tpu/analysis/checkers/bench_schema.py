@@ -1,0 +1,50 @@
+"""Checker ``bench-schema``: the committed ``BENCH_*.json`` artifacts
+validate against scripts/check_bench_schema.py — registered here so ONE
+``dslint`` invocation runs every contract the repo enforces (the original
+tier-1 wiring, tests/unit/test_bench_schema.py, keeps running too).
+
+This is the framework's one non-AST checker: it contributes nothing to
+the per-file walk and does all its work in ``finish`` by delegating to the
+schema script's ``validate_all`` (loaded standalone by path — stdlib-only,
+same as the rest of dslint).
+"""
+
+import importlib.util
+import os
+import re
+
+from ..core import Checker, Runner
+
+_ERR_RE = re.compile(r"^(?P<name>BENCH_[\w.]+\.json)[:\s]")
+
+
+class BenchSchemaChecker(Checker):
+    name = "bench-schema"
+    description = "committed BENCH_*.json artifacts match their schemas"
+
+    def applies(self, rel: str) -> bool:
+        return False  # finish-only: validates artifacts, not Python files
+
+    def _script_path(self, run: Runner) -> str:
+        local = os.path.join(run.root, "scripts", "check_bench_schema.py")
+        if os.path.isfile(local):
+            return local
+        # fixture trees have no scripts/: fall back to the repo this
+        # package lives in, so the checker still validates their BENCH files
+        here = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        return os.path.join(os.path.dirname(here), "scripts",
+                            "check_bench_schema.py")
+
+    def finish(self, run: Runner):
+        script = self._script_path(run)
+        if not os.path.isfile(script):
+            return
+        spec = importlib.util.spec_from_file_location("_dslint_bench_schema",
+                                                      script)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        for err in mod.validate_all(run.root):
+            m = _ERR_RE.match(err)
+            path = m.group("name") if m else "BENCH"
+            run.report(path, 1, self.name, err)

@@ -1,0 +1,116 @@
+"""Checker ``crash-transparency``: the chaos contract
+(resilience/fault_injection.py) is that :class:`InjectedCrash` — simulated
+process death — is NEVER absorbed: not by retry loops, not by
+"observability must never break the operation" shields, not by per-request
+error isolation.  A chaos test that kills a replica mid-monitor-forward
+must see the crash, or the kill silently becomes a no-op and the whole
+fault-injection suite tests nothing.
+
+Rule: inside ``resilience/``, ``serving/`` and ``checkpoint/``, every
+broad handler (bare ``except``, ``except Exception``, ``except
+BaseException``) must satisfy one of:
+
+* a PRECEDING handler in the same ``try`` is exactly
+  ``except InjectedCrash: raise`` (the guard pattern,
+  serving/fleet/pool.py); or
+* the handler itself unconditionally re-raises: its last top-level
+  statement is a bare ``raise`` AND no statement anywhere in the handler
+  can exit before reaching it (``return``/``break``/``continue``, or a
+  ``raise`` of a *different* exception — ``raise OSError(...) from e``
+  launders the crash into a retryable type, and a conditional early exit
+  would swallow it on that path); or
+* a ``# dslint-ok(crash-transparency): <why>`` suppression on the
+  ``except`` line.
+"""
+
+import ast
+
+from ..core import Checker, FileContext
+
+SCOPE_SEGMENTS = ("/resilience/", "/serving/", "/checkpoint/")
+_BROAD_NAMES = ("Exception", "BaseException")
+
+
+def _type_name(node) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if _type_name(t) in _BROAD_NAMES:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(_type_name(e) in _BROAD_NAMES for e in t.elts)
+    return False
+
+
+def _is_crash_guard(handler: ast.ExceptHandler) -> bool:
+    """``except InjectedCrash: raise`` — nothing more, nothing less."""
+    if _type_name(handler.type) != "InjectedCrash":
+        return False
+    return (len(handler.body) == 1
+            and isinstance(handler.body[0], ast.Raise)
+            and handler.body[0].exc is None)
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    last = handler.body[-1]
+    if not (isinstance(last, ast.Raise) and last.exc is None):
+        return False
+    # the trailing bare raise must be unavoidable: a return/break/continue
+    # nested in the handler (e.g. `if is_transient(e): return None`) or a
+    # raise of a DIFFERENT exception (`raise Retryable() from e` — the
+    # laundering the module docstring rejects) opens a path that absorbs
+    # InjectedCrash, so the handler doesn't count as a re-raise
+    # (nested def/lambda bodies are separate scopes and don't exit this one)
+    return not any(_has_early_exit(stmt) for stmt in handler.body[:-1])
+
+
+def _has_early_exit(node: ast.AST, in_loop: bool = False) -> bool:
+    if isinstance(node, ast.Return):
+        return True
+    if isinstance(node, ast.Raise):
+        return node.exc is not None  # raising a different exception launders
+    if isinstance(node, (ast.Break, ast.Continue)):
+        return not in_loop  # inside a handler-local loop they stay put
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return False  # separate scope — its exits can't leave the handler
+    if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+        return any(_has_early_exit(c, in_loop=True)
+                   for c in ast.iter_child_nodes(node))
+    return any(_has_early_exit(c, in_loop) for c in ast.iter_child_nodes(node))
+
+
+class CrashTransparencyChecker(Checker):
+    name = "crash-transparency"
+    description = ("broad except in resilience/serving/checkpoint must "
+                   "re-raise InjectedCrash first")
+
+    def applies(self, rel: str) -> bool:
+        r = "/" + rel
+        return any(seg in r for seg in SCOPE_SEGMENTS)
+
+    def visit(self, node, ctx: FileContext):
+        if not isinstance(node, ast.Try):
+            return
+        guarded = False
+        for handler in node.handlers:
+            if _is_crash_guard(handler):
+                guarded = True
+                continue
+            if not _is_broad(handler):
+                continue
+            if guarded or _reraises(handler):
+                continue
+            caught = "bare except" if handler.type is None else \
+                f"except {ast.unparse(handler.type)}"
+            ctx.report(self.name, handler.lineno,
+                       f"{caught} absorbs InjectedCrash — add "
+                       "'except InjectedCrash: raise' before it (guard "
+                       "pattern, serving/fleet/pool.py) or re-raise")

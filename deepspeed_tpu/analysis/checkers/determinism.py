@@ -1,0 +1,108 @@
+"""Checker ``determinism``: the bit-reproducibility arc (VirtualClock
+traces, FleetSimulator byte-identical benches, seeded chaos) only holds if
+nondeterminism can't leak in through the three classic side doors:
+
+1. **Wall-clock reads** (``time.time``/``monotonic``/``perf_counter``,
+   ``datetime.now``) anywhere outside the allowlisted pluggable-clock
+   modules.  Everything else must take a clock object (serving/clock.py)
+   or a tracer (telemetry/trace.py) so tests can pin time.
+2. **Filesystem enumeration order** — ``os.listdir``/``glob.glob``
+   results are OS/filesystem-order unless sorted; feeding them into
+   selection or iteration makes behaviour differ across machines (the
+   r11 live hit: checkpoint tag scanning for newest-valid-tag fallback).
+   Order-independent sinks (``sorted``/``set``/``len``/membership) pass.
+3. **Global-RNG randomness** — legacy ``random.*`` / ``np.random.*``
+   module-level functions share hidden interpreter-global state; any
+   import order change reshuffles every downstream draw.  Seeded
+   instances (``random.Random(seed)``, ``np.random.default_rng(seed)``,
+   ``jax.random``) pass.
+"""
+
+import ast
+
+from ..core import Checker, FileContext
+
+#: modules allowed to read the wall clock: the pluggable-clock primitives
+#: everything else is supposed to depend on
+CLOCK_MODULE_SUFFIXES = (
+    "deepspeed_tpu/serving/clock.py",
+    "deepspeed_tpu/telemetry/trace.py",
+    "deepspeed_tpu/utils/timer.py",
+)
+
+WALL_CLOCK = frozenset({
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+})
+
+FS_ENUM = frozenset({"os.listdir", "os.scandir", "glob.glob", "glob.iglob"})
+
+#: legacy module-level functions drawing from the hidden global RNG
+GLOBAL_RANDOM = frozenset({
+    "random.random", "random.randint", "random.randrange", "random.choice",
+    "random.choices", "random.shuffle", "random.sample", "random.uniform",
+    "random.gauss", "random.normalvariate", "random.seed",
+    "numpy.random.rand", "numpy.random.randn", "numpy.random.randint",
+    "numpy.random.random", "numpy.random.random_sample", "numpy.random.ranf",
+    "numpy.random.sample", "numpy.random.choice", "numpy.random.shuffle",
+    "numpy.random.permutation", "numpy.random.uniform", "numpy.random.normal",
+    "numpy.random.standard_normal", "numpy.random.seed",
+})
+
+#: wrappers that make enumeration order irrelevant
+_ORDER_INDEPENDENT_CALLS = frozenset({"sorted", "set", "frozenset", "len"})
+
+
+class DeterminismChecker(Checker):
+    name = "determinism"
+    description = ("wall-clock reads outside clock modules, unsorted "
+                   "filesystem enumeration, global-RNG randomness")
+
+    def applies(self, rel: str) -> bool:
+        # tests may freely read clocks and draw randomness; the contract
+        # binds production code (and the committed bench scripts)
+        return "tests/" not in rel and not rel.startswith("tests")
+
+    def visit(self, node, ctx: FileContext):
+        if not isinstance(node, ast.Call):
+            return
+        target = ctx.resolve_call(node.func)
+        if not target:
+            return
+        if target in WALL_CLOCK:
+            if not any(ctx.rel.endswith(s) for s in CLOCK_MODULE_SUFFIXES):
+                ctx.report(self.name, node.lineno,
+                           f"wall-clock read {target}() outside the clock "
+                           "modules — take a pluggable clock "
+                           "(serving/clock.py) so tests can pin time")
+        elif target in FS_ENUM:
+            if not self._order_independent(node, ctx):
+                ctx.report(self.name, node.lineno,
+                           f"{target}() order is filesystem-dependent — wrap "
+                           "in sorted(...) before selecting or iterating")
+        elif target in GLOBAL_RANDOM:
+            ctx.report(self.name, node.lineno,
+                       f"{target}() draws from the hidden global RNG — use a "
+                       "seeded instance (random.Random(seed) / "
+                       "np.random.default_rng(seed))")
+
+    def _order_independent(self, node: ast.Call, ctx: FileContext) -> bool:
+        """Is the enumeration's immediate sink order-independent?"""
+        p = ctx.parent(node)
+        if isinstance(p, ast.Call) and isinstance(p.func, ast.Name) \
+                and p.func.id in _ORDER_INDEPENDENT_CALLS:
+            return True
+        if isinstance(p, ast.Compare):
+            # only membership (`x in os.listdir(d)`) ignores order; `==`/
+            # `<` on the listing itself compares in enumeration order
+            for op, comparator in zip(p.ops, p.comparators):
+                if comparator is node:
+                    return isinstance(op, (ast.In, ast.NotIn))
+            return False  # node is p.left: order-sensitive
+        if isinstance(p, ast.comprehension) and p.iter is node:
+            comp = ctx.parent(p)
+            # set/dict comprehensions erase order; list/genexp keep it
+            return isinstance(comp, (ast.SetComp, ast.DictComp))
+        return False

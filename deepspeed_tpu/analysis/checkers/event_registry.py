@@ -1,0 +1,161 @@
+"""Checker ``event-registry``: every monitor/telemetry event-name literal
+in the package must be registered in ``telemetry/event_registry.py``, every
+registered name must still have an emitter, and the generated event table
+in docs/OBSERVABILITY.md must match :func:`render_event_table` — three
+directions of drift, all fatal in tier-1.
+
+Mechanics: any string constant matching ``<prefix>/<segment>[...]`` for
+the known prefixes (resilience, serving, fleet, telemetry, monitor,
+profiler) is an event-name use — except statement-position strings
+(docstrings) and the registry file itself.  f-string names
+(``f"fleet/health/{state.value}"``) are validated by their literal head
+against the registry's DYNAMIC prefix families.
+"""
+
+import ast
+import importlib.util
+import os
+import re
+from typing import Dict, List, Tuple
+
+from ..core import Checker, FileContext, Runner, collect_files
+
+EVENT_RE = re.compile(
+    r"^(resilience|serving|fleet|telemetry|monitor|profiler)/"
+    r"[a-z0-9_]+(/[a-z0-9_]+)*$")
+_PREFIXES = ("resilience/", "serving/", "fleet/", "telemetry/",
+             "monitor/", "profiler/")
+REGISTRY_REL = "telemetry/event_registry.py"
+
+
+def _load_registry(path: str):
+    spec = importlib.util.spec_from_file_location("_dslint_event_registry", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class EventRegistryChecker(Checker):
+    name = "event-registry"
+    description = ("event-name literals registered in "
+                   "telemetry/event_registry.py; registered names emitted; "
+                   "OBSERVABILITY.md table in sync")
+
+    def __init__(self):
+        self.literals: List[Tuple[str, int, str]] = []   # (rel, line, name)
+        self.dynamic_heads: List[Tuple[str, int, str]] = []
+
+    def applies(self, rel: str) -> bool:
+        if rel.endswith(REGISTRY_REL):
+            return False  # the registry's own entries are not emitter uses
+        return True
+
+    def visit(self, node, ctx: FileContext):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if EVENT_RE.match(node.value) \
+                    and not isinstance(ctx.parent(node), ast.Expr):
+                self.literals.append((ctx.rel, node.lineno, node.value))
+        elif isinstance(node, ast.JoinedStr):
+            head = ""
+            if node.values and isinstance(node.values[0], ast.Constant) \
+                    and isinstance(node.values[0].value, str):
+                head = node.values[0].value
+            if not head.startswith(_PREFIXES):
+                return
+            if all(isinstance(v, ast.Constant) for v in node.values):
+                # an f-string with no placeholders is just a literal
+                full = "".join(v.value for v in node.values)
+                if EVENT_RE.match(full):
+                    self.literals.append((ctx.rel, node.lineno, full))
+                return
+            self.dynamic_heads.append((ctx.rel, node.lineno, head))
+
+    def finish(self, run: Runner):
+        self.registry_path = os.path.join(run.root, "deepspeed_tpu",
+                                          REGISTRY_REL)
+        if not os.path.isfile(self.registry_path):
+            return  # no registry in this tree: nothing to validate against
+        reg = _load_registry(self.registry_path)
+        names = frozenset(getattr(reg, "EVENTS", {}))
+        prefixes = tuple(d["prefix"] for d in getattr(reg, "DYNAMIC", []))
+        used = set()
+        for rel, line, name in self.literals:
+            # literals are validated STRICTLY against EVENTS: the DYNAMIC
+            # prefix families only legitimize f-strings, otherwise one
+            # broad prefix would waive its whole namespace
+            if name in names:
+                used.add(name)
+            else:
+                run.report(rel, line, self.name,
+                           f"event name '{name}' is not registered in "
+                           f"{REGISTRY_REL} — add it (and regenerate the "
+                           "OBSERVABILITY.md table)")
+        for rel, line, head in self.dynamic_heads:
+            if not any(head.startswith(p) or p.startswith(head)
+                       for p in prefixes):
+                run.report(rel, line, self.name,
+                           f"dynamic event name f\"{head}...\" matches no "
+                           f"DYNAMIC prefix family in {REGISTRY_REL}")
+        if self._scanned_full_scope(run):
+            self._check_unemitted(run, reg, names, used)
+        self._check_doc_sync(run, reg)
+
+    def _scanned_full_scope(self, run: Runner) -> bool:
+        """'No emitter' is only decidable when every potential emitter was
+        scanned — on a partial invocation (`dslint.py path/to/file.py`)
+        absent emitters are an artifact of scope, not dead registry
+        entries, so that direction is skipped."""
+        pkg = os.path.join(run.root, "deepspeed_tpu")
+        if not os.path.isdir(pkg):
+            return True  # fixture trees: whatever was given IS the scope
+        expected = collect_files([pkg], run.root)
+        scanned = set(run.contexts)
+        return all(
+            os.path.relpath(f, run.root).replace(os.sep, "/") in scanned
+            for f in expected
+            # the registry itself is applies()-excluded, never scanned
+            if not f.endswith(REGISTRY_REL))
+
+    def _check_unemitted(self, run: Runner, reg, names, used):
+        reg_rel = "deepspeed_tpu/" + REGISTRY_REL
+        src_lines = []
+        try:
+            with open(self.registry_path, encoding="utf-8") as f:
+                src_lines = f.read().splitlines()
+        except OSError:
+            pass
+
+        def line_of(name: str) -> int:
+            quoted = f'"{name}"'
+            for i, l in enumerate(src_lines, start=1):
+                if quoted in l:
+                    return i
+            return 1
+
+        for name in sorted(names - used):
+            run.report(reg_rel, line_of(name), self.name,
+                       f"registered event '{name}' has no emitter in the "
+                       "scanned tree — dead registry entry (or the emitter "
+                       "moved out of scan scope)")
+
+    def _check_doc_sync(self, run: Runner, reg):
+        render = getattr(reg, "render_event_table", None)
+        extract = getattr(reg, "extract_doc_block", None)
+        if render is None or extract is None:
+            return  # miniature fixture registries skip the doc contract
+        doc_path = os.path.join(run.root, "docs", "OBSERVABILITY.md")
+        if not os.path.isfile(doc_path):
+            return
+        with open(doc_path, encoding="utf-8") as f:
+            text = f.read()
+        block = extract(text)
+        doc_rel = "docs/OBSERVABILITY.md"
+        if block is None:
+            run.report(doc_rel, 1, self.name,
+                       "event-table markers missing — the event table must "
+                       f"be generated from {REGISTRY_REL}")
+        elif block != render():
+            run.report(doc_rel, 1, self.name,
+                       "committed event table differs from "
+                       f"render_event_table() — run `python deepspeed_tpu/"
+                       "telemetry/event_registry.py --sync docs/OBSERVABILITY.md`")

@@ -1,0 +1,103 @@
+"""Checker ``fault-sites``: the injection-site taxonomy in
+``resilience/fault_injection.INJECTION_SITES`` and the probes scattered
+through the stack (``fi.check("<site>")``, ``writer_fault(site)``,
+``retry_call(..., site=...)``) must agree in BOTH directions:
+
+* a probe naming an unregistered site would raise ``ValueError`` the
+  first time injection is armed — in the chaos drill, not in CI;
+* a registered site with no production probe is a dead entry: a chaos
+  plan arming it silently never fires, and docs/RESILIENCE.md lies.
+
+The registry is read from the AST of whichever scanned file assigns
+``INJECTION_SITES`` (no import of the package), so the checker also works
+over test fixture trees carrying a miniature fault_injection.py.
+"""
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from ..core import Checker, FileContext, Runner
+
+_SITE_RE = re.compile(r"^[a-z0-9_]+\.[a-z0-9_]+$")
+_PROBE_FUNCS = ("check", "writer_fault")
+
+
+class FaultSiteChecker(Checker):
+    name = "fault-sites"
+    description = ("inject-site literals registered in INJECTION_SITES; "
+                   "every registered site probed in production")
+
+    def __init__(self):
+        #: site -> (rel, line) of its registry entry
+        self.registry: Dict[str, Tuple[str, int]] = {}
+        self.registry_file: str = ""
+        #: (rel, line, site) for every probe literal outside the registry file
+        self.uses: List[Tuple[str, int, str]] = []
+
+    def visit(self, node, ctx: FileContext):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "INJECTION_SITES":
+                    self.registry_file = ctx.rel
+                    for const in ast.walk(node.value):
+                        if isinstance(const, ast.Constant) \
+                                and isinstance(const.value, str):
+                            self.registry[const.value] = (ctx.rel, const.lineno)
+            return
+        if isinstance(node, ast.Call):
+            self._collect_call(node, ctx)
+        elif isinstance(node, ast.FunctionDef) or isinstance(node, ast.AsyncFunctionDef):
+            self._collect_defaults(node, ctx)
+
+    def _collect_call(self, node: ast.Call, ctx: FileContext):
+        func = node.func
+        fname = func.attr if isinstance(func, ast.Attribute) else \
+            (func.id if isinstance(func, ast.Name) else "")
+        if fname in _PROBE_FUNCS and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                    and _SITE_RE.match(arg.value):
+                self._use(ctx, arg.lineno, arg.value)
+        for kw in node.keywords:
+            if kw.arg == "site" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str) and kw.value.value:
+                self._use(ctx, kw.value.lineno, kw.value.value)
+
+    def _collect_defaults(self, node, ctx: FileContext):
+        # positional defaults right-align onto posonly + regular args
+        # combined (ast.arguments.defaults spans both lists)
+        allargs = node.args.posonlyargs + node.args.args
+        pos_args = allargs[len(allargs) - len(node.args.defaults):]
+        for a, d in list(zip(pos_args, node.args.defaults)) + \
+                list(zip(node.args.kwonlyargs, node.args.kw_defaults)):
+            if a.arg == "site" and isinstance(d, ast.Constant) \
+                    and isinstance(d.value, str) and d.value:
+                self._use(ctx, d.lineno, d.value)
+
+    def _use(self, ctx: FileContext, line: int, site: str):
+        # the registry file's own mentions (docstrings aside, its probes
+        # reject rather than poll) are not production call sites
+        if ctx.rel.endswith("fault_injection.py"):
+            return
+        self.uses.append((ctx.rel, line, site))
+
+    def finish(self, run: Runner):
+        if not self.registry:
+            return  # no registry in the scan set: nothing to reconcile
+        probed = set()
+        for rel, line, site in self.uses:
+            if site not in self.registry:
+                run.report(rel, line, self.name,
+                           f"injection site '{site}' is not in "
+                           "INJECTION_SITES — arming it raises ValueError; "
+                           "register it in resilience/fault_injection.py")
+            else:
+                probed.add(site)
+        for site in sorted(self.registry):
+            if site not in probed:
+                rel, line = self.registry[site]
+                run.report(rel, line, self.name,
+                           f"registered injection site '{site}' has no "
+                           "production probe — a chaos plan arming it "
+                           "never fires")

@@ -1,0 +1,284 @@
+"""Framework: one AST walk per file, pluggable checkers, deterministic
+findings, suppression markers with mandatory reasons.
+
+A checker subclasses :class:`Checker` and gets three hooks:
+
+* ``visit(node, ctx)``   — called for every AST node of every file it
+  ``applies()`` to, during the file's single walk;
+* ``end_file(ctx)``      — after a file's walk;
+* ``finish(run)``        — once, after all files (cross-file contracts:
+  registry reconciliation, doc sync, non-AST artifacts).
+
+Findings are reported through ``ctx.report`` / ``run.report`` so the
+suppression check (``# dslint-ok(<checker>): <reason>``) is applied in one
+place.  A marker without a reason, or naming an unknown checker, is itself
+a finding (checker ``suppression``) — a suppression is a written-down
+decision, not an off switch.
+"""
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# the reason is lazy and stops before the next marker so several markers
+# sharing a line each keep their own reason
+SUPPRESS_RE = re.compile(
+    r"#\s*dslint-ok\(\s*(?P<name>[A-Za-z0-9_-]+)\s*\)\s*"
+    r"(?::\s*(?P<reason>.*?))?\s*(?=#\s*dslint-ok\(|$)")
+
+#: directories never descended into when expanding path arguments
+SKIP_DIRS = frozenset({"__pycache__", ".git", ".claude", "node_modules",
+                       "tests", "examples"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str      # root-relative, '/'-separated
+    line: int
+    checker: str
+    message: str
+
+    @property
+    def sort_key(self):
+        return (self.path, self.line, self.checker, self.message)
+
+    def human(self) -> str:
+        return f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line,
+                "checker": self.checker, "message": self.message}
+
+
+class Checker:
+    """Base class.  ``name`` is the suppression key; keep it kebab-case."""
+
+    name: str = ""
+    description: str = ""
+
+    def applies(self, rel: str) -> bool:
+        return True
+
+    def visit(self, node: ast.AST, ctx: "FileContext") -> None:
+        pass
+
+    def end_file(self, ctx: "FileContext") -> None:
+        pass
+
+    def finish(self, run: "Runner") -> None:
+        pass
+
+
+class FileContext:
+    """Per-file state shared by all checkers: source, AST, a parent map,
+    an import-alias map, and the suppression table."""
+
+    def __init__(self, run: "Runner", path: str, rel: str):
+        self.run = run
+        self.path = path
+        self.rel = rel
+        with open(path, "r", encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        #: local name -> dotted origin ("time", "numpy", "time.perf_counter")
+        self.imports: Dict[str, str] = {}
+        #: line -> {checker names suppressed on that line}
+        self.suppressions: Dict[int, Set[str]] = {}
+        self._scan_suppressions()
+
+    # ------------------------------------------------------------- parsing
+
+    def parse(self) -> bool:
+        try:
+            self.tree = ast.parse(self.source, filename=self.path)
+        except SyntaxError as e:
+            self.run._add(Finding(self.rel, e.lineno or 1, "parse",
+                                  f"unparseable: {e.msg}"))
+            return False
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        self._collect_imports()
+        return True
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def _collect_imports(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mod = node.module.lstrip(".")  # normalize relative imports
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.imports[a.asname or a.name] = f"{mod}.{a.name}"
+
+    def resolve_call(self, func: ast.AST) -> str:
+        """Dotted origin of a call target, following import aliases:
+        ``_time.time()`` -> ``time.time``; ``pc()`` after ``from time
+        import perf_counter as pc`` -> ``time.perf_counter``."""
+        parts: List[str] = []
+        node = func
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return ""
+        base = self.imports.get(node.id, node.id)
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+    # -------------------------------------------------------- suppressions
+
+    def _scan_suppressions(self):
+        if "dslint-ok" not in self.source:
+            return  # skip tokenizing the vast majority of files
+        # markers live in COMMENT tokens only — a docstring describing the
+        # syntax must neither suppress anything nor read as malformed
+        for i, line in self._comment_lines():
+            if "dslint-ok" not in line:
+                continue
+            matched = False
+            for m in SUPPRESS_RE.finditer(line):
+                matched = True
+                name, reason = m.group("name"), m.group("reason")
+                if not reason:
+                    self.run._add(Finding(
+                        self.rel, i, "suppression",
+                        f"dslint-ok({name}) without a reason — a suppression "
+                        f"must record WHY: '# dslint-ok({name}): <why>'"))
+                    continue
+                if name not in self.run.checker_names:
+                    self.run._add(Finding(
+                        self.rel, i, "suppression",
+                        f"dslint-ok({name}) names an unknown checker "
+                        f"(known: {', '.join(sorted(self.run.checker_names))})"))
+                    continue
+                self.suppressions.setdefault(i, set()).add(name)
+            if not matched:
+                self.run._add(Finding(
+                    self.rel, i, "suppression",
+                    "malformed dslint-ok marker — expected "
+                    "'# dslint-ok(<checker>): <reason>'"))
+
+    def _comment_lines(self):
+        """(lineno, comment_text) pairs; tolerant of tokenize errors (the
+        parse checker reports real syntax problems separately)."""
+        out = []
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(self.source).readline):
+                if tok.type == tokenize.COMMENT:
+                    out.append((tok.start[0], tok.string))
+        except (tokenize.TokenizeError, SyntaxError, IndentationError):
+            pass
+        return out
+
+    def suppressed(self, line: int, checker: str) -> bool:
+        return checker in self.suppressions.get(line, ())
+
+    def report(self, checker: str, line: int, message: str) -> None:
+        if self.suppressed(line, checker):
+            self.run.suppressed_count += 1
+            return
+        self.run._add(Finding(self.rel, line, checker, message))
+
+
+class Runner:
+    """Collects files, runs every checker in one walk per file, then the
+    cross-file ``finish`` phase.  Findings come out sorted — two identical
+    runs produce byte-identical output (asserted in tier-1)."""
+
+    def __init__(self, root: str, checkers: Sequence[Checker],
+                 known_checker_names: Optional[Iterable[str]] = None):
+        self.root = os.path.abspath(root)
+        self.checkers = list(checkers)
+        # suppression markers validate against the FULL registry, not just
+        # the checkers selected for this run — a file annotated for checker
+        # X must not read as "unknown checker" when only Y runs (the
+        # atomic-write shim scans files carrying determinism markers)
+        self.checker_names = set(known_checker_names or ()) \
+            | {c.name for c in self.checkers} | {"suppression", "parse"}
+        self.findings: List[Finding] = []
+        self.files: List[str] = []          # rel paths scanned
+        self.contexts: Dict[str, FileContext] = {}
+        self.suppressed_count = 0
+
+    def _add(self, finding: Finding) -> None:
+        self.findings.append(finding)
+
+    def report(self, path: str, line: int, checker: str, message: str) -> None:
+        """finish()-phase reporting; honors suppressions when the file was
+        one of the scanned ones."""
+        ctx = self.contexts.get(path)
+        if ctx is not None:
+            ctx.report(checker, line, message)
+        else:
+            self._add(Finding(path, line, checker, message))
+
+    def run(self, paths: Sequence[str]) -> List[Finding]:
+        for path in collect_files(paths, self.root):
+            rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+            active = [c for c in self.checkers if c.applies(rel)]
+            if not active:
+                continue
+            ctx = FileContext(self, path, rel)
+            self.files.append(rel)
+            self.contexts[rel] = ctx
+            if not ctx.parse():
+                continue
+            for node in ast.walk(ctx.tree):
+                for c in active:
+                    c.visit(node, ctx)
+            for c in active:
+                c.end_file(ctx)
+        for c in self.checkers:
+            c.finish(self)
+        self.findings.sort(key=lambda f: f.sort_key)
+        return self.findings
+
+    # -------------------------------------------------------------- output
+
+    def to_json(self) -> str:
+        doc = {
+            "version": 1,
+            "checkers": sorted(c.name for c in self.checkers),
+            "files_scanned": len(self.files),
+            "suppressions_honored": self.suppressed_count,
+            "findings": [f.to_dict() for f in self.findings],
+        }
+        return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+    def summary(self) -> str:
+        status = "FAIL" if self.findings else "OK"
+        return (f"dslint: {status} — {len(self.findings)} finding(s), "
+                f"{len(self.files)} file(s) scanned, "
+                f"{self.suppressed_count} suppression(s) honored")
+
+
+def collect_files(paths: Iterable[str], root: str) -> List[str]:
+    """Expand files/directories into a sorted list of .py files (sorted by
+    root-relative path so the walk order — and therefore finding order and
+    cross-file state accumulation — is platform-independent)."""
+    out: Set[str] = set()
+    for p in paths:
+        p = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.add(os.path.abspath(p))
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        out.add(os.path.abspath(os.path.join(dirpath, fn)))
+    return sorted(out, key=lambda f: os.path.relpath(f, root).replace(os.sep, "/"))

@@ -115,7 +115,10 @@ def list_tags(save_dir: str) -> List[str]:
             mtime = 0.0
         return (steps, mtime)
 
-    tags = [d for d in os.listdir(save_dir)
+    # sorted(): os.listdir order is filesystem-dependent, and the (steps,
+    # mtime) sort below is stable — ties would otherwise resolve in disk
+    # order, making newest-valid-tag fallback differ across machines
+    tags = [d for d in sorted(os.listdir(save_dir))
             if os.path.isdir(os.path.join(save_dir, d, "state"))
             or os.path.exists(os.path.join(save_dir, d, "meta.json"))]
     return sorted(tags, key=order, reverse=True)

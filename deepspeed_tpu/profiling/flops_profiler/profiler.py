@@ -111,13 +111,13 @@ class FlopsProfiler:
     def start_profile(self, ignore_list=None, example_batch=None):
         self.reset_profile()
         self.started = True
-        self._t0 = time.perf_counter()
+        self._t0 = time.perf_counter()  # dslint-ok(determinism): flops profiler measures the real step wall duration it reports
         self._example_batch = example_batch
 
     def stop_profile(self):
         if not self.started:
             return
-        self._duration = time.perf_counter() - self._t0
+        self._duration = time.perf_counter() - self._t0  # dslint-ok(determinism): flops profiler measures the real step wall duration it reports
         self._collect()
 
     def reset_profile(self):

@@ -176,6 +176,8 @@ def build_manifest(root: str, match: Optional[Callable[[str], bool]] = None) -> 
             if fn.endswith(".npz"):
                 try:
                     entry["arrays"] = npz_array_crcs(full)
+                except InjectedCrash:
+                    raise  # a crash must not be laundered into an OSError
                 except Exception as e:
                     # a manifest is built right after a fenced save; an
                     # unreadable archive here is a real save failure

@@ -42,8 +42,12 @@ def emit(name: str, value: float = 1.0, step: Optional[int] = None) -> None:
         _BUFFER.append(event)
         monitor = _MONITOR
     if monitor is not None and getattr(monitor, "enabled", True):
+        # deferred import: fault_injection imports this module at its top
+        from .fault_injection import InjectedCrash
         try:
             monitor.write_events([event])
+        except InjectedCrash:
+            raise  # simulated process death must never be absorbed
         except Exception as e:  # observability must never break the operation
             logger.warning(f"resilience event forward failed: {e}")
 

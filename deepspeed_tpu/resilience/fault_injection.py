@@ -156,7 +156,7 @@ class FaultInjector:
                 fires = spec.at <= n < spec.at + spec.times
             if fires:
                 self._fired[i] += 1
-                events.emit(f"resilience/fault_injected", 1.0)
+                events.emit("resilience/fault_injected", 1.0)
                 logger.warning(f"FaultInjector: firing '{spec.kind}' at site "
                                f"'{site}' (hit {n})")
                 return spec
@@ -265,5 +265,5 @@ def arm_from_env() -> Optional[FaultInjector]:
 # launcher-spawned processes inherit a drill armed via the environment
 try:
     arm_from_env()
-except Exception as e:  # a malformed env plan must not break imports
+except Exception as e:  # dslint-ok(crash-transparency): import-time arming only parses JSON config — no injectable code runs here; a malformed env plan must not break imports
     logger.warning(f"ignoring malformed {ENV_PLAN_VAR}: {e}")

@@ -51,7 +51,7 @@ class StepWatchdog:
         def target():
             try:
                 box["result"] = fn(*args, **kwargs)
-            except BaseException as e:  # re-raised on the caller thread
+            except BaseException as e:  # dslint-ok(crash-transparency): cross-thread trampoline — the box is re-raised verbatim on the caller thread below, InjectedCrash included
                 box["error"] = e
 
         worker = threading.Thread(target=target, name=f"watchdog-{self.name}",

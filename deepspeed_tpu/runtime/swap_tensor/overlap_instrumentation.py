@@ -43,7 +43,7 @@ PHASES = ("upload", "compute", "download")
 
 
 def now() -> float:
-    return time.perf_counter()
+    return time.perf_counter()  # dslint-ok(determinism): the pipeline perf-clock primitive itself; lifted into the tracer domain by anchor offset
 
 
 class OverlapInstrumentation:

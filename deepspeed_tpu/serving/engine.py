@@ -27,6 +27,7 @@ import dataclasses
 import itertools
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..resilience.fault_injection import InjectedCrash
 from ..telemetry.trace import NULL_TRACER
 from ..utils.logging import logger
 from .admission import AdmissionConfig, AdmissionController
@@ -340,6 +341,8 @@ class ServingEngine:
             if req.stream is not None:
                 try:
                     req.stream(req, [int(t) for t in toks], now)
+                except InjectedCrash:
+                    raise  # simulated process death; chaos tests must see it
                 except Exception as e:
                     # one client's broken delivery sink (closed socket, ...)
                     # must not take down every other in-flight request; the
@@ -562,5 +565,7 @@ class ServingEngine:
             return
         try:
             self.monitor.write_events(events)
+        except InjectedCrash:
+            raise  # simulated process death; chaos tests must see it
         except Exception as e:  # monitoring must never take down serving
             logger.warning(f"serving monitor write failed: {e}")

@@ -22,6 +22,7 @@ mesh/host.  The pool adds what a fleet needs around them:
 import dataclasses
 from typing import Callable, Dict, List, Optional
 
+from ...resilience.fault_injection import InjectedCrash
 from ...utils.logging import logger
 from ..clock import ReplicaClockView, VirtualClock
 from ..engine import ServingConfig, ServingEngine
@@ -79,6 +80,8 @@ class ReplicaPool:
             return
         try:
             self.monitor.write_events([(name, value, len(self.health.history))])
+        except InjectedCrash:
+            raise  # simulated process death; chaos tests must see it
         except Exception as e:  # observability must never take down the fleet
             logger.warning(f"fleet monitor write failed: {e}")
 
@@ -166,7 +169,6 @@ class ReplicaPool:
         :class:`~...resilience.fault_injection.InjectedCrash` is re-raised —
         it simulates death of THIS driver process, not of one replica, and
         nothing may absorb it (the resilience-layer contract)."""
-        from ...resilience.fault_injection import InjectedCrash
         if not self.health.serving(rid):
             return {}, []
         rep = self.replicas[rid]

@@ -557,5 +557,7 @@ class Router:
             return
         try:
             self.monitor.write_events(events)
+        except _fi.InjectedCrash:
+            raise  # simulated process death; chaos tests must see it
         except Exception as e:  # monitoring must never take down routing
             logger.warning(f"fleet monitor write failed: {e}")

@@ -1,0 +1,203 @@
+"""Central registry of every monitor/telemetry event name in the stack.
+
+Until r11 the event taxonomy lived in three places that drifted
+independently: the emitter call sites (``events.emit``, ``_emit``,
+``metrics.counter/gauge/histogram``), the docs/OBSERVABILITY.md table, and
+reviewers' heads.  This module is now the single source of truth:
+
+* the ``event-registry`` dslint checker validates every event-name
+  literal in the package against :data:`EVENTS` / :data:`DYNAMIC`
+  (an emitter using an unregistered name fails tier-1);
+* the event table in docs/OBSERVABILITY.md is GENERATED from here
+  (``python deepspeed_tpu/telemetry/event_registry.py --sync
+  docs/OBSERVABILITY.md``) and the same checker fails when the committed
+  doc block differs from :func:`render_event_table` — docs cannot drift.
+
+Deliberately stdlib-only with no package-relative imports: dslint loads it
+standalone (no jax import) and it runs directly by path.
+
+``kind`` vocabulary: ``event`` (a monitor ``write_events`` tuple),
+``counter``/``gauge``/``histogram`` (MetricsRegistry instruments — note
+histograms additionally fan out over the ``telemetry/`` bridge as
+``_p50/_p95/_p99/_count``).
+"""
+
+import re
+
+#: static event names: one entry per literal an emitter uses
+EVENTS = {
+    # ---- resilience bus (resilience/events.py -> monitor forward)
+    "resilience/fault_injected": ("event", "resilience/fault_injection.py",
+                                  "a planned fault fired at a site"),
+    "resilience/retry": ("event", "resilience/retry.py",
+                         "transient failure absorbed; backing off"),
+    "resilience/retry_exhausted": ("event", "resilience/retry.py",
+                                   "retry budget/schedule spent; re-raising"),
+    "resilience/admission_retry": ("event", "resilience/retry.py",
+                                   "serving admission backoff probe"),
+    "resilience/watchdog_hang": ("event", "resilience/watchdog.py",
+                                 "step exceeded the hang threshold"),
+    "resilience/rendezvous": ("event", "elasticity/elastic_agent.py",
+                              "elastic agent re-rendezvous after a loss"),
+    "resilience/device_loss": ("event", "elasticity/elastic_agent.py",
+                               "DEVICE_LOST-class failure classified"),
+    "resilience/ckpt_published": ("event", "checkpoint/engine.py",
+                                  "'latest' atomically points at a new tag"),
+    "resilience/ckpt_invalid_tag": ("event", "checkpoint/engine.py",
+                                    "requested tag failed validation"),
+    "resilience/ckpt_fallback": ("event", "checkpoint/engine.py",
+                                 "auto-fallback to the newest valid tag"),
+    "resilience/ckpt_retention_delete": ("event", "checkpoint/engine.py",
+                                         "keep-last-K pruned a tag"),
+    "resilience/host_opt_reject": ("event",
+                                   "runtime/swap_tensor/host_streamed_optimizer.py",
+                                   "host-tier npz failed manifest/crc checks"),
+    # ---- serving frontend (serving/engine.py)
+    "serving/rejected": ("event+counter", "serving/engine.py",
+                         "admission rejected a request"),
+    "serving/preempted": ("event", "serving/engine.py",
+                          "KV pressure evicted + requeued a request"),
+    "serving/e2e_latency": ("event", "serving/engine.py",
+                            "terminal request end-to-end seconds"),
+    "serving/preemptions": ("event+counter", "serving/engine.py",
+                            "preemption count of a terminal request"),
+    "serving/ttft": ("event", "serving/engine.py", "time to first token"),
+    "serving/tpot": ("event", "serving/engine.py", "time per output token"),
+    "serving/queue_wait": ("event", "serving/engine.py",
+                           "admission-queue wait of a DONE request"),
+    "serving/deadline_met": ("event", "serving/engine.py",
+                             "1/0: DONE request met its SLA deadline"),
+    "serving/timed_out": ("event", "serving/engine.py",
+                          "request expired its deadline"),
+    "serving/submitted": ("counter", "serving/engine.py",
+                          "requests entering submit()"),
+    "serving/e2e_s": ("histogram", "serving/engine.py",
+                      "end-to-end seconds, all terminal requests"),
+    "serving/ttft_s": ("histogram", "serving/engine.py",
+                       "time to first token, DONE requests"),
+    "serving/tpot_s": ("histogram", "serving/engine.py",
+                       "time per output token, DONE requests"),
+    "serving/queue_wait_s": ("histogram", "serving/engine.py",
+                             "admission-queue wait, DONE requests"),
+    # ---- fleet router (serving/fleet/)
+    "fleet/dispatch": ("event", "serving/fleet/router.py",
+                       "request placed on a replica (value = rid)"),
+    "fleet/replica_dead": ("event", "serving/fleet/router.py",
+                           "replica declared dead (value = rid)"),
+    "fleet/failover_requeued": ("event", "serving/fleet/router.py",
+                                "in-flight requests displaced to survivors"),
+    # ---- monitor surface (monitor/monitor.py)
+    "monitor/dropped_events": ("event", "monitor/monitor.py",
+                               "cumulative events shed by the max_events cap"),
+    # ---- flops profiler gauges (profiling/flops_profiler/profiler.py)
+    "profiler/flops_per_step": ("gauge", "profiling/flops_profiler/profiler.py",
+                                "model FLOPs of the profiled step"),
+    "profiler/macs_per_step": ("gauge", "profiling/flops_profiler/profiler.py",
+                               "model MACs of the profiled step"),
+    "profiler/params": ("gauge", "profiling/flops_profiler/profiler.py",
+                        "parameter count"),
+    "profiler/bytes_per_step": ("gauge", "profiling/flops_profiler/profiler.py",
+                                "activation+weight bytes moved per step"),
+    "profiler/step_duration_s": ("gauge", "profiling/flops_profiler/profiler.py",
+                                 "measured wall duration of the profiled step"),
+}
+
+#: dynamic name families built with f-strings; ``prefix`` legitimizes the
+#: emitter's literal head, ``expansions`` documents the closed value set
+#: ("..." marks an open family)
+DYNAMIC = [
+    {"prefix": "serving/", "template": "serving/<terminal-state>",
+     "kind": "counter", "source": "serving/engine.py",
+     "expansions": ["serving/done", "serving/timed_out"],
+     "doc": "terminal-state counter per finished request"},
+    {"prefix": "fleet/", "template": "fleet/<terminal-state>",
+     "kind": "event", "source": "serving/fleet/router.py",
+     "expansions": ["fleet/done", "fleet/timed_out", "fleet/rejected"],
+     "doc": "terminal-state event per finished fleet request"},
+    {"prefix": "fleet/health/", "template": "fleet/health/<state>",
+     "kind": "event", "source": "serving/fleet/health.py",
+     "expansions": ["fleet/health/healthy", "fleet/health/degraded",
+                    "fleet/health/draining", "fleet/health/dead",
+                    "fleet/health/recovering"],
+     "doc": "replica health transition (value = rid)"},
+    {"prefix": "telemetry/", "template": "telemetry/<metric>[_p50|_p95|_p99|_count]",
+     "kind": "event", "source": "telemetry/metrics.py",
+     "expansions": ["..."],
+     "doc": "MetricsRegistry.flush_to_monitor bridge of every registered "
+            "metric (histograms fan out quantiles + count)"},
+]
+
+BEGIN_MARK = ("<!-- BEGIN EVENT TABLE (generated from "
+              "deepspeed_tpu/telemetry/event_registry.py — edit there, then "
+              "`python deepspeed_tpu/telemetry/event_registry.py --sync "
+              "docs/OBSERVABILITY.md`) -->")
+END_MARK = "<!-- END EVENT TABLE -->"
+
+
+def registered_names():
+    return frozenset(EVENTS)
+
+
+def dynamic_prefixes():
+    return tuple(d["prefix"] for d in DYNAMIC)
+
+
+def _cell(text: str) -> str:
+    # GFM splits table cells on '|' even inside code spans
+    return text.replace("|", "\\|")
+
+
+def render_event_table() -> str:
+    """The markdown block committed between the OBSERVABILITY.md markers.
+    Deterministic: sorted rows, no timestamps."""
+    lines = [BEGIN_MARK, "",
+             "| event | kind | emitted by | meaning |",
+             "|---|---|---|---|"]
+    for name in sorted(EVENTS):
+        kind, source, doc = EVENTS[name]
+        lines.append(f"| `{_cell(name)}` | {_cell(kind)} | `{_cell(source)}` "
+                     f"| {_cell(doc)} |")
+    for d in sorted(DYNAMIC, key=lambda d: d["template"]):
+        exp = ", ".join(f"`{_cell(e)}`" for e in d["expansions"])
+        lines.append(f"| `{_cell(d['template'])}` | {_cell(d['kind'])} | "
+                     f"`{_cell(d['source'])}` | "
+                     f"{_cell(d['doc'])} — expands to: {exp} |")
+    lines += ["", END_MARK]
+    return "\n".join(lines)
+
+
+def extract_doc_block(doc_text: str):
+    """The committed table block (markers included), or None."""
+    m = re.search(re.escape(BEGIN_MARK) + r".*?" + re.escape(END_MARK),
+                  doc_text, re.DOTALL)
+    return m.group(0) if m else None
+
+
+def sync_doc(doc_path: str) -> bool:
+    """Rewrite the generated block in ``doc_path``; returns True when the
+    file changed.  The block must already exist (markers committed)."""
+    with open(doc_path, "r", encoding="utf-8") as f:
+        text = f.read()
+    old = extract_doc_block(text)
+    if old is None:
+        raise SystemExit(f"{doc_path}: event-table markers not found — add\n"
+                         f"{BEGIN_MARK}\n{END_MARK}")
+    new = render_event_table()
+    if old == new:
+        return False
+    with open(doc_path, "w", encoding="utf-8") as f:  # atomic-ok: doc regeneration, not a durability artifact
+        f.write(text.replace(old, new))
+    return True
+
+
+if __name__ == "__main__":
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sync", metavar="DOC",
+                    help="rewrite the generated event table in DOC")
+    args = ap.parse_args()
+    if args.sync:
+        changed = sync_doc(args.sync)
+        print(f"{args.sync}: {'updated' if changed else 'already in sync'}")
+    else:
+        print(render_event_table())

@@ -228,7 +228,7 @@ SERVING_CONFIGS = {
 
 def analyze_serving(name):
     import numpy as np
-    t0 = time.time()
+    t0 = time.time()  # dslint-ok(determinism): benchmark measures real compile wall time
     metas, n_params, meta = SERVING_CONFIGS[name]()
     phases = {}
     peak = arg = temp = 0
@@ -247,7 +247,7 @@ def analyze_serving(name):
         peak_gb=round(peak / 1e9, 2),
         v5p_hbm_gb=round(V5P_HBM_BYTES / 1e9, 2),
         fits_v5p=bool(max(peak, arg + temp) <= V5P_HBM_BYTES),
-        compile_seconds=round(time.time() - t0, 1),
+        compile_seconds=round(time.time() - t0, 1),  # dslint-ok(determinism): benchmark measures real compile wall time
     )
 
 
@@ -257,7 +257,7 @@ def analyze(name):
     if name in SERVING_CONFIGS:
         return analyze_serving(name)
     build = CONFIGS[name]
-    t0 = time.time()
+    t0 = time.time()  # dslint-ok(determinism): benchmark measures real compile wall time
     engine, batch, meta = build()
     compiled = engine.compile_aot(batch)
     ma = compiled.memory_analysis()
@@ -279,7 +279,7 @@ def analyze(name):
         v5p_hbm_gb=round(V5P_HBM_BYTES / 1e9, 2),
         fits_v5p=bool(max(peak, int(ma.argument_size_in_bytes) + int(ma.temp_size_in_bytes))
                       <= V5P_HBM_BYTES),
-        compile_seconds=round(time.time() - t0, 1),
+        compile_seconds=round(time.time() - t0, 1),  # dslint-ok(determinism): benchmark measures real compile wall time
     )
     return rec
 

@@ -1,125 +1,48 @@
 #!/usr/bin/env python
-"""Tier-1 lint: durability-sensitive paths must use the atomic-write helper.
+"""Thin shim (r11): the atomic-write lint lives in the dslint framework.
 
-The r7 issue's failure class: a bare ``open(path, "w")`` (or ``np.savez``)
-on a checkpoint or benchmark-artifact path tears under a crash — a reader
-(resume, the bench-schema checker, the next round's reviewer) then sees a
-half-written file at the published name.  ``resilience/atomic_io.py``
-exists precisely so that never happens (temp + fsync + rename), and this
-checker keeps the codebase honest: inside the SENSITIVE path set, every
-``open(..., "w"/"wb"/"a"/"x")`` call and every direct ``savez`` /
-``savez_compressed`` / ``json.dump``-to-file must either go through the
-helper or carry an explicit ``# atomic-ok: <why>`` marker on the same
-line (e.g. reads-modify-in-place corruptors, stdout fallbacks).
+The r8 checker this file used to implement moved verbatim into
+``deepspeed_tpu/analysis/checkers/atomic_write.py`` so it runs in the same
+single AST walk as every other contract (``scripts/dslint.py``).  This
+shim keeps the legacy surface working unchanged:
 
-Sensitive set (writers of state another process/run must be able to trust):
-  * deepspeed_tpu/checkpoint/**           — checkpoint machinery
-  * deepspeed_tpu/runtime/checkpoint_engine.py
-  * deepspeed_tpu/runtime/swap_tensor/**  — swap/optimizer persistence
-  * deepspeed_tpu/resilience/**           — the helper's own home
-  * scripts/bench_*.py, scripts/aot_membudget.py, bench.py,
-    bench_inference.py                    — committed BENCH_*/artifact JSON
+* ``python scripts/check_atomic_writes.py [root]`` — same CLI, same exit
+  code, same ``rel:line: message`` output;
+* ``validate_all(root)`` — the API tests/unit/test_atomic_writes.py loads
+  by path; findings come back in the legacy string format.
 
-Wired as a unit test (tests/unit/test_atomic_writes.py), same pattern as
-check_bench_schema.py.
+Rules, sensitive path set, and the ``# atomic-ok: <why>`` escape are
+documented in the checker module and docs/ANALYSIS.md.
 """
 
-import ast
-import fnmatch
 import os
 import sys
 from typing import List
 
-SENSITIVE_GLOBS = [
-    "deepspeed_tpu/checkpoint/*.py",
-    "deepspeed_tpu/runtime/checkpoint_engine.py",
-    "deepspeed_tpu/runtime/swap_tensor/*.py",
-    "deepspeed_tpu/resilience/*.py",
-    "scripts/bench_*.py",
-    "scripts/aot_membudget.py",
-    "bench.py",
-    "bench_inference.py",
-]
-
-ALLOW_MARKER = "atomic-ok"
-# '+' catches in-place mutation ('r+'/'rb+') — the same torn-file class
-WRITE_MODES = ("w", "a", "x", "+")
-#: attribute calls that publish a whole artifact in one non-atomic shot
-FORBIDDEN_ATTRS = ("savez", "savez_compressed")
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
-def _is_sensitive(rel: str) -> bool:
-    rel = rel.replace(os.sep, "/")
-    return any(fnmatch.fnmatch(rel, g) for g in SENSITIVE_GLOBS)
-
-
-def _open_mode(call: ast.Call):
-    """The mode of an ``open()`` call when statically known ('r' default)."""
-    mode = None
-    if len(call.args) >= 2:
-        mode = call.args[1]
-    for kw in call.keywords:
-        if kw.arg == "mode":
-            mode = kw.value
-    if mode is None:
-        return "r"
-    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
-        return mode.value
-    return None  # dynamic — not flagged
-
-
-def check_file(path: str, rel: str) -> List[str]:
-    with open(path, "r", encoding="utf-8") as f:
-        source = f.read()
-    lines = source.splitlines()
-
-    def allowed(lineno: int) -> bool:
-        return 0 < lineno <= len(lines) and ALLOW_MARKER in lines[lineno - 1]
-
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [f"{rel}:{e.lineno}: unparseable ({e.msg})"]
-    errors = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        if isinstance(func, ast.Name) and func.id == "open":
-            mode = _open_mode(node)
-            if mode is not None and any(m in mode for m in WRITE_MODES) \
-                    and not allowed(node.lineno):
-                errors.append(
-                    f"{rel}:{node.lineno}: bare open(..., {mode!r}) on a "
-                    "durability-sensitive path — use resilience.atomic_io "
-                    f"(or justify with '# {ALLOW_MARKER}: <why>')")
-        elif isinstance(func, ast.Attribute) and func.attr in FORBIDDEN_ATTRS \
-                and not allowed(node.lineno):
-            errors.append(
-                f"{rel}:{node.lineno}: direct .{func.attr}(...) on a "
-                "durability-sensitive path — use resilience.atomic_io."
-                f"atomic_savez (or justify with '# {ALLOW_MARKER}: <why>')")
-    return errors
+def _analysis():
+    pkg_dir = os.path.join(REPO_ROOT, "deepspeed_tpu")
+    if pkg_dir not in sys.path:
+        sys.path.insert(0, pkg_dir)
+    import analysis
+    return analysis
 
 
 def validate_all(root: str) -> List[str]:
-    errors = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames
-                       if d not in ("__pycache__", ".git", "tests", "examples")]
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            full = os.path.join(dirpath, fn)
-            rel = os.path.relpath(full, root)
-            if _is_sensitive(rel):
-                errors.extend(check_file(full, rel))
-    return errors
+    analysis = _analysis()
+    root = os.path.abspath(root)
+    runner = analysis.Runner(
+        root, [c for c in analysis.all_checkers() if c.name == "atomic-write"],
+        known_checker_names=analysis.checker_names())
+    runner.run([root])
+    return [f"{f.path.replace('/', os.sep)}:{f.line}: {f.message}"
+            for f in runner.findings]
 
 
 def main() -> int:
-    root = sys.argv[1] if len(sys.argv) > 1 else \
-        os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    root = sys.argv[1] if len(sys.argv) > 1 else REPO_ROOT
     errors = validate_all(root)
     for e in errors:
         print(e)

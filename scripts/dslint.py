@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""dslint — the unified static-analysis pass (r11 tentpole).
+
+Runs every registered checker (determinism, crash-transparency,
+fault-sites, event-registry, atomic-write, bench-schema) in one AST walk
+per file and exits non-zero on any unsuppressed finding.  Deterministic:
+two identical runs produce byte-identical output (``--json`` asserted in
+tier-1, tests/unit/test_dslint.py).
+
+    python scripts/dslint.py deepspeed_tpu scripts            # the tier-1 run
+    python scripts/dslint.py --json deepspeed_tpu scripts
+    python scripts/dslint.py --list-checkers
+    python scripts/dslint.py --checkers determinism path/to/file.py
+
+Suppression: ``# dslint-ok(<checker>): <reason>`` on the flagged line —
+the reason is mandatory (checker catalog + syntax: docs/ANALYSIS.md).
+
+The ``analysis`` package is imported standalone (the ``deepspeed_tpu/``
+directory itself goes on ``sys.path``) so dslint never imports jax and the
+full-repo run stays well inside its 5-second budget.
+"""
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def load_analysis(root: str = REPO_ROOT):
+    """Import ``deepspeed_tpu/analysis`` as the top-level package
+    ``analysis`` — skipping deepspeed_tpu/__init__ (jax, ~seconds)."""
+    pkg_dir = os.path.join(root, "deepspeed_tpu")
+    if pkg_dir not in sys.path:
+        sys.path.insert(0, pkg_dir)
+    import analysis
+    return analysis
+
+
+def run_dslint(paths, root=REPO_ROOT, checkers=None):
+    """Programmatic entry (the tier-1 test and the atomic-write shim use
+    this): returns the populated ``analysis.core.Runner``."""
+    analysis = load_analysis()
+    everything = analysis.all_checkers()
+    selected = everything
+    if checkers is not None:
+        wanted = set(checkers)
+        unknown = sorted(wanted - {c.name for c in everything})
+        if unknown:
+            # a typo'd --checkers must not silently lint nothing and pass
+            raise ValueError(
+                f"unknown checker(s): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(c.name for c in everything))})")
+        selected = [c for c in everything if c.name in wanted]
+    runner = analysis.Runner(root, selected,
+                             known_checker_names=[c.name for c in everything])
+    runner.run(paths)
+    return runner
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        prog="dslint", description="unified static-analysis pass")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to scan (default: deepspeed_tpu scripts)")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="repo root findings are reported relative to")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable deterministic output")
+    ap.add_argument("--checkers", default=None,
+                    help="comma-separated subset of checkers to run")
+    ap.add_argument("--list-checkers", action="store_true")
+    args = ap.parse_args()
+
+    analysis = load_analysis()
+    if args.list_checkers:
+        for c in analysis.all_checkers():
+            print(f"{c.name:20s} {c.description}")
+        return 0
+
+    paths = args.paths or ["deepspeed_tpu", "scripts"]
+    checkers = args.checkers.split(",") if args.checkers else None
+    try:
+        runner = run_dslint(paths, root=os.path.abspath(args.root),
+                            checkers=checkers)
+    except ValueError as e:
+        print(f"dslint: error: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        sys.stdout.write(runner.to_json())
+    else:
+        for f in runner.findings:
+            print(f.human())
+        print(runner.summary())
+    return 1 if runner.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

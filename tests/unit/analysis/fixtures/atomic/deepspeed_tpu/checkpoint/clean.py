@@ -1,0 +1,15 @@
+"""Atomic-write fixture (clean): helper use, justified escape, reads."""
+
+
+def save(path, obj, atomic_write_json):
+    atomic_write_json(path, obj)
+
+
+def patch_in_place(path):
+    with open(path, "r+b") as f:  # atomic-ok: test fixture exercising the escape
+        f.write(b"x")
+
+
+def load(path):
+    with open(path) as f:
+        return f.read()

@@ -1,0 +1,10 @@
+"""Atomic-write fixture: bare writes on a durability-sensitive path."""
+import json
+
+import numpy as np
+
+
+def save(path, obj, arrs):
+    with open(path, "w") as f:
+        json.dump(obj, f)
+    np.savez(path + ".npz", **arrs)

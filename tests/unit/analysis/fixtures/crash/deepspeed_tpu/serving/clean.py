@@ -1,0 +1,45 @@
+"""Crash-transparency fixture (clean): the three sanctioned shapes."""
+
+
+class InjectedCrash(Exception):
+    pass
+
+
+def forward_guarded(monitor, events):
+    try:
+        monitor.write_events(events)
+    except InjectedCrash:
+        raise
+    except Exception:
+        pass
+
+
+def cleanup_and_propagate(path, data):
+    try:
+        path.write(data)
+    except Exception:
+        path.unlink()
+        raise
+
+
+def narrow(monitor, events):
+    try:
+        monitor.write_events(events)
+    except OSError:
+        pass
+
+
+def cleanup_loop_and_propagate(paths, data):
+    # break/continue confined to a handler-local loop never skip the
+    # trailing re-raise; a nested def's return is a different scope
+    try:
+        paths[0].write(data)
+    except Exception:
+        for p in paths:
+            if not p.exists():
+                continue
+            p.unlink()
+        def _note():
+            return "cleaned"
+        _note()
+        raise

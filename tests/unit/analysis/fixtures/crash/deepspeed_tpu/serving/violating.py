@@ -1,0 +1,31 @@
+"""Crash-transparency fixture: a broad except with no guard, no re-raise,
+no suppression — it would absorb InjectedCrash."""
+
+
+def forward(monitor, events):
+    try:
+        monitor.write_events(events)
+    except Exception:
+        pass
+
+
+def conditional_swallow(monitor, events, is_transient):
+    # the trailing bare raise is NOT unavoidable: the early return path
+    # absorbs an InjectedCrash whenever is_transient() matches it
+    try:
+        monitor.write_events(events)
+    except Exception as e:
+        if is_transient(e):
+            return None
+        raise
+
+
+def conditional_launder(monitor, events, is_transient):
+    # raising a DIFFERENT exception converts an InjectedCrash into a
+    # retryable type on the transient branch — laundering, not re-raising
+    try:
+        monitor.write_events(events)
+    except Exception as e:
+        if is_transient(e):
+            raise OSError("retry me") from e
+        raise

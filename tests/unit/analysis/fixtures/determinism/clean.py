@@ -1,0 +1,33 @@
+"""Determinism fixture (clean): the sanctioned counterparts."""
+import glob
+import os
+import random
+
+import numpy as np
+
+
+def stamp(clock):
+    return clock.now()                      # pluggable clock
+
+
+def pick_newest(d):
+    for entry in sorted(os.listdir(d)):     # sorted enumeration
+        yield entry
+
+
+def pick_file(d):
+    return sorted(glob.glob(d + "/*"))[0]
+
+
+def known_files(d):
+    return {f for f in os.listdir(d)}       # set: order-independent sink
+
+
+def has_file(d, name):
+    return name in os.listdir(d)            # membership: order-independent
+
+
+def jitter(seed):
+    rng = random.Random(seed)               # seeded instances
+    nrng = np.random.default_rng(seed)
+    return rng.random() + nrng.random()

@@ -1,0 +1,29 @@
+"""Determinism fixture: one hit per rule (wall clock, unsorted
+enumeration, global RNG)."""
+import glob
+import os
+import random
+import time
+
+import numpy as np
+
+
+def stamp():
+    return time.time()            # wall-clock read
+
+
+def pick_newest(d):
+    for entry in os.listdir(d):   # unsorted enumeration feeding iteration
+        yield entry
+
+
+def pick_file(d):
+    return glob.glob(d + "/*")[0]  # unsorted enumeration feeding selection
+
+
+def jitter():
+    return random.random() + np.random.rand()  # global RNG, twice
+
+
+def matches_manifest(d, expected):
+    return os.listdir(d) == expected  # list equality is order-sensitive

@@ -1,0 +1,8 @@
+"""Event-registry fixture: an unregistered literal, an unregistered
+dynamic family (and serving/dead left without an emitter)."""
+
+
+def emit_all(emit, state):
+    emit("serving/ok", 1.0)
+    emit("serving/not_registered", 1.0)
+    emit(f"serving/phase/{state}", 1.0)

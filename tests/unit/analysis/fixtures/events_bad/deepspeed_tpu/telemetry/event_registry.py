@@ -1,0 +1,6 @@
+"""Miniature registry: 'serving/ok' is emitted; 'serving/dead' is not."""
+EVENTS = {
+    "serving/ok": ("event", "serving/emitter.py", "registered and emitted"),
+    "serving/dead": ("event", "serving/emitter.py", "registered, never emitted"),
+}
+DYNAMIC = []

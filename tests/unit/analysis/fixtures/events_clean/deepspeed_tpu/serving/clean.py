@@ -1,0 +1,3 @@
+def emit_all(emit, state):
+    emit("serving/ok", 1.0)
+    emit(f"serving/state/{state}", 1.0)

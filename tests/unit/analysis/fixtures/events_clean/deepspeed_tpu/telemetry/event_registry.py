@@ -1,0 +1,8 @@
+EVENTS = {
+    "serving/ok": ("event", "serving/emitter.py", "registered and emitted"),
+}
+DYNAMIC = [
+    {"prefix": "serving/state/", "template": "serving/state/<s>",
+     "kind": "event", "source": "serving/emitter.py",
+     "expansions": ["serving/state/a", "serving/state/b"], "doc": "states"},
+]

@@ -1,0 +1,5 @@
+"""Miniature registry: 'ckpt.save' is probed; 'swap.read' is dead."""
+INJECTION_SITES = frozenset({
+    "ckpt.save",
+    "swap.read",
+})

@@ -1,0 +1,9 @@
+"""Fault-site fixture: probes an UNREGISTERED site (and leaves swap.read
+registered-but-unprobed)."""
+from ..resilience import fault_injection as fi
+
+
+def save(retry_call, do_save):
+    fi.check("ckpt.save")
+    fi.check("ckpt.not_a_site")
+    retry_call(do_save, site="serving.also_missing")

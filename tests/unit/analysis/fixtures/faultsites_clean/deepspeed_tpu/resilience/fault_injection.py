@@ -1,0 +1,4 @@
+INJECTION_SITES = frozenset({
+    "ckpt.save",
+    "swap.write",
+})

@@ -1,0 +1,12 @@
+from ..resilience import fault_injection as fi
+
+
+def save(retry_call, do_save):
+    fi.check("ckpt.save")
+    retry_call(do_save, site="ckpt.save")
+
+
+def write(arr=None, /, site="swap.write"):
+    # posonly arg before the site default: ast.arguments.defaults spans
+    # posonlyargs + args, so the alignment must not shift
+    fi.check(site)

@@ -1,0 +1,6 @@
+"""Suppression fixture (clean): a well-formed marker with a reason."""
+import time
+
+
+def a():
+    return time.time()  # dslint-ok(determinism): fixture demonstrating a justified wall-clock read

@@ -1,0 +1,11 @@
+"""Suppression fixture (clean): two checkers fire on ONE line — broad
+except (crash-transparency, this is a serving/ path) and a wall-clock
+read (determinism) — and two markers each suppress their own, keeping
+their own reasons."""
+import time
+
+
+def a(sink):
+    try:
+        sink.flush()
+    except Exception: sink.note(time.time())  # dslint-ok(crash-transparency): fixture: two markers share the line  # dslint-ok(determinism): each keeps its own reason

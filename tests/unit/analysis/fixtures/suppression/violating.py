@@ -1,0 +1,10 @@
+"""Suppression fixture: a reason-less marker, and an unknown checker."""
+import time
+
+
+def a():
+    return time.time()  # dslint-ok(determinism)
+
+
+def b():
+    return time.time()  # dslint-ok(not-a-checker): the checker name is wrong

@@ -1,0 +1,82 @@
+"""Regression (r11 determinism checker's live hit): checkpoint tag
+scanning must be filesystem-order-independent.  ``list_tags`` sorts by
+(global_steps, mtime) with a stable sort — before the fix, ties fell back
+to raw ``os.listdir`` order, so newest-valid-tag fallback could pick a
+different checkpoint on a different filesystem."""
+
+import json
+import os
+import random
+
+from deepspeed_tpu.checkpoint import engine as ckpt_engine
+from deepspeed_tpu.resilience import atomic_io
+
+
+def _make_tag(save_dir, tag, steps):
+    path = os.path.join(save_dir, tag)
+    os.makedirs(os.path.join(path, "state"))
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump({"tag": tag, "global_steps": steps}, f)
+    atomic_io.write_manifest(path, site=None)
+    return path
+
+
+def _pin_mtimes(save_dir, tags, mtime=1_700_000_000.0):
+    for t in tags:
+        os.utime(os.path.join(save_dir, t), (mtime, mtime))
+
+
+def test_list_tags_stable_under_shuffled_listdir(tmp_path, monkeypatch):
+    save_dir = str(tmp_path)
+    # equal steps AND equal mtime: the tie the stable sort must break
+    # identically regardless of enumeration order
+    tags = [f"tag_{c}" for c in "dbeac"]
+    for t in tags:
+        _make_tag(save_dir, t, steps=5)
+    _pin_mtimes(save_dir, tags)
+
+    real_listdir = os.listdir
+    orders = []
+    for seed in range(6):
+        rng = random.Random(seed)
+
+        def shuffled(path, _rng=rng):
+            entries = real_listdir(path)
+            _rng.shuffle(entries)
+            return entries
+
+        monkeypatch.setattr(os, "listdir", shuffled)
+        orders.append(ckpt_engine.list_tags(save_dir))
+        monkeypatch.setattr(os, "listdir", real_listdir)
+
+    assert all(o == orders[0] for o in orders), orders
+    assert sorted(orders[0]) == sorted(tags)
+
+
+def test_newest_valid_fallback_order_independent(tmp_path, monkeypatch):
+    """The fallback consumer: with the latest-pointed tag invalid and two
+    equally-new valid candidates, every enumeration order picks the same
+    fallback tag."""
+    save_dir = str(tmp_path)
+    for t in ("cand_a", "cand_b"):
+        _make_tag(save_dir, t, steps=7)
+    broken = _make_tag(save_dir, "broken", steps=9)
+    os.remove(os.path.join(broken, "meta.json"))  # not loadable
+    _pin_mtimes(save_dir, ("cand_a", "cand_b", "broken"))
+
+    real_listdir = os.listdir
+    picks = set()
+    for seed in range(8):
+        rng = random.Random(seed)
+
+        def shuffled(path, _rng=rng):
+            entries = real_listdir(path)
+            _rng.shuffle(entries)
+            return entries
+
+        monkeypatch.setattr(os, "listdir", shuffled)
+        picks.add(ckpt_engine.find_newest_valid_tag(save_dir, exclude={"broken"}))
+        monkeypatch.setattr(os, "listdir", real_listdir)
+
+    assert len(picks) == 1, f"fallback tag depends on listdir order: {picks}"
+    assert picks.pop() in ("cand_a", "cand_b")

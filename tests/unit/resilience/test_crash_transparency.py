@@ -1,0 +1,116 @@
+"""Chaos contract regression (r11 crash-transparency checker): an
+:class:`InjectedCrash` raised INSIDE a monitor-forward path must propagate
+to the caller — the "observability must never break the operation" shields
+absorb ordinary failures, but simulated process death may never be
+absorbed, or replica-kill chaos tests silently test nothing.
+
+Each guard added by the r11 audit is exercised directly: the resilience
+event bus, the serving engine's ``_emit``, the fleet router's and pool's
+``_emit``, and the per-request stream-callback shield.  The inverse is
+asserted too: a garden-variety monitor failure is still swallowed.
+"""
+
+import types
+
+import pytest
+
+from deepspeed_tpu.resilience import events
+from deepspeed_tpu.resilience.fault_injection import InjectedCrash
+from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.fleet.pool import ReplicaPool
+from deepspeed_tpu.serving.fleet.router import Router
+
+
+class _CrashingMonitor:
+    enabled = True
+
+    def write_events(self, evts):
+        raise InjectedCrash("injected crash inside monitor forward")
+
+
+class _FlakyMonitor:
+    enabled = True
+
+    def write_events(self, evts):
+        raise RuntimeError("backend went away")
+
+
+@pytest.fixture(autouse=True)
+def _detach_bus_monitor():
+    yield
+    events.attach_monitor(None)
+
+
+def test_event_bus_forward_propagates_injected_crash():
+    events.attach_monitor(_CrashingMonitor())
+    with pytest.raises(InjectedCrash):
+        events.emit("resilience/fault_injected")
+
+
+def test_event_bus_forward_swallows_ordinary_failure():
+    events.attach_monitor(_FlakyMonitor())
+    events.emit("resilience/fault_injected")  # must not raise
+    assert events.recent("resilience/")  # still recorded in the ring
+
+
+def _bound(method, **attrs):
+    """Bind an unbound ``_emit``-style method to a minimal stand-in object
+    so the guard is tested without building a whole engine/fleet."""
+    holder = types.SimpleNamespace(**attrs)
+    return method.__get__(holder)
+
+
+@pytest.mark.parametrize("emit_method,payload", [
+    (ServingEngine._emit, [("serving/preempted", 1.0, 0)]),
+    (Router._emit, [("fleet/dispatch", 0.0, 0)]),
+])
+def test_emit_shields_propagate_injected_crash(emit_method, payload):
+    emit = _bound(emit_method, monitor=_CrashingMonitor())
+    with pytest.raises(InjectedCrash):
+        emit(payload)
+    emit = _bound(emit_method, monitor=_FlakyMonitor())
+    emit(payload)  # ordinary failure: swallowed
+
+
+def test_pool_emit_shield_propagates_injected_crash():
+    emit = _bound(ReplicaPool._emit, monitor=_CrashingMonitor(),
+                  health=types.SimpleNamespace(history=[]))
+    with pytest.raises(InjectedCrash):
+        emit("fleet/replica_dead", 1.0)
+    emit = _bound(ReplicaPool._emit, monitor=_FlakyMonitor(),
+                  health=types.SimpleNamespace(history=[]))
+    emit("fleet/replica_dead", 1.0)
+
+
+def test_stream_callback_shield_propagates_injected_crash():
+    """The per-request delivery shield isolates one client's broken sink —
+    but an InjectedCrash from a chaos plan is not a broken sink."""
+    from deepspeed_tpu.serving.request import RequestState, ServingRequest
+
+    def crashing_stream(req, toks, now):
+        raise InjectedCrash("injected crash inside stream delivery")
+
+    req = ServingRequest(uid=1, prompt=[1, 2], max_new_tokens=4,
+                         arrival_ts=0.0, stream=crashing_stream)
+    req.to(RequestState.PREFILL, 0.0)
+    seqs = {}
+    holder = types.SimpleNamespace(
+        _active={1: req}, metrics=None, stats=None, monitor=None,
+        engine=types.SimpleNamespace(state=types.SimpleNamespace(seqs=seqs)))
+    deliver = ServingEngine._deliver.__get__(holder)
+    with pytest.raises(InjectedCrash):
+        deliver({1: [7]}, now=1.0)
+
+    # ordinary failure: the sink is dropped, delivery continues
+    def broken_stream(req, toks, now):
+        raise ValueError("closed socket")
+
+    req2 = ServingRequest(uid=2, prompt=[1], max_new_tokens=4,
+                          arrival_ts=0.0, stream=broken_stream)
+    req2.to(RequestState.PREFILL, 0.0)
+    holder2 = types.SimpleNamespace(
+        _active={2: req2}, metrics=None, stats=None, monitor=None,
+        engine=types.SimpleNamespace(state=types.SimpleNamespace(seqs={})))
+    ServingEngine._deliver.__get__(holder2)({2: [7]}, now=1.0)
+    assert req2.stream is None, "broken ordinary sink must be dropped"
+    assert req2.tokens[-1] == 7, "delivery itself must succeed"

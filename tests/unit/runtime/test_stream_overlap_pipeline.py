@@ -193,7 +193,14 @@ def test_serialized_probe_loss_parity_with_pipelined():
 def test_load_state_mismatch_probe_resyncs(tmp_path):
     """Same-shaped host_opt_group*.npz from a DIFFERENT run must not
     silently revert params: load_checkpoint probes master-vs-params after a
-    successful load_state and resyncs (moments zeroed) on mismatch."""
+    successful load_state and resyncs (moments zeroed) on mismatch.
+
+    Since the r8 crc manifest, a bare file swap is caught EARLIER (manifest
+    verification fails and the tag is not loadable), so the adversary here
+    must be manifest-consistent: the wrong-run files arrive with a
+    re-written manifest (an operator "restoring" files from another run and
+    refreshing checksums, or a pre-manifest-era checkpoint).  Checksums
+    then pass — only the semantic probe can catch the mismatch."""
     b = _batch()
     e1 = _engine()
     for _ in range(3):
@@ -206,6 +213,8 @@ def test_load_state_mismatch_probe_resyncs(tmp_path):
     import shutil
     for f in (tmp_path / "b" / "t").glob("host_opt_group*.npz"):
         shutil.copy(f, tmp_path / "a" / "t" / f.name)
+    from deepspeed_tpu.resilience import atomic_io
+    atomic_io.write_manifest(str(tmp_path / "a" / "t"), site=None)
     e2 = _engine()
     e2.train_batch(batch=b)  # materialize
     e2.load_checkpoint(tmp_path / "a", tag="t")
