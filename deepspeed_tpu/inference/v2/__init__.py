@@ -1,5 +1,7 @@
 from .ragged import (BlockedAllocator, BlockedKVCache, RaggedBatch, SequenceDescriptor,  # noqa: F401
                      StateManager)
 from .scheduler import SchedulerConfig, SplitFuseScheduler, StepPlan  # noqa: F401
+from .spec import (DRAFTERS, DraftProvider, NGramDrafter, SpecConfig,  # noqa: F401
+                   SpecStats, make_drafter)
 from .engine_v2 import (InferenceEngineV2, RaggedInferenceEngineConfig,  # noqa: F401
                         build_engine, compile_aot_serving)

@@ -32,6 +32,7 @@ from ...models.llama_cache import LlamaForCausalLMWithCache, PagedKVConfig, init
 from ...utils.logging import logger
 from .ragged import BlockedKVCache, RaggedBatch, StateManager
 from .scheduler import SchedulerConfig, SplitFuseScheduler, StepPlan
+from .spec import SpecConfig, SpecStats, make_drafter
 
 
 def build_cache_model(cfg, page_size: int):
@@ -86,6 +87,13 @@ class RaggedInferenceEngineConfig:
     # down_proj allreduces AutoTP hand-wires.  An explicit ``mesh=`` to the
     # engine takes precedence over this degree.
     tensor_parallel: int = 1
+    # speculative decoding (spec/): a drafter proposes up to k tokens per
+    # pure-decode round and ONE (k+1)-position verify dispatch emits
+    # accepted+1 of them, greedy-parity by construction.  Greedy only; on
+    # pure-decode rounds speculation takes precedence over the fused
+    # multi-step rung (which stays the fallback when no row drafts or KV
+    # pages are short).  None disables.
+    spec: Optional[SpecConfig] = None
 
 
 def _make_step_fn(model, qparams, greedy: bool, temperature: float):
@@ -174,6 +182,29 @@ class InferenceEngineV2:
     def __init__(self, cfg: LlamaConfig, params, engine_config: RaggedInferenceEngineConfig = None,
                  rng: Optional[jax.Array] = None, mesh=None):
         self.econfig = engine_config or RaggedInferenceEngineConfig()
+        # speculative decoding: greedy-only (the accept rule is an argmax
+        # identity — under sampling, emitted tokens would need the full
+        # rejection-sampling correction, not implemented), and the verify
+        # slots must be charged against the scheduler's token budget
+        if self.econfig.spec is not None and not self.econfig.greedy:
+            logger.warning("spec decoding requires greedy sampling "
+                           "(accept-longest-prefix parity is an argmax identity); "
+                           "disabling speculation")
+            self.econfig = dataclasses.replace(self.econfig, spec=None)
+        if self.econfig.spec is not None and \
+                self.econfig.scheduler.spec_verify_tokens == 0:
+            self.econfig = dataclasses.replace(
+                self.econfig, scheduler=dataclasses.replace(
+                    self.econfig.scheduler,
+                    spec_verify_tokens=self.econfig.spec.max_draft))
+        self.drafter = (make_drafter(self.econfig.spec)
+                        if self.econfig.spec is not None else None)
+        self.spec_stats = SpecStats()
+        # uid -> (proposed, accepted, rollback_pages) of the LAST step's
+        # verify round (cleared every step): the serving frontend folds
+        # these into per-request acceptance accounting and metrics
+        self.last_spec_round: Dict[int, Tuple[int, int, int]] = {}
+        self._spec_on: Dict[int, bool] = {}
         kvcfg = self.econfig.kv
         from ..quantization import QuantizedParams
         self.mesh = self._resolve_mesh(mesh)
@@ -310,6 +341,8 @@ class InferenceEngineV2:
     def flush(self, uid: int) -> None:
         self.state.flush(uid)
         self._max_new.pop(uid, None)
+        self._spec_on.pop(uid, None)
+        self.last_spec_round.pop(uid, None)
 
     def preempt(self, uid: int):
         """Evict one sequence under KV pressure (serving frontend): pages
@@ -317,7 +350,17 @@ class InferenceEngineV2:
         Unlike ``flush`` the uid must exist — preempting a finished/unknown
         sequence is a frontend bug, not a no-op."""
         self._max_new.pop(uid, None)
+        self._spec_on.pop(uid, None)
+        self.last_spec_round.pop(uid, None)
         return self.state.preempt(uid)
+
+    def set_spec(self, uid: int, enabled: bool) -> None:
+        """Per-sequence speculation opt-in/out (the serving frontend's
+        per-request control).  No-op when the engine carries no spec
+        config — a request asking for speculation on a spec-less engine
+        just decodes normally."""
+        if self.econfig.spec is not None:
+            self._spec_on[uid] = bool(enabled)
 
     def single_step_page_demand(self, plan: Optional[StepPlan] = None) -> int:
         """KV pages the NEXT step needs beyond what its sequences hold, at
@@ -391,13 +434,177 @@ class InferenceEngineV2:
             self._step_fns[key] = jax.jit(mstep, donate_argnums=(1, ), **self._jit_kwargs())
         return self._step_fns[key]
 
+    def _compiled_verify(self, batch: int, width: int):
+        """The speculative VERIFY program: ONE chunked forward over
+        ``width = max_draft + 1`` positions per row, returning the argmax
+        at EVERY position (the model's own next-token choice after each
+        fed prefix) instead of a single last-token sample.  Shorter drafts
+        ride as ragged rows via ``chunk_lens`` — KV writes and attention
+        mask at the per-row length, exactly like ragged prefill chunks —
+        so steady-state serving keeps ONE verify program per batch
+        bucket."""
+        key = ("verify", batch, width)
+        if key not in self._step_fns:
+            logger.info(f"InferenceEngineV2: compiling verify program batch={batch} "
+                        f"width={width}")
+
+            def vstep(params, cache, tokens, start_pos, block_tables, chunk_lens):
+                if self._qparams is not None:
+                    params = {"params": self._qparams.dequantize(params["params"])}
+                logits, cache = self.model.apply(params, tokens, start_pos,
+                                                 block_tables, cache, chunk_lens)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+            kwargs = {}
+            if self.mesh is not None:
+                r = self._repl_sh
+                kwargs = dict(in_shardings=(self._param_sh, self._cache_sh, r, r, r, r),
+                              out_shardings=(r, self._cache_sh))
+            self._step_fns[key] = jax.jit(vstep, donate_argnums=(1, ), **kwargs)
+        return self._step_fns[key]
+
+    def warm_verify(self, batch_sizes: Sequence[int]) -> None:
+        """Pre-compile the speculative verify program for the given raw
+        batch sizes (bucketed, width pinned at ``max_draft + 1``) by
+        running one ALL-PADDING dispatch per bucket: every row has
+        chunk_len 0 and an all-null block table, so KV writes land in the
+        null scratch page and engine state is untouched.  Serving
+        harnesses call this next to their step-program warmup — drafting
+        is history-dependent, so a short warm generation may never reach a
+        verify round, and the first real one would otherwise pay a
+        multi-second jit inside measured request latency.  No-op without a
+        spec config."""
+        if self.drafter is None:
+            return
+        width = self.econfig.spec.max_draft + 1
+        for b in sorted({self._bucket_batch(n) for n in batch_sizes}):
+            fn = self._compiled_verify(b, width)
+            zeros = jnp.zeros((b, ), jnp.int32)
+            _, self.cache = self._invoke(
+                fn, self.params, self.cache, jnp.zeros((b, width), jnp.int32),
+                zeros, jnp.zeros((b, self.kv.max_pages_per_seq), jnp.int32), zeros)
+
+    def _plan_drafts(self, seqs) -> List[List[int]]:
+        """Draft up to ``max_draft`` tokens per decode row, then shrink
+        under pressure.  Per-row caps keep the verify dispatch feasible by
+        construction: a draft never proposes past the row's ``max_new``
+        limit (emitting ``accepted + 1`` tokens, only ``remaining - 1``
+        drafts can ever be useful), the verify-slot width the scheduler
+        charges (``spec_verify_tokens``), the position table, or its page
+        capacity.  Aggregate demand self-shrinks the same way the fused
+        rung does — halve every draft until the arena can take the round
+        AND the round's total fed tokens (1 + draft per row) fit the
+        SplitFuse ``token_budget`` — so the KV-pressure preflight's k=1
+        guarantee still holds when every draft reaches zero."""
+        spec = self.econfig.spec
+        sched = self.econfig.scheduler
+        width = min(spec.max_draft, sched.spec_verify_tokens or spec.max_draft)
+        cap = min(self.kv.max_pages_per_seq * self.kv.page_size,
+                  getattr(self.cfg, "max_position_embeddings", None) or (1 << 30))
+        drafts: List[List[int]] = []
+        for s in seqs:
+            if not self._spec_on.get(s.uid, True):
+                drafts.append([])
+                continue
+            limit = self._max_new.get(s.uid, self.econfig.max_new_tokens)
+            room = min(width, limit - len(s.generated) - 1,
+                       cap - len(s.tokens))
+            drafts.append(self.drafter.draft(s.tokens, room) if room > 0 else [])
+        while any(drafts) and (
+                sum(1 + len(d) for d in drafts) > sched.token_budget or
+                sum(self.kv.pages_needed(s, 1 + len(d)) for s, d in zip(seqs, drafts))
+                > self.kv.allocator.free_pages):
+            drafts = [d[:len(d) // 2] for d in drafts]
+        return drafts
+
+    def _spec_decode(self, seqs, drafts: List[List[int]]) -> Dict[int, List[int]]:
+        """One draft-verify round for a pure-decode batch: feed
+        ``[last_sampled, draft_0 .. draft_{d-1}]`` per row through the
+        verify program, accept the longest prefix of drafts matching the
+        model's per-position argmax host-side, emit ``accepted + 1``
+        tokens (the argmax after the last accepted draft rides along as
+        the bonus/correction token), and roll rejected tokens' KV back
+        via ``StateManager.truncate``.  Greedy outputs are byte-identical
+        to non-speculative decode by construction — every emitted token
+        IS the model's argmax given the exact accepted history."""
+        from ...resilience import fault_injection as _fi
+        width = self.econfig.spec.max_draft + 1
+        batch = self._bucket_batch(len(seqs))
+        base_len = [len(s.tokens) for s in seqs]
+        # drafts ride in the token history for pack() (sliced back out
+        # below — they are verify INPUTS, not accepted output)
+        for s, d in zip(seqs, drafts):
+            s.tokens.extend(d)
+        try:
+            rb: RaggedBatch = self.state.pack([(s, 1 + len(d)) for s, d in zip(seqs, drafts)],
+                                              width, pad_to=batch)
+            fn = self._compiled_verify(batch, width)
+            _fi.check("engine.verify_step")  # chaos site: device loss mid-verify
+            argmax, self.cache = self._invoke(fn, self.params, self.cache,
+                                              jnp.asarray(rb.tokens), jnp.asarray(rb.start_pos),
+                                              jnp.asarray(rb.block_tables),
+                                              jnp.asarray(rb.chunk_lens))
+        except BaseException:
+            # a failed verify dispatch must never bake unverified drafts
+            # into the history: restore every row's token list so a caller
+            # that survives the error (chaos drill, retry layer) decodes
+            # from exactly the pre-round state.  seen_tokens/pages were not
+            # advanced yet; extra pages pack() allocated are plain capacity
+            # the next round reuses.
+            for s, L in zip(seqs, base_len):
+                del s.tokens[L:]
+            raise
+        argmax = np.asarray(argmax)
+
+        out: Dict[int, List[int]] = {}
+        eos = self.econfig.eos_token_id
+        self.spec_stats.rounds += 1
+        for i, (s, d) in enumerate(zip(seqs, drafts)):
+            L = base_len[i]
+            s.seen_tokens += 1 + len(d)
+            # g[j] = the model's choice for history index L+j given the
+            # prefix through index L-1+j; draft j (at index L+j) is
+            # accepted iff it equals g[j]
+            g = [int(t) for t in argmax[i, :1 + len(d)]]
+            a = 0
+            while a < len(d) and d[a] == g[a]:
+                a += 1
+            del s.tokens[L:]
+            before = len(s.generated)
+            limit = self._max_new.get(s.uid, self.econfig.max_new_tokens)
+            for t in d[:a] + [g[a]]:
+                s.tokens.append(int(t))
+                s.generated.append(int(t))
+                if len(s.generated) >= limit or (eos is not None and int(t) == eos):
+                    s.done = True
+                    break
+            # rollback: rejected drafts' KV lies past the accepted
+            # boundary — clamp seen_tokens and return wholly-surplus pages
+            # to the arena THIS step (free capacity is visible to the next
+            # preflight immediately, not at sequence death)
+            freed = self.state.truncate(s, min(L + a, len(s.tokens)))
+            self.state.note_progress(s)
+            out[s.uid] = list(s.generated[before:])
+            self.spec_stats.proposed += len(d)
+            self.spec_stats.accepted += a
+            self.spec_stats.emitted += len(out[s.uid])
+            self.spec_stats.rollback_pages += freed
+            self.last_spec_round[s.uid] = (len(d), a, freed)
+        return out
+
     def _multi_decode(self, seqs, k: int) -> Dict[int, List[int]]:
         """Run ``k`` fused decode rounds for a pure-decode batch."""
         batch = self._bucket_batch(len(seqs))
         for s in seqs:
             # capacity for the WHOLE block up front; pack()'s per-token
-            # ensure_capacity then finds nothing left to allocate
-            self.kv.ensure_capacity(s, k)
+            # ensure_capacity then finds nothing left to allocate.  Capped
+            # at the row's remaining max_new budget: a short-tail row keeps
+            # at most `remaining` of the k tokens, and KV writes past its
+            # reservation land in the null scratch page — reserving the
+            # full k would over-allocate pages the row can never use
+            remaining = self._max_new.get(s.uid, self.econfig.max_new_tokens) \
+                - len(s.generated)
+            self.kv.ensure_capacity(s, min(k, remaining))
         rb: RaggedBatch = self.state.pack([(s, 1) for s in seqs], 1, pad_to=batch)
 
         self.rng, sub = jax.random.split(self.rng)
@@ -418,11 +625,13 @@ class InferenceEngineV2:
                 s.generated.append(int(t))
                 if len(s.generated) >= limit or (eos is not None and int(t) == eos):
                     # surplus tokens computed past EOS/limit are discarded;
-                    # the KV written for them lies beyond the clamped seen
-                    # boundary and is released with the sequence
+                    # truncate() clamps the seen boundary past them AND
+                    # returns their wholly-surplus KV pages to the arena
+                    # this step (visible to the next KV-pressure preflight
+                    # immediately — not held until the sequence dies)
                     s.done = True
                     break
-            s.seen_tokens = min(s.seen_tokens, len(s.tokens))
+            self.state.truncate(s, len(s.tokens))
             self.state.note_progress(s)
             out[s.uid] = list(s.generated[before:])
         return out
@@ -440,6 +649,19 @@ class InferenceEngineV2:
         it must have been computed against the CURRENT state."""
         if plan is None:
             plan = self.scheduler.plan(self.state)
+        # per-step spec accounting: entries describe THIS step's verify
+        # round only (the serving frontend reads them right after step())
+        self.last_spec_round.clear()
+        if self.drafter is not None and plan.decode and not plan.prefill:
+            # speculation outranks the fused rung on pure-decode rounds: a
+            # round with any non-empty draft emits accepted+1 tokens per
+            # drafting row for ONE dispatch.  When no row drafts (cold
+            # history, per-request opt-out, page pressure shrank every
+            # draft to zero) fall through to the fused/single-step rungs —
+            # a drained-draft round must still make k=1 progress.
+            drafts = self._plan_drafts(plan.decode)
+            if any(drafts):
+                return self._spec_decode(plan.decode, drafts)
         k_cfg = self.econfig.decode_steps_per_dispatch
         if k_cfg > 1 and plan.decode and not plan.prefill:
             # OVERSHOOT policy (r4): always run the full k rung and discard

@@ -274,6 +274,26 @@ class BlockedKVCache:
         self.allocator.free(seq.pages)
         seq.pages = []
 
+    def release_tail(self, seq: SequenceDescriptor, keep_pages: int) -> int:
+        """Return ``seq``'s pages past the first ``keep_pages`` to the
+        allocator (speculative-decode rollback; EOS/limit mid-rung surplus).
+        The freed capacity is visible to ``allocator.free_pages`` — and so
+        to ``single_step_page_demand`` preflights — the same step.
+
+        Pages the sequence already published to the prefix cache are never
+        released here, whatever ``keep_pages`` says: ``register()``'s
+        cursor (``pc_pages``) indexes into ``seq.pages``, so dropping a
+        published page would shift every later index under the cursor.
+        Callers only roll back past the seen/accepted boundary and the
+        cache only holds FULL pages below it, so the clamp is a guard, not
+        a policy.  Returns how many pages were freed."""
+        keep = max(int(keep_pages), seq.pc_pages)
+        tail = seq.pages[keep:]
+        if tail:
+            self.allocator.free(tail)
+            del seq.pages[keep:]
+        return len(tail)
+
 
 @dataclasses.dataclass
 class RaggedBatch:
@@ -318,6 +338,20 @@ class StateManager:
         full pages to the prefix cache."""
         if self.kv.prefix_cache is not None:
             self.kv.prefix_cache.register(seq)
+
+    def truncate(self, seq: SequenceDescriptor, n_tokens: int) -> int:
+        """Drop KV state past the first ``n_tokens`` of ``seq``'s history:
+        clamp ``seen_tokens`` and release wholly-surplus tail pages
+        (:meth:`BlockedKVCache.release_tail`).  The paged-KV rollback
+        primitive behind speculative decoding (rejected drafts' pages) and
+        the fused-decode EOS/limit surplus fix — KV entries beyond the
+        clamped boundary inside the retained trailing page are never
+        attended (the kernels mask at ``start_pos``) and are overwritten
+        by the next step's writes at those positions.  Returns pages
+        freed."""
+        seq.seen_tokens = min(seq.seen_tokens, int(n_tokens))
+        keep = -(-int(n_tokens) // self.kv.page_size)   # ceil
+        return self.kv.release_tail(seq, keep)
 
     def flush(self, uid: int) -> None:
         """Release a sequence's KV + state (ref: engine_v2.py flush)."""

@@ -24,6 +24,16 @@ class SchedulerConfig:
     max_seqs: int = 64                 # ref: max ragged sequence count
     prefill_chunk: int = 128           # SplitFuse chunk quantum
     decode_bucket: int = 8             # decode batch rounds up to a multiple
+    # speculative decoding (engine_v2 sets this from SpecConfig.max_draft):
+    # the verify-slot width a speculating decode row may grow to.  Verify
+    # rounds run ONLY on pure-decode steps (no prefill planned), so plan()
+    # keeps charging mixed steps 1 token per bucketed decode row — charging
+    # 1+k there would throttle prefill for verify work that cannot happen.
+    # The budget is enforced where verify slots are actually planned:
+    # engine_v2._plan_drafts caps each row's draft at this width and
+    # shrinks the round until its total fed tokens (1 + draft per row) fit
+    # token_budget.
+    spec_verify_tokens: int = 0
 
 
 @dataclasses.dataclass

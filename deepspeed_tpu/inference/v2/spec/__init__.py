@@ -1,0 +1,142 @@
+"""Speculative decoding for the SplitFuse hot path: draft k, verify once.
+
+Reference: draft-verify speculative decoding (Leviathan et al. 2023) and
+SpecInfer-style multi-token verification, specialized to the v2 engine's
+paged-KV serving stack.  The decode hot path is one model dispatch per
+emitted token; with a drafter proposing ``k`` tokens per pure-decode round
+the engine instead runs ONE verify forward over ``k+1`` positions per row
+and emits ``accepted + 1`` tokens:
+
+* the VERIFY step feeds ``[last_sampled, draft_0 .. draft_{k-1}]`` through
+  the same chunked forward that serves prefill (the KV for every fed
+  position is written as a side effect) and returns the argmax at EVERY
+  position — the model's own next-token choice after each fed prefix;
+* the ACCEPT rule is host-side longest-prefix: draft token ``i`` is
+  accepted iff it equals the argmax at position ``i``; the argmax at the
+  last accepted position rides along as the bonus/correction token.
+  Greedy outputs are therefore byte-identical to non-speculative decode
+  *by construction* — every emitted token IS the model's argmax given the
+  exact accepted history;
+* ROLLBACK is host-side accounting: rejected drafts were fed as inputs
+  only (never appended to the sequence's token history), so the engine
+  clamps ``seen_tokens`` to the accepted boundary and releases
+  wholly-surplus KV pages back to the arena
+  (``StateManager.truncate`` / ``BlockedKVCache.release_tail``).  Stale KV
+  entries inside the retained trailing page sit beyond the clamped seen
+  boundary, are never attended (attention masks at ``start_pos``), and are
+  overwritten by the next round's writes at those positions.
+
+The default drafter is a deterministic n-gram / prompt-lookup scan over
+the request's OWN token history (prompt + generated): no second model, no
+device work, works on the CPU tier-1 suite.  Drafters are pluggable via
+:data:`DRAFTERS` — a small draft model would slot in behind the same
+``DraftProvider.draft`` contract.
+"""
+
+import dataclasses
+from typing import Dict, List, Protocol, Sequence, Type
+
+__all__ = ["SpecConfig", "SpecStats", "DraftProvider", "NGramDrafter",
+           "DRAFTERS", "make_drafter"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    """Engine-level speculative-decoding configuration
+    (``RaggedInferenceEngineConfig.spec``; None disables speculation).
+
+    ``max_draft`` is the ``k`` of the verify program's ``(batch, k+1)``
+    bucketing: every verify dispatch compiles at width ``k+1`` and shorter
+    drafts ride as ragged rows (``chunk_lens``), so steady-state serving
+    keeps ONE verify program per batch bucket."""
+    max_draft: int = 4          # k: tokens drafted per pure-decode round
+    drafter: str = "ngram"      # DRAFTERS registry key
+    max_ngram: int = 3          # longest suffix n-gram tried first
+    min_ngram: int = 1          # shortest suffix n-gram worth matching
+
+    def __post_init__(self):
+        if self.max_draft < 1:
+            raise ValueError(f"spec.max_draft must be >= 1, got {self.max_draft}")
+        if not (1 <= self.min_ngram <= self.max_ngram):
+            raise ValueError(f"spec n-gram bounds need 1 <= min_ngram <= max_ngram, "
+                             f"got [{self.min_ngram}, {self.max_ngram}]")
+
+
+@dataclasses.dataclass
+class SpecStats:
+    """Engine-lifetime speculation counters (``engine.spec_stats``)."""
+    rounds: int = 0             # verify dispatches run
+    proposed: int = 0           # draft tokens fed to verify steps
+    accepted: int = 0           # draft tokens accepted (bonus tokens excluded)
+    emitted: int = 0            # tokens emitted by verify steps (accepted + bonus)
+    rollback_pages: int = 0     # KV pages released by post-verify truncation
+
+    @property
+    def acceptance_rate(self):
+        """Accepted / proposed over the engine's lifetime; None before the
+        first draft."""
+        return self.accepted / self.proposed if self.proposed else None
+
+
+class DraftProvider(Protocol):
+    """The drafter contract: propose up to ``max_tokens`` continuation
+    tokens for a sequence whose full history (prompt + generated) is
+    ``tokens``.  MUST be deterministic in ``tokens`` — the scheduler may
+    re-draft the same history after a preemption/failover and greedy
+    replay must converge to identical outputs.  Returning ``[]`` opts the
+    row out of this round's speculation (it rides the verify dispatch as a
+    plain 1-token decode row)."""
+
+    def draft(self, tokens: Sequence[int], max_tokens: int) -> List[int]:
+        ...
+
+
+class NGramDrafter:
+    """Deterministic prompt-lookup drafting: find the most recent earlier
+    occurrence of the history's trailing n-gram (longest n first) and
+    propose the tokens that followed it.
+
+    Rationale: serving traffic — and small greedy models — repeat
+    themselves (copied spans, looping continuations, templated output);
+    the sequence's own history is a free draft model with zero device
+    cost.  O(max_ngram * len(tokens)) per call via a right-to-left scan
+    guarded on the first suffix token, so the common non-matching
+    position costs one int compare, not a slice; history lengths are
+    bounded by ``max_pages_per_seq * page_size``, so the host-side cost
+    stays far below one model dispatch.  (The production upgrade for
+    very long histories is a per-sequence incremental n-gram→position
+    index, O(max_ngram) per appended token — see ROADMAP.)"""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if not (1 <= min_ngram <= max_ngram):
+            raise ValueError(f"need 1 <= min_ngram <= max_ngram, "
+                             f"got [{min_ngram}, {max_ngram}]")
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def draft(self, tokens: Sequence[int], max_tokens: int) -> List[int]:
+        L = len(tokens)
+        if max_tokens <= 0 or L < self.min_ngram + 1:
+            return []
+        toks = tokens if isinstance(tokens, list) else list(tokens)
+        for n in range(min(self.max_ngram, L - 1), self.min_ngram - 1, -1):
+            suffix = toks[L - n:]
+            first = suffix[0]
+            # most recent occurrence strictly before the suffix itself, so
+            # the continuation exists and the match can't be the suffix
+            for i in range(L - n - 1, -1, -1):
+                if toks[i] == first and toks[i:i + n] == suffix:
+                    return [int(t) for t in toks[i + n:i + n + max_tokens]]
+        return []
+
+
+#: pluggable drafter registry (SpecConfig.drafter selects by key)
+DRAFTERS: Dict[str, Type] = {"ngram": NGramDrafter}
+
+
+def make_drafter(config: SpecConfig) -> DraftProvider:
+    cls = DRAFTERS.get(config.drafter)
+    if cls is None:
+        raise ValueError(f"unknown drafter '{config.drafter}'; "
+                         f"registered: {sorted(DRAFTERS)}")
+    return cls(max_ngram=config.max_ngram, min_ngram=config.min_ngram)

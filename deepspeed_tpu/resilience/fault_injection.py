@@ -80,6 +80,7 @@ INJECTION_SITES = frozenset({
     "swap.write",           # NVMe/disk swap write issue (ops/aio)
     "swap.read",            # NVMe/disk swap read issue
     "engine.step",          # training-step dispatch (runtime/engine.py)
+    "engine.verify_step",   # speculative verify dispatch (inference/v2/engine_v2.py)
     "serving.admit",        # serving request admission (serving/engine.py)
     "router.dispatch",      # fleet router request dispatch (serving/fleet/router.py)
 })

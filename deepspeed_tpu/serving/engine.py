@@ -141,7 +141,8 @@ class ServingEngine:
                priority: float = 0.0, stream: Optional[Callable] = None,
                retry_policy=None, resume_tokens: Optional[Sequence[int]] = None,
                trace_id: Optional[int] = None,
-               parent_span_id: Optional[int] = None) -> ServingRequest:
+               parent_span_id: Optional[int] = None,
+               spec: Optional[bool] = None) -> ServingRequest:
         """Enqueue one request.  NEVER raises on overload: the returned
         request's state is REJECTED (with ``reject_reason``) when admission
         refuses it — callers inspect, the serving loop keeps running.
@@ -158,6 +159,13 @@ class ServingEngine:
         A fleet router passes its client trace id plus the per-replica
         attempt span so this request's phase spans land in the CLIENT's
         trace; standalone, a fresh trace id is allocated per request.
+
+        ``spec``: per-request speculative-decoding control — ``False``
+        opts this request out of an engine-level ``SpecConfig`` (it rides
+        verify rounds as a plain 1-token row), ``True``/``None`` keep the
+        engine default.  On a spec-less engine the flag is a no-op.
+        Acceptance lands on ``req.spec_proposed/spec_accepted`` and the
+        ``spec/*`` metrics as the request decodes.
 
         ``retry_policy`` (a resilience ``RetryPolicy``): back off on the
         clock and re-probe admission while the rejection is TRANSIENT
@@ -184,7 +192,7 @@ class ServingEngine:
         req = ServingRequest(
             uid=uid, prompt=list(prompt), arrival_ts=now,
             max_new_tokens=max_new_tokens,
-            deadline=deadline, priority=priority, stream=stream)
+            deadline=deadline, priority=priority, stream=stream, spec=spec)
         if resume_tokens:
             if len(resume_tokens) >= max_new_tokens:
                 raise ValueError(
@@ -263,8 +271,31 @@ class ServingEngine:
         dt = charged if charged is not None else self.clock.now() - t_step
         self._ewma_step_s = dt if self._ewma_step_s is None \
             else 0.8 * self._ewma_step_s + 0.2 * dt
+        # fold BEFORE _deliver: finishing a request flushes its engine
+        # sequence, which pops its last_spec_round entry
+        self._record_spec_rounds()
         self._deliver(out, self.clock.now())
         return out
+
+    def _record_spec_rounds(self) -> None:
+        """Fold the step's verify-round accounting (``engine.last_spec_round``,
+        one ``(proposed, accepted, rollback_pages)`` per speculating uid)
+        into per-request counters and the ``spec/*`` metrics."""
+        rounds = getattr(self.engine, "last_spec_round", None)
+        if not rounds:
+            return
+        for uid, (proposed, accepted, rb_pages) in rounds.items():
+            req = self._active.get(uid)
+            if req is not None:
+                req.spec_proposed += proposed
+                req.spec_accepted += accepted
+                req.spec_rollback_pages += rb_pages
+            if self.metrics is not None and proposed:
+                self.metrics.counter("spec/proposed").inc(proposed)
+                self.metrics.counter("spec/accepted").inc(accepted)
+                self.metrics.counter("spec/rollback_pages").inc(rb_pages)
+                self.metrics.histogram("spec/acceptance_rate").record(
+                    accepted / proposed)
 
     def _expire(self, now: float) -> None:
         if not self.config.kill_on_deadline:
@@ -296,6 +327,10 @@ class ServingEngine:
                 "collision) — cannot admit")
             self.engine.put([req.uid], [req.engine_tokens()],
                             max_new_tokens=req.remaining_new_tokens)
+            if req.spec is not None:
+                # re-applied on every (re)admission: preemption/flush
+                # cleared the engine's per-uid opt-out
+                self.engine.set_spec(req.uid, req.spec)
             if req.admitted_ts is None:
                 req.admitted_ts = now
             req.to(RequestState.PREFILL, now)

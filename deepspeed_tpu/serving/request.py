@@ -76,6 +76,14 @@ class ServingRequest:
     preemptions: int = 0
     reject_reason: Optional[str] = None
     history: List[Tuple[RequestState, float]] = dataclasses.field(default_factory=list)
+    # speculative decoding (inference/v2/spec): per-request opt-in/out
+    # (None = the engine's default — on whenever the engine carries a
+    # SpecConfig) and lifetime acceptance accounting, folded in from
+    # ``engine.last_spec_round`` each tick this request speculated
+    spec: Optional[bool] = None
+    spec_proposed: int = 0            # draft tokens fed to verify dispatches
+    spec_accepted: int = 0            # drafts the model's argmax confirmed
+    spec_rollback_pages: int = 0      # KV pages rolled back for rejected drafts
 
     def __post_init__(self):
         self.prompt = list(self.prompt)
@@ -93,6 +101,14 @@ class ServingRequest:
     @property
     def remaining_new_tokens(self) -> int:
         return max(0, self.max_new_tokens - len(self.tokens))
+
+    @property
+    def spec_acceptance(self) -> Optional[float]:
+        """Accepted / proposed draft tokens over this request's lifetime;
+        None if it never speculated (spec off, or no draftable history)."""
+        if not self.spec_proposed:
+            return None
+        return self.spec_accepted / self.spec_proposed
 
     @property
     def ttft(self) -> Optional[float]:

@@ -79,6 +79,16 @@ EVENTS = {
                        "time per output token, DONE requests"),
     "serving/queue_wait_s": ("histogram", "serving/engine.py",
                              "admission-queue wait, DONE requests"),
+    # ---- speculative decoding (serving/engine.py folding
+    #      inference/v2/engine_v2.py last_spec_round)
+    "spec/proposed": ("counter", "serving/engine.py",
+                      "draft tokens fed to verify dispatches"),
+    "spec/accepted": ("counter", "serving/engine.py",
+                      "draft tokens the verify argmax confirmed"),
+    "spec/rollback_pages": ("counter", "serving/engine.py",
+                            "KV pages released rolling back rejected drafts"),
+    "spec/acceptance_rate": ("histogram", "serving/engine.py",
+                             "per-verify-round accepted/proposed ratio"),
     # ---- fleet router (serving/fleet/)
     "fleet/dispatch": ("event", "serving/fleet/router.py",
                        "request placed on a replica (value = rid)"),

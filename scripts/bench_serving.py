@@ -24,9 +24,10 @@ Two clock modes:
             signal, absolute numbers are not.
   default   the 125M bench model on the local accelerator, WallClock.
 
-Writes BENCH_SERVING.json (schema v2 — scripts/check_bench_schema.py
+Writes BENCH_SERVING.json (schema v3 — scripts/check_bench_schema.py
 validates it; ``bench_inference.py``'s raw-throughput record rides in the
-``engine_throughput`` section) and prints one JSON line.
+``engine_throughput`` section; the ``spec`` section is the speculative-
+decoding spec-on/spec-off comparison pair) and prints one JSON line.
 """
 
 import argparse
@@ -70,13 +71,15 @@ def _build_engine(dryrun: bool):
     model = LlamaForCausalLM(cfg)
     params = jax.jit(model.init)(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
 
-    def make():
+    def make(spec=None):
         # decode_steps_per_dispatch=1: the SLA bench measures PER-TOKEN
         # latency; the fused k-step dispatch would quantize token delivery
-        # to k-sized bursts and blur TPOT
+        # to k-sized bursts and blur TPOT.  ``spec`` (a SpecConfig) turns
+        # on draft-verify speculative decoding for the spec-on/spec-off
+        # comparison pair.
         return build_engine(cfg, params, RaggedInferenceEngineConfig(
             kv=kv, scheduler=sched, kv_dtype=cfg.dtype,
-            decode_steps_per_dispatch=1))
+            decode_steps_per_dispatch=1, spec=spec))
     return make, cfg, kv, sched
 
 
@@ -106,6 +109,9 @@ def _warm(eng, max_seqs):
     and irrelevant under the virtual clock."""
     eng.generate([[1, 2, 3]], max_new_tokens=2)
     eng.generate([[1, 2, 3]] * max_seqs, max_new_tokens=2)
+    # spec engines: the verify program too (drafting is history-dependent,
+    # so the tiny warm generations above never reach a verify round)
+    eng.warm_verify([1, max_seqs])
 
 
 def run_open_loop(make_engine, clock_factory, arrivals, rate, max_queue_depth=256,
@@ -134,6 +140,68 @@ def run_open_loop(make_engine, clock_factory, arrivals, rate, max_queue_depth=25
         print(f"# trace: {len(tracer.spans)} spans -> {trace_path} "
               f"(scripts/trace_report.py folds it)", flush=True)
     return rec
+
+
+def run_spec_pair(make_engine, clock_factory, arrivals, rate, max_queue_depth,
+                  dryrun, max_draft=4):
+    """Speculative-decoding receipt: the SAME workload served spec-off and
+    spec-on (n-gram drafter, ONE (k+1)-wide verify dispatch per pure-decode
+    round), with greedy parity checked request-by-request.  Under the
+    deterministic --dryrun clock parity is ASSERTED — byte-identical token
+    streams for every request is the accept-longest-prefix contract, not a
+    statistical claim — and the TPOT columns show what acceptance buys at
+    equal goodput (same completions, same deadline hits)."""
+    from deepspeed_tpu.inference.v2 import SpecConfig
+    from deepspeed_tpu.serving import AdmissionConfig, ServingConfig, ServingEngine
+    spec_cfg = SpecConfig(max_draft=max_draft)
+    recs, outputs = {}, {}
+    for label, cfg in (("off", None), ("on", spec_cfg)):
+        eng = make_engine(cfg)
+        _warm(eng, eng.econfig.scheduler.max_seqs)
+        serve = ServingEngine(eng, clock=clock_factory(),
+                              config=ServingConfig(
+                                  admission=AdmissionConfig(max_queue_depth=max_queue_depth)))
+        reqs = serve.run(arrivals)
+        rec = serve.stats.summary(elapsed=serve.clock.now())
+        rec["arrival_rate"] = rate
+        rec["offered_rps"] = round(len(arrivals) / max(arrivals[-1]["arrival_ts"], 1e-9), 6)
+        outputs[label] = [(r.state.value, list(r.tokens)) for r in reqs]
+        if label == "on":
+            st = eng.spec_stats
+            rec["spec_rounds"] = st.rounds
+            rec["proposed"] = st.proposed
+            rec["accepted"] = st.accepted
+            rec["rollback_pages"] = st.rollback_pages
+        recs[label] = rec
+    # greedy_parity is a DECODING claim, so it compares token streams of
+    # requests that reached DONE in both runs: on a wall clock, deadline
+    # kills are timing noise (a request can time out in one run and finish
+    # in the other) and must not report a spec regression.  The dryrun's
+    # deterministic virtual clock has no such noise — there the strict
+    # contract (identical state AND tokens for every request) is asserted.
+    done_both = [i for i, (a, b) in enumerate(zip(outputs["on"], outputs["off"]))
+                 if a[0] == "done" and b[0] == "done"]
+    parity = bool(done_both) and all(
+        outputs["on"][i][1] == outputs["off"][i][1] for i in done_both)
+    if dryrun:
+        assert outputs["on"] == outputs["off"], (
+            "speculative decoding diverged from greedy baseline: "
+            + str([i for i, (a, b) in enumerate(zip(outputs["on"], outputs["off"]))
+                   if a != b][:5]))
+    st_on = recs["on"]
+    acceptance = (st_on["accepted"] / st_on["proposed"]) if st_on["proposed"] else 0.0
+    return {
+        "arrival_rate": rate,
+        "drafter": spec_cfg.drafter,
+        "max_draft": spec_cfg.max_draft,
+        "greedy_parity": bool(parity),
+        "acceptance_rate": round(acceptance, 6),
+        "proposed": st_on["proposed"],
+        "accepted": st_on["accepted"],
+        "rollback_pages": st_on["rollback_pages"],
+        "off": recs["off"],
+        "on": recs["on"],
+    }
 
 
 def run_closed_loop(make_engine, clock_factory, rng, concurrency, n_requests,
@@ -221,6 +289,19 @@ def main():
               f"timed_out={rec['timed_out']} preemptions={rec['preemptions']} "
               f"goodput={rec['goodput_rps']}", flush=True)
 
+    # spec-on/spec-off column pair at the BUSY (but not overloaded) sweep
+    # point: every request completes in both runs, so the TPOT delta is an
+    # equal-goodput comparison, not a load-shedding artifact
+    spec_rate = rates[1] if len(rates) > 1 else rates[0]
+    rng = np.random.default_rng(args.seed)
+    spec_arrivals = _workload(rng, n_requests, spec_rate, ttft_budget, tpot_budget, vocab)
+    spec_pair = run_spec_pair(make_engine, clock_factory, spec_arrivals, spec_rate,
+                              max_queue_depth, args.dryrun)
+    print(f"# spec pair @rate={spec_rate}: parity={spec_pair['greedy_parity']} "
+          f"acceptance={spec_pair['acceptance_rate']} "
+          f"tpot p50 off={spec_pair['off']['tpot']['p50']} "
+          f"on={spec_pair['on']['tpot']['p50']}", flush=True)
+
     closed = run_closed_loop(make_engine, clock_factory, np.random.default_rng(args.seed + 1),
                              concurrency, n_requests, ttft_budget, tpot_budget, vocab)
 
@@ -241,7 +322,7 @@ def main():
         "metric": "serving_goodput_rps",
         "value": best_goodput,
         "unit": "requests/s" if not args.dryrun else "requests/step",
-        "schema_version": 2,
+        "schema_version": 3,
         "sla": {"ttft_budget": ttft_budget, "tpot_budget": tpot_budget,
                 "kill_on_deadline": True},
         "workload": {"n_requests": n_requests, "seed": args.seed,
@@ -257,6 +338,7 @@ def main():
                                    "prefill_chunk": sched.prefill_chunk,
                                    "decode_bucket": sched.decode_bucket}},
         "sweep": sweep,
+        "spec": spec_pair,
         "closed_loop": closed,
         "engine_throughput": engine_throughput,
     }

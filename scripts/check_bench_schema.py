@@ -97,6 +97,41 @@ def _router_sweep_invariants(v):
         return "no sweep point exercised the kill schedule"
     return None
 
+def _spec_pair(v):
+    """The speculative-decoding receipt (bench_serving.py run_spec_pair):
+    greedy parity must hold, acceptance_rate must be a real ratio, and the
+    spec-on column must not be SLOWER per token than spec-off at equal
+    goodput (same completions, same deadline hits) — a committed artifact
+    where speculation lost is a regression, not a benchmark."""
+    if not isinstance(v, dict):
+        return f"expected spec-pair object, got {type(v).__name__}"
+    for k in ("greedy_parity", "acceptance_rate", "proposed", "accepted",
+              "rollback_pages", "max_draft", "drafter", "off", "on"):
+        if k not in v:
+            return f"missing spec-pair key {k!r}"
+    if v["greedy_parity"] is not True:
+        return "greedy_parity must be true (spec-on output diverged)"
+    ar = v["acceptance_rate"]
+    if not isinstance(ar, (int, float)) or isinstance(ar, bool) or not (0.0 <= ar <= 1.0):
+        return f"acceptance_rate {ar!r} not in [0, 1]"
+    if not (isinstance(v["proposed"], int) and v["proposed"] > 0):
+        return "spec pair proposed no draft tokens — speculation never engaged"
+    errors = []
+    for side in ("off", "on"):
+        _check(v[side], _SWEEP_POINT, f"spec.{side}", errors)
+    if errors:
+        return "; ".join(errors)
+    on, off = v["on"], v["off"]
+    if (on["completed"], on["deadline_met"]) != (off["completed"], off["deadline_met"]):
+        return (f"not an equal-goodput pair: on completed/met "
+                f"{on['completed']}/{on['deadline_met']} vs off "
+                f"{off['completed']}/{off['deadline_met']}")
+    p50_on, p50_off = on["tpot"]["p50"], off["tpot"]["p50"]
+    if p50_on is None or p50_off is None or p50_on > p50_off:
+        return f"spec-on p50 TPOT {p50_on} exceeds spec-off {p50_off}"
+    return None
+
+
 _TERMINAL_STATES = {"done", "timed_out", "rejected"}
 
 
@@ -169,16 +204,17 @@ SCHEMAS = {
                          "?vs_baseline": NUM, "extra": DICT},
     "BENCH_LONGCTX.json": {"metric": STR, "value": NUM, "unit": STR,
                            "?vs_baseline": NUM, "extra": DICT},
-    # the SLA serving harness (scripts/bench_serving.py, schema v2)
+    # the SLA serving harness (scripts/bench_serving.py, schema v3)
     "BENCH_SERVING.json": {
         "metric": STR, "value": NUM, "unit": STR,
-        "schema_version": lambda v: None if v == 2 else f"schema_version {v} != 2",
+        "schema_version": lambda v: None if v == 3 else f"schema_version {v} != 3",
         "sla": {"ttft_budget": NUM, "tpot_budget": NUM, "kill_on_deadline": BOOL},
         "workload": {"n_requests": INT, "seed": INT, "dryrun": BOOL,
                      "virtual_clock": BOOL, "kv": DICT, "scheduler": DICT},
         "sweep": lambda v: (None if isinstance(v, list) and len(v) >= 3
                             else "sweep must cover >= 3 arrival rates"),
         "sweep[]": [_SWEEP_POINT],     # element schema, validated below
+        "spec": _spec_pair,
         "closed_loop": {**{k: v for k, v in _SWEEP_POINT.items()
                            if k not in ("arrival_rate", "offered_rps")},
                         "concurrency": INT},

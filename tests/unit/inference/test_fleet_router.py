@@ -186,6 +186,34 @@ def test_kill_mid_decode_failover_outputs_identical(trained_params, prefix_cache
     assert states == [ReplicaState.DEAD, ReplicaState.RECOVERING, ReplicaState.HEALTHY]
 
 
+@pytest.mark.parametrize("prefix_cache", [True, False])
+def test_kill_mid_decode_failover_with_speculation_identical(trained_params, prefix_cache):
+    """Failover-during-speculation: replicas running draft-verify
+    speculative decoding (r12) are killed mid-decode and their requests
+    displaced to survivors — final outputs still match the spec-OFF golden
+    byte-for-byte (greedy parity survives cross-replica resume), prefix
+    cache on and off."""
+    from deepspeed_tpu.inference.v2 import SpecConfig
+    prompts = [[5, 9, 2, 7, 1], [3, 3, 8, 1], [2, 4, 6, 8, 10, 12], [13, 1, 1, 2]]
+    golden = _factory(trained_params, enable_prefix_cache=prefix_cache)().generate(
+        prompts, max_new_tokens=12)
+    router, pool = _fleet(trained_params, 2, RoundRobinPolicy(),
+                          enable_prefix_cache=prefix_cache,
+                          spec=SpecConfig(max_draft=4))
+    reqs = FleetSimulator(router).run(
+        _arrivals(prompts, max_new=12, spacing=0.5),
+        schedule=[(4.0, "kill", 0), (10.0, "recover", 0)])
+    victims = [r for r in reqs if r.failovers]
+    assert victims, "kill at t=4 displaced nothing — schedule no longer mid-decode"
+    assert [r.state for r in reqs] == [FleetState.DONE] * len(prompts)
+    assert [r.tokens for r in reqs] == golden
+    assert router.summary()["failover"]["unrecovered"] == 0
+    # speculation genuinely engaged somewhere in the fleet
+    proposed = sum(rep.serve.engine.spec_stats.proposed
+                   for rep in pool.replicas.values() if rep.serve is not None)
+    assert proposed > 0
+
+
 def test_fleet_sim_bit_reproducible(trained_params):
     def run_once():
         router, _ = _fleet(trained_params, 2, PrefixAffinityPolicy())

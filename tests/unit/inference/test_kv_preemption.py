@@ -37,6 +37,23 @@ def _decode(kv, state, seq, n=1):
         state.note_progress(seq)
 
 
+def _speculate(kv, state, seq, drafted, accepted, rng):
+    """Host-side analog of one verify round (engine_v2._spec_decode):
+    allocate + mark KV for the (1 + drafted)-token verify block, keep only
+    ``accepted`` drafts plus the bonus token, then roll the rejected tail
+    back via StateManager.truncate — the spec rollback the r12 tentpole
+    adds.  ``accepted <= drafted``."""
+    kv.ensure_capacity(seq, 1 + drafted)          # verify pack() allocation
+    seq.seen_tokens += 1 + drafted                # KV written for the block
+    for _ in range(accepted + 1):                 # accepted drafts + bonus
+        t = int(rng.integers(1, 90))
+        seq.tokens.append(t)
+        seq.generated.append(t)
+    freed = state.truncate(seq, len(seq.tokens))  # reject the rest
+    state.note_progress(seq)
+    return freed
+
+
 def _audit(kv, state):
     """Global page-accounting invariants; returns the rc array."""
     alloc = kv.allocator
@@ -119,6 +136,24 @@ def test_preempt_all_then_cache_evict_returns_arena():
     assert kv.allocator.free_pages == kv.num_pages - 1
 
 
+def test_speculate_reject_all_frees_pages_same_step():
+    """A fully-rejected verify round hands its surplus KV pages straight
+    back to the free list (StateManager.truncate → release_tail): the
+    capacity is visible to the next preflight immediately, not parked
+    until the sequence dies."""
+    kv, state = _mk(prefix_cache=False)
+    rng = np.random.default_rng(0)
+    seq = _prefill(kv, state, 0, list(range(1, PAGE + 1)))   # exactly 1 full page
+    free_before = kv.allocator.free_pages
+    freed = _speculate(kv, state, seq, drafted=2 * PAGE, accepted=0, rng=rng)
+    assert freed == 2                                        # rejected tail pages
+    # only the bonus token survived: 5 tokens = 2 pages held, 1 newly taken
+    assert len(seq.pages) == -(-len(seq.tokens) // PAGE) == 2
+    assert kv.allocator.free_pages == free_before - 1
+    assert seq.seen_tokens == len(seq.tokens)
+    _audit(kv, state)
+
+
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
 @pytest.mark.parametrize("prefix_cache", [True, False])
 def test_property_random_admit_grow_preempt_complete(seed, prefix_cache):
@@ -135,7 +170,8 @@ def test_property_random_admit_grow_preempt_complete(seed, prefix_cache):
     stems = [list(rng.integers(1, 90, 8)) for _ in range(3)]
 
     for _ in range(300):
-        op = rng.choice(["admit", "grow", "preempt", "resume", "complete", "evict"])
+        op = rng.choice(["admit", "grow", "speculate", "preempt", "resume",
+                         "complete", "evict"])
         live = list(state.seqs.values())
         try:
             if op == "admit":
@@ -146,6 +182,10 @@ def test_property_random_admit_grow_preempt_complete(seed, prefix_cache):
             elif op == "grow" and live:
                 seq = live[int(rng.integers(len(live)))]
                 _decode(kv, state, seq, int(rng.integers(1, 4)))
+            elif op == "speculate" and live:
+                seq = live[int(rng.integers(len(live)))]
+                d = int(rng.integers(1, 5))
+                _speculate(kv, state, seq, d, int(rng.integers(0, d + 1)), rng)
             elif op == "preempt" and live:
                 seq = live[int(rng.integers(len(live)))]
                 preempted[seq.uid] = list(seq.tokens)
