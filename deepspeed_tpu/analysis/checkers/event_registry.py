@@ -21,10 +21,10 @@ from typing import Dict, List, Tuple
 from ..core import Checker, FileContext, Runner, collect_files
 
 EVENT_RE = re.compile(
-    r"^(resilience|serving|fleet|telemetry|monitor|profiler|spec)/"
+    r"^(resilience|serving|fleet|telemetry|monitor|profiler|spec|migration)/"
     r"[a-z0-9_]+(/[a-z0-9_]+)*$")
 _PREFIXES = ("resilience/", "serving/", "fleet/", "telemetry/",
-             "monitor/", "profiler/", "spec/")
+             "monitor/", "profiler/", "spec/", "migration/")
 REGISTRY_REL = "telemetry/event_registry.py"
 
 

@@ -71,6 +71,11 @@ class SequenceDescriptor:
     seen_tokens: int = 0                   # tokens whose KV is in cache
     generated: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    # migration pause (serving/kvtransfer): a paused sequence keeps its
+    # state and KV pages but is excluded from step planning, so its pages
+    # stay byte-stable while chunks of them are staged device->host between
+    # the engine's ongoing decode steps
+    paused: bool = False
     # prefix-cache cursor: pages [0, pc_pages) are already published (or came
     # from the cache); pc_hash is the running chain hash at that boundary, so
     # each register() call hashes only NEW full pages (O(1) amortized per
@@ -273,6 +278,46 @@ class BlockedKVCache:
     def release(self, seq: SequenceDescriptor) -> None:
         self.allocator.free(seq.pages)
         seq.pages = []
+
+    def export_pages(self, arena, pages: Sequence[int]) -> np.ndarray:
+        """Stage the KV blocks of ``pages`` device→host (the serving analog
+        of the L6 ``swap_tensor`` d2h path): one gather over the arena's
+        page axis, materialized as a host numpy array.  ``arena`` is the
+        engine's ``[L, P, page, 2, n_kv, hd]`` cache (jax or numpy); the
+        returned block is ``[L, len(pages), page, 2, n_kv, hd]``.  Page ids
+        are validated against the arena geometry — exporting the reserved
+        null page (0) or an out-of-range id is a caller bug, not data."""
+        idx = np.asarray(list(pages), np.int64)
+        if idx.size and not ((idx > 0) & (idx < self.num_pages)).all():
+            raise ValueError(f"export_pages: page ids out of range: {idx.tolist()}")
+        if idx.size == 0:
+            return np.asarray(arena[:, :0])   # zero-width slice keeps the dtype
+        return np.asarray(arena[:, idx])
+
+    def import_pages(self, arena, pages: Sequence[int], block: np.ndarray):
+        """Scatter a host-staged KV block back into ``pages`` of ``arena``
+        (h2d: the inverse of :meth:`export_pages`).  Returns the updated
+        arena — functional (``.at[].set``) for a jax arena so the engine
+        reassigns its donated cache handle, in-place for numpy.  The block
+        must match the arena's per-page geometry and dtype exactly; a
+        mismatched snapshot is rejected here rather than silently cast
+        (KV bytes from a different geometry are garbage, not data)."""
+        idx = np.asarray(list(pages), np.int64)
+        if idx.size and not ((idx > 0) & (idx < self.num_pages)).all():
+            raise ValueError(f"import_pages: page ids out of range: {idx.tolist()}")
+        want = (arena.shape[0], idx.size) + tuple(arena.shape[2:])
+        if tuple(block.shape) != want:
+            raise ValueError(f"import_pages: block shape {tuple(block.shape)} != "
+                             f"arena slice {want}")
+        if str(block.dtype) != str(arena.dtype):
+            raise ValueError(f"import_pages: block dtype {block.dtype} != "
+                             f"arena dtype {arena.dtype}")
+        if idx.size == 0:
+            return arena
+        if hasattr(arena, "at"):   # jax arena: functional scatter
+            return arena.at[:, idx].set(block)
+        arena[:, idx] = block
+        return arena
 
     def release_tail(self, seq: SequenceDescriptor, keep_pages: int) -> int:
         """Return ``seq``'s pages past the first ``keep_pages`` to the

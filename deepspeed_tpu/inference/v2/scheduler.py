@@ -55,7 +55,10 @@ class SplitFuseScheduler:
 
     def plan(self, manager: StateManager) -> StepPlan:
         cfg = self.config
-        running = [s for s in manager.seqs.values() if not s.done]
+        # paused sequences (mid-KV-migration — serving/kvtransfer) keep
+        # their state and pages but take no step work: their pages must stay
+        # byte-stable while export chunks overlap the other sequences' steps
+        running = [s for s in manager.seqs.values() if not s.done and not s.paused]
         if self.order_key is not None:
             running.sort(key=self.order_key)
         decodes = [s for s in running if s.in_decode]

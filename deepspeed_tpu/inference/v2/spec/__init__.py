@@ -34,7 +34,8 @@ device work, works on the CPU tier-1 suite.  Drafters are pluggable via
 """
 
 import dataclasses
-from typing import Dict, List, Protocol, Sequence, Type
+from collections import OrderedDict
+from typing import Dict, List, Optional, Protocol, Sequence, Type
 
 __all__ = ["SpecConfig", "SpecStats", "DraftProvider", "NGramDrafter",
            "DRAFTERS", "make_drafter"]
@@ -91,6 +92,69 @@ class DraftProvider(Protocol):
         ...
 
 
+class _SeqNGramIndex:
+    """Incremental n-gram → position index over ONE sequence's history.
+
+    For every n in ``[min_n, max_n]`` it tracks the two most recent start
+    positions of every n-gram (``last`` and ``prev``): the trailing suffix
+    of the current history is always the single most recent occurrence of
+    its own n-gram, so "most recent occurrence strictly before the
+    suffix" — the prompt-lookup query — is exactly ``prev``.  Appending a
+    token indexes the ``max_n - min_n + 1`` n-grams that END at the new
+    position: O(max_ngram) per appended token, replacing the per-round
+    right-to-left rescan of the whole history.
+
+    The index pins a strong reference to the token list it mirrors, so
+    CPython cannot recycle the list's identity while the entry is cached;
+    a truncation below the indexed boundary or a tail-token mismatch
+    (a different history behind a reused list) triggers a full rebuild."""
+
+    __slots__ = ("tokens", "min_n", "max_n", "indexed", "tail", "last", "prev")
+
+    def __init__(self, tokens: List[int], min_n: int, max_n: int):
+        self.tokens = tokens
+        self.min_n, self.max_n = min_n, max_n
+        self.indexed = 0
+        self.tail: Optional[int] = None   # tokens[indexed - 1] at index time
+        self.last: Dict[tuple, int] = {}
+        self.prev: Dict[tuple, int] = {}
+        self.extend()
+
+    def stale(self) -> bool:
+        if len(self.tokens) < self.indexed:
+            return True  # truncated below the indexed boundary
+        return self.indexed > 0 and self.tokens[self.indexed - 1] != self.tail
+
+    def extend(self) -> None:
+        toks, last, prev = self.tokens, self.last, self.prev
+        lo, hi = self.indexed, len(toks)
+        for end in range(lo + 1, hi + 1):
+            for n in range(self.min_n, min(self.max_n, end) + 1):
+                i = end - n
+                key = tuple(toks[i:end])
+                old = last.get(key)
+                if old is not None and old != i:
+                    prev[key] = old
+                last[key] = i
+        self.indexed = hi
+        self.tail = toks[hi - 1] if hi else None
+
+    def lookup(self, n: int) -> Optional[int]:
+        """Start position of the most recent occurrence of the trailing
+        ``n``-gram STRICTLY before the trailing suffix itself, or None."""
+        L = len(self.tokens)
+        key = tuple(self.tokens[L - n:])
+        cand = self.last.get(key)
+        if cand is None:
+            return None
+        if cand != L - n:
+            # the suffix's own occurrence is always the most recent; a
+            # smaller ``last`` can only mean a rebuild raced a mutation —
+            # it is still a valid strictly-earlier occurrence
+            return cand
+        return self.prev.get(key)
+
+
 class NGramDrafter:
     """Deterministic prompt-lookup drafting: find the most recent earlier
     occurrence of the history's trailing n-gram (longest n first) and
@@ -99,26 +163,65 @@ class NGramDrafter:
     Rationale: serving traffic — and small greedy models — repeat
     themselves (copied spans, looping continuations, templated output);
     the sequence's own history is a free draft model with zero device
-    cost.  O(max_ngram * len(tokens)) per call via a right-to-left scan
-    guarded on the first suffix token, so the common non-matching
-    position costs one int compare, not a slice; history lengths are
-    bounded by ``max_pages_per_seq * page_size``, so the host-side cost
-    stays far below one model dispatch.  (The production upgrade for
-    very long histories is a per-sequence incremental n-gram→position
-    index, O(max_ngram) per appended token — see ROADMAP.)"""
+    cost.  Matching runs on a per-sequence INCREMENTAL
+    :class:`_SeqNGramIndex` keyed by the token list's identity (the
+    engine mutates one list per live sequence in place): each call
+    indexes only the tokens appended since the last call — O(max_ngram)
+    per appended token — then answers every n-gram probe with two dict
+    lookups, so drafting cost no longer grows with history length.
+    Proposals are IDENTICAL to the r12 right-to-left rescan (the
+    regression tests in tests/unit/inference/test_spec_index.py replay
+    both); ``_scan_draft`` keeps the reference scan for non-list
+    histories and those tests."""
 
-    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1,
+                 max_cached_seqs: int = 256):
         if not (1 <= min_ngram <= max_ngram):
             raise ValueError(f"need 1 <= min_ngram <= max_ngram, "
                              f"got [{min_ngram}, {max_ngram}]")
         self.max_ngram = max_ngram
         self.min_ngram = min_ngram
+        # id(list) -> _SeqNGramIndex, LRU-bounded: entries hold a strong
+        # ref to their list (identity safety), so dead sequences' indexes
+        # must age out rather than accumulate for the engine's lifetime
+        self.max_cached_seqs = max_cached_seqs
+        self._indexes: "OrderedDict[int, _SeqNGramIndex]" = OrderedDict()
+
+    def _index_for(self, tokens: List[int]) -> _SeqNGramIndex:
+        key = id(tokens)
+        idx = self._indexes.get(key)
+        if idx is not None and idx.tokens is tokens and not idx.stale():
+            idx.extend()
+            self._indexes.move_to_end(key)
+            return idx
+        idx = _SeqNGramIndex(tokens, self.min_ngram, self.max_ngram)
+        self._indexes[key] = idx
+        self._indexes.move_to_end(key)
+        while len(self._indexes) > self.max_cached_seqs:
+            self._indexes.popitem(last=False)
+        return idx
 
     def draft(self, tokens: Sequence[int], max_tokens: int) -> List[int]:
         L = len(tokens)
         if max_tokens <= 0 or L < self.min_ngram + 1:
             return []
-        toks = tokens if isinstance(tokens, list) else list(tokens)
+        if not isinstance(tokens, list):
+            # identity-keyed indexing needs the engine's stable mutable
+            # list; an immutable/ad-hoc history gets the reference scan
+            return self._scan_draft(list(tokens), max_tokens)
+        idx = self._index_for(tokens)
+        for n in range(min(self.max_ngram, L - 1), self.min_ngram - 1, -1):
+            i = idx.lookup(n)
+            if i is not None:
+                return [int(t) for t in tokens[i + n:i + n + max_tokens]]
+        return []
+
+    def _scan_draft(self, toks: List[int], max_tokens: int) -> List[int]:
+        """The r12 reference implementation: right-to-left rescan guarded
+        on the first suffix token.  O(max_ngram * len(tokens)) per call —
+        kept as the non-list fallback and the equivalence oracle for the
+        index regression tests."""
+        L = len(toks)
         for n in range(min(self.max_ngram, L - 1), self.min_ngram - 1, -1):
             suffix = toks[L - n:]
             first = suffix[0]

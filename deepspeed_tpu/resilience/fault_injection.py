@@ -3,7 +3,8 @@
 The chaos contract this enables (docs/RESILIENCE.md): every I/O or
 state-transition edge that can tear in production — checkpoint writes and
 restores, host-tier ``host_opt_group*.npz`` save/load, NVMe swap I/O, the
-engine's step dispatch, serving admission, fleet-router dispatch — is
+engine's step dispatch, serving admission, fleet-router dispatch, KV
+migration staging (export chunks and snapshot import) — is
 wrapped in a named injection site.  A test (or an operator drill, via the environment) arms a
 *plan* of :class:`FaultSpec` entries and the exact same code path that
 runs in production fires torn writes, transient ``OSError``\\ s, device
@@ -83,6 +84,8 @@ INJECTION_SITES = frozenset({
     "engine.verify_step",   # speculative verify dispatch (inference/v2/engine_v2.py)
     "serving.admit",        # serving request admission (serving/engine.py)
     "router.dispatch",      # fleet router request dispatch (serving/fleet/router.py)
+    "kv.export",            # KV page d2h staging chunk (serving/kvtransfer/snapshot.py)
+    "kv.import",            # KV snapshot h2d import (serving/kvtransfer/snapshot.py)
 })
 
 _RAISING_KINDS = ("os_error", "crash", "device_loss", "latency")

@@ -10,6 +10,9 @@ from .admission import AdmissionConfig, AdmissionController
 from .clock import ReplicaClockView, VirtualClock, WallClock
 from .engine import ServingConfig, ServingEngine
 from .kv_pressure import KVPressureManager
+from .kvtransfer import (KVExporter, KVImportError, KVSnapshot,
+                         SnapshotAborted, SnapshotError,
+                         SnapshotIntegrityError, import_snapshot)
 from .metrics import ServingStats, percentile_summary
 from .request import RequestState, ServingRequest
 
@@ -18,4 +21,6 @@ __all__ = [
     "VirtualClock", "WallClock",
     "ServingConfig", "ServingEngine", "KVPressureManager", "ServingStats",
     "percentile_summary", "RequestState", "ServingRequest",
+    "KVExporter", "KVImportError", "KVSnapshot", "SnapshotAborted",
+    "SnapshotError", "SnapshotIntegrityError", "import_snapshot",
 ]

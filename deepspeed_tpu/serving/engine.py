@@ -142,7 +142,8 @@ class ServingEngine:
                retry_policy=None, resume_tokens: Optional[Sequence[int]] = None,
                trace_id: Optional[int] = None,
                parent_span_id: Optional[int] = None,
-               spec: Optional[bool] = None) -> ServingRequest:
+               spec: Optional[bool] = None,
+               kv_snapshot=None) -> ServingRequest:
         """Enqueue one request.  NEVER raises on overload: the returned
         request's state is REJECTED (with ``reject_reason``) when admission
         refuses it — callers inspect, the serving loop keeps running.
@@ -166,6 +167,18 @@ class ServingEngine:
         engine default.  On a spec-less engine the flag is a no-op.
         Acceptance lands on ``req.spec_proposed/spec_accepted`` and the
         ``spec/*`` metrics as the request decodes.
+
+        ``kv_snapshot`` (a ``kvtransfer.KVSnapshot``): host-staged KV for
+        ``prompt + resume_tokens``, exported from another replica.  At
+        admission the engine tries the KV-IMPORT FAST PATH — scatter the
+        staged pages into its arena and continue decode without
+        recomputing the prompt; any rejection (crc mismatch, geometry
+        drift, no page room) falls back to the ordinary
+        recompute-on-resume prefill automatically, with the fallback
+        counted on ``stats.kv_import_fallbacks`` and the
+        ``migration/import_fallback`` metric.  Either way the snapshot is
+        consumed at first admission (a preemption AFTER import resumes by
+        recompute, as always).
 
         ``retry_policy`` (a resilience ``RetryPolicy``): back off on the
         clock and re-probe admission while the rejection is TRANSIENT
@@ -200,6 +213,7 @@ class ServingEngine:
                     f"under max_new_tokens ({max_new_tokens}) — a fully-generated "
                     "request has nothing to resume")
             req.tokens.extend(int(t) for t in resume_tokens)
+        req.kv_snapshot = kv_snapshot
         self._requests[req.uid] = req
         self.stats.submitted += 1
         if self.tracer.enabled:
@@ -258,6 +272,11 @@ class ServingEngine:
         for seq in evicted:
             self._on_preempted(seq, now)
         if not self._active:  # everything runnable got preempted/expired
+            return {}
+        if not plan.decode and not plan.prefill:
+            # every active sequence is paused (mid-KV-migration): there is
+            # no step to run and no cost to charge — the export chunks are
+            # the fleet driver's work, not this replica's step loop's
             return {}
         cost = 1.0
         if self.config.step_cost is not None:
@@ -325,8 +344,10 @@ class ServingEngine:
             assert req.uid not in self.engine.state.seqs, (
                 f"uid {req.uid} already live in the engine (direct put() "
                 "collision) — cannot admit")
-            self.engine.put([req.uid], [req.engine_tokens()],
-                            max_new_tokens=req.remaining_new_tokens)
+            imported = req.kv_snapshot is not None and self._try_import(req)
+            if not imported:
+                self.engine.put([req.uid], [req.engine_tokens()],
+                                max_new_tokens=req.remaining_new_tokens)
             if req.spec is not None:
                 # re-applied on every (re)admission: preemption/flush
                 # cleared the engine's per-uid opt-out
@@ -336,6 +357,134 @@ class ServingEngine:
             req.to(RequestState.PREFILL, now)
             self._active[req.uid] = req
             reserved += self.admission._start_pages(req)
+
+    def _try_import(self, req: ServingRequest) -> bool:
+        """KV-import fast path at admission: scatter ``req.kv_snapshot``
+        into this engine's arena so decode continues without recomputing
+        the prompt.  Returns False — after consuming the snapshot — on any
+        ordinary rejection (torn snapshot, geometry/dtype drift, token
+        mismatch, no page room): the caller falls back to the recompute
+        prefill, which is always correct.  Replica-fatal failures
+        (``InjectedCrash`` driver death, ``DeviceLossError``) re-raise with
+        the request pushed back onto the queue so the kill path collects
+        it for failover."""
+        from ..resilience.fault_injection import DeviceLossError
+        from .kvtransfer import import_snapshot
+        snap, req.kv_snapshot = req.kv_snapshot, None   # consumed either way
+        try:
+            import_snapshot(self.engine, req.uid, req.engine_tokens(), snap,
+                            max_new_tokens=req.remaining_new_tokens)
+        except InjectedCrash:
+            raise  # simulated DRIVER death; chaos tests must see it
+        except DeviceLossError:
+            # this replica's device is gone: re-queue the request so the
+            # health-driven kill path collects it for failover, then let
+            # the loss classify this replica dead.  The snapshot is HOST
+            # memory — it survives this device and goes back on the
+            # request so failover can retry the import on a survivor.
+            req.kv_snapshot = snap
+            self._queue.insert(0, req)
+            raise
+        except Exception as e:
+            logger.warning(f"kv import rejected for uid={req.uid} "
+                           f"({e}); falling back to recompute-on-resume")
+            self.stats.kv_import_fallbacks += 1
+            if self.metrics is not None:
+                self.metrics.counter("migration/import_fallback").inc()
+            return False
+        self.stats.kv_imports += 1
+        if self.metrics is not None:
+            self.metrics.counter("migration/kv_imports").inc()
+        return True
+
+    # ----------------------------------------------------------- migration
+
+    def begin_migration(self, uid: int, chunk_pages: int = 4, source=None):
+        """Pause a request for KV export (docs/SERVING.md "Disaggregated
+        serving").  Its engine sequence keeps its pages but leaves step
+        planning, so the pages stay byte-stable while the returned
+        ``kvtransfer.KVExporter`` stages them chunk by chunk between this
+        replica's ongoing ticks.
+
+        Two migratable windows:
+
+        * LATE PREFILL — the DistServe handoff boundary: at least one full
+          page of prompt KV is staged and at most one prefill chunk
+          remains, so the decode replica runs only the final chunk (which
+          samples the first token) and the staging pause lands in TTFT,
+          never in the token cadence;
+        * DECODE — the catch-up path (short prompts prefill whole in one
+          chunk and are first observable here; failed earlier migrations
+          retry here).
+
+        Returns None when the request is in neither window (not active,
+        already paused, finished, or too early in prefill) or the engine's
+        cache layout is not exportable — the router just skips it."""
+        from .kvtransfer import KVExporter, KVImportError
+        req = self._active.get(uid)
+        if req is None or req.state not in (RequestState.PREFILL,
+                                            RequestState.DECODE):
+            return None
+        seq = self.engine.state.seqs.get(uid)
+        if seq is None or seq.done or seq.paused:
+            return None
+        if req.state is RequestState.PREFILL:
+            if seq.seen_tokens < self.engine.kv.page_size or \
+                    seq.remaining_prefill > self.engine.scheduler.config.prefill_chunk:
+                return None  # too early: let the prefill replica keep grinding
+        elif not seq.in_decode:
+            return None
+        seq.paused = True
+        try:
+            exporter = KVExporter(self.engine, uid, chunk_pages=chunk_pages,
+                                  source=source)
+        except KVImportError as e:
+            # structurally unexportable on THIS engine (e.g. the
+            # unroll_layers per-layer tuple cache layout): not a migratable
+            # request, not an error — the caller keeps serving it here
+            seq.paused = False
+            logger.debug(f"begin_migration({uid}): not exportable ({e})")
+            return None
+        except Exception:
+            seq.paused = False
+            raise
+        req.to(RequestState.MIGRATING, self.clock.now())
+        return exporter
+
+    def abort_migration(self, uid: int) -> None:
+        """Resume a MIGRATING request in place (export failed, or no decode
+        replica can take the handoff): the sequence re-enters step planning
+        and the phase the pause interrupted (prefill or decode) continues
+        on THIS replica exactly where it stopped."""
+        req = self._active.get(uid)
+        if req is None or req.state is not RequestState.MIGRATING:
+            return
+        seq = self.engine.state.seqs.get(uid)
+        if seq is not None:
+            seq.paused = False
+        back = RequestState.DECODE if seq is not None and seq.in_decode \
+            else RequestState.PREFILL
+        req.to(back, self.clock.now())
+
+    def complete_migration(self, uid: int) -> ServingRequest:
+        """Close out a MIGRATING request whose snapshot fully exported: the
+        engine sequence is flushed (pages released — full pages published
+        to the prefix cache survive via the cache's refcount), the request
+        reaches the MIGRATED terminal state on THIS replica, and the
+        caller re-submits it on the decode replica with the snapshot.
+        Returns the closed request."""
+        now = self.clock.now()
+        req = self._active.pop(uid)
+        assert req.state is RequestState.MIGRATING, req
+        self.engine.flush(uid)
+        req.to(RequestState.MIGRATED, now)
+        self.stats.record_terminal(req)
+        self._requests.pop(req.uid, None)
+        if self.metrics is not None:
+            self.metrics.counter("serving/migrated").inc()
+        self._trace_terminal(req, now)
+        self._emit([("serving/migrated", 1.0, self._next_event_step())])
+        return req
 
     def _on_preempted(self, seq, now: float) -> None:
         req = self._active.pop(seq.uid, None)

@@ -3,24 +3,30 @@ failover (docs/SERVING.md "Fleet router").
 
 One ``ServingEngine`` serves one mesh; the fleet layer is the data plane
 above N of them: a :class:`ReplicaPool` (shared clock, health tracking,
-kill/recover/drain lifecycle), a :class:`Router` with pluggable policies
+kill/recover/drain lifecycle, per-replica :class:`ReplicaRole`\\ s for
+prefill/decode disaggregation), a :class:`Router` with pluggable policies
 (round-robin, least-outstanding-tokens, prefix-affinity with least-loaded
-fallback), and a deterministic :class:`FleetSimulator` that replays
-arrivals plus a scripted fault schedule bit-reproducibly on CPU
-(``scripts/bench_router.py`` is the load harness).
+fallback, role-aware ``disaggregated`` with host-staged KV migration —
+``serving/kvtransfer``), and a deterministic :class:`FleetSimulator` that
+replays arrivals plus a scripted fault schedule bit-reproducibly on CPU
+(``scripts/bench_router.py`` is the load harness; the seeded workload
+generators live in :mod:`.sim`).
 """
 
 from .health import HealthConfig, HealthTracker, ReplicaState, classify_fatal
-from .policies import (POLICIES, LeastOutstandingPolicy, PrefixAffinityPolicy,
-                       RoundRobinPolicy, RoutingPolicy, make_policy)
-from .pool import Replica, ReplicaPool
+from .policies import (POLICIES, DisaggregatedPolicy, LeastOutstandingPolicy,
+                       PrefixAffinityPolicy, RoundRobinPolicy, RoutingPolicy,
+                       make_policy)
+from .pool import Replica, ReplicaPool, ReplicaRole
 from .router import FleetRequest, FleetState, Router
-from .sim import FleetEvent, FleetSimulator
+from .sim import (FleetEvent, FleetSimulator, heavy_tail_arrivals,
+                  poisson_mixed_arrivals)
 
 __all__ = [
     "HealthConfig", "HealthTracker", "ReplicaState", "classify_fatal",
-    "POLICIES", "LeastOutstandingPolicy", "PrefixAffinityPolicy",
-    "RoundRobinPolicy", "RoutingPolicy", "make_policy",
-    "Replica", "ReplicaPool", "FleetRequest", "FleetState", "Router",
-    "FleetEvent", "FleetSimulator",
+    "POLICIES", "DisaggregatedPolicy", "LeastOutstandingPolicy",
+    "PrefixAffinityPolicy", "RoundRobinPolicy", "RoutingPolicy", "make_policy",
+    "Replica", "ReplicaPool", "ReplicaRole", "FleetRequest", "FleetState",
+    "Router", "FleetEvent", "FleetSimulator",
+    "heavy_tail_arrivals", "poisson_mixed_arrivals",
 ]

@@ -21,6 +21,11 @@ read-only prefix-cache warmth probe.
   ``saturation_queue_depth`` — the policy falls back to least-loaded:
   cache locality is a latency optimization, never a reason to queue behind
   a hot spot (the standard prefix-aware routing compromise).
+* :class:`DisaggregatedPolicy` — role-aware placement for a
+  prefill/decode-split fleet: fresh prompts land on PREFILL-role
+  replicas, resumed/migrated requests on DECODE-role ones, least-loaded
+  within the pool (DistServe/Splitwise-style phase splitting; the KV
+  handoff between the pools is the router's migration machinery).
 """
 
 from typing import List, Optional, Tuple
@@ -111,8 +116,51 @@ class PrefixAffinityPolicy(RoutingPolicy):
                         "affinity_saturated": saturated}
 
 
+class DisaggregatedPolicy(RoutingPolicy):
+    """Role-aware placement for a prefill/decode-disaggregated fleet
+    (docs/SERVING.md "Disaggregated serving").
+
+    A FRESH request (no tokens yet) is prompt-processing work → place it
+    on a PREFILL-role replica; a RESUMED request (failover victim or a
+    migration handoff carrying generated tokens) is token-generation work
+    → place it on a DECODE-role replica.  MIXED replicas qualify for
+    either.  Within the matching pool the least-outstanding estimator
+    breaks ties; when NO replica of the wanted role is dispatchable the
+    policy falls back to the full candidate list — every replica runs the
+    complete stack, and availability beats specialization (a decode-only
+    fleet rump must still serve fresh prompts rather than starve them).
+
+    The KV handoff itself (export → least-loaded decode replica → import)
+    is the router's migration machinery; this policy only answers where
+    NEW dispatches land."""
+
+    name = "disaggregated"
+    #: turns on the Router's two-phase dispatch: requests reaching DECODE
+    #: on a PREFILL-role replica are exported + resumed on a decode replica
+    migrates = True
+
+    def __init__(self):
+        self._fallback = LeastOutstandingPolicy()
+
+    def select(self, request, candidates):
+        from .pool import ReplicaRole
+        if not candidates:
+            return None, {}
+        # token-generation work: the request already generated tokens OR
+        # carries a host-staged KV snapshot (a late-prefill handoff or a
+        # failover-reuse victim with no tokens yet) — routing it back to
+        # the prefill pool would import there and immediately re-migrate
+        decode_work = bool(getattr(request, "tokens", None)) \
+            or getattr(request, "_kv_snapshot", None) is not None
+        want = ReplicaRole.DECODE if decode_work else ReplicaRole.PREFILL
+        matched = [c for c in candidates
+                   if c[1].role in (want, ReplicaRole.MIXED)]
+        rid, info = self._fallback.select(request, matched or candidates)
+        return rid, {**info, "phase": want.value, "role_match": bool(matched)}
+
+
 POLICIES = {p.name: p for p in (RoundRobinPolicy, LeastOutstandingPolicy,
-                                PrefixAffinityPolicy)}
+                                PrefixAffinityPolicy, DisaggregatedPolicy)}
 
 
 def make_policy(name: str, **kwargs) -> RoutingPolicy:

@@ -20,7 +20,8 @@ mesh/host.  The pool adds what a fleet needs around them:
 """
 
 import dataclasses
-from typing import Callable, Dict, List, Optional
+import enum
+from typing import Callable, Dict, List, Optional, Sequence, Union
 
 from ...resilience.fault_injection import InjectedCrash
 from ...utils.logging import logger
@@ -30,21 +31,49 @@ from ..request import ServingRequest
 from .health import HealthConfig, HealthTracker, ReplicaState
 
 
+class ReplicaRole(enum.Enum):
+    """Disaggregation role of one replica (DistServe prefill–decode
+    disaggregation / Splitwise phase splitting — docs/SERVING.md
+    "Disaggregated serving").  The role is a ROUTING preference, not a
+    capability bound: every replica runs the full serving stack, so a
+    decode replica can absorb a whole request when the prefill pool is
+    gone (availability beats specialization) and vice versa."""
+    PREFILL = "prefill"   # admission + prompt processing; requests migrate out
+    DECODE = "decode"     # resumes migrated KV; token generation
+    MIXED = "mixed"       # the classic monolithic replica (default)
+
+
 @dataclasses.dataclass
 class Replica:
     rid: int
     serve: Optional[ServingEngine]      # None while DEAD (engine discarded)
     clock: object                       # ReplicaClockView or the shared clock
     generation: int = 0                 # bumps on every fresh engine attach
+    role: ReplicaRole = ReplicaRole.MIXED  # survives kill/recover/restart
 
 
 class ReplicaPool:
 
     def __init__(self, engine_factory: Callable[[], object], n_replicas: int,
                  clock=None, serving_config: ServingConfig = None, monitor=None,
-                 health_config: HealthConfig = None, tracer=None, metrics=None):
+                 health_config: HealthConfig = None, tracer=None, metrics=None,
+                 roles: Optional[Sequence[Union[str, ReplicaRole]]] = None,
+                 role_factories: Optional[Dict] = None):
         assert n_replicas >= 1, n_replicas
+        if roles is not None and len(roles) != n_replicas:
+            raise ValueError(f"roles ({len(roles)}) must cover every replica "
+                             f"({n_replicas})")
         self.engine_factory = engine_factory
+        # phase-specialized engine configs (Splitwise-style pool tuning):
+        # a PREFILL pool might run smaller prefill chunks and a lean KV
+        # arena (it holds prompts only transiently), a DECODE pool a large
+        # arena for the fleet's whole resident decode set.  KV migration
+        # only requires the PER-PAGE geometry (layers, page_size, kv heads,
+        # head_dim, dtype) to match across pools — arena page COUNTS may
+        # differ freely.  Factories are keyed by role and survive
+        # kill/recover/restart (the role does).
+        self.role_factories = {ReplicaRole(k): v
+                               for k, v in (role_factories or {}).items()}
         self.serving_config = serving_config or ServingConfig()
         self.monitor = monitor
         # telemetry: ONE tracer/metrics registry spans the whole fleet —
@@ -60,8 +89,9 @@ class ReplicaPool:
         self.health = HealthTracker(range(n_replicas), config=health_config,
                                     emit=self._emit, clock=self.clock)
         for rid in range(n_replicas):
+            role = ReplicaRole(roles[rid]) if roles is not None else ReplicaRole.MIXED
             self.replicas[rid] = Replica(rid=rid, serve=None,
-                                         clock=self._make_view())
+                                         clock=self._make_view(), role=role)
             self._attach_engine(rid)
 
     def _make_view(self):
@@ -69,7 +99,8 @@ class ReplicaPool:
 
     def _attach_engine(self, rid: int) -> None:
         rep = self.replicas[rid]
-        rep.serve = ServingEngine(self.engine_factory(), clock=rep.clock,
+        factory = self.role_factories.get(rep.role, self.engine_factory)
+        rep.serve = ServingEngine(factory(), clock=rep.clock,
                                   config=self.serving_config, monitor=self.monitor,
                                   tracer=self.tracer, metrics=self.metrics,
                                   trace_track=f"replica{rid}")

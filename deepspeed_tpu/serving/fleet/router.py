@@ -39,9 +39,10 @@ from ...telemetry.trace import NULL_TRACER
 from ...utils.logging import logger
 from ..metrics import percentile_summary
 from ..request import RequestState, ServingRequest
+from ..kvtransfer import SnapshotAborted
 from .health import ReplicaState
 from .policies import RoutingPolicy
-from .pool import ReplicaPool
+from .pool import ReplicaPool, ReplicaRole
 
 
 class FleetState(enum.Enum):
@@ -74,7 +75,12 @@ class FleetRequest:
     finish_ts: Optional[float] = None
     failovers: int = 0
     affinity_hits: int = 0
+    migrations: int = 0          # KV handoffs between replicas (kvtransfer)
     reject_reason: Optional[str] = None
+    #: host-staged KV carried between attempts: set when a migration's
+    #: export completed (or harvested from a dead replica — failover
+    #: reuse), consumed by the next dispatch's KV-import fast path
+    _kv_snapshot: Optional[object] = None
     #: (replica rid, dispatch ts) per attempt
     dispatches: List[Tuple[int, float]] = dataclasses.field(default_factory=list)
     history: List[Tuple[FleetState, float]] = dataclasses.field(default_factory=list)
@@ -113,10 +119,32 @@ class Router:
     """Cache-affinity, health-aware request router over a ReplicaPool."""
 
     def __init__(self, pool: ReplicaPool, policy: RoutingPolicy, monitor=None,
-                 tracer=None):
+                 tracer=None, migration_chunk_pages: int = 4,
+                 migration_chunk_cost: float = 0.0,
+                 prefill_handoff: bool = False):
         self.pool = pool
         self.policy = policy
         self.monitor = monitor
+        # prefill/decode disaggregation (docs/SERVING.md "Disaggregated
+        # serving"): policies that declare ``migrates = True`` turn on the
+        # two-phase dispatch — requests that reach DECODE on a PREFILL-role
+        # replica are paused, their KV exported chunk-by-chunk (overlapping
+        # the source's other work), and resumed on a decode replica via the
+        # KV-import fast path.  ``migration_chunk_cost`` > 0 charges each
+        # export chunk's d2h staging on the source replica's clock view
+        # (max-combined with its step cost — the overlap, not a stall).
+        self.migrate = bool(getattr(policy, "migrates", False))
+        self.migration_chunk_pages = int(migration_chunk_pages)
+        self.migration_chunk_cost = float(migration_chunk_cost)
+        # prefill_handoff=True migrates at the LATE-PREFILL boundary too
+        # (DistServe semantics: the decode replica runs the final chunk +
+        # first-token sampling, so the staging pause lands in TTFT);
+        # False (default) migrates only after the first decode token —
+        # prompt processing finishes at full speed on the prefill replica
+        self.prefill_handoff = bool(prefill_handoff)
+        #: fid -> in-flight export record {"rid", "sr", "generation",
+        #: "exporter", "started_ts"}
+        self._migrations: Dict[int, dict] = {}
         # one trace per CLIENT request: the trace_id allocated at submit
         # propagates through every per-replica attempt and survives
         # failover (the resumed attempt links to the dead replica's span).
@@ -148,6 +176,9 @@ class Router:
             "submitted": 0, "dispatches": 0, "failovers": 0,
             "affinity_hits": 0, "affinity_misses": 0,
             "dispatch_faults": 0, "saturated_dispatches": 0,
+            "migrations_started": 0, "migration_chunks": 0,
+            "migrations_completed": 0, "migration_fallbacks": 0,
+            "migration_failover_reuse": 0,
         }
         self.recovery_times: List[float] = []
 
@@ -191,6 +222,15 @@ class Router:
         round; a structural rejection (infeasible on this engine geometry —
         identical across replicas) is terminal."""
         now = self.clock.now() if now is None else now
+        if self.migrate:
+            # pre-charge each in-flight export's next chunk on its source's
+            # clock view HERE — the dispatch phase runs before this round's
+            # replica ticks advance the clock, so the staging cost lands
+            # INSIDE the MIGRATING window (the phase span the telemetry
+            # materializes per migrated request has real width) and is
+            # max-combined with the source's own step cost: overlapped,
+            # not serial.  The chunks themselves are pumped in poll().
+            self._precharge_migrations()
         # priority class (lower = more urgent) then FCFS — the fleet queue
         # must honor the priority submit() accepts, or urgent work waits
         # behind bulk arrivals exactly when every replica is saturated;
@@ -261,11 +301,13 @@ class Router:
             stream=self._make_stream(fr, rep.generation),
             resume_tokens=list(fr.tokens) or None,
             trace_id=fr.trace["trace_id"] if fr.trace is not None else None,
-            parent_span_id=att["span_id"] if att is not None else None)
+            parent_span_id=att["span_id"] if att is not None else None,
+            kv_snapshot=fr._kv_snapshot)
         if sr.state is RequestState.REJECTED:
             if sr.reject_reason == "queue_full":
                 self.stats["saturated_dispatches"] += 1
-                return False            # transient: stays pending
+                return False            # transient: stays pending (the
+                # snapshot, if any, stays on fr for the retry)
             self._pending.remove(fr)
             fr.reject_reason = sr.reject_reason
             if att is not None:
@@ -274,6 +316,9 @@ class Router:
             self._finish(fr, FleetState.REJECTED, now)
             return False
         self._pending.remove(fr)
+        # the ServingRequest owns the snapshot now (consumed — or rejected
+        # into the recompute fallback — at its admission on the replica)
+        fr._kv_snapshot = None
         if att is not None:
             fr.trace["attempts"].append(att)
             fr.trace["last_dead"] = None
@@ -304,8 +349,18 @@ class Router:
     # ---------------------------------------------------------------- poll
 
     def poll(self, now: Optional[float] = None) -> None:
-        """Fold per-replica terminal states up into fleet terminal states."""
+        """Fold per-replica terminal states up into fleet terminal states.
+        Under a migrating policy this is also the migration pump: one
+        export chunk per in-flight migration per round, completions handed
+        off to a decode replica."""
         now = self.clock.now() if now is None else now
+        if self.migrate:
+            # pump BEFORE starting new exports: a fresh export's first
+            # chunk waits for the next poll, after its pre-charged staging
+            # cost has landed on the clock — so even a single-chunk
+            # migration's MIGRATING window spans a real clock advance
+            self._pump_migrations(now)
+            self._start_migrations(now)
         for fr in list(self._dispatched.values()):
             rid, sr, _gen = fr._current
             if sr.state is RequestState.DONE:
@@ -324,6 +379,154 @@ class Router:
                 t_out = sr.history[-1][1]
                 self._close_attempt(fr, "timed_out", t_out)
                 self._finish(fr, FleetState.TIMED_OUT, t_out)
+
+    # ----------------------------------------------------------- migration
+
+    def _decode_candidates(self, exclude_rid: int):
+        """Dispatchable DECODE/MIXED-role replicas other than the source —
+        the pool a completed export can hand off to."""
+        return [(rid, rep, st) for rid, rep, st in self._candidates()
+                if rid != exclude_rid
+                and rep.role in (ReplicaRole.DECODE, ReplicaRole.MIXED)]
+
+    def _precharge_migrations(self) -> None:
+        """Charge each in-flight export's next chunk on its source's clock
+        view (see dispatch_pending: runs before the round's ticks so the
+        cost advances the clock inside the MIGRATING window)."""
+        if self.migration_chunk_cost <= 0:
+            return
+        for m in self._migrations.values():
+            if not m["exporter"].snapshot.complete \
+                    and m["sr"].state is RequestState.MIGRATING:
+                self.pool.replica(m["rid"]).clock.on_step(
+                    self.migration_chunk_cost)
+
+    def _start_migrations(self, now: float) -> None:
+        """Begin exports for requests that reached DECODE on a PREFILL-role
+        replica — only when a decode replica exists to take the handoff."""
+        ok_states = (RequestState.PREFILL, RequestState.DECODE) \
+            if self.prefill_handoff else (RequestState.DECODE, )
+        # ONE candidate snapshot per round (same stance as
+        # dispatch_pending): a per-request rebuild would run load_stats on
+        # every replica for every dispatched request.  Only existence per
+        # source rid matters here; the handoff picks its target later.
+        decode_rids = {rid for rid, rep, _ in self._candidates()
+                       if rep.role in (ReplicaRole.DECODE, ReplicaRole.MIXED)}
+        if not decode_rids:
+            return
+        for fr in list(self._dispatched.values()):
+            if fr.fid in self._migrations or fr._current is None:
+                continue
+            rid, sr, gen = fr._current
+            rep = self.pool.replica(rid)
+            if rep.role is not ReplicaRole.PREFILL or rep.serve is None:
+                continue
+            if sr.state not in ok_states:
+                continue  # begin_migration arbitrates the exact window
+            if not (decode_rids - {rid}):
+                continue  # no handoff target: keep prefilling/decoding here
+            exporter = rep.serve.begin_migration(
+                sr.uid, chunk_pages=self.migration_chunk_pages,
+                source=f"replica{rid}")
+            if exporter is None:
+                continue
+            self._migrations[fr.fid] = {"rid": rid, "sr": sr, "generation": gen,
+                                        "exporter": exporter, "started_ts": now}
+            fr.migrations += 1
+            self.stats["migrations_started"] += 1
+            self._emit([("fleet/migration_start", float(rid),
+                         self._next_event_step())])
+
+    def _pump_migrations(self, now: float) -> None:
+        """One poll round of the two-phase dispatch (DistServe-style
+        prefill→decode handoff; docs/SERVING.md "Disaggregated serving"):
+        every in-flight export stages ONE chunk — the d2h copies overlap
+        the source replica's ongoing steps for everything else it serves —
+        and a completed snapshot is handed off: the source closes the
+        request as MIGRATED, and the router re-dispatches it onto the
+        least-loaded decode replica carrying the snapshot, where the
+        KV-import fast path resumes decode without recomputing the prompt.
+
+        Fallback ladder (never wrong, only slower): a transient export
+        fault or a vanished handoff target resumes decode IN PLACE
+        (``abort_migration``); a source-side preemption/timeout mid-export
+        already moved the request back to the recompute path; an import
+        rejection on the target falls back to recompute-on-resume inside
+        the replica's admission.  Outputs are byte-identical on every rung."""
+        from ...resilience.fault_injection import DeviceLossError
+        for fid, m in list(self._migrations.items()):
+            fr = self._dispatched.get(fid)
+            if fr is None or fr._current is None or fr._current[1] is not m["sr"]:
+                # displaced (replica death harvested the record) or terminal
+                self._migrations.pop(fid, None)
+                continue
+            sr, rid = m["sr"], m["rid"]
+            if sr.state is not RequestState.MIGRATING:
+                # preempted (EVICTED→QUEUED) or expired on the source mid-
+                # export: the recompute path owns the request again
+                self._migration_fallback(fid, "source left MIGRATING")
+                continue
+            rep = self.pool.replica(rid)
+            exporter = m["exporter"]
+            try:
+                done = exporter.step_chunk()
+            except _fi.InjectedCrash:
+                raise  # simulated death of THIS driver process
+            except DeviceLossError as e:
+                # the d2h staging found the source device gone — replica
+                # death; on_replica_dead harvests the migration record
+                self.on_replica_dead(rid, now, reason=str(e))
+                continue
+            except SnapshotAborted as e:
+                self._migration_fallback(fid, str(e))
+                continue
+            except OSError as e:
+                # transient staging fault: resume decode in place
+                if rep.serve is not None:
+                    rep.serve.abort_migration(sr.uid)
+                self._migration_fallback(fid, f"export fault: {e}")
+                continue
+            self.stats["migration_chunks"] += 1
+            if not done:
+                continue
+            targets = self._decode_candidates(rid)
+            if not targets:
+                # the decode pool vanished mid-export: decode continues on
+                # the source exactly where it paused
+                if rep.serve is not None:
+                    rep.serve.abort_migration(sr.uid)
+                self._migration_fallback(fid, "no decode replica for handoff")
+                continue
+            snapshot = exporter.snapshot
+            rep.serve.complete_migration(sr.uid)
+            self._migrations.pop(fid)
+            del self._dispatched[fid]
+            fr._current = None
+            fr.state = FleetState.PENDING
+            fr.history.append((FleetState.PENDING, now))
+            fr._kv_snapshot = snapshot
+            self._close_attempt(fr, "migrated", now)
+            if fr.trace is not None and fr.trace["attempts"]:
+                # the decode-side attempt links back to the prefill attempt
+                fr.trace["last_dead"] = fr.trace["attempts"][-1]["span_id"]
+            self._pending.append(fr)
+            self.stats["migrations_completed"] += 1
+            self._emit([("fleet/migration_complete", float(rid),
+                         self._next_event_step())])
+            # place on the least-outstanding decode replica NOW (a round of
+            # pending latency saved); queue_full leaves it pending with the
+            # snapshot for the next dispatch round
+            tid, _, _ = min(targets,
+                            key=lambda c: (c[2]["outstanding_tokens"],
+                                           c[2]["queue_depth"], c[0]))
+            self._dispatch_to(fr, tid, {"phase": "decode", "role_match": True,
+                                        "migration": True}, now)
+
+    def _migration_fallback(self, fid: int, reason: str) -> None:
+        self._migrations.pop(fid, None)
+        self.stats["migration_fallbacks"] += 1
+        logger.warning(f"fleet: migration of fid={fid} fell back ({reason})")
+        self._emit([("fleet/migration_fallback", 1.0, self._next_event_step())])
 
     # ------------------------------------------------------------ failover
 
@@ -364,6 +567,21 @@ class Router:
                         self._close_attempt(fr, displaced_sr.state.value, t_out)
                         self._finish(fr, FleetState.TIMED_OUT, t_out)
                     continue
+                # failover KV reuse: host-staged snapshots survive the
+                # replica's death.  Either the SOURCE died with the export
+                # already complete (migration record), or the TARGET died
+                # before admitting a handed-off request (unconsumed
+                # req.kv_snapshot) — both resume the survivor through the
+                # KV-import fast path instead of a full recompute.
+                m = self._migrations.pop(fr.fid, None)
+                if m is not None and m["exporter"].snapshot.complete \
+                        and fr._kv_snapshot is None:
+                    fr._kv_snapshot = m["exporter"].snapshot
+                    self.stats["migration_failover_reuse"] += 1
+                elif getattr(displaced_sr, "kv_snapshot", None) is not None:
+                    fr._kv_snapshot = displaced_sr.kv_snapshot
+                    displaced_sr.kv_snapshot = None
+                    self.stats["migration_failover_reuse"] += 1
                 fr.failovers += 1
                 fr.state = FleetState.PENDING
                 fr.history.append((FleetState.PENDING, now))
@@ -375,6 +593,11 @@ class Router:
                 self._pending.append(fr)
                 victims.append(fr)
                 self.stats["failovers"] += 1
+        # drop any remaining export records anchored on the dead replica
+        # (e.g. a terminal-at-death request): their exporters' source
+        # engine is gone and the next step_chunk would abort anyway
+        for fid in [f for f, m in self._migrations.items() if m["rid"] == rid]:
+            self._migrations.pop(fid)
         if was_dead and not victims:
             return []
         record = {"rid": rid, "ts": now, "reason": reason,
@@ -534,6 +757,22 @@ class Router:
             "affinity": {
                 "hits": hits, "misses": misses,
                 "hit_rate": round(hits / (hits + misses), 4) if hits + misses else None,
+            },
+            "migration": {
+                "started": self.stats["migrations_started"],
+                "chunks": self.stats["migration_chunks"],
+                "completed": self.stats["migrations_completed"],
+                "fallbacks": self.stats["migration_fallbacks"],
+                "failover_reuse": self.stats["migration_failover_reuse"],
+                "migrated_requests": sum(1 for r in self.requests if r.migrations),
+                # live-replica import accounting (engines discarded by kills
+                # take their counters with them — same stance as load_stats)
+                "kv_imports": sum(rep.serve.stats.kv_imports
+                                  for rep in self.pool.replicas.values()
+                                  if rep.serve is not None),
+                "import_fallbacks": sum(rep.serve.stats.kv_import_fallbacks
+                                        for rep in self.pool.replicas.values()
+                                        if rep.serve is not None),
             },
             "failover": {
                 "kills": len(self.kill_records),

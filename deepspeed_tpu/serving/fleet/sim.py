@@ -35,10 +35,87 @@ in flight is lost.
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from .health import ReplicaState
 from .router import Router
 
 _ACTIONS = ("kill", "recover", "drain", "restart")
+
+
+# ------------------------------------------------------------------ workloads
+#
+# Seeded arrival generators for the fleet benches and tests.  All of them
+# return Router.submit() kwarg dicts (with ``arrival_ts``) and are pure
+# functions of their seed: same seed, bit-identical workload on every
+# machine (np.random.default_rng is a seeded instance, so runs are
+# deterministic and dslint's global-RNG rule stays satisfied).
+
+
+def poisson_mixed_arrivals(seed: int, n_requests: int, rate: float, vocab: int,
+                           short_len: int = 8, long_len: int = 96,
+                           long_frac: float = 0.25,
+                           short_new: int = 12, long_new: int = 12,
+                           deadline_slack: Optional[float] = None) -> List[dict]:
+    """Mixed long-prompt/short-prompt Poisson traffic — the workload
+    prefill/decode disaggregation exists for: a minority of LONG prompts
+    (``long_frac``) whose chunked prefills head-of-line-block every short
+    request's decode steps on a monolithic replica.  Lengths jitter ±25%
+    around their class mean so no two long prompts are identical.
+    ``deadline_slack``: optional deadline = arrival + slack (None = no
+    deadline — every request runs to completion, the shape divergence
+    audits need)."""
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    arrivals = []
+    for _ in range(n_requests):
+        t += float(rng.exponential(1.0 / rate))
+        is_long = bool(rng.random() < long_frac)
+        mean_len = long_len if is_long else short_len
+        p_len = max(2, int(rng.integers(int(mean_len * 0.75),
+                                        int(mean_len * 1.25) + 1)))
+        arrivals.append({
+            "arrival_ts": round(t, 6),
+            "prompt": [int(x) for x in rng.integers(1, vocab, p_len)],
+            "max_new_tokens": int(long_new if is_long else short_new),
+            "deadline": None if deadline_slack is None
+            else round(t + deadline_slack, 6),
+        })
+    return arrivals
+
+
+def heavy_tail_arrivals(seed: int, n_requests: int, rate: float, vocab: int,
+                        prompt_median: int = 12, prompt_sigma: float = 0.8,
+                        tail_frac: float = 0.1, tail_alpha: float = 1.2,
+                        tail_scale: int = 32, max_prompt: int = 192,
+                        out_median: int = 8, out_sigma: float = 0.5,
+                        max_new: int = 24,
+                        deadline_slack: Optional[float] = None) -> List[dict]:
+    """Heavy-tailed production-shaped traffic: lognormal prompt/output
+    length bodies with a Pareto(``tail_alpha``) prompt tail mixed in at
+    ``tail_frac`` — the occasional pathological context that dominates
+    p99s (alpha < 2: infinite-variance territory, clipped at
+    ``max_prompt`` to the engine's geometry)."""
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    arrivals = []
+    for _ in range(n_requests):
+        t += float(rng.exponential(1.0 / rate))
+        if rng.random() < tail_frac:
+            p_len = int(tail_scale * float(rng.pareto(tail_alpha) + 1.0))
+        else:
+            p_len = int(rng.lognormal(np.log(prompt_median), prompt_sigma))
+        p_len = int(np.clip(p_len, 2, max_prompt))
+        o_len = int(np.clip(rng.lognormal(np.log(out_median), out_sigma),
+                            2, max_new))
+        arrivals.append({
+            "arrival_ts": round(t, 6),
+            "prompt": [int(x) for x in rng.integers(1, vocab, p_len)],
+            "max_new_tokens": o_len,
+            "deadline": None if deadline_slack is None
+            else round(t + deadline_slack, 6),
+        })
+    return arrivals
 
 
 @dataclasses.dataclass(frozen=True)
@@ -177,5 +254,12 @@ class FleetSimulator:
                    for s in rep.serve.engine.state.seqs.values())
         return (a_i, e_i, len(router.requests), router.outstanding,
                 router.stats["dispatches"], router.stats["failovers"],
+                # migration pump progress: export chunks advance no clock
+                # and deliver no tokens, but they ARE progress — without
+                # these the stall detector would fire mid-export on an
+                # otherwise-idle fleet
+                router.stats["migration_chunks"],
+                router.stats["migrations_started"],
+                router.stats["migration_fallbacks"],
                 sum(len(r.tokens) for r in router.requests), seen,
                 len(self.pool.health.history))

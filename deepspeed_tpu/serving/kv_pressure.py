@@ -54,6 +54,12 @@ class KVPressureManager:
                 if kv.prefix_cache.evict(shortfall) > 0:
                     continue  # re-check: cache pages may have covered it
             victims = [s for s in plan.decode] + [s for s, _ in plan.prefill]
+            # paused sequences (mid-KV-migration) hold pages but take no
+            # step work, so they never appear in the plan — they are still
+            # preemptible capacity (the migration layer detects the
+            # eviction and falls back to recompute-on-resume)
+            victims += [s for s in engine.state.seqs.values()
+                        if s.paused and not s.done]
             if not victims:
                 # nothing to shed — pack() would raise; surface a clear error
                 raise RuntimeError(

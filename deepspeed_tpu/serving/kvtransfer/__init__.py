@@ -1,0 +1,18 @@
+"""KV page export/import for cross-replica migration (docs/SERVING.md
+"Disaggregated serving").
+
+Device↔host staging of a request's paged KV state: a crc-tagged
+:class:`KVSnapshot` container, a chunked :class:`KVExporter` whose d2h
+copies overlap the source replica's ongoing decode steps, and
+:func:`import_snapshot` to resume decode on another engine with
+byte-identical outputs.  Fault sites ``kv.export`` / ``kv.import`` wrap
+the staging edges (docs/RESILIENCE.md).
+"""
+
+from .snapshot import (KVExporter, KVImportError, KVSnapshot, SnapshotAborted,
+                       SnapshotError, SnapshotIntegrityError, import_snapshot)
+
+__all__ = [
+    "KVExporter", "KVImportError", "KVSnapshot", "SnapshotAborted",
+    "SnapshotError", "SnapshotIntegrityError", "import_snapshot",
+]

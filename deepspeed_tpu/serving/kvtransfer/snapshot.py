@@ -1,0 +1,270 @@
+"""Host-staged KV migration: export a sequence's paged KV device→host,
+carry it as a crc-tagged :class:`KVSnapshot`, and import it into another
+engine's arena so decode resumes there with byte-identical outputs.
+
+This is the serving-side application of PAPER.md's L6 host-staging
+machinery (``swap_tensor`` / host-memory-kind shardings — the
+ZeRO-Offload/Infinity mapping): instead of optimizer shards, the staged
+payload is a request's KV pages, and the consumer is another replica of
+the fleet (DistServe-style prefill/decode disaggregation, Splitwise-style
+phase splitting — see docs/SERVING.md "Disaggregated serving").
+
+Protocol pieces:
+
+* :class:`KVSnapshot` — the host-side container: the sequence's full token
+  history + seen boundary at export time, the arena's per-page geometry,
+  and the staged page blocks in export order, each crc32-tagged.
+  ``verify()`` re-checksums every chunk; a torn or bit-rotted snapshot is
+  rejected at import (→ the caller's recompute fallback), never silently
+  decoded into wrong KV.
+* :class:`KVExporter` — incremental device→host export of one PAUSED
+  sequence, ``chunk_pages`` pages per :meth:`step_chunk` call, so a fleet
+  driver interleaves export chunks with the source replica's ongoing
+  decode steps instead of stalling them behind one bulk d2h.  The source
+  sequence must stay paused and intact between chunks; if it was preempted
+  (pages released) mid-flight the exporter raises :class:`SnapshotAborted`
+  and the caller falls back to the token path.
+* :func:`import_snapshot` — allocate fresh pages on the target engine,
+  scatter the staged blocks into its arena, and materialize a sequence
+  whose next step continues generation exactly where the source stopped
+  (the same contract as recompute-on-resume, minus the recompute).
+
+Fault-injection sites: ``kv.export`` fires per export chunk, ``kv.import``
+fires before any target-side mutation — chaos tests drive torn snapshots,
+crash-mid-import and import-reject→recompute through the exact production
+paths (docs/RESILIENCE.md).
+"""
+
+import dataclasses
+import zlib
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...resilience import fault_injection as _fi
+from ...utils.logging import logger
+
+__all__ = ["KVSnapshot", "KVExporter", "import_snapshot",
+           "SnapshotError", "SnapshotIntegrityError", "SnapshotAborted",
+           "KVImportError"]
+
+
+class SnapshotError(RuntimeError):
+    """Base class for KV snapshot export/import failures."""
+
+
+class SnapshotIntegrityError(SnapshotError):
+    """A staged chunk's crc32 no longer matches its payload (torn copy,
+    bit rot in host staging, truncation in transit)."""
+
+
+class SnapshotAborted(SnapshotError):
+    """The source sequence changed out from under an in-flight export
+    (preempted / flushed / resumed): the staged prefix is unusable."""
+
+
+class KVImportError(SnapshotError):
+    """The target engine cannot take this snapshot (geometry/dtype/token
+    mismatch, no page capacity, unsupported arena layout)."""
+
+
+def _crc(block: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(block).tobytes())
+
+
+@dataclasses.dataclass
+class KVSnapshot:
+    """One sequence's host-staged KV state.
+
+    ``tokens``/``seen_tokens`` pin WHAT the pages mean: pages ``i`` of the
+    export order hold the KV of token positions ``[i*page_size,
+    (i+1)*page_size)`` of ``tokens``, valid through ``seen_tokens``.
+    ``block_shape`` is the arena's per-page geometry ``(L, page_size, 2,
+    n_kv, head_dim)`` and ``dtype`` its element type — both must match the
+    importing arena exactly.  ``chunks`` are the staged blocks in export
+    order (``[L, n_i, page, 2, n_kv, hd]`` each) with one crc32 per chunk;
+    ``complete`` flips only after the LAST chunk landed, so a partially
+    exported snapshot (source died mid-flight) is structurally unusable."""
+    tokens: List[int]
+    seen_tokens: int
+    page_size: int
+    block_shape: Tuple[int, ...]
+    dtype: str
+    chunks: List[np.ndarray] = dataclasses.field(default_factory=list)
+    crcs: List[int] = dataclasses.field(default_factory=list)
+    complete: bool = False
+    source: Optional[str] = None          # provenance tag (replica id), logs only
+
+    @property
+    def n_pages(self) -> int:
+        return sum(int(c.shape[1]) for c in self.chunks)
+
+    @property
+    def n_bytes(self) -> int:
+        return sum(int(c.nbytes) for c in self.chunks)
+
+    def add_chunk(self, block: np.ndarray) -> None:
+        self.chunks.append(block)
+        self.crcs.append(_crc(block))
+
+    def verify(self) -> None:
+        """Re-checksum every staged chunk; raises on any mismatch.  An
+        incomplete snapshot fails here too — importing a prefix of a
+        sequence's KV would silently attend to garbage for the tail."""
+        if not self.complete:
+            raise SnapshotIntegrityError(
+                f"snapshot incomplete: {self.n_pages} page(s) staged, export "
+                "never finished")
+        for i, (block, crc) in enumerate(zip(self.chunks, self.crcs)):
+            if _crc(block) != crc:
+                raise SnapshotIntegrityError(
+                    f"snapshot chunk {i} crc mismatch "
+                    f"({block.shape[1]} page(s)) — torn or corrupted staging")
+
+
+class KVExporter:
+    """Chunked device→host export of one paused sequence's KV pages.
+
+    Construction snapshots the sequence's identity (token history, seen
+    boundary, page list) — the caller pauses the sequence first, so these
+    are stable for the export's lifetime.  Each :meth:`step_chunk` stages
+    the next ``chunk_pages`` pages through
+    :meth:`~....inference.v2.ragged.BlockedKVCache.export_pages` and
+    returns True once the snapshot is complete; the fleet driver calls it
+    once per round so the d2h copies overlap the source replica's ongoing
+    decode steps for everything else it is serving."""
+
+    def __init__(self, engine, uid: int, chunk_pages: int = 4,
+                 source: Optional[str] = None):
+        if chunk_pages < 1:
+            raise ValueError(f"chunk_pages must be >= 1, got {chunk_pages}")
+        seq = engine.state.seqs[uid]
+        kv = engine.kv
+        arena = engine.cache
+        if not hasattr(arena, "shape") or len(arena.shape) != 6:
+            raise KVImportError(
+                "KV export supports the scanned single-arena layout only "
+                "(unroll_layers builds a per-layer tuple)")
+        self.engine = engine
+        self.uid = uid
+        self.chunk_pages = int(chunk_pages)
+        self._seq = seq
+        # pages covering [0, seen_tokens): the trailing partial page is
+        # exported whole — positions past ``seen_tokens`` inside it are
+        # never attended on the importer either (kernels mask at start_pos)
+        n_pages = -(-seq.seen_tokens // kv.page_size)
+        self._pages = list(seq.pages[:n_pages])
+        self._next = 0
+        self.snapshot = KVSnapshot(
+            tokens=list(seq.tokens), seen_tokens=seq.seen_tokens,
+            page_size=kv.page_size,
+            block_shape=(arena.shape[0], ) + tuple(arena.shape[2:]),
+            dtype=str(arena.dtype), source=source)
+
+    @property
+    def remaining_pages(self) -> int:
+        return len(self._pages) - self._next
+
+    def _check_source(self) -> None:
+        seq = self.engine.state.seqs.get(self.uid)
+        if seq is not self._seq or not seq.paused or seq.done:
+            raise SnapshotAborted(
+                f"uid {self.uid}: source sequence preempted/flushed/resumed "
+                "mid-export — staged prefix unusable")
+        if seq.pages[:len(self._pages)] != self._pages:
+            raise SnapshotAborted(
+                f"uid {self.uid}: source page table changed mid-export")
+
+    def step_chunk(self) -> bool:
+        """Stage the next chunk; returns True when the snapshot completed.
+        Idempotent after completion."""
+        if self.snapshot.complete:
+            return True
+        _fi.check("kv.export")   # chaos site: torn/failed d2h staging
+        self._check_source()
+        lo = self._next
+        hi = min(lo + self.chunk_pages, len(self._pages))
+        if hi > lo:
+            block = self.engine.kv.export_pages(self.engine.cache,
+                                                self._pages[lo:hi])
+            self.snapshot.add_chunk(block)
+        self._next = hi
+        if self._next >= len(self._pages):
+            self.snapshot.complete = True
+        return self.snapshot.complete
+
+
+def import_snapshot(engine, uid: int, tokens: Sequence[int],
+                    snapshot: KVSnapshot, max_new_tokens: int):
+    """Materialize ``snapshot`` as sequence ``uid`` on ``engine``: verify
+    integrity, validate geometry, allocate fresh pages, scatter the staged
+    blocks host→device, and register a descriptor whose next step continues
+    generation exactly where the source stopped.
+
+    ``tokens`` is the caller's authoritative history (``prompt + tokens
+    generated so far``) and must equal the snapshot's — a snapshot carrying
+    a different history would resume the wrong request.  Raises a
+    :class:`SnapshotError` subclass on any rejection; the caller falls back
+    to the recompute-on-resume token path.  On failure nothing leaks: pages
+    are allocated only after every validation and freed if the scatter
+    itself fails, so allocator refcounts never drift."""
+    _fi.check("kv.import")   # chaos site: crash/device-loss mid-import
+    snapshot.verify()
+    kv = engine.kv
+    arena = engine.cache
+    if not hasattr(arena, "shape") or len(arena.shape) != 6:
+        raise KVImportError("KV import supports the scanned single-arena "
+                            "layout only (unroll_layers builds a tuple)")
+    if snapshot.page_size != kv.page_size:
+        raise KVImportError(f"page_size mismatch: snapshot {snapshot.page_size} "
+                            f"vs engine {kv.page_size}")
+    want = (arena.shape[0], ) + tuple(arena.shape[2:])
+    if tuple(snapshot.block_shape) != want:
+        raise KVImportError(f"arena geometry mismatch: snapshot "
+                            f"{tuple(snapshot.block_shape)} vs engine {want}")
+    if snapshot.dtype != str(arena.dtype):
+        raise KVImportError(f"arena dtype mismatch: snapshot {snapshot.dtype} "
+                            f"vs engine {arena.dtype}")
+    if list(snapshot.tokens) != [int(t) for t in tokens]:
+        raise KVImportError("token history mismatch: snapshot does not carry "
+                            "this request's prompt + generated tokens")
+    if uid in engine.state.seqs:
+        raise KVImportError(f"uid {uid} already live on the target engine")
+    n = snapshot.n_pages
+    if n != -(-snapshot.seen_tokens // kv.page_size):
+        raise KVImportError(f"snapshot pages ({n}) do not cover its seen "
+                            f"boundary ({snapshot.seen_tokens})")
+    if n > kv.max_pages_per_seq:
+        raise KVImportError(f"snapshot needs {n} pages > max_pages_per_seq="
+                            f"{kv.max_pages_per_seq}")
+    shortfall = n - kv.allocator.free_pages
+    if shortfall > 0 and kv.prefix_cache is not None:
+        kv.prefix_cache.evict(shortfall)
+        shortfall = n - kv.allocator.free_pages
+    if shortfall > 0:
+        raise KVImportError(f"target arena short {shortfall} page(s) for the "
+                            f"{n}-page import")
+    from ...inference.v2.ragged import SequenceDescriptor
+    pages = kv.allocator.allocate(n)
+    try:
+        new_arena = arena
+        off = 0
+        for block in snapshot.chunks:
+            cnt = int(block.shape[1])
+            new_arena = kv.import_pages(new_arena, pages[off:off + cnt], block)
+            off += cnt
+    except BaseException:
+        kv.allocator.free(pages)
+        raise
+    engine.cache = new_arena
+    seq = SequenceDescriptor(uid=uid, tokens=list(snapshot.tokens), pages=pages,
+                             seen_tokens=snapshot.seen_tokens)
+    engine.state.seqs[uid] = seq
+    engine._max_new[uid] = int(max_new_tokens)
+    # publish the imported full pages to the target's prefix cache: the
+    # decode replica becomes warm for affinity routing exactly as if it had
+    # prefilled the prompt itself
+    engine.state.note_progress(seq)
+    logger.debug(f"kvtransfer: imported uid={uid} ({n} pages, "
+                 f"{snapshot.n_bytes} bytes, source={snapshot.source})")
+    return seq

@@ -42,6 +42,9 @@ class ServingStats:
     rejected: int = 0
     timed_out: int = 0
     preemptions: int = 0       # events, not requests (one request can be evicted twice)
+    migrated: int = 0          # requests handed off with their KV (kvtransfer)
+    kv_imports: int = 0        # KV-import fast-path resumes on THIS replica
+    kv_import_fallbacks: int = 0   # snapshot rejected -> recompute-on-resume
     reject_reasons: Dict[str, int] = dataclasses.field(default_factory=dict)
     finished: List[ServingRequest] = dataclasses.field(default_factory=list)
 
@@ -52,6 +55,8 @@ class ServingStats:
     def record_terminal(self, req: ServingRequest) -> None:
         if req.state is RequestState.TIMED_OUT:
             self.timed_out += 1
+        elif req.state is RequestState.MIGRATED:
+            self.migrated += 1
         self.finished.append(req)
 
     @property
@@ -71,6 +76,9 @@ class ServingStats:
             "timed_out": self.timed_out,
             "preemptions": self.preemptions,
             "preempted_requests": sum(1 for r in self.finished if r.preemptions),
+            "migrated": self.migrated,
+            "kv_imports": self.kv_imports,
+            "kv_import_fallbacks": self.kv_import_fallbacks,
             "deadline_met": len(met),
             "rejection_rate": round(self.rejected / n_sub, 4),
             "preemption_rate": round(self.preemptions / n_sub, 4),

@@ -10,14 +10,25 @@ state machine the frontend drives:
     QUEUED → PREFILL → DECODE → DONE
        │        │         │
        │        └→ EVICTED ┘→ QUEUED   (KV-pressure preemption; resume
-       │                                recomputes the generated tokens'
-       │                                KV from the extended prompt)
+       │                  ▲             recomputes the generated tokens'
+       │                  │             KV from the extended prompt)
+       │  {PREFILL|DECODE} → MIGRATING → MIGRATED  (KV handed off to
+       │                        │         another replica — kvtransfer;
+       │                        │         late-prefill pause = the
+       │                        │         DistServe boundary)
+       │                        └→ {PREFILL|DECODE}  (migration aborted:
+       │                                              resume in place)
        └→ REJECTED                      (admission: queue full / infeasible)
     any non-terminal → TIMED_OUT        (deadline passed)
 
-Terminal states: DONE, TIMED_OUT, REJECTED.  EVICTED is transient — the
-frontend immediately requeues (or times out) the victim; it appears in the
-history so preemption events are auditable per request.
+Terminal states: DONE, TIMED_OUT, REJECTED, MIGRATED.  EVICTED is
+transient — the frontend immediately requeues (or times out) the victim;
+it appears in the history so preemption events are auditable per request.
+MIGRATING is the host-staging window of a KV migration: the request's
+engine sequence is paused (pages byte-stable for chunked export) and the
+fleet router either hands it off (MIGRATED — the request continues on a
+decode replica), aborts back to DECODE, or loses it to preemption
+(EVICTED — recompute-on-resume, the migration's fallback ladder).
 """
 
 import dataclasses
@@ -29,24 +40,38 @@ class RequestState(enum.Enum):
     QUEUED = "queued"
     PREFILL = "prefill"
     DECODE = "decode"
+    MIGRATING = "migrating"   # paused for KV export (serving/kvtransfer)
     DONE = "done"
     EVICTED = "evicted"
     TIMED_OUT = "timed_out"
     REJECTED = "rejected"
+    MIGRATED = "migrated"     # handed off to another replica with its KV
 
     @property
     def terminal(self) -> bool:
-        return self in (RequestState.DONE, RequestState.TIMED_OUT, RequestState.REJECTED)
+        return self in (RequestState.DONE, RequestState.TIMED_OUT,
+                        RequestState.REJECTED, RequestState.MIGRATED)
 
 
 _ALLOWED = {
     RequestState.QUEUED: {RequestState.PREFILL, RequestState.TIMED_OUT, RequestState.REJECTED},
-    RequestState.PREFILL: {RequestState.DECODE, RequestState.EVICTED, RequestState.TIMED_OUT},
-    RequestState.DECODE: {RequestState.DONE, RequestState.EVICTED, RequestState.TIMED_OUT},
+    RequestState.PREFILL: {RequestState.DECODE, RequestState.EVICTED, RequestState.TIMED_OUT,
+                           RequestState.MIGRATING},
+    RequestState.DECODE: {RequestState.DONE, RequestState.EVICTED, RequestState.TIMED_OUT,
+                          RequestState.MIGRATING},
+    # a migration can begin LATE IN PREFILL (the DistServe boundary: the
+    # final chunk + first-token sampling run on the decode replica, so the
+    # staging pause lands in TTFT, never TPOT) or mid-DECODE (short
+    # prompts whose whole prefill fit one chunk); an abort resumes the
+    # phase the pause interrupted
+    RequestState.MIGRATING: {RequestState.PREFILL, RequestState.DECODE,
+                             RequestState.MIGRATED,
+                             RequestState.EVICTED, RequestState.TIMED_OUT},
     RequestState.EVICTED: {RequestState.QUEUED, RequestState.TIMED_OUT},
     RequestState.DONE: set(),
     RequestState.TIMED_OUT: set(),
     RequestState.REJECTED: set(),
+    RequestState.MIGRATED: set(),
 }
 
 
@@ -84,6 +109,10 @@ class ServingRequest:
     spec_proposed: int = 0            # draft tokens fed to verify dispatches
     spec_accepted: int = 0            # drafts the model's argmax confirmed
     spec_rollback_pages: int = 0      # KV pages rolled back for rejected drafts
+    # host-staged KV state to import at admission instead of recomputing
+    # the prompt (serving/kvtransfer KVSnapshot; consumed — and cleared —
+    # on first admission whether the import succeeds or falls back)
+    kv_snapshot: Optional[object] = None
 
     def __post_init__(self):
         self.prompt = list(self.prompt)

@@ -89,6 +89,14 @@ EVENTS = {
                             "KV pages released rolling back rejected drafts"),
     "spec/acceptance_rate": ("histogram", "serving/engine.py",
                              "per-verify-round accepted/proposed ratio"),
+    # ---- KV migration (serving/kvtransfer/ via serving/engine.py)
+    "serving/migrated": ("event+counter", "serving/engine.py",
+                         "request handed off to another replica with its KV"),
+    "migration/kv_imports": ("counter", "serving/engine.py",
+                             "KV-import fast-path resumes (no prompt recompute)"),
+    "migration/import_fallback": ("counter", "serving/engine.py",
+                                  "snapshot rejected at import -> "
+                                  "recompute-on-resume"),
     # ---- fleet router (serving/fleet/)
     "fleet/dispatch": ("event", "serving/fleet/router.py",
                        "request placed on a replica (value = rid)"),
@@ -96,6 +104,15 @@ EVENTS = {
                            "replica declared dead (value = rid)"),
     "fleet/failover_requeued": ("event", "serving/fleet/router.py",
                                 "in-flight requests displaced to survivors"),
+    "fleet/migration_start": ("event", "serving/fleet/router.py",
+                              "KV export began on a prefill replica "
+                              "(value = source rid)"),
+    "fleet/migration_complete": ("event", "serving/fleet/router.py",
+                                 "snapshot handed off to a decode replica "
+                                 "(value = source rid)"),
+    "fleet/migration_fallback": ("event", "serving/fleet/router.py",
+                                 "migration abandoned; recompute/in-place "
+                                 "decode owns the request"),
     # ---- monitor surface (monitor/monitor.py)
     "monitor/dropped_events": ("event", "monitor/monitor.py",
                                "cumulative events shed by the max_events cap"),
@@ -118,7 +135,7 @@ EVENTS = {
 DYNAMIC = [
     {"prefix": "serving/", "template": "serving/<terminal-state>",
      "kind": "counter", "source": "serving/engine.py",
-     "expansions": ["serving/done", "serving/timed_out"],
+     "expansions": ["serving/done", "serving/timed_out", "serving/migrated"],
      "doc": "terminal-state counter per finished request"},
     {"prefix": "fleet/", "template": "fleet/<terminal-state>",
      "kind": "event", "source": "serving/fleet/router.py",

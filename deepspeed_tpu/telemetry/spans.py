@@ -6,11 +6,13 @@ tracer does not shadow it with live open/close bookkeeping on the hot
 path.  Instead, when a request (or a failed-over replica attempt) ends,
 its history is folded into contiguous **phase spans** here:
 
-    queued   — QUEUED (admission queue, preemption requeue, backoff)
-    prefill  — PREFILL (prompt + recompute-on-resume KV build)
-    decode   — DECODE
-    pending  — fleet-level router queue time (before dispatch, between
-               failover displacement and re-dispatch)
+    queued    — QUEUED (admission queue, preemption requeue, backoff)
+    prefill   — PREFILL (prompt + recompute-on-resume KV build)
+    decode    — DECODE
+    migrating — MIGRATING (paused for chunked KV export — the per-request
+                migration cost of disaggregated serving)
+    pending   — fleet-level router queue time (before dispatch, between
+                failover displacement and re-dispatch)
 
 Phase spans TILE the request's lifetime exactly — consecutive history
 entries share boundary timestamps — which is the property
@@ -38,6 +40,10 @@ PHASE_OF_STATE = {
     RequestState.PREFILL: "prefill",
     RequestState.DECODE: "decode",
     RequestState.EVICTED: "evicted",
+    # host-staging window of a KV migration (serving/kvtransfer): the
+    # request is paused on the source replica while its pages export — the
+    # per-request migration cost the disaggregation bench accounts for
+    RequestState.MIGRATING: "migrating",
 }
 
 

@@ -16,6 +16,17 @@ prefixes), over every (replica count x routing policy) point:
   the *failover recovery time* (replica death -> last displaced request
   terminal) is recorded per kill.
 
+Plus the DISAGGREGATION leg (schema v2, docs/SERVING.md "Disaggregated
+serving"): a mixed long-prompt/short-prompt Poisson workload under a
+token-proportional step-cost model, served twice — monolithic (4 MIXED
+replicas, least_outstanding) vs disaggregated (2 PREFILL + 2 DECODE
+replicas, the ``disaggregated`` policy with host-staged KV migration).
+The committed record must show the disaggregated fleet beating the
+monolithic one on p99 TTFT *and* p99 TPOT with ZERO output divergence,
+the per-request migration cost visible as ``phase/migrating`` telemetry
+spans (one per migrated request), and the KV-import fast path actually
+taken (``kv_imports`` > 0).
+
 Two clock modes, as in bench_serving.py:
   --dryrun  CPU + ONE shared deterministic VirtualClock (a fleet round =
             max replica step cost): bit-reproducible across invocations —
@@ -41,6 +52,7 @@ import numpy as np
 
 REPLICA_COUNTS = (1, 2, 4)
 POLICY_NAMES = ("round_robin", "least_outstanding", "prefix_affinity")
+DISAGG_ROLES = ("prefill", "prefill", "decode", "decode")
 
 
 def _build_factory(dryrun: bool):
@@ -154,6 +166,83 @@ def run_point(factory, clock_factory, policy_name, n_replicas, arrivals, rate,
     return rec
 
 
+def _disagg_point(factory, clock_factory, arrivals, roles, policy_name,
+                  serving_config, **router_kw):
+    """One disaggregation-leg run: trace it (the ``phase/migrating`` spans
+    are the acceptance receipt), return (summary, per-request outputs,
+    migrating-span stats)."""
+    from deepspeed_tpu.serving.fleet import (FleetSimulator, ReplicaPool,
+                                             Router, make_policy)
+    from deepspeed_tpu.telemetry import Tracer
+    clock = clock_factory()
+    tracer = Tracer(clock=clock)
+    pool = ReplicaPool(factory, 4, clock=clock, serving_config=serving_config,
+                       tracer=tracer, roles=roles)
+    pool.rebase_clock()
+    router = Router(pool, make_policy(policy_name), tracer=tracer, **router_kw)
+    reqs = FleetSimulator(router).run([dict(a) for a in arrivals])
+    rec = router.summary()
+    rec["offered_rps"] = round(len(arrivals) / max(arrivals[-1]["arrival_ts"], 1e-9), 6)
+    mig = [s for s in tracer.spans if s.name == "phase/migrating"]
+    total = sum(s.end_ts - s.start_ts for s in mig)
+    span_stats = {"count": len(mig), "total_s": round(total, 6),
+                  "mean_s": round(total / len(mig), 6) if mig else None}
+    return rec, [list(r.tokens) for r in reqs], span_stats
+
+
+def run_disaggregation_leg(factory, clock_factory, seed, vocab, dryrun):
+    """Monolithic vs disaggregated on the same mixed long/short workload.
+    Returns the schema-v2 ``disaggregation`` record."""
+    from deepspeed_tpu.serving import ServingConfig
+    from deepspeed_tpu.serving.fleet import poisson_mixed_arrivals
+    wl = {"kind": "poisson_mixed", "seed": seed,
+          "n_requests": 40 if dryrun else 64,
+          "rate": 1.15 if dryrun else 6.0,
+          "short_len": 8, "long_len": 160, "long_frac": 0.35,
+          "short_new": 24, "long_new": 24}
+    arrivals = poisson_mixed_arrivals(
+        seed=wl["seed"], n_requests=wl["n_requests"], rate=wl["rate"],
+        vocab=vocab, short_len=wl["short_len"], long_len=wl["long_len"],
+        long_frac=wl["long_frac"], short_new=wl["short_new"],
+        long_new=wl["long_new"])
+    # token-proportional virtual step cost: a mixed step carrying a prefill
+    # chunk is slower than a pure-decode step — the head-of-line blocking
+    # disaggregation removes.  WallClock mode measures real time instead.
+    scfg = ServingConfig(step_cost=(lambda toks: 0.25 + 0.015 * toks)
+                         if dryrun else None)
+    chunk_pages, chunk_cost = 20, 0.05 if dryrun else 0.0
+    mono_rec, mono_out, _ = _disagg_point(
+        factory, clock_factory, arrivals, None, "least_outstanding", scfg)
+    dis_rec, dis_out, span_stats = _disagg_point(
+        factory, clock_factory, arrivals, list(DISAGG_ROLES), "disaggregated",
+        scfg, migration_chunk_pages=chunk_pages,
+        migration_chunk_cost=chunk_cost)
+    divergent = sum(1 for a, b in zip(mono_out, dis_out) if a != b)
+    mono_rec["arrival_rate"] = dis_rec["arrival_rate"] = wl["rate"]
+    rec = {
+        "workload": wl,
+        "roles": list(DISAGG_ROLES),
+        "step_cost": "0.25 + 0.015 * planned_tokens" if dryrun else "wall",
+        "migration_chunk_pages": chunk_pages,
+        "migration_chunk_cost": chunk_cost,
+        "monolithic": mono_rec,
+        "disaggregated": dis_rec,
+        "zero_divergence": divergent == 0,
+        "divergent_requests": divergent,
+        "migration_spans": span_stats,
+    }
+    for k in ("ttft", "tpot"):
+        m, d = mono_rec[k]["p99"], dis_rec[k]["p99"]
+        rec[f"p99_{k}_improvement"] = round(1.0 - d / m, 4) if m else None
+    print(f"# disaggregation: mono ttft p99={mono_rec['ttft']['p99']} "
+          f"tpot p99={mono_rec['tpot']['p99']} | disagg ttft "
+          f"p99={dis_rec['ttft']['p99']} tpot p99={dis_rec['tpot']['p99']} | "
+          f"migrated={dis_rec['migration']['migrated_requests']} "
+          f"kv_imports={dis_rec['migration']['kv_imports']} "
+          f"divergent={divergent}", flush=True)
+    return rec
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--dryrun", action="store_true",
@@ -216,6 +305,26 @@ def main():
                   f"affinity_hit_rate={rec['affinity']['hit_rate']} "
                   f"recovery={rec['failover']['recovery_times']}", flush=True)
 
+    disagg = run_disaggregation_leg(factory, clock_factory, args.seed, vocab,
+                                    args.dryrun)
+    if args.dryrun:
+        # the disaggregation receipts (deterministic on the virtual clock —
+        # fail the run, not just CI; wall mode records without asserting)
+        assert disagg["zero_divergence"], \
+            f"disaggregated outputs diverged on {disagg['divergent_requests']} request(s)"
+        mig = disagg["disaggregated"]["migration"]
+        assert mig["completed"] > 0 and mig["kv_imports"] > 0, \
+            f"migration never took the KV-import fast path: {mig}"
+        # at least one span per migrated request (a transient-fallback
+        # retry legitimately adds a second MIGRATING interval)
+        assert disagg["migration_spans"]["count"] >= mig["migrated_requests"] > 0, \
+            f"migrating phase spans ({disagg['migration_spans']}) < " \
+            f"migrated requests ({mig['migrated_requests']})"
+        for k in ("ttft", "tpot"):
+            m = disagg["monolithic"][k]["p99"]
+            d = disagg["disaggregated"][k]["p99"]
+            assert d < m, f"disaggregated p99 {k} {d} does not beat monolithic {m}"
+
     # the receipts the acceptance criteria pin — fail the run, not just CI
     aff = [r for r in sweep if r["policy"] == "prefix_affinity"]
     assert any((r["affinity"]["hit_rate"] or 0) > 0 for r in aff), \
@@ -232,7 +341,7 @@ def main():
         "metric": "fleet_goodput_rps",
         "value": best["goodput_rps"],
         "unit": "requests/s" if not args.dryrun else "requests/step",
-        "schema_version": 1,
+        "schema_version": 2,
         "sla": {"ttft_budget": ttft_budget, "tpot_budget": tpot_budget},
         "workload": {"n_requests": n_requests, "seed": args.seed,
                      "arrival_rate": rate,
@@ -252,6 +361,7 @@ def main():
         "replica_counts": list(REPLICA_COUNTS),
         "policies": list(POLICY_NAMES),
         "sweep": sweep,
+        "disaggregation": disagg,
     }
     print(json.dumps({k: result[k] for k in ("metric", "value", "unit")} |
                      {"best": {"policy": best["policy"],

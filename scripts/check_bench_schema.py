@@ -60,10 +60,67 @@ _ROUTER_POINT = {
     "submitted": INT, "completed": INT, "timed_out": INT, "rejected": INT,
     "dispatches": INT, "failovers": INT, "deadline_met": INT, "goodput_rps": NUM,
     "affinity": {"hits": INT, "misses": INT, "hit_rate": ("nullable", NUM)},
+    "migration": {"started": INT, "chunks": INT, "completed": INT,
+                  "fallbacks": INT, "failover_reuse": INT,
+                  "migrated_requests": INT, "kv_imports": INT,
+                  "import_fallbacks": INT},
     "failover": {"kills": INT, "requeued": INT, "recovery_times": [NUM],
                  "unrecovered": INT},
     "ttft": _pct_ordered, "tpot": _pct_ordered, "e2e": _pct_ordered,
 }
+
+
+def _disagg_record(v):
+    """The disaggregation receipt (bench_router.py run_disaggregation_leg):
+    the 2-prefill + 2-decode fleet must beat the monolithic 4-replica one
+    on p99 TTFT AND p99 TPOT over the same mixed long/short workload, with
+    zero output divergence, migrations actually completing through the
+    KV-import fast path, and the per-request migration cost materialized
+    as exactly one ``phase/migrating`` telemetry span per migrated
+    request.  A committed artifact where disaggregation lost (or lied
+    about outputs) is a regression, not a benchmark."""
+    if not isinstance(v, dict):
+        return f"expected disaggregation object, got {type(v).__name__}"
+    for k in ("workload", "roles", "monolithic", "disaggregated",
+              "zero_divergence", "divergent_requests", "migration_spans"):
+        if k not in v:
+            return f"missing disaggregation key {k!r}"
+    if v["zero_divergence"] is not True or v["divergent_requests"] != 0:
+        return (f"output divergence recorded ({v['divergent_requests']} "
+                "request(s)) — the migration identical-outputs contract broke")
+    roles = v["roles"]
+    if not (isinstance(roles, list) and "prefill" in roles and "decode" in roles):
+        return f"roles {roles!r} do not split the fleet into prefill + decode"
+    errors = []
+    for side in ("monolithic", "disaggregated"):
+        _check(v[side], _ROUTER_POINT, f"disaggregation.{side}", errors)
+    if errors:
+        return "; ".join(errors)
+    mono, dis = v["monolithic"], v["disaggregated"]
+    if mono["completed"] != dis["completed"]:
+        return (f"not an equal-completion pair: monolithic {mono['completed']} "
+                f"vs disaggregated {dis['completed']}")
+    mig = dis["migration"]
+    if not (mig["completed"] > 0 and mig["kv_imports"] > 0):
+        return f"migration never took the KV-import fast path: {mig}"
+    spans = v["migration_spans"]
+    n_spans = spans.get("count", 0)
+    # AT LEAST one positive-width span per migrated request; a request
+    # legitimately re-enters MIGRATING after a transient fallback (each
+    # interval folds to its own span), so exact equality only holds on a
+    # fallback-free run
+    if n_spans < mig["migrated_requests"] or mig["migrated_requests"] <= 0:
+        return (f"migrating phase spans ({n_spans}) < migrated requests "
+                f"({mig['migrated_requests']}) — migration cost invisible "
+                "in telemetry")
+    if mig["fallbacks"] == 0 and n_spans != mig["migrated_requests"]:
+        return (f"fallback-free run but migrating spans ({n_spans}) != "
+                f"migrated requests ({mig['migrated_requests']})")
+    for k in ("ttft", "tpot"):
+        m, d = mono[k]["p99"], dis[k]["p99"]
+        if m is None or d is None or not d < m:
+            return f"disaggregated p99 {k} {d} does not beat monolithic {m}"
+    return None
 
 
 def _router_sweep_invariants(v):
@@ -220,10 +277,10 @@ SCHEMAS = {
                         "concurrency": INT},
         "engine_throughput": ("nullable", _LEGACY_THROUGHPUT),
     },
-    # the fleet router harness (scripts/bench_router.py, schema v1)
+    # the fleet router harness (scripts/bench_router.py, schema v2)
     "BENCH_ROUTER.json": {
         "metric": STR, "value": NUM, "unit": STR,
-        "schema_version": lambda v: None if v == 1 else f"schema_version {v} != 1",
+        "schema_version": lambda v: None if v == 2 else f"schema_version {v} != 2",
         "sla": {"ttft_budget": NUM, "tpot_budget": NUM},
         "workload": {"n_requests": INT, "seed": INT, "arrival_rate": NUM,
                      "prefix_groups": INT, "prefix_pages": INT, "dryrun": BOOL,
@@ -232,6 +289,7 @@ SCHEMAS = {
         "policies": [STR],
         "sweep": _router_sweep_invariants,
         "sweep[]": [_ROUTER_POINT],
+        "disaggregation": _disagg_record,
     },
 }
 

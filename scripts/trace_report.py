@@ -7,7 +7,9 @@ Input: a trace exported by ``deepspeed_tpu.telemetry.write_chrome_trace``
 ``request``) the phase child spans — ``pending`` (router queue /
 failover re-dispatch wait), ``queued`` (replica admission queue, incl.
 preemption requeue and submit backoff), ``prefill``, ``decode``,
-``evicted`` — are summed into a per-request breakdown, then aggregated
+``migrating`` (paused for chunked KV export — the per-request
+cost of a disaggregated prefill→decode handoff), ``evicted`` — are
+summed into a per-request breakdown, then aggregated
 into the fleet-level critical path: where does a request's latency
 actually go — queueing, prompt processing, token generation, or
 retry/backoff after preemption and failover?
@@ -37,7 +39,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 from deepspeed_tpu.serving.metrics import percentile_summary  # noqa: E402
 
-PHASES = ("pending", "queued", "prefill", "decode", "evicted")
+PHASES = ("pending", "queued", "prefill", "decode", "migrating", "evicted")
 _US = 1e6
 
 

@@ -1,0 +1,95 @@
+"""Regression tests for the incremental n-gram→position index behind
+``NGramDrafter`` (inference/v2/spec): proposals must be IDENTICAL to the
+r12 right-to-left rescan on every history — including the engine's exact
+mutation pattern (extend-with-drafts, truncate back, append accepted,
+preemption rebuilding the list) — while indexing only the appended
+suffix.  Pure host-side: no jax, no model."""
+
+import random
+
+import pytest
+
+from deepspeed_tpu.inference.v2.spec import NGramDrafter, SpecConfig, make_drafter
+
+
+def test_long_history_drafts_identical_to_scan():
+    """The satellite's pinned regression: long-history drafting proposes
+    exactly what the reference rescan proposes, at every length."""
+    rng = random.Random(0)
+    d = NGramDrafter(max_ngram=3, min_ngram=1)
+    toks = []
+    for step in range(3000):
+        toks.append(rng.randrange(2, 40))          # repetitive alphabet
+        if step % 7 == 0:                           # probe at mixed cadences
+            k = rng.randrange(1, 6)
+            assert d.draft(toks, k) == d._scan_draft(list(toks), k), \
+                f"divergence at len={len(toks)}"
+    assert len(toks) == 3000
+    # the index only ever extended: one entry, indexed through the last
+    # probe (draft() indexes lazily, on call)
+    assert len(d._indexes) == 1
+    (idx, ) = d._indexes.values()
+    assert idx.indexed == 2997 and idx.tokens is toks
+
+
+@pytest.mark.parametrize("max_ngram", [1, 2, 4])
+def test_engine_mutation_pattern_fuzz(max_ngram):
+    """Replays the engine's exact list mutations: extend with drafts,
+    slice back out, append accepted tokens, occasional preemption (a NEW
+    list object for the same logical request)."""
+    rng = random.Random(max_ngram)
+    d = NGramDrafter(max_ngram=max_ngram, min_ngram=1)
+    for trial in range(60):
+        toks = [rng.randrange(2, 9) for _ in range(rng.randrange(0, 30))]
+        for _ in range(80):
+            k = rng.randrange(0, 5)
+            assert d.draft(toks, k) == d._scan_draft(list(toks), k)
+            base = len(toks)
+            toks.extend(rng.randrange(2, 9) for _ in range(rng.randrange(0, 4)))
+            del toks[base:]                          # verify-round rollback
+            for _ in range(rng.randrange(1, 3)):
+                toks.append(rng.randrange(2, 9))    # accepted + bonus
+            if rng.random() < 0.05:                  # preemption: fresh list
+                toks = list(toks)
+
+
+def test_truncation_below_index_rebuilds():
+    d = NGramDrafter(max_ngram=3)
+    toks = [1, 2, 3, 1, 2, 3, 1, 2]
+    assert d.draft(toks, 3) == d._scan_draft(list(toks), 3) == [3, 1, 2]
+    del toks[3:]                                     # shrink BELOW the indexed boundary
+    toks.extend([9, 9, 1, 2])                        # different continuation
+    assert d.draft(toks, 3) == d._scan_draft(list(toks), 3)
+    # and a same-length different-content rewrite is caught by the tail probe
+    toks2 = [5, 6, 5, 6, 5]
+    assert d.draft(toks2, 2) == [6, 5]
+    toks2[-1] = 7
+    toks2[0] = 7                                     # tokens[indexed-1] changed
+    assert d.draft(toks2, 2) == d._scan_draft(list(toks2), 2)
+
+
+def test_index_cache_is_bounded():
+    d = NGramDrafter(max_ngram=2, max_cached_seqs=4)
+    lists = [[i, i + 1, i, i + 1] for i in range(10)]
+    for t in lists:
+        d.draft(t, 2)
+    assert len(d._indexes) == 4                      # LRU bound holds
+
+
+def test_non_list_histories_use_reference_scan():
+    d = NGramDrafter(max_ngram=3)
+    t = (4, 5, 6, 4, 5, 6, 4)
+    assert d.draft(t, 2) == [5, 6]
+    assert not d._indexes                            # tuple path never indexes
+
+
+def test_drafter_contract_unchanged():
+    """The r12 behavioural edges the engine relies on."""
+    d = make_drafter(SpecConfig(max_draft=4, max_ngram=3, min_ngram=1))
+    assert isinstance(d, NGramDrafter)
+    assert d.draft([], 4) == []                      # empty history
+    assert d.draft([1], 4) == []                     # too short to match
+    assert d.draft([1, 2, 1, 2], 0) == []            # no room
+    assert d.draft([3, 4, 3], 4) == [4, 3]           # wraps the whole tail
+    with pytest.raises(ValueError, match="min_ngram"):
+        NGramDrafter(max_ngram=2, min_ngram=3)

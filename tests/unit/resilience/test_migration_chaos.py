@@ -1,0 +1,213 @@
+"""Chaos tests for the ``kv.export`` / ``kv.import`` fault-injection sites
+(serving/kvtransfer): torn staging, transient I/O faults, device losses and
+driver crashes fired through the exact production migration paths — every
+rung of the fallback ladder must keep outputs byte-identical to an
+unperturbed run with zero page-refcount drift, plus a seeded property
+audit across random migrate/preempt/kill schedules."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, build_engine
+from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.models.llama_cache import PagedKVConfig
+from deepspeed_tpu.resilience.fault_injection import (INJECTION_SITES, FaultSpec,
+                                                      InjectedCrash,
+                                                      configure_fault_injection)
+from deepspeed_tpu.serving import ServingConfig, VirtualClock
+from deepspeed_tpu.serving.fleet import (FleetSimulator, FleetState, ReplicaPool,
+                                         ReplicaState, Router, make_policy,
+                                         poisson_mixed_arrivals)
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=256,
+                  rope_theta=1e4, dtype=jnp.float32, scan_layers=True, remat=False)
+
+
+@pytest.fixture(scope="module")
+def trained_params():
+    model = LlamaForCausalLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    configure_fault_injection(None)
+
+
+def _factory(trained_params, num_pages=64):
+    def make():
+        kv = PagedKVConfig(num_pages=num_pages, page_size=8, max_pages_per_seq=16)
+        sched = SchedulerConfig(token_budget=64, max_seqs=8, prefill_chunk=8,
+                                decode_bucket=4)
+        return build_engine(CFG, trained_params, RaggedInferenceEngineConfig(
+            kv=kv, scheduler=sched, kv_dtype=jnp.float32, decode_steps_per_dispatch=1))
+    return make
+
+
+PROMPTS = [[5, 9, 2, 7, 1], [3, 3, 8], [1, 2, 3, 4, 5, 6, 7, 8, 9], [11, 4, 4]]
+
+
+def _arrivals(prompts, max_new=8, spacing=0.5):
+    return [dict(prompt=p, max_new_tokens=max_new, arrival_ts=round(i * spacing, 6))
+            for i, p in enumerate(prompts)]
+
+
+def _fleet(trained_params, roles, **router_kw):
+    pool = ReplicaPool(_factory(trained_params), len(roles), clock=VirtualClock(),
+                       roles=roles)
+    router = Router(pool, make_policy("disaggregated"),
+                    migration_chunk_pages=router_kw.pop("chunk_pages", 1),
+                    **router_kw)
+    return router, pool
+
+
+def _assert_clean(pool):
+    """Zero page-refcount drift on every live replica: no sequences left,
+    and dropping the prefix cache frees everything but the null page."""
+    for rep in pool.replicas.values():
+        if rep.serve is None:
+            continue
+        eng = rep.serve.engine
+        assert not eng.state.seqs
+        if eng.kv.prefix_cache is not None:
+            eng.kv.prefix_cache.evict(eng.kv.num_pages)
+        assert eng.kv.allocator.free_pages == eng.kv.num_pages - 1
+
+
+def test_kv_sites_registered():
+    assert "kv.export" in INJECTION_SITES and "kv.import" in INJECTION_SITES
+    FaultSpec(site="kv.export", kind="os_error")     # validates
+    FaultSpec(site="kv.import", kind="device_loss")
+    with pytest.raises(ValueError, match="unknown injection site"):
+        FaultSpec(site="kv.exprot", kind="crash")
+
+
+def test_export_os_error_falls_back_to_in_place_decode(trained_params):
+    """A transient d2h staging fault aborts the migration; decode resumes
+    on the source replica exactly where it paused — outputs identical."""
+    golden = _factory(trained_params)().generate(PROMPTS, max_new_tokens=8)
+    configure_fault_injection(
+        {"sites": [{"site": "kv.export", "kind": "os_error", "at": 1}]})
+    router, pool = _fleet(trained_params, ["prefill", "decode"])
+    reqs = FleetSimulator(router).run(_arrivals(PROMPTS))
+    assert [r.state for r in reqs] == [FleetState.DONE] * 4
+    assert [r.tokens for r in reqs] == golden
+    assert router.stats["migration_fallbacks"] == 1
+    # the fault was TRANSIENT: the victim resumed decode in place, was
+    # picked up again on a later round, and the retry migrated it through
+    # the fast path — all four requests still hand off
+    assert router.summary()["migration"]["completed"] == 4
+    assert router.summary()["migration"]["kv_imports"] == 4
+    assert max(r.migrations for r in reqs) == 2   # the victim's retry
+    _assert_clean(pool)
+
+
+def test_export_device_loss_kills_source_and_fails_over(trained_params):
+    """The d2h staging finds the source device gone: the prefill replica
+    dies, its in-flight work (including the half-exported victim) fails
+    over by recompute — outputs identical."""
+    golden = _factory(trained_params)().generate(PROMPTS, max_new_tokens=8)
+    configure_fault_injection(
+        {"sites": [{"site": "kv.export", "kind": "device_loss", "at": 1}]})
+    router, pool = _fleet(trained_params, ["prefill", "decode"])
+    reqs = FleetSimulator(router).run(_arrivals(PROMPTS),
+                                      schedule=[(30.0, "recover", 0)])
+    assert [r.state for r in reqs] == [FleetState.DONE] * 4
+    assert [r.tokens for r in reqs] == golden
+    dead = [h for h in pool.health.history if h[2] is ReplicaState.DEAD]
+    assert len(dead) == 1
+    assert router.stats["failovers"] >= 1
+    _assert_clean(pool)
+
+
+def test_import_os_error_falls_back_to_recompute(trained_params):
+    """An import-side fault consumes the snapshot and recomputes the
+    prompt on the decode replica instead — slower, never wrong."""
+    golden = _factory(trained_params)().generate(PROMPTS, max_new_tokens=8)
+    configure_fault_injection(
+        {"sites": [{"site": "kv.import", "kind": "os_error", "at": 1}]})
+    router, pool = _fleet(trained_params, ["prefill", "decode"])
+    reqs = FleetSimulator(router).run(_arrivals(PROMPTS))
+    assert [r.state for r in reqs] == [FleetState.DONE] * 4
+    assert [r.tokens for r in reqs] == golden
+    mig = router.summary()["migration"]
+    assert mig["completed"] == 4
+    assert mig["import_fallbacks"] == 1 and mig["kv_imports"] == 3
+    assert pool.replica(1).serve.stats.kv_import_fallbacks == 1
+    _assert_clean(pool)
+
+
+def test_import_device_loss_kills_target_snapshot_survives(trained_params):
+    """Crash mid-import: the decode TARGET dies at the h2d scatter.  The
+    snapshot is host memory — it goes back on the request and the OTHER
+    decode replica resumes through the fast path, outputs identical."""
+    golden = _factory(trained_params)().generate([PROMPTS[2]], max_new_tokens=8)
+    configure_fault_injection(
+        {"sites": [{"site": "kv.import", "kind": "device_loss", "at": 1}]})
+    router, pool = _fleet(trained_params, ["prefill", "decode", "decode"])
+    reqs = FleetSimulator(router).run(_arrivals([PROMPTS[2]]))
+    fr = reqs[0]
+    assert fr.state is FleetState.DONE and fr.tokens == golden[0]
+    dead = [h for h in pool.health.history if h[2] is ReplicaState.DEAD]
+    assert len(dead) == 1 and dead[0][0] in (1, 2)
+    survivor = 3 - dead[0][0]
+    assert router.stats["migration_failover_reuse"] == 1
+    assert pool.replica(survivor).serve.stats.kv_imports == 1  # fast path reused
+    _assert_clean(pool)
+
+
+def test_import_crash_propagates(trained_params):
+    """InjectedCrash at kv.import simulates death of THIS driver process —
+    nothing in the migration stack may absorb it."""
+    configure_fault_injection(
+        {"sites": [{"site": "kv.import", "kind": "crash", "at": 1}]})
+    router, pool = _fleet(trained_params, ["prefill", "decode"])
+    with pytest.raises(InjectedCrash):
+        FleetSimulator(router).run(_arrivals([PROMPTS[2]]))
+
+
+def test_export_crash_propagates(trained_params):
+    configure_fault_injection(
+        {"sites": [{"site": "kv.export", "kind": "crash", "at": 1}]})
+    router, pool = _fleet(trained_params, ["prefill", "decode"])
+    with pytest.raises(InjectedCrash):
+        FleetSimulator(router).run(_arrivals([PROMPTS[2]]))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_property_random_migrate_preempt_kill(trained_params, seed):
+    """Seeded property audit: a mixed workload over a disaggregated fleet
+    with random transient staging faults AND a random kill/recover of one
+    replica — every request completes with outputs identical to a
+    straight-line single-engine run, nothing lost or duplicated, zero
+    refcount drift on every surviving replica."""
+    rng = np.random.default_rng(seed)
+    arrivals = poisson_mixed_arrivals(seed=seed, n_requests=10, rate=1.5,
+                                      vocab=CFG.vocab_size, short_len=6,
+                                      long_len=24, long_frac=0.4,
+                                      short_new=6, long_new=6)
+    golden = _factory(trained_params)().generate(
+        [a["prompt"] for a in arrivals], max_new_tokens=6)
+    # random transient faults on both staging edges, seeded → reproducible
+    configure_fault_injection(
+        {"seed": int(seed),
+         "sites": [{"site": "kv.export", "kind": "os_error", "p": 0.2},
+                   {"site": "kv.import", "kind": "os_error", "p": 0.2}]})
+    roles = ["prefill", "decode", "decode"]
+    router, pool = _fleet(trained_params, roles)
+    victim = int(rng.integers(0, len(roles)))
+    kill_at = float(rng.uniform(1.0, 6.0))
+    reqs = FleetSimulator(router).run(
+        arrivals, schedule=[(kill_at, "kill", victim),
+                            (kill_at + 10.0, "recover", victim)])
+    assert [r.state for r in reqs] == [FleetState.DONE] * len(arrivals)
+    assert [r.tokens for r in reqs] == golden
+    # exactly-once terminal accounting
+    for r in reqs:
+        assert sum(1 for st, _ in r.history if st.terminal) == 1
+    _assert_clean(pool)
