@@ -83,7 +83,9 @@ INJECTION_SITES = frozenset({
     "engine.step",          # training-step dispatch (runtime/engine.py)
     "engine.verify_step",   # speculative verify dispatch (inference/v2/engine_v2.py)
     "serving.admit",        # serving request admission (serving/engine.py)
+    "admission.tenant",     # tenant-QoS admission bookkeeping (serving/fleet/router.py)
     "router.dispatch",      # fleet router request dispatch (serving/fleet/router.py)
+    "autoscaler.decide",    # overload-control-plane decision probe (serving/fleet/autoscale.py)
     "kv.export",            # KV page d2h staging chunk (serving/kvtransfer/snapshot.py)
     "kv.import",            # KV snapshot h2d import (serving/kvtransfer/snapshot.py)
 })

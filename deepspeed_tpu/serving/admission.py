@@ -63,6 +63,20 @@ class AdmissionController:
             return False, "queue_full"
         return True, None
 
+    def retry_after_hint(self, queue_depth: int,
+                         ewma_step_s: Optional[float]) -> float:
+        """Deterministic retry-after for a ``queue_full`` rejection: the
+        estimated time for the standing queue to drain — queue depth times
+        the observed per-step seconds (1.0 before the first step, the
+        VirtualClock unit).  A conservative upper bound, so callers that
+        can probe cheaply (``ServingEngine.submit``'s hinted wait) re-check
+        as capacity frees instead of sitting out the whole estimate.  An
+        informed wait beats the blind exponential ladder: the client (or
+        the fleet router) comes back when capacity plausibly exists
+        instead of probing through geometric guesses."""
+        per_step = ewma_step_s if ewma_step_s else 1.0
+        return round(max(1, queue_depth) * per_step, 6)
+
     # -------------------------------------------------------------- start
 
     def _start_pages(self, req: ServingRequest) -> int:

@@ -180,15 +180,20 @@ class ServingEngine:
         consumed at first admission (a preemption AFTER import resumes by
         recompute, as always).
 
-        ``retry_policy`` (a resilience ``RetryPolicy``): back off on the
-        clock and re-probe admission while the rejection is TRANSIENT
-        (``queue_full`` — pressure that drains), within the policy's
-        attempt/time budget; structural rejections (infeasible request)
-        are final immediately.  Each backoff probe runs one ``tick()`` so
-        the loop makes real progress while the submitter waits (in a
-        single-threaded clock-driven driver nothing else would drain the
-        queue); deadlines that expire during the wait expire because time
-        — and engine work — genuinely passed."""
+        ``retry_policy`` (a resilience ``RetryPolicy``): re-probe admission
+        while the rejection is TRANSIENT (``queue_full`` — pressure that
+        drains); structural rejections (infeasible request) are final
+        immediately.  The FIRST wait honors the admission controller's
+        ``retry_after`` hint (queue depth x EWMA step seconds — when
+        capacity plausibly exists) instead of a blind exponential ladder;
+        only if that informed probe still finds the queue full does the
+        policy's backoff schedule run, within its attempt/time budget.
+        Each wait runs ``tick()``\\ s so the loop makes real progress while
+        the submitter waits (in a single-threaded clock-driven driver
+        nothing else would drain the queue); deadlines that expire during
+        the wait expire because time — and engine work — genuinely
+        passed.  A request rejected with ``queue_full`` carries the hint
+        on ``req.retry_after`` either way."""
         from ..resilience import fault_injection as _fi
         _fi.check("serving.admit")  # chaos site: admission stragglers/faults
         now = self.clock.now() if arrival_ts is None else float(arrival_ts)
@@ -230,21 +235,66 @@ class ServingEngine:
         if not ok and reason == "queue_full" and retry_policy is not None:
             from ..resilience.retry import backoff_until
 
-            def _probe():
-                self.tick()  # drain queued work: backoff must be able to succeed
-                got, why = self.admission.submit_ok(req, len(self._queue))
-                return got, why == "queue_full"
-
-            if backoff_until(_probe, retry_policy, self.clock, site="serving.admit"):
-                ok, reason = True, None
+            # FIRST honor the admission controller's retry-after hint: one
+            # informed wait sized to the queue's estimated drain time,
+            # ticking so the queue actually drains.  Only if the hinted
+            # wait was not enough does the blind exponential ladder run —
+            # the hint turns most backoffs into a single well-aimed probe.
+            # The hint is CLAMPED to the policy's time budget (the caller
+            # bounded how long submit may block — the hinted wait and the
+            # ladder share ONE budget, not a budget each) and to the
+            # request's own deadline (waiting past it can only time out).
+            hint = self.admission.retry_after_hint(
+                len(self._queue), self._ewma_step_s)
+            hint = min(hint, retry_policy.budget_s)
+            if deadline is not None:
+                hint = max(0.0, min(hint, deadline - self.clock.now()))
+            t_hint = self.clock.now()
+            target = t_hint + hint
+            ok, why = False, "queue_full"   # a zero hint changes nothing
+            while self.clock.now() < target:
+                before = self._progress_marker()
+                self.tick()
+                ok, why = self.admission.submit_ok(req, len(self._queue))
+                if ok or why != "queue_full":
+                    break   # capacity freed early (or drained into a
+                    # structural answer): don't sit out the rest of the hint
+                if self._progress_marker() == before:
+                    # nothing admissible moved: wait out the remainder of
+                    # the hint instead of spinning (WallClock sleeps here;
+                    # a productive tick is progress, not a spin, so the
+                    # marker — never the raw clock — decides; the wait
+                    # itself cannot change what submit_ok reads)
+                    self.clock.wait_until(target)
+            if ok:
+                reason = None
+            elif why != "queue_full":
+                reason = why   # drained into a structural rejection
             else:
-                ok, reason = self.admission.submit_ok(req, len(self._queue))
+                def _probe():
+                    self.tick()  # drain queued work: backoff must be able to succeed
+                    got, w = self.admission.submit_ok(req, len(self._queue))
+                    return got, w == "queue_full"
+
+                ladder = dataclasses.replace(
+                    retry_policy, budget_s=max(
+                        0.0, retry_policy.budget_s - (self.clock.now() - t_hint)))
+                if backoff_until(_probe, ladder, self.clock,
+                                 site="serving.admit"):
+                    ok, reason = True, None
+                else:
+                    ok, reason = self.admission.submit_ok(req, len(self._queue))
             # the clock advanced (and the engine ticked) during the
             # backoff — a terminal transition stamped with the stale
             # pre-backoff `now` would erase the wait the request lived
             now = self.clock.now()
         if not ok:
             req.reject_reason = reason
+            if reason == "queue_full":
+                # transient: tell the client WHEN to come back (the fleet
+                # router and submit(retry_policy=) both honor this)
+                req.retry_after = self.admission.retry_after_hint(
+                    len(self._queue), self._ewma_step_s)
             req.to(RequestState.REJECTED, now)
             self.stats.record_reject(reason)
             self.stats.record_terminal(req)

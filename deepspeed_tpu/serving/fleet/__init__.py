@@ -13,20 +13,26 @@ replays arrivals plus a scripted fault schedule bit-reproducibly on CPU
 generators live in :mod:`.sim`).
 """
 
+from .autoscale import (RUNGS, AutoscaleConfig, Autoscaler, OverloadConfig,
+                        OverloadController)
 from .health import HealthConfig, HealthTracker, ReplicaState, classify_fatal
 from .policies import (POLICIES, DisaggregatedPolicy, LeastOutstandingPolicy,
                        PrefixAffinityPolicy, RoundRobinPolicy, RoutingPolicy,
                        make_policy)
 from .pool import Replica, ReplicaPool, ReplicaRole
 from .router import FleetRequest, FleetState, Router
-from .sim import (FleetEvent, FleetSimulator, heavy_tail_arrivals,
-                  poisson_mixed_arrivals)
+from .sim import (FleetEvent, FleetSimulator, flash_crowd_arrivals,
+                  heavy_tail_arrivals, poisson_mixed_arrivals)
+from .tenancy import DEFAULT_TENANT, TenantRegistry, TenantSpec
 
 __all__ = [
+    "RUNGS", "AutoscaleConfig", "Autoscaler", "OverloadConfig",
+    "OverloadController",
     "HealthConfig", "HealthTracker", "ReplicaState", "classify_fatal",
     "POLICIES", "DisaggregatedPolicy", "LeastOutstandingPolicy",
     "PrefixAffinityPolicy", "RoundRobinPolicy", "RoutingPolicy", "make_policy",
     "Replica", "ReplicaPool", "ReplicaRole", "FleetRequest", "FleetState",
-    "Router", "FleetEvent", "FleetSimulator",
+    "Router", "FleetEvent", "FleetSimulator", "flash_crowd_arrivals",
     "heavy_tail_arrivals", "poisson_mixed_arrivals",
+    "DEFAULT_TENANT", "TenantRegistry", "TenantSpec",
 ]

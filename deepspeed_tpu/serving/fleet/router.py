@@ -43,6 +43,7 @@ from ..kvtransfer import SnapshotAborted
 from .health import ReplicaState
 from .policies import RoutingPolicy
 from .pool import ReplicaPool, ReplicaRole
+from .tenancy import TenantRegistry, order_key as _tenant_order_key
 
 
 class FleetState(enum.Enum):
@@ -77,6 +78,17 @@ class FleetRequest:
     affinity_hits: int = 0
     migrations: int = 0          # KV handoffs between replicas (kvtransfer)
     reject_reason: Optional[str] = None
+    #: when to retry a REJECTED request (clock-seconds from rejection) —
+    #: set on transient rejections (overload shed, tenant-admission
+    #: fault); None on structural rejections, where retrying cannot help
+    #: (replica-level queue_full never rejects at the FLEET level — the
+    #: request just stays pending for the next dispatch round)
+    retry_after: Optional[float] = None
+    #: QoS: the submitting tenant and its weighted-fair stride pass
+    tenant: str = "default"
+    _wfq: float = 0.0
+    #: True when a brownout rung capped this request's max_new_tokens
+    brownout_capped: bool = False
     #: host-staged KV carried between attempts: set when a migration's
     #: export completed (or harvested from a dead replica — failover
     #: reuse), consumed by the next dispatch's KV-import fast path
@@ -115,16 +127,41 @@ class FleetRequest:
         return self.deadline is None or self.finish_ts <= self.deadline
 
 
+#: retry-after stamped on a TRANSIENT tenant-admission fault when no
+#: overload episode is in progress: a bookkeeping blip, not backpressure —
+#: retry soon (an active brownout substitutes the ladder's own hint)
+TENANT_FAULT_RETRY_S = 1.0
+
+
 class Router:
     """Cache-affinity, health-aware request router over a ReplicaPool."""
 
     def __init__(self, pool: ReplicaPool, policy: RoutingPolicy, monitor=None,
                  tracer=None, migration_chunk_pages: int = 4,
                  migration_chunk_cost: float = 0.0,
-                 prefill_handoff: bool = False):
+                 prefill_handoff: bool = False,
+                 tenants: Optional[TenantRegistry] = None,
+                 overload=None):
         self.pool = pool
         self.policy = policy
         self.monitor = monitor
+        # multi-tenant QoS (docs/SERVING.md "Overload control plane"):
+        # weighted-fair ordering + per-tenant outstanding bounds come from
+        # the registry; with no registry every request rides the implicit
+        # "default" tenant and ordering degenerates to the pre-tenancy
+        # (priority, arrival, fid) FCFS — zero behavioral change
+        self.tenants = tenants if tenants is not None else TenantRegistry()
+        #: per-tenant terminal accounting, keyed by tenant name
+        self.tenant_stats: Dict[str, dict] = {}
+        # graceful-degradation ladder (fleet/autoscale.py): consulted at
+        # admission (shed/cap) and dispatch (spec off, migration pause)
+        self.overload = overload
+        if overload is not None:
+            overload.bind(lambda name, value: self._emit(
+                [(name, value, self._next_event_step())]))
+        #: DONE-request TTFTs in completion order — the autoscaler's EWMA
+        #: input (appended in _finish; never truncated mid-run)
+        self.ttft_log: List[float] = []
         # prefill/decode disaggregation (docs/SERVING.md "Disaggregated
         # serving"): policies that declare ``migrates = True`` turn on the
         # two-phase dispatch — requests that reach DECODE on a PREFILL-role
@@ -179,6 +216,8 @@ class Router:
             "migrations_started": 0, "migration_chunks": 0,
             "migrations_completed": 0, "migration_fallbacks": 0,
             "migration_failover_reuse": 0,
+            "shed": 0, "brownout_capped": 0, "tenant_admission_faults": 0,
+            "tenant_deferrals": 0,
         }
         self.recovery_times: List[float] = []
 
@@ -186,11 +225,22 @@ class Router:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                deadline: Optional[float] = None, arrival_ts: Optional[float] = None,
-               priority: float = 0.0) -> FleetRequest:
+               priority: float = 0.0, tenant: str = "default") -> FleetRequest:
         now = self.clock.now() if arrival_ts is None else float(arrival_ts)
+        spec = self.tenants.spec(tenant)
+        max_new_tokens = int(max_new_tokens)
+        capped = False
+        if self.overload is not None and self.overload.token_cap_active \
+                and spec.best_effort:
+            # brownout rung 1: best-effort output budgets shrink.  Greedy
+            # decode makes the capped output an exact PREFIX of the uncapped
+            # one, so degradation never changes a token, only truncates.
+            cap = self.overload.config.token_cap
+            if max_new_tokens > cap:
+                max_new_tokens, capped = cap, True
         fr = FleetRequest(fid=next(self._fids), prompt=list(prompt),
-                          max_new_tokens=int(max_new_tokens), arrival_ts=now,
-                          deadline=deadline, priority=priority)
+                          max_new_tokens=max_new_tokens, arrival_ts=now,
+                          deadline=deadline, priority=priority, tenant=tenant)
         if self.tracer.enabled:
             # reserve the root span id now: attempt/phase children parent
             # to it long before the root's extent (terminal ts) is known
@@ -198,9 +248,72 @@ class Router:
                         "root_id": self.tracer.reserve_span_id(),
                         "attempts": [], "last_dead": None}
         self.requests.append(fr)
-        self._pending.append(fr)
         self.stats["submitted"] += 1
+        self._taccount(tenant)["submitted"] += 1
+        try:
+            # chaos site: per-tenant admission bookkeeping is a control-
+            # plane edge of its own (quota lookups, accounting stores)
+            _fi.check("admission.tenant")
+        except _fi.InjectedCrash:
+            raise  # simulated death of THIS driver process
+        except OSError as e:
+            # transient tenant-admission fault: the client sees a REJECTED
+            # request with a reason and a retry-after hint, never a crash
+            self.stats["tenant_admission_faults"] += 1
+            fr.reject_reason = "tenant_admission_fault"
+            fr.retry_after = self.overload.config.retry_after \
+                if (self.overload is not None and self.overload.rung >= 1) \
+                else TENANT_FAULT_RETRY_S
+            logger.warning(f"admission.tenant transient fault for "
+                           f"fid={fr.fid}: {e}")
+            self._finish(fr, FleetState.REJECTED, now)
+            return fr
+        if self.overload is not None and self.overload.shed(spec):
+            # brownout rung 4: best-effort admissions are shed outright —
+            # an explicit REJECTED with a retry-after beats queueing work
+            # the fleet cannot serve inside anyone's deadline
+            self.stats["shed"] += 1
+            self._taccount(tenant)["shed"] += 1
+            self.overload.record_shed()
+            fr.reject_reason = "shed_overload"
+            fr.retry_after = self.overload.config.retry_after
+            self._emit([("fleet/overload_shed", float(self.overload.rung),
+                         self._next_event_step())])
+            self._finish(fr, FleetState.REJECTED, now)
+            return fr
+        if capped:
+            # flagged/counted only for requests that will actually be
+            # SERVED with the truncated budget — a shed/fault-rejected
+            # request never ran and must not inflate the brownout receipt
+            fr.brownout_capped = True
+            self.stats["brownout_capped"] += 1
+            self._taccount(tenant)["brownout_capped"] += 1
+        if not self._pending and not self._dispatched:
+            # fully idle fleet: no backlog to arbitrate — reset the stride
+            # state so the next busy period starts fair for everyone
+            self.tenants.reset_passes()
+        # the WFQ virtual-time floor tracks ALL outstanding work, not just
+        # the queue: under steady uncontended load the queue drains between
+        # arrivals, and a pending-only floor (stuck at 0) would let passes
+        # earned while nobody waited become permanent scheduling debt —
+        # and let a newly-joining tenant jump ahead of every incumbent
+        floor = min((r._wfq for r in self._pending), default=None)
+        if floor is None:
+            floor = min((r._wfq for r in self._dispatched.values()),
+                        default=0.0)
+        fr._wfq = self.tenants.next_pass(tenant, floor=floor)
+        self._pending.append(fr)
         return fr
+
+    def _taccount(self, tenant: str) -> dict:
+        t = self.tenant_stats.get(tenant)
+        if t is None:
+            t = self.tenant_stats[tenant] = {
+                "submitted": 0, "completed": 0, "deadline_met": 0,
+                "timed_out": 0, "rejected": 0, "shed": 0,
+                "brownout_capped": 0, "failovers": 0, "dispatches": 0,
+                "tokens": 0}
+        return t
 
     # ------------------------------------------------------------ dispatch
 
@@ -231,11 +344,15 @@ class Router:
             # max-combined with the source's own step cost: overlapped,
             # not serial.  The chunks themselves are pumped in poll().
             self._precharge_migrations()
-        # priority class (lower = more urgent) then FCFS — the fleet queue
-        # must honor the priority submit() accepts, or urgent work waits
-        # behind bulk arrivals exactly when every replica is saturated;
-        # anti-starvation aging applies per replica once dispatched
-        self._pending.sort(key=lambda r: (r.priority, r.arrival_ts, r.fid))
+        # priority class (lower = more urgent) first, then WEIGHTED-FAIR
+        # order within the class (tenancy.py stride pass; single-tenant
+        # fleets degenerate to pure FCFS), then FCFS tie-break — the fleet
+        # queue must honor both the priority submit() accepts and the
+        # tenant weights, or one heavy tenant's burst starves everyone
+        # exactly when every replica is saturated; anti-starvation aging
+        # applies per replica once dispatched
+        self._pending.sort(key=lambda r: _tenant_order_key(
+            r.priority, r._wfq, r.arrival_ts, r.fid))
         # expire FIRST, for every pending request — expiry must not depend
         # on dispatchable capacity existing (with all replicas dead, expired
         # work still has to reach TIMED_OUT or the driver would stall on a
@@ -250,9 +367,22 @@ class Router:
         # O(pending x replicas) per round for state that only changes where
         # a request just landed (or a replica just died)
         candidates = self._candidates()
+        # per-tenant concurrency bound: a tenant at max_outstanding keeps
+        # its requests PENDING (deferred, not rejected) until completions
+        # free slots — the cap is what stops one tenant's burst from
+        # occupying every replica's batch at once
+        outstanding_by_tenant: Dict[str, int] = {}
+        for d in self._dispatched.values():
+            outstanding_by_tenant[d.tenant] = \
+                outstanding_by_tenant.get(d.tenant, 0) + 1
         for fr in list(self._pending):
             if not candidates:
                 break
+            tspec = self.tenants.spec(fr.tenant)
+            if tspec.max_outstanding > 0 and \
+                    outstanding_by_tenant.get(fr.tenant, 0) >= tspec.max_outstanding:
+                self.stats["tenant_deferrals"] += 1
+                continue
             rid, info = self.policy.select(fr, candidates)
             if rid is None:
                 continue
@@ -273,6 +403,8 @@ class Router:
                 continue
             if self._dispatch_to(fr, rid, info, now):
                 placed += 1
+                outstanding_by_tenant[fr.tenant] = \
+                    outstanding_by_tenant.get(fr.tenant, 0) + 1
                 candidates = [(r, rp, rp.serve.load_stats() if r == rid else st)
                               for r, rp, st in candidates]
         return placed
@@ -295,6 +427,12 @@ class Router:
                    "dispatch_ts": now, "generation": rep.generation,
                    "resumed_from": fr.trace["last_dead"],
                    "resume_tokens": len(fr.tokens), "end_ts": None}
+        # brownout rung 2: speculative decoding off for NEW dispatches —
+        # verify dispatches are k+1-wide model work the overloaded fleet
+        # can spend on plain decode instead; greedy parity means outputs
+        # do not change, only the speed strategy does
+        spec_flag = False if (self.overload is not None
+                              and self.overload.spec_disabled) else None
         sr = rep.serve.submit(
             fr.prompt, max_new_tokens=fr.max_new_tokens, deadline=fr.deadline,
             arrival_ts=fr.arrival_ts, priority=fr.priority,
@@ -302,6 +440,7 @@ class Router:
             resume_tokens=list(fr.tokens) or None,
             trace_id=fr.trace["trace_id"] if fr.trace is not None else None,
             parent_span_id=att["span_id"] if att is not None else None,
+            spec=spec_flag,
             kv_snapshot=fr._kv_snapshot)
         if sr.state is RequestState.REJECTED:
             if sr.reject_reason == "queue_full":
@@ -328,6 +467,7 @@ class Router:
         fr.history.append((FleetState.DISPATCHED, now))
         self._dispatched[fr.fid] = fr
         self.stats["dispatches"] += 1
+        self._taccount(fr.tenant)["dispatches"] += 1
         if "affinity_hit" in info:
             key = "affinity_hits" if info["affinity_hit"] else "affinity_misses"
             self.stats[key] += 1
@@ -404,6 +544,11 @@ class Router:
     def _start_migrations(self, now: float) -> None:
         """Begin exports for requests that reached DECODE on a PREFILL-role
         replica — only when a decode replica exists to take the handoff."""
+        if self.overload is not None and self.overload.migrations_paused:
+            # brownout rung 3: no NEW exports/prefix imports under overload
+            # — the d2h/h2d staging bandwidth (and the decode pool's page
+            # headroom) goes to serving; in-flight exports still complete
+            return
         ok_states = (RequestState.PREFILL, RequestState.DECODE) \
             if self.prefill_handoff else (RequestState.DECODE, )
         # ONE candidate snapshot per round (same stance as
@@ -583,6 +728,7 @@ class Router:
                     displaced_sr.kv_snapshot = None
                     self.stats["migration_failover_reuse"] += 1
                 fr.failovers += 1
+                self._taccount(fr.tenant)["failovers"] += 1
                 fr.state = FleetState.PENDING
                 fr.history.append((FleetState.PENDING, now))
                 # the dead attempt's spans close NOW (its frontend is
@@ -631,6 +777,18 @@ class Router:
             f"({fr.state.value} then {state.value})"
         fr.state = state
         fr.history.append((state, now))
+        t = self._taccount(fr.tenant)
+        if state is FleetState.DONE:
+            t["completed"] += 1
+            t["tokens"] += len(fr.tokens)
+            if fr.met_deadline:
+                t["deadline_met"] += 1
+            if fr.ttft is not None:
+                self.ttft_log.append(fr.ttft)
+        elif state is FleetState.TIMED_OUT:
+            t["timed_out"] += 1
+        elif state is FleetState.REJECTED:
+            t["rejected"] += 1
         self._note_victim_resolved(fr, now)
         if fr.trace is not None:
             self._trace_finish(fr, state, now)
@@ -727,6 +885,39 @@ class Router:
     def outstanding(self) -> int:
         return len(self._pending) + len(self._dispatched)
 
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting in the ROUTER queue (not yet on a replica) —
+        a primary autoscaler/overload signal."""
+        return len(self._pending)
+
+    def export_replica_gauges(self) -> None:
+        """Publish each live replica's ``load_stats()`` snapshot as
+        ``fleet/replica_*`` gauges on the pool's MetricsRegistry, plus the
+        fleet-level serving-replica count and (when an overload controller
+        is attached) the current brownout rung.  The fleet driver calls
+        this once per round; with no registry it is a no-op."""
+        metrics = self.pool.metrics
+        if metrics is None:
+            return
+        stats = self.pool.load_stats()
+        for rid in self.pool.rids:
+            # DEAD/parked replicas are absent from load_stats — their
+            # gauges read 0, not their last pre-kill values frozen forever
+            st = stats.get(rid) or {"queue_depth": 0, "free_kv_pages": 0,
+                                    "outstanding_tokens": 0, "active": 0}
+            metrics.gauge(f"fleet/replica_queue_depth/{rid}").set(
+                st["queue_depth"])
+            metrics.gauge(f"fleet/replica_free_kv_pages/{rid}").set(
+                st["free_kv_pages"])
+            metrics.gauge(f"fleet/replica_outstanding_tokens/{rid}").set(
+                st["outstanding_tokens"])
+            metrics.gauge(f"fleet/replica_active/{rid}").set(st["active"])
+        metrics.gauge("fleet/serving_replicas").set(sum(
+            1 for rid in self.pool.rids if self.pool.health.serving(rid)))
+        if self.overload is not None:
+            metrics.gauge("fleet/overload_rung").set(self.overload.rung)
+
     def pending_timestamps(self) -> List[float]:
         """Future timestamps that could unblock progress (pending
         deadlines) — the simulator's idle-jump input."""
@@ -784,8 +975,39 @@ class Router:
             "ttft": percentile_summary([r.ttft for r in done if r.ttft is not None]),
             "tpot": percentile_summary([r.tpot for r in done if r.tpot is not None]),
             "e2e": percentile_summary([r.e2e for r in done if r.e2e is not None]),
+            "tenants": self._tenant_summary(done),
+            "overload": None if self.overload is None else self.overload.summary(),
+            "shed": self.stats["shed"],
+            "brownout_capped": self.stats["brownout_capped"],
             "health_transitions": len(self.pool.health.history),
         }
+
+    def _tenant_summary(self, done: List[FleetRequest]) -> dict:
+        """Per-tenant goodput/violation record.  ``sla_violations`` counts
+        timeouts plus DONE-but-late completions plus (when the tenant has a
+        ``ttft_slo``) on-time completions whose TTFT still blew the
+        per-tenant budget; ``closed`` is the conservation receipt the
+        property audit pins: submitted == completed+timed_out+rejected."""
+        out = {}
+        for name in sorted(self.tenant_stats):
+            t = dict(self.tenant_stats[name])
+            spec = self.tenants.spec(name)
+            mine = [r for r in done if r.tenant == name]
+            late = sum(1 for r in mine if not r.met_deadline)
+            slo_miss = 0
+            if spec.ttft_slo is not None:
+                slo_miss = sum(1 for r in mine
+                               if r.met_deadline and r.ttft is not None
+                               and r.ttft > spec.ttft_slo)
+            t["sla_violations"] = t["timed_out"] + late + slo_miss
+            t["weight"] = spec.weight
+            t["best_effort"] = spec.best_effort
+            t["ttft"] = percentile_summary(
+                [r.ttft for r in mine if r.ttft is not None])
+            t["closed"] = (t["submitted"] ==
+                           t["completed"] + t["timed_out"] + t["rejected"])
+            out[name] = t
+        return out
 
     def _next_event_step(self) -> int:
         self._events_step += 1

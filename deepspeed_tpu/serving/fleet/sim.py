@@ -118,6 +118,62 @@ def heavy_tail_arrivals(seed: int, n_requests: int, rate: float, vocab: int,
     return arrivals
 
 
+def flash_crowd_arrivals(seed: int, n_requests: int, base_rate: float,
+                         crowd_rate: float, crowd_start: float,
+                         crowd_duration: float, vocab: int,
+                         tenants: Optional[List[Tuple[str, float,
+                                                      Optional[float]]]] = None,
+                         prompt_median: int = 8, prompt_sigma: float = 0.5,
+                         max_prompt: int = 64,
+                         out_median: int = 10, out_sigma: float = 0.4,
+                         max_new: int = 24) -> List[dict]:
+    """Flash-crowd traffic with a tenant mix: Poisson arrivals at
+    ``base_rate`` that spike to ``crowd_rate`` inside the window
+    ``[crowd_start, crowd_start + crowd_duration)`` — the viral-moment
+    shape the autoscaler + degradation ladder exist for.  ``tenants`` is a
+    list of ``(name, mix_probability, deadline_slack_or_None)``; each
+    arrival draws its tenant from the mix and gets ``deadline = arrival +
+    slack`` (None = best-effort, runs to completion).  Deterministic in
+    ``seed`` like every generator here."""
+    rng = np.random.default_rng(seed)
+    tenants = tenants or [("default", 1.0, None)]
+    probs = np.asarray([t[1] for t in tenants], np.float64)
+    probs = probs / probs.sum()
+    t = 0.0
+    arrivals = []
+    crowd_end = crowd_start + crowd_duration
+    for _ in range(n_requests):
+        # piecewise-inhomogeneous Poisson: a gap that would cross a rate
+        # boundary is re-drawn AT the boundary at the new rate (exactly
+        # valid by memorylessness) — without this, one long base-rate gap
+        # can jump clean over the whole crowd window
+        while True:
+            in_crowd = crowd_start <= t < crowd_end
+            rate = crowd_rate if in_crowd else base_rate
+            gap = float(rng.exponential(1.0 / rate))
+            boundary = crowd_start if t < crowd_start \
+                else (crowd_end if t < crowd_end else None)
+            if boundary is not None and t + gap > boundary:
+                t = boundary
+                continue
+            t += gap
+            break
+        i = int(rng.choice(len(tenants), p=probs))
+        name, _, slack = tenants[i]
+        p_len = int(np.clip(rng.lognormal(np.log(prompt_median), prompt_sigma),
+                            2, max_prompt))
+        o_len = int(np.clip(rng.lognormal(np.log(out_median), out_sigma),
+                            2, max_new))
+        arrivals.append({
+            "arrival_ts": round(t, 6),
+            "prompt": [int(x) for x in rng.integers(1, vocab, p_len)],
+            "max_new_tokens": o_len,
+            "deadline": None if slack is None else round(t + slack, 6),
+            "tenant": name,
+        })
+    return arrivals
+
+
 @dataclasses.dataclass(frozen=True)
 class FleetEvent:
     ts: float
@@ -130,7 +186,8 @@ class FleetEvent:
 
 class FleetSimulator:
 
-    def __init__(self, router: Router, max_rounds: int = 200_000):
+    def __init__(self, router: Router, max_rounds: int = 200_000,
+                 autoscaler=None):
         self.router = router
         self.pool = router.pool
         self.clock = router.clock
@@ -141,6 +198,18 @@ class FleetSimulator:
         # drivers reuse — instead of drift from — this loop.
         self.max_rounds = max_rounds
         self.rounds = 0
+        # control plane (fleet/autoscale.py): stepped once per round,
+        # BEFORE arrivals/dispatch, so a scale decision made from last
+        # round's signals shapes this round's placement
+        self.autoscaler = autoscaler
+        #: provisioning cost receipts: ``replica_steps`` counts one unit
+        #: per provisioned (non-DEAD) replica per WORKING round — the
+        #: quantity static-max vs autoscaled provisioning is compared on;
+        #: ``replica_seconds`` integrates provisioned count over clock
+        #: time (idle waits included — a provisioned-but-idle replica
+        #: still costs money)
+        self.replica_steps = 0
+        self.replica_seconds = 0.0
 
     def run(self, arrivals: List[dict],
             schedule: Optional[List[Tuple[float, str, int]]] = None) -> List:
@@ -175,6 +244,12 @@ class FleetSimulator:
                     deferred_restarts.remove(rid)
                     pool.restart(rid)
 
+            # 1.5 control plane: the autoscaler reads last round's signals
+            # and acts (recover/drain/park, ladder moves) before this
+            # round's dispatch sees the fleet
+            if self.autoscaler is not None:
+                self.autoscaler.step(now)
+
             # 2. arrivals + dispatch
             while a_i < len(pending_arrivals) and \
                     pending_arrivals[a_i]["arrival_ts"] <= now:
@@ -184,6 +259,8 @@ class FleetSimulator:
 
             # 3. one concurrent tick across the fleet
             marker = self._marker(a_i, e_i)
+            n_provisioned = sum(1 for rid in pool.rids
+                                if pool.health.state(rid) is not ReplicaState.DEAD)
             costs = []
             for rid in pool.rids:
                 if not pool.health.serving(rid):
@@ -199,12 +276,23 @@ class FleetSimulator:
             # 4. the round took as long as its slowest replica
             if costs:
                 clock.advance(max(costs))
+                # provisioning receipt: every non-DEAD replica billed one
+                # step for this working round (parked replicas are free —
+                # the saving the autoscale bench measures)
+                self.replica_steps += n_provisioned
+
+            # 4.5 per-round observability: replica load_stats gauges (and
+            # the serving-count/rung gauges) — no-op without a registry
+            router.export_replica_gauges()
 
             # 5. completions
             router.poll(clock.now())
+            self.replica_seconds += (clock.now() - now) * n_provisioned
 
             if a_i >= len(pending_arrivals) and e_i >= len(events) \
                     and not deferred_restarts and router.outstanding == 0:
+                if self.autoscaler is not None:
+                    self.autoscaler.finalize(clock.now())
                 return reqs
 
             if not costs and self._marker(a_i, e_i) == marker:
@@ -215,13 +303,19 @@ class FleetSimulator:
                     waits.append(pending_arrivals[a_i]["arrival_ts"])
                 if e_i < len(events):
                     waits.append(events[e_i].ts)
+                if self.autoscaler is not None:
+                    wake = self.autoscaler.wake_ts(clock.now())
+                    if wake is not None:
+                        waits.append(wake)
                 if not waits:
                     raise RuntimeError(
                         f"fleet simulation stalled at t={now}: "
                         f"{router.outstanding} outstanding request(s), "
                         f"replicas {[(r, pool.health.state(r).value) for r in pool.rids]}, "
                         "no future arrival/schedule/deadline to wait for")
+                t_jump = clock.now()
                 clock.wait_until(min(waits) + 1e-9)
+                self.replica_seconds += (clock.now() - t_jump) * n_provisioned
         raise RuntimeError(f"fleet simulation exceeded max_rounds={self.max_rounds}")
 
     def _apply(self, ev: FleetEvent, deferred_restarts: List[int]) -> None:
@@ -262,4 +356,8 @@ class FleetSimulator:
                 router.stats["migrations_started"],
                 router.stats["migration_fallbacks"],
                 sum(len(r.tokens) for r in router.requests), seen,
-                len(self.pool.health.history))
+                len(self.pool.health.history),
+                # control-plane progress: scale decisions and ladder moves
+                # advance no clock and deliver no tokens, but they ARE
+                # progress (a recover this round changes next round)
+                self.autoscaler.marker() if self.autoscaler is not None else None)

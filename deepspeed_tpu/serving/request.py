@@ -100,6 +100,11 @@ class ServingRequest:
     tokens: List[int] = dataclasses.field(default_factory=list)
     preemptions: int = 0
     reject_reason: Optional[str] = None
+    #: clock-seconds the client should wait before retrying a TRANSIENT
+    #: rejection (queue_full): the admission controller's queue-drain
+    #: estimate, not a blind backoff.  None on structural rejections —
+    #: retrying an infeasible request can never help.
+    retry_after: Optional[float] = None
     history: List[Tuple[RequestState, float]] = dataclasses.field(default_factory=list)
     # speculative decoding (inference/v2/spec): per-request opt-in/out
     # (None = the engine's default — on whenever the engine carries a

@@ -113,6 +113,31 @@ EVENTS = {
     "fleet/migration_fallback": ("event", "serving/fleet/router.py",
                                  "migration abandoned; recompute/in-place "
                                  "decode owns the request"),
+    # ---- overload control plane (serving/fleet/autoscale.py + router.py)
+    "fleet/scale_up": ("event", "serving/fleet/autoscale.py",
+                       "autoscaler provisioned a replica through "
+                       "RECOVERING (value = rid)"),
+    "fleet/scale_drain": ("event", "serving/fleet/autoscale.py",
+                          "scale-down drain began; no new dispatches "
+                          "(value = rid)"),
+    "fleet/scale_down": ("event", "serving/fleet/autoscale.py",
+                         "drained replica parked idle — nothing in "
+                         "flight was killed (value = rid)"),
+    "fleet/overload_step_up": ("event", "serving/fleet/autoscale.py",
+                               "degradation ladder stepped up "
+                               "(value = new rung)"),
+    "fleet/overload_step_down": ("event", "serving/fleet/autoscale.py",
+                                 "degradation ladder stepped down "
+                                 "(value = new rung)"),
+    "fleet/overload_shed": ("event", "serving/fleet/router.py",
+                            "best-effort admission shed with a "
+                            "retry-after hint (value = rung)"),
+    "fleet/serving_replicas": ("gauge", "serving/fleet/router.py",
+                               "replicas in a serving state, sampled "
+                               "once per fleet round"),
+    "fleet/overload_rung": ("gauge", "serving/fleet/router.py",
+                            "current degradation-ladder rung (0 = "
+                            "normal service)"),
     # ---- monitor surface (monitor/monitor.py)
     "monitor/dropped_events": ("event", "monitor/monitor.py",
                                "cumulative events shed by the max_events cap"),
@@ -141,6 +166,13 @@ DYNAMIC = [
      "kind": "event", "source": "serving/fleet/router.py",
      "expansions": ["fleet/done", "fleet/timed_out", "fleet/rejected"],
      "doc": "terminal-state event per finished fleet request"},
+    {"prefix": "fleet/replica_", "template": "fleet/replica_<stat>/<rid>",
+     "kind": "gauge", "source": "serving/fleet/router.py",
+     "expansions": ["fleet/replica_queue_depth/<rid>",
+                    "fleet/replica_free_kv_pages/<rid>",
+                    "fleet/replica_outstanding_tokens/<rid>",
+                    "fleet/replica_active/<rid>"],
+     "doc": "per-replica load_stats snapshot exported once per fleet round"},
     {"prefix": "fleet/health/", "template": "fleet/health/<state>",
      "kind": "event", "source": "serving/fleet/health.py",
      "expansions": ["fleet/health/healthy", "fleet/health/degraded",

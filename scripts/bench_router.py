@@ -27,6 +27,16 @@ the per-request migration cost visible as ``phase/migrating`` telemetry
 spans (one per migrated request), and the KV-import fast path actually
 taken (``kv_imports`` > 0).
 
+Plus the AUTOSCALE leg (schema v3, docs/SERVING.md "Overload control
+plane"): the same seeded flash-crowd workload (tenant-mixed: premium /
+standard / best-effort) served under static-max provisioning (4 always-on
+replicas) vs the overload control plane (1 warm + 3 parked, SLA
+autoscaler scaling through RECOVERING/DRAINING, weighted-fair tenant
+admission, graceful-degradation ladder).  The committed record must show
+>= 30% fewer replica-steps with the premium tenant's SLA held, zero
+output divergence, every brownout rung entered also exited, and the
+autoscaled run byte-identical when repeated.
+
 Two clock modes, as in bench_serving.py:
   --dryrun  CPU + ONE shared deterministic VirtualClock (a fleet round =
             max replica step cost): bit-reproducible across invocations —
@@ -35,7 +45,7 @@ Two clock modes, as in bench_serving.py:
             replicas ticking round-robin from one host loop (a single-host
             stand-in for N meshes; the *routing* behaviour is identical).
 
-Writes BENCH_ROUTER.json (schema v1 — scripts/check_bench_schema.py
+Writes BENCH_ROUTER.json (schema v3 — scripts/check_bench_schema.py
 validates it, incl. affinity hit rate > 0 on the prefix_affinity points
 and finite recovery on every kill) and prints one JSON line.
 """
@@ -243,6 +253,134 @@ def run_disaggregation_leg(factory, clock_factory, seed, vocab, dryrun):
     return rec
 
 
+AUTOSCALE_TENANTS = (
+    # (name, mix probability, deadline slack, weight, max_outstanding,
+    #  ttft_slo, best_effort)
+    ("premium", 0.25, 30.0, 4.0, 0, 25.0, False),
+    ("standard", 0.35, 80.0, 2.0, 0, None, False),
+    ("best_effort", 0.40, None, 1.0, 8, None, True),
+)
+
+
+def _autoscale_point(factory, clock_factory, arrivals, serving_config,
+                     ttft_slo, autoscaled):
+    """One flash-crowd run: static-max provisioning (4 always-on replicas)
+    or the autoscaled control plane (1 warm + 3 parked, SLA autoscaler +
+    degradation ladder).  Returns (summary+receipts, per-request outputs)."""
+    from deepspeed_tpu.serving.fleet import (AutoscaleConfig, Autoscaler,
+                                             FleetSimulator, OverloadConfig,
+                                             OverloadController, ReplicaPool,
+                                             Router, TenantRegistry,
+                                             TenantSpec, make_policy)
+    clock = clock_factory()
+    pool = ReplicaPool(factory, 4, clock=clock, serving_config=serving_config)
+    pool.rebase_clock()
+    tenants = TenantRegistry([
+        TenantSpec(name, weight=w, max_outstanding=mo, ttft_slo=slo,
+                   best_effort=be)
+        for name, _, _, w, mo, slo, be in AUTOSCALE_TENANTS])
+    overload = None
+    if autoscaled:
+        overload = OverloadController(OverloadConfig(
+            hi=1.0, lo=0.45, cooldown=1.5, token_cap=6, retry_after=10.0))
+    router = Router(pool, make_policy("least_outstanding"), tenants=tenants,
+                    overload=overload)
+    autoscaler = None
+    if autoscaled:
+        # start lean: one warm replica, three parked (DEAD, engine
+        # discarded) — the autoscaler provisions through RECOVERING as the
+        # crowd builds and drains back down after it passes
+        for rid in (1, 2, 3):
+            pool.kill(rid, reason="autoscale: parked")
+        autoscaler = Autoscaler(router, AutoscaleConfig(
+            min_replicas=1, ttft_slo=ttft_slo, up_frac=0.5, queue_hi=1.5,
+            queue_lo=0.75, down_streak=3, cooldown_up=1.5, cooldown_down=6.0,
+            decide_interval=0.5))
+    sim = FleetSimulator(router, autoscaler=autoscaler)
+    reqs = sim.run([dict(a) for a in arrivals])
+    rec = router.summary()
+    rec["replica_steps"] = sim.replica_steps
+    rec["replica_seconds"] = round(sim.replica_seconds, 6)
+    rec["rounds"] = sim.rounds
+    if autoscaler is not None:
+        rec["autoscaler"] = autoscaler.summary()
+    return rec, [list(r.tokens) for r in reqs]
+
+
+def run_autoscale_leg(factory, clock_factory, seed, vocab, dryrun):
+    """Static-max vs autoscaled provisioning over the same seeded flash
+    crowd (schema-v3 ``autoscale`` record).  The receipts the acceptance
+    criteria pin: >= 30% fewer replica-steps, the premium tenant's SLA
+    held, zero output divergence (brownout caps only ever TRUNCATE
+    best-effort outputs — greedy prefixes, never different tokens), every
+    brownout rung entered also exited, and the autoscaled leg repeated
+    byte-identically."""
+    from deepspeed_tpu.serving import ServingConfig
+    from deepspeed_tpu.serving.fleet import flash_crowd_arrivals
+    ttft_slo = 25.0 if dryrun else 2.0
+    # the crowd must END with workload left over: the post-crowd tail is
+    # where the ladder unwinds rung by rung and the autoscaler drains back
+    # down — a workload the crowd fully consumes would end the run at peak
+    wl = {"kind": "flash_crowd", "seed": seed,
+          "n_requests": 110 if dryrun else 96,
+          "base_rate": 0.5 if dryrun else 2.0,
+          "crowd_rate": 12.0 if dryrun else 24.0,
+          "crowd_start": 10.0 if dryrun else 2.0,
+          "crowd_duration": 6.0 if dryrun else 3.0}
+    arrivals = flash_crowd_arrivals(
+        seed=wl["seed"], n_requests=wl["n_requests"], base_rate=wl["base_rate"],
+        crowd_rate=wl["crowd_rate"], crowd_start=wl["crowd_start"],
+        crowd_duration=wl["crowd_duration"], vocab=vocab,
+        tenants=[(name, p, slack) for name, p, slack, *_ in AUTOSCALE_TENANTS])
+    scfg = ServingConfig(step_cost=(lambda toks: 0.25 + 0.01 * toks)
+                         if dryrun else None)
+    static_rec, static_out = _autoscale_point(
+        factory, clock_factory, arrivals, scfg, ttft_slo, autoscaled=False)
+    auto_rec, auto_out = _autoscale_point(
+        factory, clock_factory, arrivals, scfg, ttft_slo, autoscaled=True)
+    auto_rec2, auto_out2 = _autoscale_point(
+        factory, clock_factory, arrivals, scfg, ttft_slo, autoscaled=True)
+    repeat_identical = (auto_rec == auto_rec2 and auto_out == auto_out2)
+    # divergence: a token DIFFERING at a shared position between the two
+    # provisioning modes.  Brownout-capped best-effort requests complete
+    # with a shorter budget; greedy decode makes the capped output an
+    # exact prefix, so prefix-consistency IS zero divergence.
+    divergent = 0
+    for a, b in zip(static_out, auto_out):
+        n = min(len(a), len(b))
+        if a[:n] != b[:n]:
+            divergent += 1
+    saving = 1.0 - auto_rec["replica_steps"] / max(1, static_rec["replica_steps"])
+    prem = auto_rec["tenants"].get("premium", {})
+    premium_sla_held = bool(prem) and prem["sla_violations"] == 0 \
+        and prem["completed"] == prem["submitted"]
+    rec = {
+        "workload": wl,
+        "tenants": {name: {"mix": p, "deadline_slack": slack, "weight": w,
+                           "max_outstanding": mo, "ttft_slo": slo,
+                           "best_effort": be}
+                    for name, p, slack, w, mo, slo, be in AUTOSCALE_TENANTS},
+        "step_cost": "0.25 + 0.01 * planned_tokens" if dryrun else "wall",
+        "ttft_slo": ttft_slo,
+        "static": static_rec,
+        "autoscaled": auto_rec,
+        "replica_step_saving": round(saving, 4),
+        "premium_sla_held": premium_sla_held,
+        "premium_ttft_slo": AUTOSCALE_TENANTS[0][5],
+        "divergent_requests": divergent,
+        "zero_divergence": divergent == 0,
+        "determinism_repeat_identical": repeat_identical,
+        "brownout": auto_rec["overload"],
+    }
+    print(f"# autoscale: static steps={static_rec['replica_steps']} "
+          f"auto steps={auto_rec['replica_steps']} saving={saving:.3f} | "
+          f"premium p99 ttft={prem.get('ttft', {}).get('p99')} "
+          f"violations={prem.get('sla_violations')} | "
+          f"rung moves={len((auto_rec['overload'] or {}).get('moves', []))} "
+          f"shed={auto_rec.get('shed')} divergent={divergent}", flush=True)
+    return rec
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--dryrun", action="store_true",
@@ -307,7 +445,27 @@ def main():
 
     disagg = run_disaggregation_leg(factory, clock_factory, args.seed, vocab,
                                     args.dryrun)
+    autoscale = run_autoscale_leg(factory, clock_factory, args.seed, vocab,
+                                  args.dryrun)
     if args.dryrun:
+        # the overload-control-plane receipts (deterministic on the virtual
+        # clock — fail the run, not just CI; wall mode records only)
+        assert autoscale["determinism_repeat_identical"], \
+            "autoscaled flash-crowd leg is not byte-reproducible"
+        assert autoscale["zero_divergence"], \
+            f"{autoscale['divergent_requests']} request(s) diverged between " \
+            "static-max and autoscaled provisioning"
+        assert autoscale["replica_step_saving"] >= 0.30, \
+            f"autoscaler saved only {autoscale['replica_step_saving']:.1%} " \
+            "replica-steps (< 30%) vs static max provisioning"
+        assert autoscale["premium_sla_held"], \
+            f"premium tenant SLA broke: {autoscale['autoscaled']['tenants'].get('premium')}"
+        bo = autoscale["brownout"]
+        assert bo["balanced"] and bo["entered"], \
+            f"brownout ladder not exercised-and-unwound: {bo}"
+        asc = autoscale["autoscaled"]["autoscaler"]
+        assert asc["n_up"] >= 1 and asc["n_down"] >= 1, \
+            f"autoscaler never scaled both ways: {asc['decisions']}"
         # the disaggregation receipts (deterministic on the virtual clock —
         # fail the run, not just CI; wall mode records without asserting)
         assert disagg["zero_divergence"], \
@@ -341,7 +499,7 @@ def main():
         "metric": "fleet_goodput_rps",
         "value": best["goodput_rps"],
         "unit": "requests/s" if not args.dryrun else "requests/step",
-        "schema_version": 2,
+        "schema_version": 3,
         "sla": {"ttft_budget": ttft_budget, "tpot_budget": tpot_budget},
         "workload": {"n_requests": n_requests, "seed": args.seed,
                      "arrival_rate": rate,
@@ -362,6 +520,7 @@ def main():
         "policies": list(POLICY_NAMES),
         "sweep": sweep,
         "disaggregation": disagg,
+        "autoscale": autoscale,
     }
     print(json.dumps({k: result[k] for k in ("metric", "value", "unit")} |
                      {"best": {"policy": best["policy"],

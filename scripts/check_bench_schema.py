@@ -123,6 +123,64 @@ def _disagg_record(v):
     return None
 
 
+def _autoscale_record(v):
+    """The overload-control-plane receipt (bench_router.py
+    run_autoscale_leg): the SLA autoscaler must beat static-max
+    provisioning by >= 30% replica-steps over the same flash crowd while
+    the premium tenant's SLA holds, with zero output divergence (brownout
+    may only TRUNCATE best-effort outputs, never change a token), every
+    brownout rung entered also exited by end of sweep, per-tenant
+    accounting closed, and the autoscaled leg byte-identical when
+    repeated.  A committed artifact where the control plane lost any of
+    those is a regression, not a benchmark."""
+    if not isinstance(v, dict):
+        return f"expected autoscale object, got {type(v).__name__}"
+    for k in ("workload", "tenants", "static", "autoscaled",
+              "replica_step_saving", "premium_sla_held",
+              "divergent_requests", "zero_divergence",
+              "determinism_repeat_identical", "brownout"):
+        if k not in v:
+            return f"missing autoscale key {k!r}"
+    if v["determinism_repeat_identical"] is not True:
+        return "autoscaled flash-crowd leg not byte-identical across runs"
+    if v["zero_divergence"] is not True or v["divergent_requests"] != 0:
+        return (f"output divergence recorded ({v['divergent_requests']} "
+                "request(s)) between static-max and autoscaled provisioning")
+    saving = v["replica_step_saving"]
+    if not isinstance(saving, (int, float)) or isinstance(saving, bool) \
+            or saving < 0.30:
+        return (f"replica_step_saving {saving!r} < 0.30 — the autoscaler "
+                "must save >= 30% replica-steps vs static max")
+    if v["premium_sla_held"] is not True:
+        return "premium tenant SLA not held across the flash crowd"
+    errors = []
+    for side in ("static", "autoscaled"):
+        rec = v[side]
+        _check(rec, {"replica_steps": INT, "rounds": INT, "submitted": INT,
+                     "completed": INT, "tenants": DICT,
+                     "ttft": _pct_ordered}, f"autoscale.{side}", errors)
+        if errors:
+            return "; ".join(errors)
+        for name, t in rec["tenants"].items():
+            if t.get("closed") is not True:
+                return (f"autoscale.{side}: tenant {name!r} accounting did "
+                        "not close (submitted != completed+timed_out+rejected)")
+    if not (v["static"]["replica_steps"] > v["autoscaled"]["replica_steps"] > 0):
+        return (f"replica-step counts not ordered: static "
+                f"{v['static']['replica_steps']} vs autoscaled "
+                f"{v['autoscaled']['replica_steps']}")
+    bo = v["brownout"]
+    if not isinstance(bo, dict) or bo.get("balanced") is not True:
+        return f"brownout ladder not balanced (a rung entered was never exited): {bo}"
+    if not bo.get("entered"):
+        return "brownout ladder never engaged — the flash crowd did not exercise degradation"
+    asc = v["autoscaled"].get("autoscaler") or {}
+    if not (asc.get("n_up", 0) >= 1 and asc.get("n_down", 0) >= 1):
+        return ("autoscaler never scaled both up and down: "
+                f"{asc.get('decisions')}")
+    return None
+
+
 def _router_sweep_invariants(v):
     """The fleet bench's acceptance receipts: >= 3 points, the
     prefix_affinity policy actually hit its cache somewhere, and every
@@ -277,10 +335,10 @@ SCHEMAS = {
                         "concurrency": INT},
         "engine_throughput": ("nullable", _LEGACY_THROUGHPUT),
     },
-    # the fleet router harness (scripts/bench_router.py, schema v2)
+    # the fleet router harness (scripts/bench_router.py, schema v3)
     "BENCH_ROUTER.json": {
         "metric": STR, "value": NUM, "unit": STR,
-        "schema_version": lambda v: None if v == 2 else f"schema_version {v} != 2",
+        "schema_version": lambda v: None if v == 3 else f"schema_version {v} != 3",
         "sla": {"ttft_budget": NUM, "tpot_budget": NUM},
         "workload": {"n_requests": INT, "seed": INT, "arrival_rate": NUM,
                      "prefix_groups": INT, "prefix_pages": INT, "dryrun": BOOL,
@@ -290,6 +348,7 @@ SCHEMAS = {
         "sweep": _router_sweep_invariants,
         "sweep[]": [_ROUTER_POINT],
         "disaggregation": _disagg_record,
+        "autoscale": _autoscale_record,
     },
 }
 

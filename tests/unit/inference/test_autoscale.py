@@ -1,0 +1,439 @@
+"""Overload control plane tests (deepspeed_tpu/serving/fleet/autoscale.py
++ tenancy.py): weighted-fair multi-tenant admission, SLA autoscaler
+scale-up/down through the RECOVERING/DRAINING lifecycle (never killing
+in-flight work), the graceful-degradation ladder, retry-after hints, and
+the seeded property audit — random flash crowds + kill/recover schedules
+with nothing lost, exactly-once terminals, byte-identical scale decisions
+and closed per-tenant accounting."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, build_engine
+from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.models.llama_cache import PagedKVConfig
+from deepspeed_tpu.serving import ServingEngine, VirtualClock
+from deepspeed_tpu.serving.admission import AdmissionConfig
+from deepspeed_tpu.serving.engine import ServingConfig
+from deepspeed_tpu.serving.fleet import (AutoscaleConfig, Autoscaler,
+                                         FleetSimulator, FleetState,
+                                         OverloadConfig, OverloadController,
+                                         ReplicaPool, ReplicaState, Router,
+                                         TenantRegistry, TenantSpec,
+                                         flash_crowd_arrivals, make_policy)
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128,
+                  rope_theta=1e4, dtype=jnp.float32, scan_layers=True, remat=False)
+
+
+@pytest.fixture(scope="module")
+def trained_params():
+    model = LlamaForCausalLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def _factory(trained_params, num_pages=64, max_seqs=4):
+    def make():
+        kv = PagedKVConfig(num_pages=num_pages, page_size=8, max_pages_per_seq=8)
+        sched = SchedulerConfig(token_budget=64, max_seqs=max_seqs, prefill_chunk=8,
+                                decode_bucket=4)
+        return build_engine(CFG, trained_params, RaggedInferenceEngineConfig(
+            kv=kv, scheduler=sched, kv_dtype=jnp.float32, decode_steps_per_dispatch=1))
+    return make
+
+
+@pytest.fixture(scope="module")
+def goldens(trained_params):
+    """Unperturbed single-engine outputs keyed by prompt: the oracle for
+    'served with the right tokens' — a brownout-capped request's output
+    must be an exact PREFIX of the full golden (greedy determinism)."""
+    cache = {}
+    eng = _factory(trained_params)()
+
+    def get(prompt, max_new=8):
+        key = tuple(prompt)
+        if key not in cache or len(cache[key]) < max_new:
+            cache[key] = eng.generate([list(prompt)], max_new_tokens=max_new)[0]
+        return cache[key]
+    return get
+
+
+# ------------------------------------------------------------------ tenancy
+
+
+def test_tenant_registry_stride_weights():
+    reg = TenantRegistry([TenantSpec("premium", weight=4.0),
+                          TenantSpec("bulk", weight=1.0)])
+    order = sorted([("premium", reg.next_pass("premium")) for _ in range(8)] +
+                   [("bulk", reg.next_pass("bulk")) for _ in range(8)],
+                   key=lambda x: x[1])
+    # weight 4 vs 1: the first 5 slots are 4 premium + 1 bulk — the
+    # stride interleave, not starvation in either direction
+    assert [n for n, _ in order[:5]].count("premium") == 4
+    assert "bulk" in [n for n, _ in order[:5]]
+    # unknown tenants auto-create a default (weight 1) contract
+    assert reg.spec("walkup").weight == 1.0
+
+    # a joiner is clamped UP to the caller's virtual-time floor: it
+    # competes from now, not from the history it sat out
+    reg2 = TenantRegistry([TenantSpec("old", weight=1.0),
+                           TenantSpec("late", weight=1.0)])
+    for _ in range(5):
+        reg2.next_pass("old")
+    assert reg2.next_pass("late", floor=2.5) == pytest.approx(2.5)
+    # ... and reset_passes clears the slate for a fully idle fleet
+    reg2.reset_passes()
+    assert reg2.next_pass("old") == pytest.approx(0.0)
+
+
+def test_tenant_spec_validation():
+    with pytest.raises(ValueError, match="weight"):
+        TenantSpec("x", weight=0.0)
+    with pytest.raises(ValueError, match="lo < hi"):
+        OverloadConfig(hi=0.5, lo=0.9)
+    with pytest.raises(ValueError, match="min_replicas"):
+        AutoscaleConfig(min_replicas=0)
+
+
+def test_weighted_fair_admission_no_starvation(trained_params):
+    """A heavy best-effort tenant floods the queue; a weighted premium
+    tenant's requests still interleave into dispatch instead of waiting
+    behind the whole flood."""
+    tenants = TenantRegistry([TenantSpec("premium", weight=6.0),
+                              TenantSpec("bulk", weight=1.0, best_effort=True)])
+    pool = ReplicaPool(_factory(trained_params), 1, clock=VirtualClock(),
+                       serving_config=ServingConfig(
+                           admission=AdmissionConfig(max_queue_depth=2)))
+    router = Router(pool, make_policy("least_outstanding"), tenants=tenants)
+    rng = np.random.default_rng(0)
+    bulk = [router.submit([int(x) for x in rng.integers(1, 100, 5)],
+                          max_new_tokens=4, arrival_ts=0.0, tenant="bulk")
+            for _ in range(10)]
+    prem = [router.submit([int(x) for x in rng.integers(1, 100, 5)],
+                          max_new_tokens=4, arrival_ts=0.0, tenant="premium")
+            for _ in range(3)]
+    FleetSimulator(router).run([])
+    assert all(r.state is FleetState.DONE for r in bulk + prem)
+    # every premium request was DISPATCHED before the bulk flood finished
+    # dispatching — weighted-fair order, despite arriving after all of it
+    last_prem_dispatch = max(r.dispatches[0][1] for r in prem)
+    bulk_dispatches = sorted(r.dispatches[0][1] for r in bulk)
+    assert last_prem_dispatch <= bulk_dispatches[-3], \
+        (last_prem_dispatch, bulk_dispatches)
+    s = router.summary()["tenants"]
+    assert s["premium"]["closed"] and s["bulk"]["closed"]
+
+
+def test_max_outstanding_bounds_tenant(trained_params):
+    tenants = TenantRegistry([TenantSpec("bulk", max_outstanding=1)])
+    pool = ReplicaPool(_factory(trained_params), 2, clock=VirtualClock())
+    router = Router(pool, make_policy("least_outstanding"), tenants=tenants)
+    reqs = [router.submit([1 + i, 2, 3], max_new_tokens=3, arrival_ts=0.0,
+                          tenant="bulk") for i in range(3)]
+    router.dispatch_pending()
+    dispatched = [r for r in reqs if r.state is FleetState.DISPATCHED]
+    assert len(dispatched) == 1   # the cap, despite 2 idle replicas
+    assert router.stats["tenant_deferrals"] >= 2
+    FleetSimulator(router).run([])
+    assert all(r.state is FleetState.DONE for r in reqs)
+    # serialized: one dispatch window at a time
+    windows = sorted(r.dispatches[0][1] for r in reqs)
+    assert windows[0] < windows[1] < windows[2]
+
+
+# --------------------------------------------------------------- autoscaler
+
+
+def test_autoscaler_scales_up_then_down(trained_params, goldens):
+    """A flash crowd on a 1-warm/2-parked fleet: the autoscaler provisions
+    through RECOVERING, then drains and parks back down to min_replicas —
+    with every output identical to the unperturbed golden and scale
+    decisions byte-identical across runs."""
+    def run():
+        pool = ReplicaPool(_factory(trained_params), 3, clock=VirtualClock(),
+                           serving_config=ServingConfig(step_cost=lambda t: 0.5))
+        router = Router(pool, make_policy("least_outstanding"))
+        for rid in (1, 2):
+            pool.kill(rid, reason="autoscale: parked")
+        asc = Autoscaler(router, AutoscaleConfig(
+            min_replicas=1, ttft_slo=20.0, queue_hi=1.5, queue_lo=0.75,
+            down_streak=2, cooldown_up=1.0, cooldown_down=3.0,
+            decide_interval=0.5))
+        arrivals = flash_crowd_arrivals(
+            seed=3, n_requests=24, base_rate=0.3, crowd_rate=8.0,
+            crowd_start=4.0, crowd_duration=4.0, vocab=CFG.vocab_size,
+            max_new=8)
+        reqs = FleetSimulator(router, autoscaler=asc).run(
+            [dict(a) for a in arrivals])
+        return pool, router, asc, reqs
+
+    pool, router, asc, reqs = run()
+    actions = [d[1] for d in asc.decisions]
+    assert "up" in actions and "drain" in actions and "down" in actions
+    # scaled down from the peak (the sim ends with the last request, so a
+    # final drain may still be in flight — but at least one replica was
+    # drained AND parked, and the fleet ended below its 3-replica peak)
+    assert asc.summary()["provisioned_end"] < 3
+    assert all(r.state is FleetState.DONE for r in reqs)
+    for r in reqs:
+        assert r.tokens == goldens(r.prompt)[:len(r.tokens)]
+        assert len(r.tokens) == r.max_new_tokens
+    # byte-identical control plane + data plane on a second run
+    _, router2, asc2, reqs2 = run()
+    assert asc.decisions == asc2.decisions
+    assert [r.tokens for r in reqs] == [r.tokens for r in reqs2]
+    assert router.summary() == router2.summary()
+
+
+def test_scale_down_drains_before_parking(trained_params):
+    """Scale-down must never kill in-flight work: the drained replica keeps
+    serving its long request (no failover), parks only once idle."""
+    pool = ReplicaPool(_factory(trained_params), 2, clock=VirtualClock())
+    router = Router(pool, make_policy("least_outstanding"))
+    asc = Autoscaler(router, AutoscaleConfig(
+        min_replicas=1, queue_lo=1.0, down_streak=1, cooldown_down=0.0,
+        decide_interval=0.0))
+    filler = router.submit([9, 9, 9], max_new_tokens=2, arrival_ts=0.0)
+    long_req = router.submit([1, 2, 3, 4], max_new_tokens=10, arrival_ts=0.0)
+    router.dispatch_pending()
+    assert long_req.dispatches[0][0] == 1
+    for rid in pool.rids:   # one round: replicas admit their queued work
+        pool.tick(rid)
+    router.poll()
+    asc.step(0.0)
+    assert asc.decisions and asc.decisions[0][1] == "drain"
+    assert pool.health.state(1) is ReplicaState.DRAINING
+    rounds = 0
+    while long_req.state is not FleetState.DONE:
+        for rid in pool.rids:
+            pool.tick(rid)
+        router.poll()
+        asc.step(float(rounds))
+        rounds += 1
+        assert rounds < 100
+    # never displaced, full output, and only parked once idle
+    assert long_req.failovers == 0 and len(long_req.tokens) == 10
+    asc.step(float(rounds))
+    assert pool.health.state(1) is ReplicaState.DEAD
+    assert [d[1] for d in asc.decisions] == ["drain", "down"]
+    assert filler.state is FleetState.DONE
+
+
+def test_scale_up_cancels_inflight_drain(trained_params):
+    """Pressure arriving mid-drain flips the drain into a rolling restart
+    instead of parking: capacity returns without a kill."""
+    pool = ReplicaPool(_factory(trained_params), 2, clock=VirtualClock())
+    router = Router(pool, make_policy("least_outstanding"))
+    asc = Autoscaler(router, AutoscaleConfig(
+        min_replicas=1, queue_hi=2.0, queue_lo=1.0, down_streak=1,
+        cooldown_up=0.0, cooldown_down=0.0, decide_interval=0.0))
+    long_req = router.submit([1, 2, 3, 4], max_new_tokens=8, arrival_ts=0.0)
+    filler = router.submit([7, 7], max_new_tokens=2, arrival_ts=0.0)
+    router.dispatch_pending()
+    for rid in pool.rids:   # one round: replicas admit their queued work
+        pool.tick(rid)
+    router.poll()
+    # drain starts on replica 1 (low occupancy), while it still has work
+    asc.step(0.0)
+    assert pool.health.state(1) is ReplicaState.DRAINING
+    # a queue burst arrives: the autoscaler cancels the drain
+    burst = [router.submit([5 + i], max_new_tokens=2, arrival_ts=0.0)
+             for i in range(6)]
+    asc.step(1.0)
+    assert ("cancel_drain" in [d[1] for d in asc.decisions])
+    rounds = 0
+    while any(r.state is not FleetState.DONE
+              for r in [long_req, filler] + burst):
+        for rid in pool.rids:
+            pool.tick(rid)
+        router.poll()
+        asc.step(2.0 + rounds)
+        router.dispatch_pending()
+        rounds += 1
+        assert rounds < 200
+    # the drained replica came back through RECOVERING (rolling restart,
+    # never DEAD-with-victims); the aggressive test config may re-park it
+    # AFTER the burst drains — what matters is nothing was displaced
+    states = [h[2] for h in pool.health.history if h[0] == 1]
+    assert ReplicaState.RECOVERING in states
+    assert long_req.failovers == 0
+    assert all(r.failovers == 0 for r in burst)
+
+
+# ----------------------------------------------------------------- overload
+
+
+def test_overload_ladder_steps_symmetrically():
+    events = []
+    ol = OverloadController(OverloadConfig(hi=1.0, lo=0.5, cooldown=1.0),
+                            emit=lambda n, v: events.append((n, v)))
+    for t in range(3):   # sustained pressure: one rung per cooldown window
+        ol.update(float(t), 2.0)
+    assert ol.rung == 3 and ol.migrations_paused and ol.spec_disabled
+    ol.update(3.0, 2.0)
+    assert ol.rung == 4 and ol.shed(TenantSpec("b", best_effort=True))
+    assert not ol.shed(TenantSpec("p"))   # premium is never shed
+    for t in range(4, 9):
+        ol.update(float(t), 0.1)
+    assert ol.rung == 0
+    ol.finalize(10.0)
+    s = ol.summary()
+    assert s["balanced"] and s["entered"] == s["exited"]
+    ups = [n for n, _ in events if n == "fleet/overload_step_up"]
+    downs = [n for n, _ in events if n == "fleet/overload_step_down"]
+    assert len(ups) == len(downs) == 4
+    assert abs(sum(s["occupancy"].values()) - 10.0) < 1e-9
+
+
+def test_overload_cooldown_prevents_flap():
+    ol = OverloadController(OverloadConfig(hi=1.0, lo=0.5, cooldown=5.0))
+    ol.update(0.0, 2.0)
+    assert ol.rung == 1
+    ol.update(1.0, 0.0)   # inside cooldown: no move despite low pressure
+    assert ol.rung == 1
+    ol.update(6.0, 0.0)
+    assert ol.rung == 0
+
+
+def test_brownout_cap_and_shed_at_admission(trained_params):
+    tenants = TenantRegistry([TenantSpec("bulk", best_effort=True),
+                              TenantSpec("premium")])
+    ol = OverloadController(OverloadConfig(token_cap=4, retry_after=7.0))
+    pool = ReplicaPool(_factory(trained_params), 1, clock=VirtualClock())
+    router = Router(pool, make_policy("least_outstanding"), tenants=tenants,
+                    overload=ol)
+    ol.rung = 1   # cap_tokens
+    capped = router.submit([1, 2, 3], max_new_tokens=20, arrival_ts=0.0,
+                           tenant="bulk")
+    prem = router.submit([1, 2, 3], max_new_tokens=20, arrival_ts=0.0,
+                         tenant="premium")
+    assert capped.max_new_tokens == 4 and capped.brownout_capped
+    assert prem.max_new_tokens == 20 and not prem.brownout_capped
+    ol.rung = 4   # shed_best_effort
+    shed = router.submit([4, 5, 6], max_new_tokens=8, arrival_ts=0.0,
+                         tenant="bulk")
+    assert shed.state is FleetState.REJECTED
+    assert shed.reject_reason == "shed_overload"
+    assert shed.retry_after == 7.0
+    served = router.submit([4, 5, 6], max_new_tokens=8, arrival_ts=0.0,
+                           tenant="premium")
+    assert served.state is FleetState.PENDING
+    ol.rung = 0
+    FleetSimulator(router).run([])
+    assert capped.state is FleetState.DONE and len(capped.tokens) == 4
+    ts = router.summary()["tenants"]
+    assert ts["bulk"]["shed"] == 1 and ts["bulk"]["rejected"] == 1
+    assert ts["bulk"]["closed"] and ts["premium"]["closed"]
+
+
+# -------------------------------------------------------------- retry-after
+
+
+def test_queue_full_rejection_carries_retry_after(trained_params):
+    serve = ServingEngine(
+        _factory(trained_params)(), clock=VirtualClock(),
+        config=ServingConfig(admission=AdmissionConfig(max_queue_depth=1)))
+    serve.submit([1, 2, 3], max_new_tokens=4)
+    rej = serve.submit([4, 5, 6], max_new_tokens=4)
+    assert rej.state.value == "rejected" and rej.reject_reason == "queue_full"
+    assert rej.retry_after is not None and rej.retry_after >= 1.0
+    # structural rejections carry NO hint: retrying can never help
+    infeasible = serve.submit(list(range(1, 100)), max_new_tokens=60)
+    assert infeasible.reject_reason == "exceeds_max_pages_per_seq"
+    assert infeasible.retry_after is None
+
+
+def test_submit_retry_policy_honors_hint(trained_params):
+    from deepspeed_tpu.resilience.retry import RetryPolicy
+    serve = ServingEngine(
+        _factory(trained_params)(), clock=VirtualClock(),
+        config=ServingConfig(admission=AdmissionConfig(max_queue_depth=1)))
+    serve.submit([1, 2, 3], max_new_tokens=3)
+    # the hinted wait ticks the queue down and admits WITHOUT burning the
+    # exponential ladder: one informed wait instead of geometric probing
+    req = serve.submit([4, 5, 6], max_new_tokens=3,
+                       retry_policy=RetryPolicy(max_attempts=3, budget_s=100.0))
+    assert req.state.value != "rejected"
+    serve.drain()
+    assert len(req.tokens) == 3
+
+
+# ------------------------------------------------------------ property audit
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_flash_crowd_chaos_property_audit(trained_params, goldens, seed):
+    """The PR's property audit: seeded random flash-crowd arrivals +
+    random kill/recover schedules against the full control plane
+    (autoscaler + ladder + tenants).  Invariants: nothing lost or served
+    twice, exactly-once terminals, DONE outputs are exact prefixes of the
+    unperturbed goldens at their (possibly brownout-capped) budget,
+    per-tenant accounting closes, and scale decisions + outputs are
+    byte-identical across same-seed runs."""
+    rng = np.random.default_rng(seed)
+    arrivals = flash_crowd_arrivals(
+        seed=seed, n_requests=16, base_rate=0.4, crowd_rate=6.0,
+        crowd_start=float(rng.uniform(2.0, 5.0)), crowd_duration=4.0,
+        vocab=CFG.vocab_size, max_new=8,
+        tenants=[("premium", 0.3, 60.0), ("bulk", 0.7, None)])
+    horizon = arrivals[-1]["arrival_ts"]
+    schedule = []
+    for _ in range(int(rng.integers(1, 3))):
+        rid = int(rng.integers(0, 3))
+        t_kill = round(float(rng.uniform(1.0, horizon)), 6)
+        schedule += [(t_kill, "kill", rid),
+                     (round(t_kill + float(rng.uniform(2.0, 8.0)), 6),
+                      "recover", rid)]
+
+    def run():
+        tenants = TenantRegistry([
+            TenantSpec("premium", weight=4.0, ttft_slo=40.0),
+            TenantSpec("bulk", weight=1.0, best_effort=True,
+                       max_outstanding=6)])
+        pool = ReplicaPool(_factory(trained_params), 3, clock=VirtualClock(),
+                           serving_config=ServingConfig(step_cost=lambda t: 0.5))
+        ol = OverloadController(OverloadConfig(hi=1.0, lo=0.5, cooldown=1.0,
+                                               token_cap=4))
+        router = Router(pool, make_policy("least_outstanding"),
+                        tenants=tenants, overload=ol)
+        pool.kill(2, reason="autoscale: parked")
+        asc = Autoscaler(router, AutoscaleConfig(
+            min_replicas=1, ttft_slo=40.0, queue_hi=1.5, queue_lo=0.75,
+            down_streak=2, cooldown_up=1.0, cooldown_down=4.0,
+            decide_interval=0.5))
+        reqs = FleetSimulator(router, autoscaler=asc).run(
+            [dict(a) for a in arrivals], schedule=list(schedule))
+        return router, asc, reqs
+
+    router, asc, reqs = run()
+    assert len(reqs) == len(arrivals) == len(router.requests)
+    assert router.outstanding == 0
+    by_state = {s: 0 for s in FleetState}
+    for r in reqs:
+        # exactly one terminal state, reached exactly once
+        terminals = [st for st, _ in r.history if st.terminal]
+        assert terminals == [r.state], (r.fid, r.history)
+        by_state[r.state] += 1
+        assert len(r.tokens) <= r.max_new_tokens
+        if r.state is FleetState.DONE:
+            # never served twice / never diverged: the output is the exact
+            # golden prefix at the request's (possibly capped) budget
+            assert len(r.tokens) == r.max_new_tokens
+            assert r.tokens == goldens(r.prompt)[:len(r.tokens)], \
+                (r.fid, r.failovers, r.tenant)
+    assert by_state[FleetState.DONE] + by_state[FleetState.TIMED_OUT] \
+        + by_state[FleetState.REJECTED] == len(arrivals)
+    s = router.summary()
+    assert s["failover"]["unrecovered"] == 0
+    for name, t in s["tenants"].items():
+        assert t["closed"], (name, t)
+    # same seed, same world: control decisions and outputs byte-identical
+    router2, asc2, reqs2 = run()
+    assert asc.decisions == asc2.decisions
+    assert [r.tokens for r in reqs] == [r.tokens for r in reqs2]
+    assert [r.state for r in reqs] == [r.state for r in reqs2]
+    assert s == router2.summary()

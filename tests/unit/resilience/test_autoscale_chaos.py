@@ -1,0 +1,199 @@
+"""Chaos legs for the overload control plane (fleet/autoscale.py):
+
+* a replica KILLED mid-flash-crowd while the autoscaler is scaling — every
+  completed output still equals the unperturbed golden byte-for-byte;
+* an injected ``device_loss`` at the ``autoscaler.decide`` site while a
+  scale-down DRAIN is in flight — the draining replica's in-flight request
+  is re-homed to a survivor with identical output;
+* transient faults at the ``admission.tenant`` site surface as REJECTED
+  with a reason + retry-after hint (never a crash), while ``crash`` specs
+  propagate untouched (crash transparency).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, build_engine
+from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.models.llama_cache import PagedKVConfig
+from deepspeed_tpu.resilience.fault_injection import (InjectedCrash,
+                                                      configure_fault_injection)
+from deepspeed_tpu.serving import VirtualClock
+from deepspeed_tpu.serving.engine import ServingConfig
+from deepspeed_tpu.serving.fleet import (AutoscaleConfig, Autoscaler,
+                                         FleetSimulator, FleetState,
+                                         OverloadConfig, OverloadController,
+                                         ReplicaPool, ReplicaState, Router,
+                                         TenantRegistry, TenantSpec,
+                                         flash_crowd_arrivals, make_policy)
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128,
+                  rope_theta=1e4, dtype=jnp.float32, scan_layers=True, remat=False)
+
+
+@pytest.fixture(scope="module")
+def trained_params():
+    model = LlamaForCausalLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def _factory(trained_params):
+    def make():
+        kv = PagedKVConfig(num_pages=64, page_size=8, max_pages_per_seq=8)
+        sched = SchedulerConfig(token_budget=64, max_seqs=4, prefill_chunk=8,
+                                decode_bucket=4)
+        return build_engine(CFG, trained_params, RaggedInferenceEngineConfig(
+            kv=kv, scheduler=sched, kv_dtype=jnp.float32, decode_steps_per_dispatch=1))
+    return make
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    configure_fault_injection(None)
+
+
+def _goldens(trained_params, reqs):
+    eng = _factory(trained_params)()
+    return {r.fid: eng.generate([list(r.prompt)],
+                                max_new_tokens=r.max_new_tokens)[0]
+            for r in reqs if r.state is FleetState.DONE}
+
+
+def test_replica_kill_during_flash_crowd_zero_divergence(trained_params):
+    """A scripted kill lands in the middle of the crowd, while the
+    autoscaler is mid-scale-up: displaced requests fail over, the floor
+    re-provisions, and every DONE output equals the unperturbed golden."""
+    arrivals = flash_crowd_arrivals(
+        seed=7, n_requests=18, base_rate=0.4, crowd_rate=7.0,
+        crowd_start=3.0, crowd_duration=4.0, vocab=CFG.vocab_size, max_new=8,
+        tenants=[("premium", 0.3, 60.0), ("bulk", 0.7, None)])
+
+    def run(schedule):
+        tenants = TenantRegistry([
+            TenantSpec("premium", weight=4.0),
+            TenantSpec("bulk", weight=1.0, best_effort=True)])
+        pool = ReplicaPool(_factory(trained_params), 3, clock=VirtualClock(),
+                           serving_config=ServingConfig(step_cost=lambda t: 0.5))
+        router = Router(pool, make_policy("least_outstanding"),
+                        tenants=tenants,
+                        overload=OverloadController(OverloadConfig(
+                            hi=1.0, lo=0.5, cooldown=1.0, token_cap=4)))
+        pool.kill(2, reason="autoscale: parked")
+        asc = Autoscaler(router, AutoscaleConfig(
+            min_replicas=1, ttft_slo=30.0, queue_hi=1.5, queue_lo=0.75,
+            down_streak=2, cooldown_up=1.0, cooldown_down=4.0,
+            decide_interval=0.5))
+        reqs = FleetSimulator(router, autoscaler=asc).run(
+            [dict(a) for a in arrivals], schedule=schedule)
+        return router, reqs
+
+    # kill replica 0 mid-crowd; recover later (chaos, not the autoscaler)
+    router, reqs = run([(5.0, "kill", 0), (12.0, "recover", 0)])
+    assert any(r.failovers for r in reqs), "kill at t=5 displaced nothing"
+    assert router.summary()["failover"]["unrecovered"] == 0
+    golden = _goldens(trained_params, reqs)
+    for r in reqs:
+        terminals = [st for st, _ in r.history if st.terminal]
+        assert terminals == [r.state]
+        if r.state is FleetState.DONE:
+            assert r.tokens == golden[r.fid], (r.fid, r.failovers)
+
+
+def test_device_loss_mid_scale_down_drain_rehomes(trained_params):
+    """The satellite chaos leg: a ``device_loss`` injected at the
+    ``autoscaler.decide`` site while the autoscaler is DRAINING a replica
+    for scale-down.  The drained replica's in-flight request must be
+    re-homed to a survivor and finish with output identical to the
+    unperturbed run."""
+    def run(inject: bool):
+        pool = ReplicaPool(_factory(trained_params), 2, clock=VirtualClock())
+        router = Router(pool, make_policy("least_outstanding"))
+        asc = Autoscaler(router, AutoscaleConfig(
+            min_replicas=1, queue_lo=1.0, down_streak=1, cooldown_down=0.0,
+            decide_interval=0.0))
+        short = router.submit([9, 9, 9], max_new_tokens=2, arrival_ts=0.0)
+        long_req = router.submit([1, 2, 3, 4], max_new_tokens=10,
+                                 arrival_ts=0.0)
+        router.dispatch_pending()
+        assert long_req.dispatches[0][0] == 1
+        for rid in pool.rids:   # one round: replicas admit their queued work
+            pool.tick(rid)
+        router.poll()
+        # outstanding (2) <= queue_lo * dispatchable (2): drain starts on
+        # replica 1 — which still has the long request in flight
+        asc.step(0.0)
+        assert [d[1] for d in asc.decisions] == ["drain"]
+        assert pool.health.state(1) is ReplicaState.DRAINING
+        assert long_req.state is FleetState.DISPATCHED
+        if inject:
+            # the NEXT control-plane probe finds the draining replica's
+            # device gone (fresh injector: first hit fires)
+            configure_fault_injection({"sites": [
+                {"site": "autoscaler.decide", "kind": "device_loss", "at": 1}]})
+        asc.step(0.5)
+        if inject:
+            configure_fault_injection(None)
+            # the drained replica died mid-drain: its request re-homed
+            assert pool.health.state(1) is ReplicaState.DEAD
+            assert long_req.failovers == 1
+            assert [d[1] for d in asc.decisions] == ["drain", "device_loss"]
+        rounds = 0
+        while any(r.state is not FleetState.DONE for r in (short, long_req)):
+            router.dispatch_pending()
+            for rid in pool.rids:
+                pool.tick(rid)
+            router.poll()
+            asc.step(1.0 + rounds)
+            rounds += 1
+            assert rounds < 200
+        return router, asc, short, long_req
+
+    _, _, _, golden_long = run(inject=False)
+    router, asc, short, long_req = run(inject=True)
+    # re-homed onto the survivor, identical output
+    assert long_req.dispatches[-1][0] == 0
+    assert long_req.tokens == golden_long.tokens
+    assert len(long_req.tokens) == 10
+    assert router.summary()["failover"]["unrecovered"] == 0
+
+
+def test_admission_tenant_transient_fault_rejects_with_hint(trained_params):
+    pool = ReplicaPool(_factory(trained_params), 1, clock=VirtualClock())
+    router = Router(pool, make_policy("least_outstanding"))
+    configure_fault_injection({"sites": [
+        {"site": "admission.tenant", "kind": "os_error", "at": 1}]})
+    fr = router.submit([1, 2, 3], max_new_tokens=4, arrival_ts=0.0)
+    assert fr.state is FleetState.REJECTED
+    assert fr.reject_reason == "tenant_admission_fault"
+    assert fr.retry_after is not None
+    # the fault was transient: the retry (hit 2, unarmed) is served
+    fr2 = router.submit([1, 2, 3], max_new_tokens=4, arrival_ts=0.0)
+    assert fr2.state is FleetState.PENDING
+    FleetSimulator(router).run([])
+    assert fr2.state is FleetState.DONE
+    assert router.summary()["tenants"]["default"]["closed"]
+
+
+def test_admission_tenant_crash_propagates(trained_params):
+    """Crash transparency: an InjectedCrash at the tenant-admission edge is
+    simulated process death and must NOT be absorbed into a rejection."""
+    pool = ReplicaPool(_factory(trained_params), 1, clock=VirtualClock())
+    router = Router(pool, make_policy("least_outstanding"))
+    configure_fault_injection({"sites": [
+        {"site": "admission.tenant", "kind": "crash", "at": 1}]})
+    with pytest.raises(InjectedCrash):
+        router.submit([1, 2, 3], max_new_tokens=4, arrival_ts=0.0)
+
+
+def test_autoscaler_decide_crash_propagates(trained_params):
+    pool = ReplicaPool(_factory(trained_params), 2, clock=VirtualClock())
+    router = Router(pool, make_policy("least_outstanding"))
+    asc = Autoscaler(router, AutoscaleConfig(decide_interval=0.0))
+    configure_fault_injection({"sites": [
+        {"site": "autoscaler.decide", "kind": "crash", "at": 1}]})
+    with pytest.raises(InjectedCrash):
+        asc.step(0.0)
