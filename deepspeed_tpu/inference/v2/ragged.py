@@ -62,6 +62,33 @@ class BlockedAllocator:
                 self._free.append(p)
 
 
+#: seed of the prefix chain hash — shared by :class:`PrefixCacheManager`
+#: and the fleet's router-resident prefix directory
+#: (serving/fleet/prefix_directory.py), which must compute IDENTICAL
+#: digests from tokens alone to know which replica holds which pages
+PREFIX_CHAIN_SEED = 0x9E3779B9
+
+
+def iter_prefix_chain_hashes(tokens: Sequence[int], page_size: int):
+    """Lazily yield the chain hash of each FULL page of ``tokens``:
+    ``h_k = hash(h_{k-1}, tokens[k*P:(k+1)*P])`` from
+    :data:`PREFIX_CHAIN_SEED`, so a match on ``h_k`` transitively pins
+    every earlier token.  This is THE digest rule the prefix cache keys
+    pages by and the fleet prefix directory routes on — one rule, two
+    consumers, no way to drift.  A generator so hot-path walkers that
+    stop at the first miss stop HASHING there too.  Deterministic across
+    processes for integer tokens (int/tuple hashing is not salted)."""
+    h = PREFIX_CHAIN_SEED
+    for i in range(len(tokens) // page_size):
+        h = hash((h, tuple(tokens[i * page_size:(i + 1) * page_size])))
+        yield h
+
+
+def prefix_chain_hashes(tokens: Sequence[int], page_size: int) -> List[int]:
+    """Materialized form of :func:`iter_prefix_chain_hashes`."""
+    return list(iter_prefix_chain_hashes(tokens, page_size))
+
+
 @dataclasses.dataclass
 class SequenceDescriptor:
     """Host-side state of one generation (ref: DSSequenceDescriptor)."""
@@ -112,11 +139,18 @@ class PrefixCacheManager:
     registered page, so pages survive their creator's release and are
     evicted LRU only under allocator pressure."""
 
-    _SEED = 0x9E3779B9
+    _SEED = PREFIX_CHAIN_SEED
 
     def __init__(self, allocator: "BlockedAllocator", page_size: int):
         self.allocator = allocator
         self.page_size = page_size
+        #: optional publish/evict notification sink: ``listener(event,
+        #: chain_hash)`` with event ``"publish"`` (a full page entered the
+        #: cache — register() or adopt()) or ``"evict"`` (it left).  The
+        #: fleet ReplicaPool wires this to the router-resident
+        #: PrefixDirectory so routing warmth is pushed, not probed; None
+        #: (the default) costs one ``is None`` test per transition.
+        self.listener = None
         # chain hash → (page id, page's token tuple, parent chain hash).
         # The tokens are kept for verification on match: a 64-bit hash
         # collision would otherwise silently attach another prompt's KV
@@ -135,11 +169,16 @@ class PrefixCacheManager:
         self.misses = 0
 
     def _chain(self, tokens: Sequence[int]):
-        """Yield (chain_hash, page_index) for each FULL page of ``tokens``."""
-        h = self._SEED
-        for i in range(len(tokens) // self.page_size):
-            h = hash((h, tuple(tokens[i * self.page_size:(i + 1) * self.page_size])))
+        """Yield (chain_hash, page_index) for each FULL page of ``tokens``
+        (delegates to :func:`iter_prefix_chain_hashes` — the one digest
+        rule the fleet prefix directory shares; lazy, so a walker that
+        stops at the first miss stops hashing there too)."""
+        for i, h in enumerate(iter_prefix_chain_hashes(tokens, self.page_size)):
             yield h, i
+
+    def _notify(self, event: str, h: int) -> None:
+        if self.listener is not None:
+            self.listener(event, h)
 
     def _walk(self, tokens: Sequence[int]):
         """Yield ``(chain_hash, page_id)`` for the longest run of cached
@@ -205,6 +244,7 @@ class PrefixCacheManager:
                     self._children.setdefault(parent, set()).add(h)
                 self._lru[h] = None
                 self.allocator.retain([seq.pages[i]])
+                self._notify("publish", h)
         seq.pc_pages = full
         seq.pc_hash = h if full else seq.pc_hash
 
@@ -240,8 +280,53 @@ class PrefixCacheManager:
                     if not self._children[parent]:
                         del self._children[parent]
                 freed += 1
+                self._notify("evict", h)
                 h = parent
         return freed
+
+    def held_depth(self, tokens: Sequence[int]) -> int:
+        """Leading FULL pages of ``tokens`` this cache holds, WITHOUT the
+        last-token usable cap :meth:`lookup_depth` applies — cache-
+        population accounting (what a prefix import may skip), not a match
+        preview (what a prefill can reuse)."""
+        depth = 0
+        for h, i in self._chain(tokens):
+            entry = self._pages.get(h)
+            if entry is None or entry[1] != tuple(
+                    tokens[i * self.page_size:(i + 1) * self.page_size]):
+                break
+            depth += 1
+        return depth
+
+    def adopt(self, tokens: Sequence[int], start_page: int,
+              page_ids: Sequence[int]) -> None:
+        """Insert externally-imported full pages ``start_page ..
+        start_page+len(page_ids)-1`` of ``tokens`` (the fleet's hot-prefix
+        KV import: the page CONTENT was scattered into the arena by the
+        caller; this publishes the chain entries so the next ``match()``
+        attaches them).  The caller transfers exactly ONE refcount per page
+        to the cache — the allocation it made for the import — matching
+        register()'s invariant that the cache holds one reference per
+        entry.  A hash already present keeps its existing page and the
+        duplicate id is freed (same dedup stance as register)."""
+        chain = prefix_chain_hashes(tokens, self.page_size)
+        assert start_page + len(page_ids) <= len(chain), \
+            (start_page, len(page_ids), len(chain))
+        for j, page in enumerate(page_ids):
+            i = start_page + j
+            h = chain[i]
+            if h in self._pages:
+                # raced with a local prefill publishing the same page:
+                # keep the incumbent, return the duplicate's refcount
+                self.allocator.free([page])
+                continue
+            parent = chain[i - 1] if i else None
+            page_toks = tuple(tokens[i * self.page_size:(i + 1) * self.page_size])
+            self._pages[h] = (page, page_toks, parent)
+            if parent is not None:
+                self._children.setdefault(parent, set()).add(h)
+            self._lru[h] = None
+            self._notify("publish", h)
 
     @property
     def cached_pages(self) -> int:

@@ -88,6 +88,8 @@ INJECTION_SITES = frozenset({
     "autoscaler.decide",    # overload-control-plane decision probe (serving/fleet/autoscale.py)
     "kv.export",            # KV page d2h staging chunk (serving/kvtransfer/snapshot.py)
     "kv.import",            # KV snapshot h2d import (serving/kvtransfer/snapshot.py)
+    "prefix.publish",       # replica->directory digest publish/retract (serving/fleet/prefix_directory.py)
+    "prefix.import",        # hot-prefix KV h2d adoption (serving/kvtransfer/snapshot.py)
 })
 
 _RAISING_KINDS = ("os_error", "crash", "device_loss", "latency")

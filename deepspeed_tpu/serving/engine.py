@@ -447,6 +447,25 @@ class ServingEngine:
             self.metrics.counter("migration/kv_imports").inc()
         return True
 
+    def import_prefix(self, snapshot) -> int:
+        """Adopt a host-staged hot-prefix snapshot into this replica's
+        prefix cache (``kvtransfer.import_prefix``) so the NEXT admission
+        of a matching prompt attaches the pages instead of recomputing
+        their KV — the fleet prefix directory's cold-replica warm-up path
+        (docs/SERVING.md "Prefix directory").  Returns pages imported;
+        raises a ``SnapshotError`` subclass on rejection (the caller
+        dispatches cold and counts the fallback).  Unlike the migration
+        import this touches no request state — it is pure cache
+        population, safe before the request is even submitted here."""
+        from .kvtransfer import import_prefix
+        n = import_prefix(self.engine, snapshot)
+        if n:   # already-warm no-ops are not imports
+            self.stats.prefix_imports += 1
+            self.stats.prefix_import_pages += n
+            if self.metrics is not None:
+                self.metrics.counter("prefix/import").inc()
+        return n
+
     # ----------------------------------------------------------- migration
 
     def begin_migration(self, uid: int, chunk_pages: int = 4, source=None):

@@ -6,8 +6,11 @@ above N of them: a :class:`ReplicaPool` (shared clock, health tracking,
 kill/recover/drain lifecycle, per-replica :class:`ReplicaRole`\\ s for
 prefill/decode disaggregation), a :class:`Router` with pluggable policies
 (round-robin, least-outstanding-tokens, prefix-affinity with least-loaded
-fallback, role-aware ``disaggregated`` with host-staged KV migration —
-``serving/kvtransfer``), and a deterministic :class:`FleetSimulator` that
+fallback, directory-resident ``prefix_directory`` with cold-replica
+hot-prefix KV import, role-aware ``disaggregated`` with host-staged KV
+migration — ``serving/kvtransfer``), a fleet-global
+:class:`PrefixDirectory` replicas publish their prefix-chain digests
+into, and a deterministic :class:`FleetSimulator` that
 replays arrivals plus a scripted fault schedule bit-reproducibly on CPU
 (``scripts/bench_router.py`` is the load harness; the seeded workload
 generators live in :mod:`.sim`).
@@ -17,12 +20,14 @@ from .autoscale import (RUNGS, AutoscaleConfig, Autoscaler, OverloadConfig,
                         OverloadController)
 from .health import HealthConfig, HealthTracker, ReplicaState, classify_fatal
 from .policies import (POLICIES, DisaggregatedPolicy, LeastOutstandingPolicy,
-                       PrefixAffinityPolicy, RoundRobinPolicy, RoutingPolicy,
-                       make_policy)
+                       PrefixAffinityPolicy, PrefixDirectoryPolicy,
+                       RoundRobinPolicy, RoutingPolicy, make_policy)
 from .pool import Replica, ReplicaPool, ReplicaRole
+from .prefix_directory import PrefixDirectory
 from .router import FleetRequest, FleetState, Router
-from .sim import (FleetEvent, FleetSimulator, flash_crowd_arrivals,
-                  heavy_tail_arrivals, poisson_mixed_arrivals)
+from .sim import (FleetEvent, FleetSimulator, diurnal_arrivals,
+                  flash_crowd_arrivals, heavy_tail_arrivals,
+                  poisson_mixed_arrivals)
 from .tenancy import DEFAULT_TENANT, TenantRegistry, TenantSpec
 
 __all__ = [
@@ -30,9 +35,10 @@ __all__ = [
     "OverloadController",
     "HealthConfig", "HealthTracker", "ReplicaState", "classify_fatal",
     "POLICIES", "DisaggregatedPolicy", "LeastOutstandingPolicy",
-    "PrefixAffinityPolicy", "RoundRobinPolicy", "RoutingPolicy", "make_policy",
+    "PrefixAffinityPolicy", "PrefixDirectoryPolicy", "PrefixDirectory",
+    "RoundRobinPolicy", "RoutingPolicy", "make_policy",
     "Replica", "ReplicaPool", "ReplicaRole", "FleetRequest", "FleetState",
-    "Router", "FleetEvent", "FleetSimulator", "flash_crowd_arrivals",
-    "heavy_tail_arrivals", "poisson_mixed_arrivals",
+    "Router", "FleetEvent", "FleetSimulator", "diurnal_arrivals",
+    "flash_crowd_arrivals", "heavy_tail_arrivals", "poisson_mixed_arrivals",
     "DEFAULT_TENANT", "TenantRegistry", "TenantSpec",
 ]

@@ -26,6 +26,13 @@ read-only prefix-cache warmth probe.
   replicas, resumed/migrated requests on DECODE-role ones, least-loaded
   within the pool (DistServe/Splitwise-style phase splitting; the KV
   handoff between the pools is the router's migration machinery).
+* :class:`PrefixDirectoryPolicy` — prefix affinity answered from the
+  router-resident :class:`~.prefix_directory.PrefixDirectory` instead of
+  probe fan-out: ZERO per-replica calls per dispatch.  When the warm
+  target is saturated the request goes least-loaded — and the policy asks
+  the router to IMPORT the hot prefix's KV pages onto that cold replica
+  first (``prefix_import`` in the select info), turning warm-replica
+  affinity into cluster-wide warmth (docs/SERVING.md "Prefix directory").
 """
 
 from typing import List, Optional, Tuple
@@ -159,8 +166,73 @@ class DisaggregatedPolicy(RoutingPolicy):
         return rid, {**info, "phase": want.value, "role_match": bool(matched)}
 
 
+class PrefixDirectoryPolicy(RoutingPolicy):
+    """Directory-resident prefix affinity with cold-replica KV import
+    (docs/SERVING.md "Prefix directory").
+
+    Same placement shape as :class:`PrefixAffinityPolicy` — warmest
+    replica unless its queue is saturated, least-loaded otherwise — but
+    warmth comes from ONE :class:`~.prefix_directory.PrefixDirectory`
+    walk over the request's token digests: no ``lookup_depth`` probe
+    fan-out, no engine reads, O(prefix pages) per dispatch however many
+    replicas the fleet runs.  The probe policy stays available as the
+    directory-less fallback and as the cross-check oracle in tests.
+
+    The ambitious half: when the fleet IS warm for this prefix but the
+    chosen (least-loaded) replica is cold — the saturated-hot-spot case
+    where the probe policy eats a full recompute — the select info carries
+    a ``prefix_import`` plan naming the warmest donor; the router exports
+    those immutable full pages once to host and adopts them into the cold
+    replica's prefix cache BEFORE the dispatch, so the request lands warm
+    anyway.  ``import_min_pages`` gates the plan on the warmth deficit
+    being worth a staging round-trip."""
+
+    name = "prefix_directory"
+
+    def __init__(self, directory, saturation_queue_depth: int = 4,
+                 import_min_pages: int = 1):
+        assert saturation_queue_depth >= 1, saturation_queue_depth
+        assert import_min_pages >= 1, import_min_pages
+        self.directory = directory
+        self.saturation_queue_depth = saturation_queue_depth
+        self.import_min_pages = import_min_pages
+        self._fallback = LeastOutstandingPolicy()
+
+    def select(self, request, candidates):
+        if not candidates:
+            return None, {}
+        # full token history (prompt + already-generated): a failover
+        # resume is exactly the traffic whose warm pages matter — same
+        # stance as the probe policy
+        tokens = list(request.prompt) + list(request.tokens)
+        depth = self.directory.depths(tokens, [rid for rid, _, _ in candidates])
+        best = max(candidates, key=lambda c: (depth[c[0]], -c[2]["queue_depth"], -c[0]))
+        rid, _, stats = best
+        if depth[rid] > 0 and stats["queue_depth"] < self.saturation_queue_depth:
+            return rid, {"affinity_hit": True, "warm_pages": depth[rid]}
+        # cold everywhere, or the warm target is saturated: least-loaded,
+        # excluding the saturated warm target when an alternative exists
+        # (identical fallback shape to PrefixAffinityPolicy)
+        saturated = depth[rid] > 0
+        fb_candidates = [c for c in candidates if c[0] != rid] if saturated else candidates
+        if not fb_candidates:
+            fb_candidates = candidates
+        fb_rid, _ = self._fallback.select(request, fb_candidates)
+        info = {"affinity_hit": depth.get(fb_rid, 0) > 0,
+                "warm_pages": depth.get(fb_rid, 0),
+                "affinity_saturated": saturated}
+        if saturated and fb_rid is not None \
+                and depth[rid] - depth.get(fb_rid, 0) >= self.import_min_pages:
+            # the fleet is warm, the landing replica is not: ask the router
+            # to import the hot prefix there before dispatch (the router
+            # flips affinity_hit to True if the import lands)
+            info["prefix_import"] = {"donor": rid, "donor_depth": depth[rid]}
+        return fb_rid, info
+
+
 POLICIES = {p.name: p for p in (RoundRobinPolicy, LeastOutstandingPolicy,
-                                PrefixAffinityPolicy, DisaggregatedPolicy)}
+                                PrefixAffinityPolicy, DisaggregatedPolicy,
+                                PrefixDirectoryPolicy)}
 
 
 def make_policy(name: str, **kwargs) -> RoutingPolicy:

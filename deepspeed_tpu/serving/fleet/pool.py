@@ -58,7 +58,8 @@ class ReplicaPool:
                  clock=None, serving_config: ServingConfig = None, monitor=None,
                  health_config: HealthConfig = None, tracer=None, metrics=None,
                  roles: Optional[Sequence[Union[str, ReplicaRole]]] = None,
-                 role_factories: Optional[Dict] = None):
+                 role_factories: Optional[Dict] = None,
+                 prefix_directory=None):
         assert n_replicas >= 1, n_replicas
         if roles is not None and len(roles) != n_replicas:
             raise ValueError(f"roles ({len(roles)}) must cover every replica "
@@ -83,6 +84,16 @@ class ReplicaPool:
         # replica, like the clock does)
         self.tracer = tracer
         self.metrics = metrics
+        # fleet prefix directory (docs/SERVING.md "Prefix directory"): the
+        # pool is its ONE publish edge — every attached engine's prefix
+        # cache streams its chain digests through the listener bus, and
+        # death/restart purge the replica's entries, so the router-side
+        # table can never outlive the cache it mirrors by more than the
+        # documented staleness ladder
+        self.prefix_directory = prefix_directory
+        if prefix_directory is not None and metrics is not None \
+                and prefix_directory.metrics is None:
+            prefix_directory.metrics = metrics
         self.clock = clock if clock is not None else VirtualClock()
         self._virtual = isinstance(self.clock, VirtualClock)
         self.replicas: Dict[int, Replica] = {}
@@ -105,6 +116,34 @@ class ReplicaPool:
                                   tracer=self.tracer, metrics=self.metrics,
                                   trace_track=f"replica{rid}")
         rep.generation += 1
+        if self.prefix_directory is not None:
+            # a fresh engine's cache is empty: stale entries from the
+            # replica's previous life (rolling restart) must go first
+            self.prefix_directory.purge(rid)
+            pc = rep.serve.engine.kv.prefix_cache
+            if pc is not None:
+                pc.listener = self._directory_listener(rid)
+
+    def _directory_listener(self, rid: int):
+        """Publish edge replica -> directory.  A transient fault at the
+        ``prefix.publish`` site drops THIS update (the directory goes
+        stale — cold or warm — which the routing staleness ladder absorbs:
+        a mis-routed dispatch recomputes, never corrupts); ``InjectedCrash``
+        is driver death and propagates."""
+        directory = self.prefix_directory
+
+        def on_event(event: str, digest: int) -> None:
+            try:
+                if event == "publish":
+                    directory.publish(rid, digest)
+                else:
+                    directory.retract(rid, digest)
+            except InjectedCrash:
+                raise
+            except OSError as e:
+                logger.warning(f"fleet: prefix directory {event} dropped for "
+                               f"replica {rid}: {e}")
+        return on_event
 
     def _emit(self, name: str, value: float) -> None:
         if self.monitor is None or not getattr(self.monitor, "enabled", True):
@@ -164,6 +203,11 @@ class ReplicaPool:
                 key=lambda r: (r.arrival_ts, r.uid))
             rep.serve.close()
             rep.serve = None
+        if self.prefix_directory is not None:
+            # death-with-directory-entries: the cache died with the engine,
+            # so every digest this replica published is retracted at once —
+            # the router must never route to (or import from) a ghost
+            self.prefix_directory.purge(rid)
         return victims
 
     def recover(self, rid: int) -> None:

@@ -1,0 +1,166 @@
+"""Fleet-global prefix cache directory: router-resident warmth, pushed
+not probed (docs/SERVING.md "Prefix directory").
+
+``prefix_affinity`` routing (r9) GUESSES warmth by fanning a
+``lookup_depth`` probe out to every replica's ``PrefixCacheManager`` on
+each dispatch — O(replicas) engine reads per request, and a hit rate that
+tops out where the warm replica saturates.  The directory inverts the
+dataflow: replicas PUBLISH their prefix-chain digests through the cache's
+listener bus as pages enter/leave the cache (admission, extension, evict),
+and the router answers "who is warm for these tokens" from its own table —
+zero per-replica calls on the dispatch hot path.
+
+The digest is :func:`~....inference.v2.ragged.prefix_chain_hashes` — the
+SAME chain hash the cache keys pages by, so directory warmth is the
+digest-level view of exactly what a subsequent ``match()`` would attach.
+The directory stores hashes only (64-bit ints), never tokens or KV: its
+footprint is bytes per page per replica, and a stale or colliding entry
+can only mis-route, never corrupt (the replica-side ``match()`` verifies
+tokens before attaching pages, and the prefix-import path re-checksums
+staged bytes).
+
+Staleness ladder (deterministic under ``FleetSimulator``, chaos-tested in
+``tests/unit/resilience/test_prefix_chaos.py``):
+
+* evict-after-publish — the directory promises warmth a replica has since
+  evicted: the dispatch lands "warm" but ``match()``/``export_prefix``
+  find less (or nothing) and the prefill recomputes — slower, never wrong;
+* verify-fail — a torn prefix staging is rejected by the snapshot crc at
+  import and the target dispatches cold;
+* replica death — ``ReplicaPool.kill`` purges every entry the dead
+  replica published (``purge``), so the router never routes to (or
+  imports from) a ghost;
+* directory pressure — the table is BOUNDED (``capacity`` (rid, digest)
+  entries, LRU): overflow forgets the coldest entries, which costs at
+  most a cold dispatch, exactly like a replica-side cache eviction.
+
+The ``prefix.publish`` chaos site wraps every publish/retract so a drill
+can drop directory updates (stale-cold or stale-warm, both rungs of the
+ladder) or crash the driver mid-publish.
+"""
+
+from collections import OrderedDict
+from typing import Dict, Iterable, Tuple
+
+from ...inference.v2.ragged import iter_prefix_chain_hashes
+from ...resilience import fault_injection as _fi
+
+__all__ = ["PrefixDirectory"]
+
+
+class PrefixDirectory:
+    """Router-resident map ``chain digest -> replicas holding that page``.
+
+    One instance spans the fleet: pass it to ``ReplicaPool(prefix_directory=
+    ...)`` (which wires every attached engine's prefix cache to
+    :meth:`publish`/:meth:`retract` and purges on death/restart) and to the
+    ``prefix_directory`` routing policy (which reads :meth:`depths`).
+    """
+
+    def __init__(self, page_size: int, capacity: int = 65536, metrics=None):
+        assert page_size >= 1, page_size
+        assert capacity >= 1, capacity
+        self.page_size = int(page_size)
+        self.capacity = int(capacity)
+        # telemetry: always-on counters on the fleet MetricsRegistry when
+        # one is attached (the same registry the replica frontends share)
+        self.metrics = metrics
+        #: digest -> set of rids that published it
+        self._holders: Dict[int, set] = {}
+        #: (rid, digest) -> None, oldest first — the LRU the capacity
+        #: bound evicts from; refreshed on re-publish and on lookup match
+        self._lru: "OrderedDict[Tuple[int, int], None]" = OrderedDict()
+        self.stats = {"published": 0, "retracted": 0, "purged": 0,
+                      "lru_evicted": 0, "lookups": 0}
+
+    # ------------------------------------------------------------- publish
+
+    def publish(self, rid: int, digest: int) -> None:
+        """A replica's cache registered a full page keyed by ``digest``.
+        Idempotent per (rid, digest); a re-publish refreshes the LRU."""
+        _fi.check("prefix.publish")   # chaos site: dropped/crashed publish
+        key = (rid, digest)
+        if key in self._lru:
+            self._lru.move_to_end(key)
+            return
+        self._holders.setdefault(digest, set()).add(rid)
+        self._lru[key] = None
+        self.stats["published"] += 1
+        if self.metrics is not None:
+            self.metrics.counter("prefix/publish").inc()
+        while len(self._lru) > self.capacity:
+            (orid, odig), _ = self._lru.popitem(last=False)
+            self._drop(orid, odig)
+            self.stats["lru_evicted"] += 1
+
+    def retract(self, rid: int, digest: int) -> None:
+        """A replica's cache evicted the page keyed by ``digest``."""
+        _fi.check("prefix.publish")   # same stream as publish: one site
+        key = (rid, digest)
+        if key not in self._lru:
+            return
+        del self._lru[key]
+        self._drop(rid, digest)
+        self.stats["retracted"] += 1
+        if self.metrics is not None:
+            self.metrics.counter("prefix/evict").inc()
+
+    def purge(self, rid: int) -> int:
+        """Forget every entry ``rid`` published — replica death (the
+        engine and its cache are gone) or a fresh engine attach (restart:
+        the new cache starts empty).  Returns entries dropped."""
+        victims = [key for key in self._lru if key[0] == rid]
+        for key in victims:
+            del self._lru[key]
+            self._drop(*key)
+        self.stats["purged"] += len(victims)
+        return len(victims)
+
+    def _drop(self, rid: int, digest: int) -> None:
+        holders = self._holders.get(digest)
+        if holders is not None:
+            holders.discard(rid)
+            if not holders:
+                del self._holders[digest]
+
+    # -------------------------------------------------------------- lookup
+
+    def depths(self, tokens: Iterable[int],
+               rids: Iterable[int]) -> Dict[int, int]:
+        """Per-replica warmth for ``tokens``: how many LEADING full pages
+        of the token history each rid (per the directory) holds — the same
+        quantity ``PrefixCacheManager.lookup_depth`` reports, including
+        its last-token cap (the engine must still compute >= 1 token), so
+        directory routing and the probe policy agree whenever the
+        directory is fresh (the regression oracle in
+        tests/unit/inference/test_prefix_directory.py).  One chain walk
+        total — NO per-replica engine calls.  Matched entries' LRU is
+        refreshed: routed-on prefixes are hot prefixes."""
+        tokens = list(tokens)
+        rids = list(rids)
+        depth = {rid: 0 for rid in rids}
+        self.stats["lookups"] += 1
+        usable_pages = max(0, (len(tokens) - 1) // self.page_size)
+        live = set(rids)
+        for k, digest in enumerate(iter_prefix_chain_hashes(
+                tokens[:usable_pages * self.page_size], self.page_size)):
+            holders = self._holders.get(digest)
+            if holders is None:
+                break
+            live &= holders
+            if not live:
+                break
+            for rid in sorted(live):
+                depth[rid] = k + 1
+                self._lru.move_to_end((rid, digest))
+        return depth
+
+    # ------------------------------------------------------------- surface
+
+    @property
+    def entries(self) -> int:
+        return len(self._lru)
+
+    def summary(self) -> dict:
+        return {**self.stats, "entries": self.entries,
+                "digests": len(self._holders), "capacity": self.capacity}

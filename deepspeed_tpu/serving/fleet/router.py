@@ -141,10 +141,28 @@ class Router:
                  migration_chunk_cost: float = 0.0,
                  prefill_handoff: bool = False,
                  tenants: Optional[TenantRegistry] = None,
-                 overload=None):
+                 overload=None, prefix_import_cost: float = 0.0):
         self.pool = pool
         self.policy = policy
         self.monitor = monitor
+        # fleet prefix directory (docs/SERVING.md "Prefix directory"): a
+        # directory-routing policy carries the directory it reads; the
+        # POOL must carry the same one, or no replica would ever publish
+        # into it and every dispatch would read an empty table — silent
+        # 100% cold routing, not an error anyone would see
+        self.directory = getattr(policy, "directory", None)
+        if self.directory is not None \
+                and pool.prefix_directory is not self.directory:
+            raise ValueError(
+                "the routing policy's PrefixDirectory must be the "
+                "ReplicaPool's: pass prefix_directory= to ReplicaPool(...) "
+                "so replicas publish their digests into the table the "
+                "policy routes on (the pool re-wires it across "
+                "recover()/restart() engine swaps)")
+        # per-page clock charge of a hot-prefix import (d2h on the donor's
+        # view + h2d on the target's, max-combined with their step costs —
+        # overlapped staging, not a stall), mirroring migration_chunk_cost
+        self.prefix_import_cost = float(prefix_import_cost)
         # multi-tenant QoS (docs/SERVING.md "Overload control plane"):
         # weighted-fair ordering + per-tenant outstanding bounds come from
         # the registry; with no registry every request rides the implicit
@@ -216,6 +234,9 @@ class Router:
             "migrations_started": 0, "migration_chunks": 0,
             "migrations_completed": 0, "migration_fallbacks": 0,
             "migration_failover_reuse": 0,
+            "prefix_imports": 0, "prefix_import_pages": 0,
+            "prefix_import_fallbacks": 0, "prefix_imports_paused": 0,
+            "prefix_imports_noop": 0,
             "shed": 0, "brownout_capped": 0, "tenant_admission_faults": 0,
             "tenant_deferrals": 0,
         }
@@ -401,6 +422,16 @@ class Router:
                 self.stats["dispatch_faults"] += 1
                 logger.warning(f"router.dispatch transient fault for fid={fr.fid}: {e}")
                 continue
+            if info.get("prefix_import") is not None:
+                # cluster-wide warmth: adopt the hot prefix's KV onto the
+                # cold target before the dispatch (docs/SERVING.md "Prefix
+                # directory").  A replica death during staging is handled
+                # like a dispatch-time device loss: refresh candidates,
+                # and retry the request next round if its target died.
+                if self._prefix_import(fr, rid, info, now) == "dead":
+                    candidates = self._candidates()
+                    if not any(c[0] == rid for c in candidates):
+                        continue   # fr stays pending
             if self._dispatch_to(fr, rid, info, now):
                 placed += 1
                 outstanding_by_tenant[fr.tenant] = \
@@ -475,6 +506,105 @@ class Router:
                 fr.affinity_hits += 1
         self._emit([("fleet/dispatch", float(rid), self._next_event_step())])
         return True
+
+    # -------------------------------------------------------- prefix import
+
+    def _prefix_import(self, fr: FleetRequest, rid: int, info: dict,
+                       now: float) -> str:
+        """Hot-prefix KV import ahead of a cold dispatch: export the
+        directory-promised prefix pages once from the warmest donor
+        (host-staged, crc-tagged — the PR-8 ``kvtransfer`` path) and adopt
+        them into ``rid``'s prefix cache, so the request prefills warm on
+        the replica load balancing picked.  Returns ``"ok"``,
+        ``"fallback"`` (any ordinary rejection: the dispatch proceeds cold
+        and the prefill recomputes — slower, never wrong) or ``"dead"`` (a
+        replica died mid-staging; the caller refreshes its candidates).
+        The fleet-level accounting lands on ``stats["prefix_*"]`` and the
+        ``fleet/prefix_import[_fallback]`` events."""
+        from ..kvtransfer import SnapshotError, export_prefix
+        from ...resilience.fault_injection import DeviceLossError
+        plan = info.pop("prefix_import")
+        if self.overload is not None and self.overload.migrations_paused:
+            # brownout rung 3 shares one switch with migration: no NEW
+            # staging under overload — the h2d/d2h bandwidth (and the
+            # target's pages) go to serving (docs/SERVING.md ladder table)
+            self.stats["prefix_imports_paused"] += 1
+            return "fallback"
+        donor_rid = plan["donor"]
+        donor = self.pool.replica(donor_rid)
+        target = self.pool.replica(rid)
+        if donor.serve is None or target.serve is None:
+            return self._prefix_import_fallback(fr, "replica gone before staging")
+        tokens = list(fr.prompt) + list(fr.tokens)
+        try:
+            snapshot = export_prefix(donor.serve.engine, tokens,
+                                     source=f"replica{donor_rid}")
+        except _fi.InjectedCrash:
+            raise  # simulated death of THIS driver process
+        except DeviceLossError as e:
+            # the d2h staging found the DONOR device gone: replica death,
+            # ordinary failover path; the target is untouched
+            self.on_replica_dead(donor_rid, now, reason=str(e))
+            self._prefix_import_fallback(fr, f"donor died mid-export: {e}")
+            return "dead"
+        except (SnapshotError, OSError) as e:
+            return self._prefix_import_fallback(fr, f"export fault: {e}")
+        if snapshot is None:
+            # evict-after-publish staleness: the donor no longer holds what
+            # it published — recompute owns the request (the retraction
+            # that should have fixed the directory was lost or raced)
+            return self._prefix_import_fallback(fr, "donor cold (stale directory)")
+        try:
+            n_imported = target.serve.import_prefix(snapshot)
+        except _fi.InjectedCrash:
+            raise
+        except DeviceLossError as e:
+            # the h2d scatter found the TARGET device gone — the caller
+            # must re-pick a replica for this request
+            self.on_replica_dead(rid, now, reason=str(e))
+            self._prefix_import_fallback(fr, f"target died mid-import: {e}")
+            return "dead"
+        except (SnapshotError, OSError) as e:
+            # torn staging (crc verify), geometry drift, no page room, a
+            # transient import fault: cold dispatch + recompute
+            return self._prefix_import_fallback(fr, f"import rejected: {e}")
+        if n_imported == 0:
+            # directory stale-COLD about the TARGET (a dropped publish):
+            # it already held the whole chain, nothing was installed — the
+            # request lands warm, but no import is counted or charged
+            self.stats["prefix_imports_noop"] += 1
+            info["affinity_hit"] = True
+            info["warm_pages"] = snapshot.n_pages
+            return "ok"
+        if self.prefix_import_cost > 0:
+            # charge the staging on both clock views, max-combined with
+            # each side's own step cost (overlap, not a stall) — the same
+            # accounting stance as migration chunk pre-charges.  The donor
+            # staged the WHOLE snapshot d2h; the target scattered only the
+            # pages it was missing.
+            donor.clock.on_step(self.prefix_import_cost * snapshot.n_pages)
+            target.clock.on_step(self.prefix_import_cost * n_imported)
+        self.stats["prefix_imports"] += 1
+        self.stats["prefix_import_pages"] += n_imported
+        # the request now LANDS warm: the hit label reports where it landed
+        info["affinity_hit"] = True
+        info["warm_pages"] = snapshot.n_pages
+        info["prefix_imported"] = True
+        # (the per-replica "prefix/import" counter is incremented by the
+        # target frontend's import_prefix — one registry, counted once)
+        self._emit([("fleet/prefix_import", float(rid),
+                     self._next_event_step())])
+        return "ok"
+
+    def _prefix_import_fallback(self, fr: FleetRequest, reason: str) -> str:
+        self.stats["prefix_import_fallbacks"] += 1
+        logger.warning(f"fleet: prefix import for fid={fr.fid} fell back "
+                       f"({reason})")
+        if self.pool.metrics is not None:
+            self.pool.metrics.counter("prefix/import_fallback").inc()
+        self._emit([("fleet/prefix_import_fallback", 1.0,
+                     self._next_event_step())])
+        return "fallback"
 
     def _make_stream(self, fr: FleetRequest, generation: int):
         def on_tokens(sr: ServingRequest, toks: List[int], ts: float) -> None:
@@ -917,6 +1047,9 @@ class Router:
             1 for rid in self.pool.rids if self.pool.health.serving(rid)))
         if self.overload is not None:
             metrics.gauge("fleet/overload_rung").set(self.overload.rung)
+        if self.directory is not None:
+            metrics.gauge("fleet/prefix_directory_entries").set(
+                self.directory.entries)
 
     def pending_timestamps(self) -> List[float]:
         """Future timestamps that could unblock progress (pending
@@ -964,6 +1097,13 @@ class Router:
                 "import_fallbacks": sum(rep.serve.stats.kv_import_fallbacks
                                         for rep in self.pool.replicas.values()
                                         if rep.serve is not None),
+            },
+            "prefix": None if self.directory is None else {
+                "imports": self.stats["prefix_imports"],
+                "import_pages": self.stats["prefix_import_pages"],
+                "import_fallbacks": self.stats["prefix_import_fallbacks"],
+                "imports_paused": self.stats["prefix_imports_paused"],
+                "directory": self.directory.summary(),
             },
             "failover": {
                 "kills": len(self.kill_records),

@@ -174,6 +174,62 @@ def flash_crowd_arrivals(seed: int, n_requests: int, base_rate: float,
     return arrivals
 
 
+def diurnal_arrivals(seed: int, n_requests: int, base_rate: float,
+                     amplitude: float, period: float, vocab: int,
+                     phase: float = 0.0,
+                     prefixes: Optional[List[List[int]]] = None,
+                     prompt_median: int = 8, prompt_sigma: float = 0.5,
+                     max_prompt: int = 64,
+                     out_median: int = 10, out_sigma: float = 0.4,
+                     max_new: int = 24,
+                     deadline_slack: Optional[float] = None) -> List[dict]:
+    """Diurnal sinusoid traffic: Poisson arrivals whose rate swings
+    ``base_rate * (1 + amplitude * sin(2*pi*t/period))`` — the daily
+    peak/trough shape planet-scale fleets provision for (the autoscale
+    ROADMAP follow-on to the one-off ``flash_crowd_arrivals`` spike).
+    Generated by THINNING, the piecewise-exact sibling of the flash
+    crowd's boundary re-draw: candidate gaps are drawn at the PEAK rate
+    and each candidate is kept with probability ``rate(t)/peak`` — exact
+    for a smooth rate function, no discretization grid, and deterministic
+    in ``seed`` like every generator here.
+
+    ``phase`` (radians) shifts where in the cycle t=0 lands — ``-pi/2``
+    starts at the trough, the natural 'day starts quiet' shape (and what
+    lets caches warm before the first peak).  ``prefixes``: optional
+    shared page-aligned prompt prefixes (system prompts / few-shot
+    templates); each arrival draws one group uniformly and prepends it —
+    the traffic shape prefix-directory routing exists for.
+    ``deadline_slack``: deadline = arrival + slack (None = run to
+    completion, as the divergence audits need)."""
+    assert 0.0 <= amplitude < 1.0, amplitude
+    rng = np.random.default_rng(seed)
+    peak = base_rate * (1.0 + amplitude)
+    t = 0.0
+    arrivals = []
+    for _ in range(n_requests):
+        while True:
+            t += float(rng.exponential(1.0 / peak))
+            rate = base_rate * (1.0 + amplitude * np.sin(
+                2.0 * np.pi * t / period + phase))
+            if rng.random() < rate / peak:
+                break
+        p_len = int(np.clip(rng.lognormal(np.log(prompt_median), prompt_sigma),
+                            2, max_prompt))
+        o_len = int(np.clip(rng.lognormal(np.log(out_median), out_sigma),
+                            2, max_new))
+        prompt = [int(x) for x in rng.integers(1, vocab, p_len)]
+        if prefixes:
+            prompt = list(prefixes[int(rng.integers(0, len(prefixes)))]) + prompt
+        arrivals.append({
+            "arrival_ts": round(t, 6),
+            "prompt": prompt,
+            "max_new_tokens": o_len,
+            "deadline": None if deadline_slack is None
+            else round(t + deadline_slack, 6),
+        })
+    return arrivals
+
+
 @dataclasses.dataclass(frozen=True)
 class FleetEvent:
     ts: float

@@ -45,6 +45,7 @@ from ...resilience import fault_injection as _fi
 from ...utils.logging import logger
 
 __all__ = ["KVSnapshot", "KVExporter", "import_snapshot",
+           "export_prefix", "import_prefix",
            "SnapshotError", "SnapshotIntegrityError", "SnapshotAborted",
            "KVImportError"]
 
@@ -194,6 +195,26 @@ class KVExporter:
         return self.snapshot.complete
 
 
+def _validate_arena(snapshot: "KVSnapshot", kv, arena) -> None:
+    """The importability gate BOTH import paths (migration sequence,
+    prefix adoption) share: scanned single-arena layout, matching page
+    geometry and dtype.  One rule — a future layout change cannot diverge
+    the two paths."""
+    if not hasattr(arena, "shape") or len(arena.shape) != 6:
+        raise KVImportError("KV import supports the scanned single-arena "
+                            "layout only (unroll_layers builds a tuple)")
+    if snapshot.page_size != kv.page_size:
+        raise KVImportError(f"page_size mismatch: snapshot {snapshot.page_size} "
+                            f"vs engine {kv.page_size}")
+    want = (arena.shape[0], ) + tuple(arena.shape[2:])
+    if tuple(snapshot.block_shape) != want:
+        raise KVImportError(f"arena geometry mismatch: snapshot "
+                            f"{tuple(snapshot.block_shape)} vs engine {want}")
+    if snapshot.dtype != str(arena.dtype):
+        raise KVImportError(f"arena dtype mismatch: snapshot {snapshot.dtype} "
+                            f"vs engine {arena.dtype}")
+
+
 def import_snapshot(engine, uid: int, tokens: Sequence[int],
                     snapshot: KVSnapshot, max_new_tokens: int):
     """Materialize ``snapshot`` as sequence ``uid`` on ``engine``: verify
@@ -212,19 +233,7 @@ def import_snapshot(engine, uid: int, tokens: Sequence[int],
     snapshot.verify()
     kv = engine.kv
     arena = engine.cache
-    if not hasattr(arena, "shape") or len(arena.shape) != 6:
-        raise KVImportError("KV import supports the scanned single-arena "
-                            "layout only (unroll_layers builds a tuple)")
-    if snapshot.page_size != kv.page_size:
-        raise KVImportError(f"page_size mismatch: snapshot {snapshot.page_size} "
-                            f"vs engine {kv.page_size}")
-    want = (arena.shape[0], ) + tuple(arena.shape[2:])
-    if tuple(snapshot.block_shape) != want:
-        raise KVImportError(f"arena geometry mismatch: snapshot "
-                            f"{tuple(snapshot.block_shape)} vs engine {want}")
-    if snapshot.dtype != str(arena.dtype):
-        raise KVImportError(f"arena dtype mismatch: snapshot {snapshot.dtype} "
-                            f"vs engine {arena.dtype}")
+    _validate_arena(snapshot, kv, arena)
     if list(snapshot.tokens) != [int(t) for t in tokens]:
         raise KVImportError("token history mismatch: snapshot does not carry "
                             "this request's prompt + generated tokens")
@@ -268,3 +277,106 @@ def import_snapshot(engine, uid: int, tokens: Sequence[int],
     logger.debug(f"kvtransfer: imported uid={uid} ({n} pages, "
                  f"{snapshot.n_bytes} bytes, source={snapshot.source})")
     return seq
+
+
+# --------------------------------------------------------- prefix transfer
+#
+# The fleet prefix directory's hot-prefix import (docs/SERVING.md "Prefix
+# directory"): unlike a migration snapshot — one request's whole KV state,
+# consumed by resuming that request — a PREFIX snapshot carries only the
+# immutable FULL pages of a shared prompt prefix, and its consumer is the
+# target replica's PrefixCacheManager: the pages are adopted as cache
+# entries so the NEXT admission's match() attaches them, exactly as if the
+# target had prefilled the prompt itself.  Same staleness stance as the
+# migration ladder: every rejection falls back to recompute, never to
+# wrong KV.
+
+
+def export_prefix(engine, tokens: Sequence[int],
+                  source: Optional[str] = None) -> Optional["KVSnapshot"]:
+    """Stage the full prefix-cache pages ``engine`` holds for ``tokens``
+    device→host as a complete :class:`KVSnapshot` (tokens truncated to the
+    staged depth).  Returns None when the engine holds nothing usable —
+    the evict-after-publish staleness race: the directory promised warmth
+    the donor has since evicted, and the caller's recompute fallback owns
+    the request.  Read-only on the donor: no refcounts taken, no LRU
+    touched (the donor never sees this request).  The ``kv.export`` chaos
+    site fires once per staging, like a migration chunk."""
+    kv = engine.kv
+    pc = kv.prefix_cache
+    arena = engine.cache
+    if pc is None or not hasattr(arena, "shape") or len(arena.shape) != 6:
+        return None
+    pages = [page for _, page in pc._walk(tokens)]
+    if not pages:
+        return None
+    _fi.check("kv.export")   # chaos site: torn/failed d2h staging
+    depth = len(pages)
+    snapshot = KVSnapshot(
+        tokens=[int(t) for t in tokens[:depth * kv.page_size]],
+        seen_tokens=depth * kv.page_size, page_size=kv.page_size,
+        block_shape=(arena.shape[0], ) + tuple(arena.shape[2:]),
+        dtype=str(arena.dtype), source=source)
+    snapshot.add_chunk(kv.export_pages(arena, pages))
+    snapshot.complete = True
+    return snapshot
+
+
+def import_prefix(engine, snapshot: "KVSnapshot") -> int:
+    """Adopt ``snapshot``'s full prefix pages into ``engine``'s prefix
+    cache: verify integrity, validate geometry, allocate pages for the
+    MISSING tail of the chain (pages the target already holds are skipped),
+    scatter host→device, and publish the chain entries so the next
+    admission's ``match()`` attaches them.  Returns pages imported (0 =
+    target already warm).  Raises a :class:`SnapshotError` subclass on any
+    rejection — the caller dispatches cold and the ordinary prefill
+    recomputes; torn staging is caught by ``verify()`` here, never decoded
+    into wrong KV.  On failure nothing leaks: pages are allocated after
+    every validation and freed if the scatter fails."""
+    _fi.check("prefix.import")   # chaos site: crash/device-loss mid-import
+    snapshot.verify()
+    kv = engine.kv
+    pc = kv.prefix_cache
+    arena = engine.cache
+    if pc is None:
+        raise KVImportError("target engine has no prefix cache")
+    _validate_arena(snapshot, kv, arena)
+    n = snapshot.n_pages
+    if n * kv.page_size != len(snapshot.tokens) \
+            or snapshot.seen_tokens != len(snapshot.tokens):
+        raise KVImportError(
+            f"prefix snapshot must carry exactly its full pages' tokens: "
+            f"{n} page(s) vs {len(snapshot.tokens)} token(s), seen "
+            f"{snapshot.seen_tokens}")
+    # pages the target already published are skipped — held entries along
+    # one chain are always a prefix run (register/adopt insert root→leaf,
+    # eviction removes leaves), so the missing set is a contiguous tail
+    have = pc.held_depth(snapshot.tokens)
+    if have >= n:
+        return 0
+    shortfall = (n - have) - kv.allocator.free_pages
+    if shortfall > 0:
+        pc.evict(shortfall)
+        # the LRU sweep may have evicted THIS chain's own held prefix —
+        # recompute the boundary, or the adopted tail would hang off a
+        # hole in the chain and match() could never reach it
+        have = pc.held_depth(snapshot.tokens)
+    missing = n - have
+    shortfall = missing - kv.allocator.free_pages
+    if shortfall > 0:
+        raise KVImportError(f"target arena short {shortfall} page(s) for the "
+                            f"{missing}-page prefix import")
+    block = snapshot.chunks[0] if len(snapshot.chunks) == 1 \
+        else np.concatenate(snapshot.chunks, axis=1)
+    pages = kv.allocator.allocate(missing)
+    try:
+        engine.cache = kv.import_pages(engine.cache, pages,
+                                       np.ascontiguousarray(block[:, have:n]))
+    except BaseException:
+        kv.allocator.free(pages)
+        raise
+    # ownership of the allocation's refcounts transfers to the cache
+    pc.adopt(snapshot.tokens, have, pages)
+    logger.debug(f"kvtransfer: prefix import of {missing} page(s) "
+                 f"(held {have}, source={snapshot.source})")
+    return missing

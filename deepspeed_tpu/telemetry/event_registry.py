@@ -97,6 +97,30 @@ EVENTS = {
     "migration/import_fallback": ("counter", "serving/engine.py",
                                   "snapshot rejected at import -> "
                                   "recompute-on-resume"),
+    # ---- fleet prefix directory (serving/fleet/prefix_directory.py +
+    #      router.py + serving/engine.py)
+    "prefix/publish": ("counter", "serving/fleet/prefix_directory.py",
+                       "replica published a prefix-chain digest to the "
+                       "fleet directory"),
+    "prefix/evict": ("counter", "serving/fleet/prefix_directory.py",
+                     "replica retracted a digest (cache eviction) from "
+                     "the directory"),
+    "prefix/import": ("counter", "serving/engine.py",
+                      "hot-prefix KV pages adopted into this replica's "
+                      "cache (cold-replica warm-up fast path)"),
+    "prefix/import_fallback": ("counter", "serving/fleet/router.py",
+                               "prefix import rejected/failed -> cold "
+                               "dispatch, prefill recomputes"),
+    "fleet/prefix_import": ("event", "serving/fleet/router.py",
+                            "cold-replica prefix KV import completed "
+                            "before dispatch (value = target rid)"),
+    "fleet/prefix_import_fallback": ("event", "serving/fleet/router.py",
+                                     "prefix import abandoned; the "
+                                     "dispatch proceeds cold"),
+    "fleet/prefix_directory_entries": ("gauge", "serving/fleet/router.py",
+                                       "(rid, digest) entries resident in "
+                                       "the fleet prefix directory, "
+                                       "sampled once per fleet round"),
     # ---- fleet router (serving/fleet/)
     "fleet/dispatch": ("event", "serving/fleet/router.py",
                        "request placed on a replica (value = rid)"),

@@ -45,7 +45,18 @@ Two clock modes, as in bench_serving.py:
             replicas ticking round-robin from one host loop (a single-host
             stand-in for N meshes; the *routing* behaviour is identical).
 
-Writes BENCH_ROUTER.json (schema v3 — scripts/check_bench_schema.py
+Plus the PREFIX-DIRECTORY leg (schema v4, docs/SERVING.md "Prefix
+directory"): a seeded diurnal-sinusoid workload with page-aligned shared
+prompt prefixes, served twice over 4 replicas under a token-proportional
+step cost — probe-based ``prefix_affinity`` (lookup_depth fan-out) vs
+the router-resident ``prefix_directory`` (replicas publish digests; zero
+per-replica calls per dispatch; saturated-warm dispatches import the hot
+prefix's KV onto the cold target first).  The committed record must show
+directory hit rate >= 0.95 with the probe baseline recorded beside it,
+p99 TTFT strictly better at equal goodput, >= 1 cold-replica prefix
+import, zero output divergence, and byte-identical repeats.
+
+Writes BENCH_ROUTER.json (schema v4 — scripts/check_bench_schema.py
 validates it, incl. affinity hit rate > 0 on the prefix_affinity points
 and finite recovery on every kill) and prints one JSON line.
 """
@@ -253,6 +264,110 @@ def run_disaggregation_leg(factory, clock_factory, seed, vocab, dryrun):
     return rec
 
 
+def _prefix_directory_point(factory, clock_factory, arrivals, serving_config,
+                            page_size, use_directory, saturation_queue_depth):
+    """One prefix-routing run: probe-based ``prefix_affinity`` or the
+    router-resident ``prefix_directory`` (with cold-replica hot-prefix KV
+    import).  Returns (summary, per-request outputs)."""
+    from deepspeed_tpu.serving.fleet import (FleetSimulator, PrefixDirectory,
+                                             ReplicaPool, Router, make_policy)
+    clock = clock_factory()
+    directory = PrefixDirectory(page_size=page_size) if use_directory else None
+    pool = ReplicaPool(factory, 4, clock=clock, serving_config=serving_config,
+                       prefix_directory=directory)
+    pool.rebase_clock()
+    if use_directory:
+        policy = make_policy("prefix_directory", directory=directory,
+                             saturation_queue_depth=saturation_queue_depth)
+        router = Router(pool, policy, prefix_import_cost=0.02)
+    else:
+        router = Router(pool, make_policy(
+            "prefix_affinity", saturation_queue_depth=saturation_queue_depth))
+    reqs = FleetSimulator(router).run([dict(a) for a in arrivals])
+    rec = router.summary()
+    rec["offered_rps"] = round(len(arrivals) / max(arrivals[-1]["arrival_ts"], 1e-9), 6)
+    return rec, [list(r.tokens) for r in reqs]
+
+
+def run_prefix_directory_leg(factory, clock_factory, seed, vocab, page_size,
+                             dryrun):
+    """Probe-based prefix_affinity vs the fleet-global prefix directory on
+    the same diurnal-sinusoid shared-prefix workload (schema-v4
+    ``prefix_directory`` record).  The receipts the acceptance criteria
+    pin: directory hit rate >= 0.95 (the probe baseline recorded beside
+    it), p99 TTFT strictly better at equal goodput (same completions,
+    same deadline hits), >= 1 cold-replica KV prefix import through the
+    fast path, zero output divergence, and the directory leg
+    byte-identical when repeated."""
+    from deepspeed_tpu.serving import ServingConfig
+    from deepspeed_tpu.serving.fleet import diurnal_arrivals
+    rng = np.random.default_rng(seed)
+    # LONG page-aligned prefixes (system-prompt scale): a cold dispatch
+    # pays whole extra prefill chunks, which is exactly the tail the
+    # directory's import erases — and the arena pressure the eviction /
+    # retraction path needs to actually fire during the run
+    prefix_pages, groups = 4, 4
+    prefixes = [[int(x) for x in rng.integers(1, vocab, prefix_pages * page_size)]
+                for _ in range(groups)]
+    # trough-first sinusoid (phase -pi/2): the quiet opening warms each
+    # group's first replica before the peak, so the peaks measure routing
+    # quality, not cold-start noise
+    wl = {"kind": "diurnal", "seed": seed,
+          "n_requests": 110 if dryrun else 96,
+          "base_rate": 3.0 if dryrun else 8.0,
+          "amplitude": 0.8, "period": 16.0 if dryrun else 8.0,
+          "phase": -0.5 * math.pi,
+          "prefix_groups": groups, "prefix_pages": prefix_pages,
+          "deadline_slack": 250.0 if dryrun else 30.0}
+    arrivals = diurnal_arrivals(
+        seed=wl["seed"], n_requests=wl["n_requests"], base_rate=wl["base_rate"],
+        amplitude=wl["amplitude"], period=wl["period"], vocab=vocab,
+        phase=wl["phase"], prefixes=prefixes,
+        deadline_slack=wl["deadline_slack"])
+    # token-proportional virtual step cost: the quantity a warm prefix
+    # saves is prefill TOKENS, so the clock must price them — same cost
+    # model stance as the disaggregation leg.  Wall mode measures instead.
+    scfg = ServingConfig(step_cost=(lambda toks: 0.25 + 0.015 * toks)
+                         if dryrun else None)
+    sat = 1
+    probe_rec, probe_out = _prefix_directory_point(
+        factory, clock_factory, arrivals, scfg, page_size,
+        use_directory=False, saturation_queue_depth=sat)
+    dir_rec, dir_out = _prefix_directory_point(
+        factory, clock_factory, arrivals, scfg, page_size,
+        use_directory=True, saturation_queue_depth=sat)
+    dir_rec2, dir_out2 = _prefix_directory_point(
+        factory, clock_factory, arrivals, scfg, page_size,
+        use_directory=True, saturation_queue_depth=sat)
+    for r in (probe_rec, dir_rec, dir_rec2):
+        # the sinusoid has no single rate; the record carries its midline
+        r["arrival_rate"] = wl["base_rate"]
+    divergent = sum(1 for a, b in zip(probe_out, dir_out) if a != b)
+    rec = {
+        "workload": wl,
+        "step_cost": "0.25 + 0.015 * planned_tokens" if dryrun else "wall",
+        "saturation_queue_depth": sat,
+        "prefix_import_cost": 0.02,
+        "probe": probe_rec,
+        "directory": dir_rec,
+        "probe_hit_rate": probe_rec["affinity"]["hit_rate"],
+        "directory_hit_rate": dir_rec["affinity"]["hit_rate"],
+        "prefix_imports": dir_rec["prefix"]["imports"],
+        "zero_divergence": divergent == 0,
+        "divergent_requests": divergent,
+        "determinism_repeat_identical": (dir_rec == dir_rec2
+                                         and dir_out == dir_out2),
+    }
+    m, d = probe_rec["ttft"]["p99"], dir_rec["ttft"]["p99"]
+    rec["p99_ttft_improvement"] = round(1.0 - d / m, 4) if m else None
+    print(f"# prefix_directory: probe hit_rate={rec['probe_hit_rate']} "
+          f"ttft p99={m} | directory hit_rate={rec['directory_hit_rate']} "
+          f"ttft p99={d} imports={rec['prefix_imports']} "
+          f"import_fallbacks={dir_rec['prefix']['import_fallbacks']} "
+          f"divergent={divergent}", flush=True)
+    return rec
+
+
 AUTOSCALE_TENANTS = (
     # (name, mix probability, deadline slack, weight, max_outstanding,
     #  ttft_slo, best_effort)
@@ -447,6 +562,30 @@ def main():
                                     args.dryrun)
     autoscale = run_autoscale_leg(factory, clock_factory, args.seed, vocab,
                                   args.dryrun)
+    prefix_dir = run_prefix_directory_leg(factory, clock_factory, args.seed,
+                                          vocab, kv.page_size, args.dryrun)
+    if args.dryrun:
+        # the prefix-directory receipts (deterministic on the virtual
+        # clock — fail the run, not just CI; wall mode records only)
+        assert prefix_dir["determinism_repeat_identical"], \
+            "prefix_directory leg is not byte-reproducible"
+        assert prefix_dir["zero_divergence"], \
+            f"{prefix_dir['divergent_requests']} request(s) diverged between " \
+            "probe and directory prefix routing"
+        assert (prefix_dir["directory_hit_rate"] or 0) >= 0.95, \
+            f"directory hit rate {prefix_dir['directory_hit_rate']} < 0.95"
+        assert (prefix_dir["probe_hit_rate"] or 0) < \
+            (prefix_dir["directory_hit_rate"] or 0), \
+            "directory routing did not beat the probe baseline's hit rate"
+        assert prefix_dir["prefix_imports"] >= 1, \
+            "no cold-replica prefix import completed through the fast path"
+        pm, pd = prefix_dir["probe"], prefix_dir["directory"]
+        assert (pd["completed"], pd["deadline_met"]) == \
+            (pm["completed"], pm["deadline_met"]), \
+            "prefix pair is not equal-goodput (completions/deadline hits differ)"
+        assert pd["ttft"]["p99"] < pm["ttft"]["p99"], \
+            f"directory p99 TTFT {pd['ttft']['p99']} does not beat probe " \
+            f"{pm['ttft']['p99']}"
     if args.dryrun:
         # the overload-control-plane receipts (deterministic on the virtual
         # clock — fail the run, not just CI; wall mode records only)
@@ -499,7 +638,7 @@ def main():
         "metric": "fleet_goodput_rps",
         "value": best["goodput_rps"],
         "unit": "requests/s" if not args.dryrun else "requests/step",
-        "schema_version": 3,
+        "schema_version": 4,
         "sla": {"ttft_budget": ttft_budget, "tpot_budget": tpot_budget},
         "workload": {"n_requests": n_requests, "seed": args.seed,
                      "arrival_rate": rate,
@@ -521,6 +660,7 @@ def main():
         "sweep": sweep,
         "disaggregation": disagg,
         "autoscale": autoscale,
+        "prefix_directory": prefix_dir,
     }
     print(json.dumps({k: result[k] for k in ("metric", "value", "unit")} |
                      {"best": {"policy": best["policy"],

@@ -181,6 +181,60 @@ def _autoscale_record(v):
     return None
 
 
+def _prefix_directory_record(v):
+    """The fleet-prefix-directory receipt (bench_router.py
+    run_prefix_directory_leg, docs/SERVING.md "Prefix directory"): over
+    the same diurnal shared-prefix workload, directory routing must reach
+    a >= 0.95 affinity hit rate (beating the recorded probe baseline),
+    beat probe-based prefix_affinity on p99 TTFT at equal goodput (same
+    completions, same deadline hits), complete >= 1 cold-replica KV
+    prefix import through the fast path, keep outputs byte-identical
+    between the legs, and repeat byte-identically.  A committed artifact
+    where the directory lost any of those is a regression, not a
+    benchmark."""
+    if not isinstance(v, dict):
+        return f"expected prefix_directory object, got {type(v).__name__}"
+    for k in ("workload", "probe", "directory", "probe_hit_rate",
+              "directory_hit_rate", "prefix_imports", "zero_divergence",
+              "divergent_requests", "determinism_repeat_identical"):
+        if k not in v:
+            return f"missing prefix_directory key {k!r}"
+    if v["determinism_repeat_identical"] is not True:
+        return "prefix_directory leg not byte-identical across runs"
+    if v["zero_divergence"] is not True or v["divergent_requests"] != 0:
+        return (f"output divergence recorded ({v['divergent_requests']} "
+                "request(s)) between probe and directory routing")
+    hr = v["directory_hit_rate"]
+    if not isinstance(hr, (int, float)) or isinstance(hr, bool) or hr < 0.95:
+        return (f"directory hit rate {hr!r} < 0.95 — the directory must "
+                "turn probe-level affinity into cluster-wide warmth")
+    phr = v["probe_hit_rate"]
+    if not isinstance(phr, (int, float)) or isinstance(phr, bool) or not phr < hr:
+        return f"probe baseline hit rate {phr!r} not below directory {hr}"
+    if not (isinstance(v["prefix_imports"], int) and v["prefix_imports"] >= 1):
+        return ("no cold-replica KV prefix import completed through the "
+                "fast path — the cluster-wide-warmth half never engaged")
+    errors = []
+    for side in ("probe", "directory"):
+        _check(v[side], _ROUTER_POINT, f"prefix_directory.{side}", errors)
+    if errors:
+        return "; ".join(errors)
+    probe, d = v["probe"], v["directory"]
+    if (d["completed"], d["deadline_met"]) != \
+            (probe["completed"], probe["deadline_met"]):
+        return (f"not an equal-goodput pair: directory completed/met "
+                f"{d['completed']}/{d['deadline_met']} vs probe "
+                f"{probe['completed']}/{probe['deadline_met']}")
+    m, dd = probe["ttft"]["p99"], d["ttft"]["p99"]
+    if m is None or dd is None or not dd < m:
+        return f"directory p99 TTFT {dd} does not beat probe {m}"
+    pfx = d.get("prefix")
+    if not isinstance(pfx, dict) or pfx.get("imports") != v["prefix_imports"]:
+        return (f"directory-side prefix accounting {pfx!r} disagrees with "
+                f"the record's prefix_imports {v['prefix_imports']}")
+    return None
+
+
 def _router_sweep_invariants(v):
     """The fleet bench's acceptance receipts: >= 3 points, the
     prefix_affinity policy actually hit its cache somewhere, and every
@@ -335,10 +389,10 @@ SCHEMAS = {
                         "concurrency": INT},
         "engine_throughput": ("nullable", _LEGACY_THROUGHPUT),
     },
-    # the fleet router harness (scripts/bench_router.py, schema v3)
+    # the fleet router harness (scripts/bench_router.py, schema v4)
     "BENCH_ROUTER.json": {
         "metric": STR, "value": NUM, "unit": STR,
-        "schema_version": lambda v: None if v == 3 else f"schema_version {v} != 3",
+        "schema_version": lambda v: None if v == 4 else f"schema_version {v} != 4",
         "sla": {"ttft_budget": NUM, "tpot_budget": NUM},
         "workload": {"n_requests": INT, "seed": INT, "arrival_rate": NUM,
                      "prefix_groups": INT, "prefix_pages": INT, "dryrun": BOOL,
@@ -349,6 +403,7 @@ SCHEMAS = {
         "sweep[]": [_ROUTER_POINT],
         "disaggregation": _disagg_record,
         "autoscale": _autoscale_record,
+        "prefix_directory": _prefix_directory_record,
     },
 }
 

@@ -1,0 +1,419 @@
+"""Fleet-global prefix cache directory (r15,
+serving/fleet/prefix_directory.py): directory bookkeeping
+(publish/extend/retract ordering, purge-on-death, bounded size with LRU
+accounting), the zero-probe dispatch hot path with a directory-vs-probe
+agreement oracle, the cold-replica hot-prefix KV import fast path, the
+diurnal workload generator, and a 3-seed random publish/evict/kill
+property audit (outputs == unperturbed goldens, zero KV refcount
+drift)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, build_engine
+from deepspeed_tpu.inference.v2.ragged import prefix_chain_hashes
+from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.models.llama_cache import PagedKVConfig
+from deepspeed_tpu.serving import VirtualClock
+from deepspeed_tpu.serving.fleet import (FleetSimulator, FleetState,
+                                         PrefixDirectory,
+                                         PrefixDirectoryPolicy, ReplicaPool,
+                                         Router, diurnal_arrivals, make_policy)
+from deepspeed_tpu.serving.kvtransfer import (KVImportError,
+                                              SnapshotIntegrityError,
+                                              export_prefix, import_prefix)
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=256,
+                  rope_theta=1e4, dtype=jnp.float32, scan_layers=True, remat=False)
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def trained_params():
+    model = LlamaForCausalLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def _factory(trained_params, num_pages=64, max_seqs=4, **overrides):
+    def make():
+        kv = PagedKVConfig(num_pages=num_pages, page_size=PAGE, max_pages_per_seq=16)
+        sched = SchedulerConfig(token_budget=64, max_seqs=max_seqs, prefill_chunk=8,
+                                decode_bucket=4)
+        return build_engine(CFG, trained_params, RaggedInferenceEngineConfig(
+            kv=kv, scheduler=sched, kv_dtype=jnp.float32,
+            decode_steps_per_dispatch=1, **overrides))
+    return make
+
+
+def _fleet(trained_params, n_replicas, saturation_queue_depth=4, capacity=65536,
+           **factory_kw):
+    directory = PrefixDirectory(page_size=PAGE, capacity=capacity)
+    pool = ReplicaPool(_factory(trained_params, **factory_kw), n_replicas,
+                       clock=VirtualClock(), prefix_directory=directory)
+    router = Router(pool, PrefixDirectoryPolicy(
+        directory, saturation_queue_depth=saturation_queue_depth))
+    return router, pool, directory
+
+
+def _assert_clean(pool):
+    """Zero page-refcount drift on every live replica: no sequences left,
+    and dropping the prefix cache frees everything but the null page."""
+    for rep in pool.replicas.values():
+        if rep.serve is None:
+            continue
+        eng = rep.serve.engine
+        assert not eng.state.seqs
+        if eng.kv.prefix_cache is not None:
+            eng.kv.prefix_cache.evict(eng.kv.num_pages)
+        assert eng.kv.allocator.free_pages == eng.kv.num_pages - 1
+
+
+PREFIX = list(range(1, 2 * PAGE + 1))     # two full pages
+
+
+def _arrivals(prompts, max_new=4, spacing=0.5):
+    return [dict(prompt=p, max_new_tokens=max_new, arrival_ts=round(i * spacing, 6))
+            for i, p in enumerate(prompts)]
+
+
+# ------------------------------------------------------ pure bookkeeping
+
+
+def test_publish_extend_retract_ordering():
+    d = PrefixDirectory(page_size=PAGE)
+    tokens = PREFIX + [99]                # 2 usable full pages
+    h = prefix_chain_hashes(tokens, PAGE)
+    assert d.depths(tokens, [0, 1]) == {0: 0, 1: 0}
+    d.publish(0, h[0])
+    assert d.depths(tokens, [0, 1]) == {0: 1, 1: 0}
+    d.publish(0, h[1])                    # extension: deeper on the SAME chain
+    d.publish(1, h[0])
+    assert d.depths(tokens, [0, 1]) == {0: 2, 1: 1}
+    # depth counts CONSECUTIVE pages from the root: a retracted root makes
+    # the replica cold even while the child digest is still resident
+    d.retract(0, h[0])
+    assert d.depths(tokens, [0, 1]) == {0: 0, 1: 1}
+    assert d.stats["published"] == 3 and d.stats["retracted"] == 1
+    # retract is idempotent; unknown digests are ignored
+    d.retract(0, h[0])
+    d.retract(7, 12345)
+    assert d.stats["retracted"] == 1
+
+
+def test_depths_applies_last_token_usable_cap():
+    """The directory reports the SAME quantity lookup_depth does — a
+    prompt ending exactly on a page boundary keeps its last page out of
+    the usable count (the engine must still compute one token)."""
+    d = PrefixDirectory(page_size=PAGE)
+    tokens = PREFIX                        # exactly 2 pages, no tail token
+    for h in prefix_chain_hashes(tokens, PAGE):
+        d.publish(0, h)
+    assert d.depths(tokens, [0])[0] == 1           # capped at (16-1)//8 = 1
+    assert d.depths(tokens + [5], [0])[0] == 2     # one tail token: both usable
+
+
+def test_bounded_capacity_lru_accounting():
+    d = PrefixDirectory(page_size=PAGE, capacity=4)
+    tokens = list(range(1, 6 * PAGE + 1)) + [99]
+    chain = prefix_chain_hashes(tokens, PAGE)
+    for h in chain[:4]:
+        d.publish(0, h)
+    assert d.entries == 4 and d.stats["lru_evicted"] == 0
+    # touching the oldest (re-publish) saves it from the next overflow
+    d.publish(0, chain[0])
+    d.publish(0, chain[4])
+    assert d.stats["lru_evicted"] == 1 and d.entries == 4
+    held = {h for (rid, h) in d._lru}
+    assert chain[0] in held and chain[1] not in held
+    # a routed-on lookup ALSO refreshes what it matched
+    d.depths(tokens, [0])
+    d.publish(1, chain[0])
+    assert d.entries == 4   # overflow evicted the coldest, not the matched root
+    assert (0, chain[0]) in d._lru
+
+
+def test_purge_on_death_and_summary():
+    d = PrefixDirectory(page_size=PAGE)
+    tokens = PREFIX + [99]
+    chain = prefix_chain_hashes(tokens, PAGE)
+    for rid in (0, 1):
+        for h in chain:
+            d.publish(rid, h)
+    assert d.purge(0) == 2
+    assert d.depths(tokens, [0, 1]) == {0: 0, 1: 2}
+    s = d.summary()
+    assert s["purged"] == 2 and s["entries"] == 2 and s["digests"] == 2
+
+
+# ------------------------------------------------- fleet routing hot path
+
+
+def test_routes_to_warm_replica_with_zero_probe_calls(trained_params):
+    """The satellite contract: the directory policy performs ZERO
+    per-replica lookup_depth probes per dispatch — warmth is pushed
+    through the publish stream, not pulled from engines."""
+    prompts = [PREFIX + [40 + i] for i in range(4)]
+    router, pool, directory = _fleet(trained_params, 2)
+    probes = {"n": 0}
+    for rep in pool.replicas.values():
+        pc = rep.serve.engine.kv.prefix_cache
+        orig = pc.lookup_depth
+        pc.lookup_depth = lambda tokens, _o=orig: (
+            probes.__setitem__("n", probes["n"] + 1) or _o(tokens))
+    reqs = FleetSimulator(router).run(_arrivals(prompts, spacing=3.0))
+    assert all(r.state is FleetState.DONE for r in reqs)
+    assert probes["n"] == 0
+    first = reqs[0].dispatches[0][0]
+    assert [r.dispatches[0][0] for r in reqs[1:]] == [first] * 3
+    s = router.summary()["affinity"]
+    assert s["hits"] >= 3 and s["hit_rate"] > 0
+
+
+def test_directory_agrees_with_probe_oracle(trained_params):
+    """Regression oracle: after any run, the directory's per-replica depth
+    equals what a lookup_depth probe of that replica reports — the probe
+    policy stays correct as the cross-check for the pushed dataflow."""
+    rng = np.random.default_rng(0)
+    prompts = [PREFIX + [int(x) for x in rng.integers(1, CFG.vocab_size, 3)]
+               for _ in range(6)]
+    router, pool, directory = _fleet(trained_params, 3)
+    FleetSimulator(router).run(_arrivals(prompts, spacing=1.0))
+    histories = prompts + [PREFIX + [99, 98], [7] * PAGE + [1]]
+    for tokens in histories:
+        for rid, rep in pool.replicas.items():
+            probe = rep.serve.engine.kv.prefix_cache.lookup_depth(tokens)
+            assert directory.depths(tokens, [rid])[rid] == probe, (tokens, rid)
+
+
+def test_saturated_warm_target_imports_prefix_onto_cold_replica(trained_params):
+    """The cluster-wide-warmth tentpole: warm replica saturated → the
+    request lands on the least-loaded COLD replica, but only after the
+    router imports the hot prefix's KV pages there — outputs identical,
+    the target's cache genuinely warm afterwards."""
+    golden = _factory(trained_params)().generate(
+        [PREFIX + [77], PREFIX + [78], PREFIX + [79]], max_new_tokens=4)
+    router, pool, directory = _fleet(trained_params, 2, saturation_queue_depth=1)
+    warm = router.submit(PREFIX + [77], max_new_tokens=4, arrival_ts=0.0)
+    router.dispatch_pending()
+    donor = warm.dispatches[0][0]
+    while warm.state is not FleetState.DONE:
+        for rid in pool.rids:
+            pool.tick(rid)
+        router.poll()
+    cold = 1 - donor
+    assert pool.replica(cold).serve.engine.kv.prefix_cache.lookup_depth(
+        PREFIX + [0]) == 0
+    # two same-prefix requests in one round: the first queues on the warm
+    # donor, the second sees it saturated and triggers the import path
+    r2 = router.submit(PREFIX + [78], max_new_tokens=4, arrival_ts=0.0)
+    r3 = router.submit(PREFIX + [79], max_new_tokens=4, arrival_ts=0.0)
+    router.dispatch_pending()
+    assert router.stats["prefix_imports"] == 1
+    assert router.stats["prefix_import_fallbacks"] == 0
+    assert {r2.dispatches[0][0], r3.dispatches[0][0]} == {donor, cold}
+    # the import made the cold replica warm for real (probe confirms)
+    assert pool.replica(cold).serve.engine.kv.prefix_cache.lookup_depth(
+        PREFIX + [0]) == 2
+    assert pool.replica(cold).serve.stats.prefix_imports == 1
+    while not (r2.state.terminal and r3.state.terminal):
+        for rid in pool.rids:
+            pool.tick(rid)
+        router.poll()
+    assert [warm.tokens, r2.tokens, r3.tokens] == golden
+    # both dispatches were affinity hits: the imported landing counts as
+    # warm because it IS warm
+    assert router.stats["affinity_misses"] == 1   # only the very first request
+    _assert_clean(pool)
+
+
+def test_brownout_pauses_prefix_imports(trained_params):
+    """Ladder rung 3 (pause_migration) covers prefix imports: under
+    overload the staging bandwidth goes to serving and the dispatch
+    proceeds cold."""
+    from deepspeed_tpu.serving.fleet import OverloadConfig, OverloadController
+    directory = PrefixDirectory(page_size=PAGE)
+    pool = ReplicaPool(_factory(trained_params), 2, clock=VirtualClock(),
+                       prefix_directory=directory)
+    overload = OverloadController(OverloadConfig())
+    router = Router(pool, PrefixDirectoryPolicy(directory,
+                                                saturation_queue_depth=1),
+                    overload=overload)
+    warm = router.submit(PREFIX + [77], max_new_tokens=4, arrival_ts=0.0)
+    router.dispatch_pending()
+    while warm.state is not FleetState.DONE:
+        for rid in pool.rids:
+            pool.tick(rid)
+        router.poll()
+    overload.rung = 3   # pause_migration rung, directly (no ladder churn)
+    assert overload.migrations_paused
+    router.submit(PREFIX + [78], max_new_tokens=4, arrival_ts=0.0)
+    router.submit(PREFIX + [79], max_new_tokens=4, arrival_ts=0.0)
+    router.dispatch_pending()
+    assert router.stats["prefix_imports"] == 0
+    assert router.stats["prefix_imports_paused"] == 1
+
+
+def test_router_rejects_mismatched_directory_wiring(trained_params):
+    directory = PrefixDirectory(page_size=PAGE)
+    pool = ReplicaPool(_factory(trained_params), 1, clock=VirtualClock())
+    with pytest.raises(ValueError, match="prefix_directory"):
+        Router(pool, PrefixDirectoryPolicy(directory))
+
+
+def test_make_policy_prefix_directory():
+    d = PrefixDirectory(page_size=PAGE)
+    p = make_policy("prefix_directory", directory=d, saturation_queue_depth=2)
+    assert isinstance(p, PrefixDirectoryPolicy) and p.directory is d
+
+
+# ----------------------------------------------- engine-level prefix moves
+
+
+def test_export_import_prefix_roundtrip_and_dedup(trained_params):
+    a = _factory(trained_params)()
+    b = _factory(trained_params)()
+    tokens = PREFIX + [50]
+    # a cold engine exports nothing (checked BEFORE b is warmed)
+    assert export_prefix(b, tokens) is None
+    a.generate([tokens], max_new_tokens=2)
+    snap = export_prefix(a, tokens, source="a")
+    assert snap is not None and snap.complete and snap.n_pages == 2
+    assert import_prefix(b, snap) == 2
+    assert b.kv.prefix_cache.lookup_depth(tokens) == 2
+    # idempotent: the target already holds the chain
+    assert import_prefix(b, snap) == 0
+    # the imported pages serve real prefills with identical outputs (the
+    # donor's own honestly-computed output is the oracle)
+    golden = a.generate([PREFIX + [51]], max_new_tokens=4)
+    assert b.generate([PREFIX + [51]], max_new_tokens=4) == golden
+
+
+def test_torn_prefix_staging_rejected_at_import(trained_params):
+    a = _factory(trained_params)()
+    b = _factory(trained_params, num_pages=32)()   # smaller arena is fine
+    tokens = PREFIX + [50]
+    a.generate([tokens], max_new_tokens=2)
+    snap = export_prefix(a, tokens)
+    rotted = snap.chunks[0].copy()
+    rotted.flat[3] += 1.0           # bit rot in host staging; crc kept
+    snap.chunks[0] = rotted
+    free_before = b.kv.allocator.free_pages
+    with pytest.raises(SnapshotIntegrityError):
+        import_prefix(b, snap)
+    assert b.kv.allocator.free_pages == free_before   # nothing leaked
+    assert b.kv.prefix_cache.lookup_depth(tokens) == 0
+
+
+def test_import_shortfall_evicting_own_chain_falls_back_cleanly(trained_params):
+    """The capacity-eviction sweep inside import_prefix can evict the
+    TARGET's own held prefix of the chain being imported; the missing
+    boundary must be recomputed after the sweep, so the import either
+    covers the (now larger) tail or rejects cleanly — never adopts a tail
+    hanging off a hole match() can't reach."""
+    a = _factory(trained_params)()
+    b = _factory(trained_params)()
+    tokens = PREFIX + [50]
+    a.generate([tokens], max_new_tokens=2)
+    snap = export_prefix(a, tokens)
+    assert snap.n_pages == 2
+    # target honestly holds page 0 of the chain...
+    b.generate([tokens[:9]], max_new_tokens=2)
+    assert b.kv.prefix_cache.held_depth(tokens) == 1
+    # ...and its arena is otherwise fully occupied by live residents, so
+    # the import's shortfall eviction has exactly one victim: that page
+    held = b.kv.allocator.allocate(b.kv.allocator.free_pages)
+    with pytest.raises(KVImportError):
+        import_prefix(b, snap)
+    # the held prefix was sacrificed to the sweep and the import rejected:
+    # cold but consistent — no orphaned chain entries, no leaked pages
+    assert b.kv.prefix_cache.held_depth(tokens) == 0
+    assert b.kv.prefix_cache.cached_pages == 0
+    b.kv.allocator.free(held)
+    assert b.kv.allocator.free_pages == b.kv.num_pages - 1
+
+
+# -------------------------------------------------- diurnal workload shape
+
+
+def test_diurnal_arrivals_deterministic_and_modulated():
+    kw = dict(n_requests=400, base_rate=2.0, amplitude=0.8, period=50.0,
+              vocab=100, phase=0.0)
+    a1 = diurnal_arrivals(seed=3, **kw)
+    assert a1 == diurnal_arrivals(seed=3, **kw)
+    assert a1 != diurnal_arrivals(seed=4, **kw)
+    ts = np.asarray([a["arrival_ts"] for a in a1])
+    assert (np.diff(ts) > 0).all()
+    # arrivals are denser around the sinusoid's peaks (first quarter of
+    # each period) than around its troughs (third quarter)
+    frac = (ts % 50.0) / 50.0
+    peak = int(((frac >= 0.0) & (frac < 0.5)).sum())
+    trough = int(((frac >= 0.5) & (frac < 1.0)).sum())
+    assert peak > 1.5 * trough, (peak, trough)
+    # prefixes prepend page-aligned groups; deadline slack stamps deadlines
+    pre = [[7] * 8, [9] * 8]
+    a2 = diurnal_arrivals(seed=3, n_requests=20, base_rate=2.0, amplitude=0.5,
+                          period=20.0, vocab=100, prefixes=pre,
+                          deadline_slack=5.0)
+    assert all(a["prompt"][:8] in pre for a in a2)
+    assert all(abs(a["deadline"] - a["arrival_ts"] - 5.0) < 1e-6 for a in a2)
+
+
+# -------------------------------------------------- 3-seed property audit
+
+
+@pytest.fixture(scope="module")
+def golden_engine(trained_params):
+    """One long-lived oracle engine shared by the audit seeds (prefix
+    cache persistence across calls changes no token — pinned above)."""
+    return _factory(trained_params)()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_property_random_publish_evict_kill(trained_params, golden_engine, seed):
+    """Seeded property audit: shared-prefix traffic under the directory
+    policy with a random kill/recover — every request terminal exactly
+    once, DONE outputs equal the unperturbed goldens, zero refcount drift
+    on every replica, directory-vs-probe agreement at the end, and the
+    dead replica's directory entries purged."""
+    rng = np.random.default_rng(seed)
+    groups = [list(rng.integers(1, CFG.vocab_size, 2 * PAGE))
+              for _ in range(2)]
+    arrivals = []
+    t = 0.0
+    for _ in range(10):
+        t += float(rng.exponential(1.2))
+        g = int(rng.integers(0, len(groups)))
+        suffix = [int(x) for x in rng.integers(1, CFG.vocab_size,
+                                               int(rng.integers(1, 5)))]
+        arrivals.append({"arrival_ts": round(t, 6),
+                         "prompt": [int(x) for x in groups[g]] + suffix,
+                         "max_new_tokens": int(rng.integers(2, 6)),
+                         "deadline": round(t + 90.0, 6)})
+    golden = golden_engine.generate(
+        [a["prompt"] for a in arrivals],
+        max_new_tokens=max(a["max_new_tokens"] for a in arrivals))
+    router, pool, directory = _fleet(trained_params, 3,
+                                     saturation_queue_depth=1,
+                                     num_pages=48)
+    victim = int(rng.integers(0, 3))
+    kill_at = round(float(rng.uniform(1.0, 6.0)), 6)
+    reqs = FleetSimulator(router).run(
+        arrivals, schedule=[(kill_at, "kill", victim),
+                            (kill_at + 8.0, "recover", victim)])
+    assert [r.state for r in reqs] == [FleetState.DONE] * len(arrivals)
+    for r, g in zip(reqs, golden):
+        assert r.tokens == g[:r.max_new_tokens], (seed, r.fid)
+        assert sum(1 for st, _ in r.history if st.terminal) == 1
+    assert directory.stats["purged"] > 0 or not any(
+        rid == victim for rid, _ in directory._lru)
+    for tokens in [g + [1] for g in groups]:
+        for rid, rep in pool.replicas.items():
+            if rep.serve is None:
+                continue
+            probe = rep.serve.engine.kv.prefix_cache.lookup_depth(tokens)
+            assert directory.depths(tokens, [rid])[rid] == probe
+    _assert_clean(pool)

@@ -1,0 +1,244 @@
+"""Chaos tests for the fleet prefix directory's staleness ladder
+(``prefix.publish`` / ``prefix.import`` fault sites + the two
+ROADMAP-named races): dropped directory updates, failed imports,
+evict-after-publish and death-with-directory-entries — every rung must
+keep outputs byte-identical to an unperturbed run with zero page-refcount
+drift, the directory never routing to (or importing from) a ghost."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, build_engine
+from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.models.llama_cache import PagedKVConfig
+from deepspeed_tpu.resilience.fault_injection import (INJECTION_SITES, FaultSpec,
+                                                      InjectedCrash,
+                                                      configure_fault_injection)
+from deepspeed_tpu.serving import VirtualClock
+from deepspeed_tpu.serving.fleet import (FleetSimulator, FleetState,
+                                         PrefixDirectory,
+                                         PrefixDirectoryPolicy, ReplicaPool,
+                                         ReplicaState, Router)
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=256,
+                  rope_theta=1e4, dtype=jnp.float32, scan_layers=True, remat=False)
+PAGE = 8
+PREFIX = list(range(1, 2 * PAGE + 1))
+
+
+@pytest.fixture(scope="module")
+def trained_params():
+    model = LlamaForCausalLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    configure_fault_injection(None)
+
+
+def _factory(trained_params, num_pages=64):
+    def make():
+        kv = PagedKVConfig(num_pages=num_pages, page_size=PAGE, max_pages_per_seq=16)
+        sched = SchedulerConfig(token_budget=64, max_seqs=4, prefill_chunk=8,
+                                decode_bucket=4)
+        return build_engine(CFG, trained_params, RaggedInferenceEngineConfig(
+            kv=kv, scheduler=sched, kv_dtype=jnp.float32, decode_steps_per_dispatch=1))
+    return make
+
+
+def _fleet(trained_params, n_replicas, saturation_queue_depth=1):
+    directory = PrefixDirectory(page_size=PAGE)
+    pool = ReplicaPool(_factory(trained_params), n_replicas, clock=VirtualClock(),
+                       prefix_directory=directory)
+    router = Router(pool, PrefixDirectoryPolicy(
+        directory, saturation_queue_depth=saturation_queue_depth))
+    return router, pool, directory
+
+
+def _assert_clean(pool):
+    for rep in pool.replicas.values():
+        if rep.serve is None:
+            continue
+        eng = rep.serve.engine
+        assert not eng.state.seqs
+        if eng.kv.prefix_cache is not None:
+            eng.kv.prefix_cache.evict(eng.kv.num_pages)
+        assert eng.kv.allocator.free_pages == eng.kv.num_pages - 1
+
+
+PROMPTS = [PREFIX + [40 + i] for i in range(6)]
+
+
+def _arrivals(prompts, max_new=4, spacing=0.5):
+    return [dict(prompt=p, max_new_tokens=max_new, arrival_ts=round(i * spacing, 6))
+            for i, p in enumerate(prompts)]
+
+
+@pytest.fixture(scope="module")
+def goldens(trained_params):
+    """ONE long-lived oracle engine for every unperturbed golden in this
+    file (its prefix cache persisting across calls changes no token —
+    pinned by the fleet suite) — engine builds are what this file's
+    runtime is made of."""
+    eng = _factory(trained_params)()
+    cache = {}
+
+    def get(prompts, max_new=4):
+        key = (tuple(tuple(p) for p in prompts), max_new)
+        if key not in cache:
+            cache[key] = eng.generate([list(p) for p in prompts],
+                                      max_new_tokens=max_new)
+        return cache[key]
+    return get
+
+
+def test_prefix_sites_registered():
+    assert "prefix.publish" in INJECTION_SITES
+    assert "prefix.import" in INJECTION_SITES
+    FaultSpec(site="prefix.publish", kind="os_error")   # validates
+    FaultSpec(site="prefix.import", kind="device_loss")
+    with pytest.raises(ValueError, match="unknown injection site"):
+        FaultSpec(site="prefix.pubish", kind="crash")
+
+
+def test_dropped_publishes_leave_directory_cold_outputs_identical(trained_params, goldens):
+    """Transient faults on the publish stream drop directory updates: the
+    table runs stale-COLD (missed affinity, lower hit rate) but every
+    output is byte-identical and nothing leaks."""
+    golden = goldens(PROMPTS)
+    configure_fault_injection(
+        {"sites": [{"site": "prefix.publish", "kind": "os_error",
+                    "at": 1, "times": 2}]})
+    router, pool, directory = _fleet(trained_params, 2)
+    reqs = FleetSimulator(router).run(_arrivals(PROMPTS, spacing=3.0))
+    assert [r.state for r in reqs] == [FleetState.DONE] * len(PROMPTS)
+    assert [r.tokens for r in reqs] == golden
+    # directory-vs-cache agreement is exactly what the drill broke: the
+    # directory must UNDER-report, never over-report beyond retract loss
+    assert directory.stats["published"] < sum(
+        rep.serve.engine.kv.prefix_cache.cached_pages
+        for rep in pool.replicas.values())
+    _assert_clean(pool)
+
+
+def test_import_os_error_falls_back_to_cold_dispatch(trained_params, goldens):
+    """A transient fault at prefix.import consumes the attempt: the
+    dispatch proceeds cold, the prefill recomputes, outputs identical."""
+    golden = goldens(PROMPTS)
+    configure_fault_injection(
+        {"sites": [{"site": "prefix.import", "kind": "os_error", "at": 1}]})
+    router, pool, directory = _fleet(trained_params, 2)
+    reqs = FleetSimulator(router).run(_arrivals(PROMPTS, spacing=0.2))
+    assert [r.state for r in reqs] == [FleetState.DONE] * len(PROMPTS)
+    assert [r.tokens for r in reqs] == golden
+    assert router.stats["prefix_import_fallbacks"] >= 1
+    _assert_clean(pool)
+
+
+def test_publish_crash_propagates(trained_params):
+    configure_fault_injection(
+        {"sites": [{"site": "prefix.publish", "kind": "crash", "at": 1}]})
+    router, pool, _ = _fleet(trained_params, 2)
+    with pytest.raises(InjectedCrash):
+        FleetSimulator(router).run(_arrivals(PROMPTS[:2]))
+
+
+def test_import_crash_propagates(trained_params):
+    configure_fault_injection(
+        {"sites": [{"site": "prefix.import", "kind": "crash", "at": 1}]})
+    router, pool, _ = _fleet(trained_params, 2)
+    with pytest.raises(InjectedCrash):
+        FleetSimulator(router).run(_arrivals(PROMPTS, spacing=0.2))
+
+
+def test_import_device_loss_kills_target_request_retries(trained_params, goldens):
+    """The h2d scatter finds the import TARGET's device gone: the target
+    dies, the request stays pending, and a later round serves it
+    elsewhere — outputs identical."""
+    golden = goldens(PROMPTS)
+    configure_fault_injection(
+        {"sites": [{"site": "prefix.import", "kind": "device_loss", "at": 1}]})
+    router, pool, directory = _fleet(trained_params, 3)
+    reqs = FleetSimulator(router).run(
+        _arrivals(PROMPTS, spacing=0.2),
+        schedule=[(40.0, "recover", 0), (40.0, "recover", 1),
+                  (40.0, "recover", 2)])
+    assert [r.state for r in reqs] == [FleetState.DONE] * len(PROMPTS)
+    assert [r.tokens for r in reqs] == golden
+    dead = [h for h in pool.health.history if h[2] is ReplicaState.DEAD]
+    assert len(dead) == 1
+    _assert_clean(pool)
+
+
+# ---------------------------------------------- ROADMAP staleness races
+
+
+def test_evict_after_publish_recomputes_never_wrong(trained_params, goldens):
+    """Race 1: the directory promises warmth the donor has since evicted
+    (a lost retraction — here simulated by detaching the listener before
+    the donor's cache is drained).  The warm-routed dispatch recomputes;
+    the import path finds the donor cold and falls back; outputs stay
+    identical on both rungs."""
+    golden = goldens(PROMPTS)
+    router, pool, directory = _fleet(trained_params, 2)
+    # warm replica 0 the honest way
+    first = router.submit(PROMPTS[0], max_new_tokens=4, arrival_ts=0.0)
+    router.dispatch_pending()
+    donor = first.dispatches[0][0]
+    while first.state is not FleetState.DONE:
+        for rid in pool.rids:
+            pool.tick(rid)
+        router.poll()
+    # the race: the donor evicts its whole cache but the retractions are
+    # lost — the directory still says it is warm
+    pc = pool.replica(donor).serve.engine.kv.prefix_cache
+    pc.listener = None
+    pc.evict(10**6)
+    assert pc.lookup_depth(PROMPTS[1]) == 0
+    assert directory.depths(PROMPTS[1], [donor])[donor] > 0   # stale-warm
+    # rung A: unsaturated -> routed to the "warm" donor, recomputes cold
+    r2 = router.submit(PROMPTS[1], max_new_tokens=4, arrival_ts=0.0)
+    router.dispatch_pending()
+    assert r2.dispatches[0][0] == donor
+    # rung B: saturate the donor so the next request imports FROM it —
+    # the export finds nothing and the dispatch proceeds cold
+    r3 = router.submit(PROMPTS[2], max_new_tokens=4, arrival_ts=0.0)
+    router.dispatch_pending()
+    assert router.stats["prefix_import_fallbacks"] == 1
+    assert router.stats["prefix_imports"] == 0
+    while not all(r.state.terminal for r in (r2, r3)):
+        for rid in pool.rids:
+            pool.tick(rid)
+        router.poll()
+    assert [first.tokens, r2.tokens, r3.tokens] == golden[:3]
+    _assert_clean(pool)
+
+
+def test_death_with_directory_entries_purges_and_never_routes_to_ghost(trained_params, goldens):
+    """Race 2: a replica dies holding directory entries.  The kill purges
+    them atomically with the engine discard, so no later dispatch routes
+    to — or imports from — the ghost; displaced work fails over with
+    outputs identical."""
+    golden = goldens(PROMPTS, max_new=8)
+    router, pool, directory = _fleet(trained_params, 2)
+    reqs = FleetSimulator(router).run(
+        _arrivals(PROMPTS, max_new=8, spacing=1.0),
+        schedule=[(4.0, "kill", 0), (14.0, "recover", 0)])
+    assert [r.state for r in reqs] == [FleetState.DONE] * len(PROMPTS)
+    assert [r.tokens for r in reqs] == golden
+    assert directory.stats["purged"] > 0, "the kill never purged entries"
+    # no dispatch landed on replica 0 between its death and recovery
+    # (health history tuples: (rid, from_state, to_state, ts, reason))
+    dead_t = next(h[3] for h in pool.health.history
+                  if h[0] == 0 and h[2] is ReplicaState.DEAD)
+    rec_t = next(h[3] for h in pool.health.history
+                 if h[0] == 0 and h[2] is ReplicaState.RECOVERING)
+    for r in reqs:
+        for rid, ts in r.dispatches:
+            assert not (rid == 0 and dead_t < ts < rec_t), (r.fid, r.dispatches)
+    _assert_clean(pool)
