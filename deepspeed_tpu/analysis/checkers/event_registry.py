@@ -6,7 +6,7 @@ directions of drift, all fatal in tier-1.
 
 Mechanics: any string constant matching ``<prefix>/<segment>[...]`` for
 the known prefixes (resilience, serving, fleet, telemetry, monitor,
-profiler, spec, migration, prefix) is an event-name use — except statement-position strings
+profiler, spec, migration, prefix, transport) is an event-name use — except statement-position strings
 (docstrings) and the registry file itself.  f-string names
 (``f"fleet/health/{state.value}"``) are validated by their literal head
 against the registry's DYNAMIC prefix families.
@@ -22,9 +22,10 @@ from ..core import Checker, FileContext, Runner, collect_files
 
 EVENT_RE = re.compile(
     r"^(resilience|serving|fleet|telemetry|monitor|profiler|spec|migration"
-    r"|prefix)/[a-z0-9_]+(/[a-z0-9_]+)*$")
+    r"|prefix|transport)/[a-z0-9_]+(/[a-z0-9_]+)*$")
 _PREFIXES = ("resilience/", "serving/", "fleet/", "telemetry/",
-             "monitor/", "profiler/", "spec/", "migration/", "prefix/")
+             "monitor/", "profiler/", "spec/", "migration/", "prefix/",
+             "transport/")
 REGISTRY_REL = "telemetry/event_registry.py"
 
 

@@ -328,6 +328,30 @@ class PrefixCacheManager:
             self._lru[h] = None
             self._notify("publish", h)
 
+    def held_digests(self) -> List[int]:
+        """Chain hashes of every resident full page, in insertion order —
+        the fleet directory's RESYNC snapshot (docs/SERVING.md
+        "Control-plane transport"): when the router detects a gap in this
+        replica's sequence-numbered publish stream, it pulls exactly this
+        set and rebuilds its view instead of guessing."""
+        return list(self._pages)
+
+    def chain_tokens(self, h: int) -> Optional[List[int]]:
+        """Reconstruct the full token prefix whose last page is chain
+        entry ``h`` by walking parent links root-ward — the
+        directory-driven warm-up input (the directory stores digests only;
+        the DONOR's cache owns the tokens).  None when the chain is absent
+        or broken (a concurrent eviction): warm-up just skips it."""
+        parts = []
+        while h is not None:
+            entry = self._pages.get(h)
+            if entry is None:
+                return None
+            _pid, toks, parent = entry
+            parts.append(toks)
+            h = parent
+        return [t for part in reversed(parts) for t in part]
+
     @property
     def cached_pages(self) -> int:
         return len(self._pages)

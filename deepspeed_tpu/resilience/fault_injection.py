@@ -90,6 +90,8 @@ INJECTION_SITES = frozenset({
     "kv.import",            # KV snapshot h2d import (serving/kvtransfer/snapshot.py)
     "prefix.publish",       # replica->directory digest publish/retract (serving/fleet/prefix_directory.py)
     "prefix.import",        # hot-prefix KV h2d adoption (serving/kvtransfer/snapshot.py)
+    "transport.send",       # control-plane message send edge (serving/fleet/transport.py)
+    "transport.deliver",    # control-plane message delivery edge (serving/fleet/transport.py)
 })
 
 _RAISING_KINDS = ("os_error", "crash", "device_loss", "latency")

@@ -750,6 +750,35 @@ class ServingEngine:
                 sum(s.seen_tokens for s in self.engine.state.seqs.values()),
                 sum(len(r.tokens) for r in self._active.values()))
 
+    def fence(self) -> Dict[str, int]:
+        """Cancel EVERY in-flight request on this frontend — the fleet
+        fencing edge (docs/SERVING.md "Control-plane transport").  A
+        replica that outlived its lease (a partition, not a death) kept
+        decoding work the router has already re-dispatched to survivors;
+        when the partition heals, the router's FENCE lands here and that
+        zombie work — queued, active, or paused mid-migration — is
+        dropped: engine sequences flushed (pages released; prefix-cache
+        published pages survive via their refcounts), requests abandoned
+        WITHOUT a terminal transition, exactly as a ``pool.kill`` abandons
+        them — the fleet-level record was already re-homed, and a second
+        terminal here would be the double-serve fencing exists to prevent.
+        Returns the cancel counts for the fence ack."""
+        counts = {"queued": len(self._queue), "active": len(self._active)}
+        for req in list(self._queue):
+            self._requests.pop(req.uid, None)
+            self._trace_ctx.pop(req.uid, None)
+        self._queue.clear()
+        for uid in sorted(self._active):
+            if uid in self.engine.state.seqs:
+                self.engine.flush(uid)
+            self._requests.pop(uid, None)
+            self._trace_ctx.pop(uid, None)
+        self._active.clear()
+        if counts["queued"] or counts["active"]:
+            logger.warning(f"serving: fenced {counts['queued']} queued + "
+                           f"{counts['active']} active request(s)")
+        return counts
+
     def close(self) -> None:
         """Detach from the engine: restore dict-insertion step ordering and
         release the scheduler's reference to this frontend (a long-lived

@@ -18,7 +18,8 @@ generators live in :mod:`.sim`).
 
 from .autoscale import (RUNGS, AutoscaleConfig, Autoscaler, OverloadConfig,
                         OverloadController)
-from .health import HealthConfig, HealthTracker, ReplicaState, classify_fatal
+from .health import (FleetHealthView, HealthConfig, HealthTracker, LeaseConfig,
+                     LeaseState, ReplicaState, classify_fatal)
 from .policies import (POLICIES, DisaggregatedPolicy, LeastOutstandingPolicy,
                        PrefixAffinityPolicy, PrefixDirectoryPolicy,
                        RoundRobinPolicy, RoutingPolicy, make_policy)
@@ -29,10 +30,15 @@ from .sim import (FleetEvent, FleetSimulator, diurnal_arrivals,
                   flash_crowd_arrivals, heavy_tail_arrivals,
                   poisson_mixed_arrivals)
 from .tenancy import DEFAULT_TENANT, TenantRegistry, TenantSpec
+from .transport import (MESSAGE_KINDS, MESSAGE_VERSION, ControlTransport,
+                        LinkFaults, Message, PartitionWindow)
 
 __all__ = [
     "RUNGS", "AutoscaleConfig", "Autoscaler", "OverloadConfig",
     "OverloadController",
+    "ControlTransport", "LinkFaults", "Message", "PartitionWindow",
+    "MESSAGE_KINDS", "MESSAGE_VERSION",
+    "FleetHealthView", "LeaseConfig", "LeaseState",
     "HealthConfig", "HealthTracker", "ReplicaState", "classify_fatal",
     "POLICIES", "DisaggregatedPolicy", "LeastOutstandingPolicy",
     "PrefixAffinityPolicy", "PrefixDirectoryPolicy", "PrefixDirectory",

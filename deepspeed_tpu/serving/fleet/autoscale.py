@@ -282,11 +282,16 @@ class Autoscaler:
                 else (1 - a) * self._ttft_ewma + a * x
 
     def signals(self) -> dict:
-        """Point-in-time control inputs (all from existing surfaces:
-        ``pool.load_stats()``, router queue depth, the TTFT EWMA)."""
+        """Point-in-time control inputs — router queue depth, the TTFT
+        EWMA, and per-replica load snapshots via
+        ``Router.fleet_load_stats()``: a live probe without a control
+        transport, LAST-KNOWN-GOOD heartbeat payloads with an ``age``
+        annotation under one.  Stale inputs make the autoscaler react
+        late (slower), never wrongly — and ``stats_age_max`` surfaces how
+        stale its view was when it decided."""
         pool = self.pool
-        stats = pool.load_stats()
-        dispatchable = [r for r in pool.rids if pool.health.dispatchable(r)]
+        stats = self.router.fleet_load_stats()
+        dispatchable = self.router.dispatchable_rids()
         provisioned = [r for r in pool.rids
                        if pool.health.state(r) is not ReplicaState.DEAD]
         queued = self.router.queue_depth + \
@@ -308,6 +313,10 @@ class Autoscaler:
             "free_kv_pages": free_pages,
             "ttft_ewma": self._ttft_ewma,
             "pressure": max(ttft_pressure, queue_pressure),
+            # staleness receipt: the oldest load snapshot this decision
+            # rests on (0.0 under perfect in-process observation)
+            "stats_age_max": max((s.get("age", 0.0) for s in stats.values()),
+                                 default=0.0),
         }
 
     # ---------------------------------------------------------------- step
@@ -368,6 +377,7 @@ class Autoscaler:
             # scale-up arrived mid-drain: give the replica straight back
             # through the rolling-restart path instead of parking it
             self.pool.restart(rid)
+            self.router.warmup_replica(rid)
             self._decide(now, "drain_cancelled", rid, "scale-up during drain")
             self._emit_event("fleet/scale_up", float(rid))
             self._last_up = now
@@ -391,7 +401,9 @@ class Autoscaler:
         # unconditionally (no cooldown — this is repair, not reaction)
         if n_prov < cfg.min_replicas and dead:
             rid = dead[0]
-            pool.recover(rid)
+            # via the router: a prefix directory pre-imports its hottest
+            # chains while the replica is still RECOVERING (warm join)
+            self.router.recover_replica(rid)
             self._decide(now, "up", rid, f"below min_replicas ({n_prov} < "
                          f"{cfg.min_replicas})")
             self._emit_event("fleet/scale_up", float(rid))
@@ -415,7 +427,7 @@ class Autoscaler:
                 return
             if dead and n_prov < ceiling:
                 rid = dead[0]
-                pool.recover(rid)
+                self.router.recover_replica(rid)
                 self._decide(now, "up", rid,
                              f"pressure {sig['pressure']:.3f}"
                              + (" (kv starved)" if kv_starved else ""))

@@ -38,6 +38,271 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ...utils.logging import logger
 
 
+class LeaseState(enum.Enum):
+    """The ROUTER's partition-tolerant belief about one replica, derived
+    purely from heartbeats over the control transport (docs/SERVING.md
+    "Control-plane transport").  Distinct from :class:`ReplicaState`,
+    which is the replica-LOCAL truth the pool tracks from tick outcomes:
+    under a partition the two legitimately disagree — a perfectly healthy
+    replica the router cannot hear from is lease-DEAD at the router while
+    staying HEALTHY at the pool, and fencing is what reconciles them."""
+    ALIVE = "alive"        # lease fresh: heartbeats arriving inside the window
+    SUSPECT = "suspect"    # lease expiring: no new dispatches, work stays put
+    DEAD = "dead"          # lease expired: fleet-declared death, work re-dispatched
+    FENCING = "fencing"    # heartbeats resumed from a fleet-dead replica (a
+    #                        zombie, or a legit recovery): a FENCE is in
+    #                        flight; the replica rejoins only after the ack
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaseConfig:
+    #: heartbeat silence (seconds of clock time since the newest heartbeat's
+    #: SEND timestamp) after which a replica turns SUSPECT — dispatchable no
+    #: more, but its in-flight work is left alone
+    suspect_after: float = 2.0
+    #: silence after which the lease expires: the router declares the
+    #: replica fleet-dead, re-dispatches its work, and bumps its dispatch
+    #: epoch so any surviving zombie's late completions are fenced off
+    lease: float = 6.0
+    #: minimum clock time between FENCE (re)sends to an unacked zombie
+    fence_retry: float = 2.0
+
+    def __post_init__(self):
+        if not 0 < self.suspect_after < self.lease:
+            raise ValueError(f"lease needs 0 < suspect_after < lease "
+                             f"(got {self.suspect_after}, {self.lease})")
+        if self.fence_retry <= 0:
+            raise ValueError(f"fence_retry must be > 0, got {self.fence_retry}")
+
+
+class FleetHealthView:
+    """Heartbeat-lease health: what the router can DEFENSIBLY believe
+    about each replica when its only evidence is messages that may be
+    lost, late, duplicated or partitioned away.
+
+    Per replica it tracks the newest heartbeat (by sequence number — a
+    reordered older heartbeat never rewinds the view), the last-known-good
+    ``load_stats`` snapshot with its age (the staleness annotation routing
+    and autoscaling read), the replica's self-reported local health state,
+    and a monotonically increasing **dispatch epoch** that bumps on every
+    lease expiry — the fencing token that makes a zombie's late
+    completions discardable."""
+
+    def __init__(self, replica_ids, config: LeaseConfig = None, clock=None,
+                 emit: Optional[Callable[[str, float], None]] = None):
+        self.config = config or LeaseConfig()
+        self._clock = clock
+        self._emit_cb = emit
+        t0 = clock.now() if clock is not None else 0.0
+        rids = list(replica_ids)
+        # the initial lease is granted at construction: a replica that
+        # never heartbeats at all still expires on schedule
+        self._last_hb: Dict[int, float] = {r: t0 for r in rids}
+        self._last_seq: Dict[int, int] = {r: 0 for r in rids}
+        self._reported: Dict[int, str] = {r: ReplicaState.HEALTHY.value for r in rids}
+        self._stats: Dict[int, Optional[dict]] = {r: None for r in rids}
+        self._stats_ts: Dict[int, float] = {r: t0 for r in rids}
+        self._state: Dict[int, LeaseState] = {r: LeaseState.ALIVE for r in rids}
+        #: newest self-reported engine generation — a restart INSIDE the
+        #: lease window renews the lease but bumps this, which is how the
+        #: router learns its old attempts died with the old engine
+        self._generation: Dict[int, Optional[int]] = {r: None for r in rids}
+        self._fence_sent_ts: Dict[int, Optional[float]] = {r: None for r in rids}
+        #: per-replica dispatch epoch; bumped at every lease expiry
+        self.epoch: Dict[int, int] = {r: 0 for r in rids}
+        #: (rid, from, to, ts, reason) — the auditable lease timeline
+        self.history: List[Tuple[int, LeaseState, LeaseState, float, str]] = []
+
+    # ------------------------------------------------------------- queries
+
+    def state(self, rid: int) -> LeaseState:
+        return self._state[rid]
+
+    def states(self) -> Dict[int, LeaseState]:
+        return dict(self._state)
+
+    def dispatchable(self, rid: int) -> bool:
+        """May the router hand this replica NEW work?  Requires a fresh
+        lease AND a self-reported dispatchable local state (a DRAINING or
+        RECOVERING replica heartbeats, but takes no new dispatches)."""
+        if self._state[rid] is not LeaseState.ALIVE:
+            return False
+        try:
+            return ReplicaState(self._reported[rid]).dispatchable
+        except ValueError:
+            return False
+
+    def stats(self, rid: int):
+        """``(last_known_good_load_stats, age_seconds)`` — the staleness-
+        annotated routing signal.  ``(None, age)`` before any heartbeat."""
+        now = self._clock.now() if self._clock is not None else 0.0
+        return self._stats[rid], max(0.0, now - self._stats_ts[rid])
+
+    def generation(self, rid: int) -> Optional[int]:
+        """Newest self-reported engine generation (None before any
+        heartbeat)."""
+        return self._generation[rid]
+
+    # --------------------------------------------------------- transitions
+
+    def _to(self, rid: int, state: LeaseState, ts: float, reason: str) -> None:
+        cur = self._state[rid]
+        if state is cur:
+            return
+        self._state[rid] = state
+        self.history.append((rid, cur, state, ts, reason))
+        logger.info(f"fleet lease: replica {rid} {cur.value} -> {state.value} "
+                    f"({reason})")
+
+    def _emit(self, name: str, value: float) -> None:
+        if self._emit_cb is not None:
+            self._emit_cb(name, value)
+
+    # ------------------------------------------------------------- signals
+
+    def observe_heartbeat(self, rid: int, seq: int, state: str, stats: dict,
+                          sent_ts: float, now: float,
+                          generation: Optional[int] = None) -> str:
+        """Fold one delivered heartbeat.  Returns what the router must do:
+
+        * ``"ok"``         — lease renewed (SUSPECT heals back to ALIVE);
+        * ``"stale"``      — an old/duplicate heartbeat (seq not newer):
+          lease extended no further than its send time, view unchanged;
+        * ``"zombie"``     — the heartbeat came from a replica the router
+          declared fleet-dead: it must be FENCED before it may rejoin
+          (``"zombie"`` is returned again for every further heartbeat
+          until the fence acks — the router's retry timer, not this
+          return value, paces the resends).
+        """
+        if seq <= self._last_seq[rid]:
+            return "stale"
+        self._last_seq[rid] = seq
+        if generation is not None:
+            self._generation[rid] = generation
+        cur = self._state[rid]
+        if cur in (LeaseState.DEAD, LeaseState.FENCING):
+            # a fleet-dead replica is heartbeating again: either the
+            # partition healed (zombie — its fenced work must be cancelled)
+            # or a replacement engine attached (nothing to cancel; the
+            # fence is a cheap no-op).  Either way it rejoins via the ack.
+            if cur is LeaseState.DEAD:
+                self._to(rid, LeaseState.FENCING, now, "heartbeat from the fleet-dead")
+            # keep the freshest report visible for the eventual rejoin
+            self._reported[rid] = state
+            self._stats[rid] = stats
+            self._stats_ts[rid] = now
+            return "zombie"
+        # the lease is measured from the heartbeat's SEND time: a delayed
+        # heartbeat proves the replica was alive when it SENT, nothing more
+        self._last_hb[rid] = max(self._last_hb[rid], sent_ts)
+        self._reported[rid] = state
+        self._stats[rid] = stats
+        self._stats_ts[rid] = now
+        if cur is LeaseState.SUSPECT:
+            self._to(rid, LeaseState.ALIVE, now, "heartbeat resumed")
+            self._emit("fleet/lease_renewed", float(rid))
+        return "ok"
+
+    def tick(self, now: float) -> List[int]:
+        """Advance the lease clocks: ALIVE -> SUSPECT at ``suspect_after``
+        of silence, SUSPECT -> DEAD at ``lease``.  Returns the rids whose
+        lease EXPIRED this tick — the router must re-dispatch their work
+        (``Router.on_lease_expired``).  Epochs bump here: every dispatch
+        made before this instant is fenced."""
+        expired = []
+        for rid in sorted(self._state):
+            cur = self._state[rid]
+            if cur not in (LeaseState.ALIVE, LeaseState.SUSPECT):
+                continue
+            silence = now - self._last_hb[rid]
+            if silence >= self.config.lease:
+                self._to(rid, LeaseState.DEAD, now,
+                         f"lease expired ({silence:.3f}s of silence)")
+                self.epoch[rid] += 1
+                self._fence_sent_ts[rid] = None
+                self._emit("fleet/lease_expired", float(rid))
+                expired.append(rid)
+            elif cur is LeaseState.ALIVE and silence >= self.config.suspect_after:
+                self._to(rid, LeaseState.SUSPECT, now,
+                         f"lease expiring ({silence:.3f}s of silence)")
+                self._emit("fleet/lease_suspect", float(rid))
+        return expired
+
+    def declare_dead(self, rid: int, now: float,
+                     reason: str = "router-observed death") -> None:
+        """Direct death evidence — a device loss surfaced through a
+        SYNCHRONOUS dispatch/staging RPC the router itself made — is as
+        conclusive as a lease expiry and is recorded immediately, so the
+        lease sweep does not declare (and double-account) the same death
+        again when the silence catches up."""
+        if self._state[rid] in (LeaseState.ALIVE, LeaseState.SUSPECT):
+            self._to(rid, LeaseState.DEAD, now, reason)
+            self.epoch[rid] += 1
+            self._fence_sent_ts[rid] = None
+
+    # -------------------------------------------------------------- fencing
+
+    def fence_pending(self, now: float) -> List[int]:
+        """Rids in FENCING whose fence must be (re)sent now — never sent,
+        or the last send aged past ``fence_retry`` unacked (the fence/ack
+        pair crosses the same lossy fabric as everything else)."""
+        out = []
+        for rid in sorted(self._state):
+            if self._state[rid] is not LeaseState.FENCING:
+                continue
+            sent = self._fence_sent_ts[rid]
+            if sent is None or now - sent >= self.config.fence_retry:
+                out.append(rid)
+        return out
+
+    def note_fence_sent(self, rid: int, now: float) -> bool:
+        """Record a fence send; returns True when it was the FIRST send of
+        this fencing episode (the caller counts/emits once per episode)."""
+        first = self._fence_sent_ts[rid] is None
+        self._fence_sent_ts[rid] = now
+        return first
+
+    def on_fence_ack(self, rid: int, epoch: int, now: float) -> bool:
+        """A replica acknowledged the fence for ``epoch``.  Stale-epoch
+        acks (a reordered ack from a previous episode) are ignored.
+        Returns True when the replica rejoined the fleet (ALIVE, lease
+        re-granted from now)."""
+        if self._state[rid] is not LeaseState.FENCING or epoch != self.epoch[rid]:
+            return False
+        self._last_hb[rid] = now
+        self._fence_sent_ts[rid] = None
+        self._to(rid, LeaseState.ALIVE, now, f"fence acked (epoch {epoch})")
+        self._emit("fleet/lease_renewed", float(rid))
+        return True
+
+    # ------------------------------------------------------------- schedule
+
+    def deadlines(self, now: float) -> List[float]:
+        """Future instants at which this view can change by itself —
+        suspect/expiry boundaries and fence-retry timers; the simulator's
+        idle-jump input (a quiet fleet must still wake to expire a
+        lease)."""
+        out = []
+        for rid, cur in self._state.items():
+            if cur is LeaseState.ALIVE:
+                out.append(self._last_hb[rid] + self.config.suspect_after)
+                out.append(self._last_hb[rid] + self.config.lease)
+            elif cur is LeaseState.SUSPECT:
+                out.append(self._last_hb[rid] + self.config.lease)
+            elif cur is LeaseState.FENCING:
+                sent = self._fence_sent_ts[rid]
+                out.append(now if sent is None
+                           else sent + self.config.fence_retry)
+        return [t for t in out if t > now]
+
+    def summary(self) -> dict:
+        return {
+            "states": {r: s.value for r, s in sorted(self._state.items())},
+            "epochs": dict(sorted(self.epoch.items())),
+            "transitions": len(self.history),
+        }
+
+
 class ReplicaState(enum.Enum):
     HEALTHY = "healthy"
     DEGRADED = "degraded"     # serving, but deprioritized for new dispatch

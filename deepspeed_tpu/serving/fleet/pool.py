@@ -59,7 +59,8 @@ class ReplicaPool:
                  health_config: HealthConfig = None, tracer=None, metrics=None,
                  roles: Optional[Sequence[Union[str, ReplicaRole]]] = None,
                  role_factories: Optional[Dict] = None,
-                 prefix_directory=None):
+                 prefix_directory=None, transport=None,
+                 hb_interval: float = 0.5):
         assert n_replicas >= 1, n_replicas
         if roles is not None and len(roles) != n_replicas:
             raise ValueError(f"roles ({len(roles)}) must cover every replica "
@@ -94,6 +95,32 @@ class ReplicaPool:
         if prefix_directory is not None and metrics is not None \
                 and prefix_directory.metrics is None:
             prefix_directory.metrics = metrics
+        # control-plane transport (docs/SERVING.md "Control-plane
+        # transport"): when attached, the replica-side control flows stop
+        # being perfect in-process calls — each tick sends a
+        # sequence-numbered HEARTBEAT (local health state + load_stats)
+        # and prefix-cache publishes become a per-replica seq-numbered
+        # DIR_PUBLISH stream the router gap-detects; None keeps every
+        # pre-r16 direct path byte-for-byte unchanged
+        self.transport = transport
+        #: heartbeats are TIME-paced, not round-paced: at most one per
+        #: replica per ``hb_interval`` of clock time (a real lease protocol
+        #: beats on a timer, and a round-paced beat would flood the fabric
+        #: on zero-advance simulator rounds).  Must sit well under the
+        #: router's ``LeaseConfig.suspect_after`` or the fleet suspects
+        #: itself between beats.
+        self.hb_interval = float(hb_interval)
+        self._hb_last: Dict[int, Optional[float]] = {r: None for r in range(n_replicas)}
+        #: per-replica heartbeat / directory-publish sequence counters —
+        #: POOL-level so they survive engine swaps (a recovered replica's
+        #: stream continues, it does not restart at 1 and look reordered)
+        self._hb_seq: Dict[int, int] = {r: 0 for r in range(n_replicas)}
+        self._dir_seq: Dict[int, int] = {r: 0 for r in range(n_replicas)}
+        #: highest fencing epoch each replica has EXECUTED — fences are
+        #: idempotent per epoch, so a duplicated/retried FENCE delivered
+        #: after the replica rejoined re-acks without cancelling the
+        #: legitimately re-dispatched post-rejoin work
+        self._fenced_epoch: Dict[int, int] = {r: 0 for r in range(n_replicas)}
         self.clock = clock if clock is not None else VirtualClock()
         self._virtual = isinstance(self.clock, VirtualClock)
         self.replicas: Dict[int, Replica] = {}
@@ -129,10 +156,22 @@ class ReplicaPool:
         ``prefix.publish`` site drops THIS update (the directory goes
         stale — cold or warm — which the routing staleness ladder absorbs:
         a mis-routed dispatch recomputes, never corrupts); ``InjectedCrash``
-        is driver death and propagates."""
+        is driver death and propagates.
+
+        With a control transport attached the publish stops being a direct
+        table write: it becomes a sequence-numbered ``dir_publish`` message
+        on this replica's stream, and the ROUTER applies it on delivery —
+        a dropped message now leaves a detectable seq GAP (the router pulls
+        a full-digest resync) instead of being silently absorbed."""
         directory = self.prefix_directory
 
         def on_event(event: str, digest: int) -> None:
+            if self.transport is not None:
+                self._dir_seq[rid] += 1
+                self.transport.send("dir_publish", rid, "router",
+                                    {"op": event, "digest": digest},
+                                    seq=self._dir_seq[rid])
+                return
             try:
                 if event == "publish":
                     directory.publish(rid, digest)
@@ -144,6 +183,66 @@ class ReplicaPool:
                 logger.warning(f"fleet: prefix directory {event} dropped for "
                                f"replica {rid}: {e}")
         return on_event
+
+    # ------------------------------------------------------- control plane
+
+    def _send_heartbeat(self, rid: int) -> None:
+        """One lease renewal: the replica's local health state plus its
+        current ``load_stats()`` snapshot — the router's ONLY evidence of
+        this replica under the transport (docs/SERVING.md "Control-plane
+        transport").  No-op without a transport (perfect observation)."""
+        if self.transport is None:
+            return
+        rep = self.replicas[rid]
+        if rep.serve is None:
+            return
+        now = self.clock.now()
+        last = self._hb_last[rid]
+        if last is not None and now - last < self.hb_interval:
+            return
+        self._hb_last[rid] = now
+        self._hb_seq[rid] += 1
+        self.transport.send(
+            "heartbeat", rid, "router",
+            {"state": self.health.state(rid).value,
+             "stats": rep.serve.load_stats(),
+             "generation": rep.generation},
+            seq=self._hb_seq[rid])
+
+    def dir_snapshot(self, rid: int) -> Optional[dict]:
+        """Full-digest resync snapshot of this replica's prefix cache plus
+        the publish-stream BARRIER (the last seq folded into the snapshot)
+        — the router's gap repair: everything at/below the barrier is IN
+        the snapshot, buffered stream entries above it apply after.
+        None when the replica has no engine (a resync request raced its
+        death; the router's retry finds the replacement)."""
+        rep = self.replicas[rid]
+        if rep.serve is None:
+            return None
+        pc = rep.serve.engine.kv.prefix_cache
+        digests = pc.held_digests() if pc is not None else []
+        return {"digests": digests, "barrier": self._dir_seq[rid]}
+
+    def fence_replica(self, rid: int, epoch: int = 0) -> Dict[str, int]:
+        """Execute a FENCE on this replica: cancel every in-flight request
+        its frontend still holds (a zombie that outlived its lease keeps
+        decoding work the router has already re-dispatched elsewhere —
+        that work, and any late completion of it, must be discarded, never
+        double-served).  Returns the frontend's cancel counts; a fresh
+        engine (legit recovery, nothing to cancel) fences to zeros.
+
+        Idempotent per ``epoch``: the fence/ack pair crosses the same
+        lossy fabric as everything else, so a duplicated or retried FENCE
+        can arrive AFTER the ack re-admitted the replica and the router
+        re-dispatched new work to it — an already-executed epoch re-acks
+        with zeros instead of cancelling that legitimate work."""
+        if epoch <= self._fenced_epoch[rid]:
+            return {"queued": 0, "active": 0}
+        self._fenced_epoch[rid] = epoch
+        rep = self.replicas[rid]
+        if rep.serve is None:
+            return {"queued": 0, "active": 0}
+        return rep.serve.fence()
 
     def _emit(self, name: str, value: float) -> None:
         if self.monitor is None or not getattr(self.monitor, "enabled", True):
@@ -258,6 +357,11 @@ class ReplicaPool:
             logger.warning(f"fleet: replica {rid} tick failed ({e}); now {state.value}")
             if state is ReplicaState.DEAD:
                 return {}, self.kill(rid, reason=f"tick failure: {e}")
+            # still alive (merely degraded): the replica process keeps
+            # heartbeating — transient tick errors are replica-local news
+            # the router learns via the reported state, not via silence
+            self._send_heartbeat(rid)
             return {}, []
         self.health.record_success(rid)
+        self._send_heartbeat(rid)
         return out, []

@@ -40,7 +40,7 @@ ladder) or crash the driver mid-publish.
 """
 
 from collections import OrderedDict
-from typing import Dict, Iterable, Tuple
+from typing import Dict, Iterable, List, Tuple
 
 from ...inference.v2.ragged import iter_prefix_chain_hashes
 from ...resilience import fault_injection as _fi
@@ -154,6 +154,25 @@ class PrefixDirectory:
                 depth[rid] = k + 1
                 self._lru.move_to_end((rid, digest))
         return depth
+
+    def hottest(self, k: int) -> List[Tuple[int, List[int]]]:
+        """The ``k`` most-recently-used digests (newest LRU end first),
+        each with the sorted rids holding it — the directory-driven
+        autoscale warm-up input: a RECOVERING replica pre-imports these
+        chains' KV from a live donor so it joins the fleet warm instead of
+        eating a cold-start recompute on its first dispatches.  Digests
+        are deduplicated across replicas (one import warms the chain
+        fleet-wide for the target)."""
+        out: List[Tuple[int, List[int]]] = []
+        seen = set()
+        for rid, digest in reversed(self._lru):
+            if digest in seen:
+                continue
+            seen.add(digest)
+            out.append((digest, sorted(self._holders.get(digest, ()))))
+            if len(out) >= k:
+                break
+        return out
 
     # ------------------------------------------------------------- surface
 

@@ -40,7 +40,7 @@ from ...utils.logging import logger
 from ..metrics import percentile_summary
 from ..request import RequestState, ServingRequest
 from ..kvtransfer import SnapshotAborted
-from .health import ReplicaState
+from .health import FleetHealthView, LeaseConfig, LeaseState, ReplicaState
 from .policies import RoutingPolicy
 from .pool import ReplicaPool, ReplicaRole
 from .tenancy import TenantRegistry, order_key as _tenant_order_key
@@ -133,6 +133,24 @@ class FleetRequest:
 TENANT_FAULT_RETRY_S = 1.0
 
 
+@dataclasses.dataclass
+class _DirFeed:
+    """Router-side receiver state for ONE replica's sequence-numbered
+    prefix-publish stream (docs/SERVING.md "Control-plane transport").
+
+    In-order messages apply immediately; out-of-order ones buffer inside a
+    small reorder window; a gap that outlives the window (or the timeout)
+    is a LOST publish — detected, not absorbed: ``prefix/publish_gap``
+    fires and the router pulls a targeted full-digest resync from the
+    replica.  ``expect = None`` means the stream is broken (gap declared,
+    or the replica's lease expired) and every delivery is dropped until a
+    resync snapshot re-anchors the sequence at its barrier."""
+    expect: Optional[int] = 1          # next seq to apply; None = broken
+    buffer: Dict[int, Tuple[str, int]] = dataclasses.field(default_factory=dict)
+    gap_since: Optional[float] = None
+    resync_since: Optional[float] = None   # outstanding resync request ts
+
+
 class Router:
     """Cache-affinity, health-aware request router over a ReplicaPool."""
 
@@ -141,10 +159,56 @@ class Router:
                  migration_chunk_cost: float = 0.0,
                  prefill_handoff: bool = False,
                  tenants: Optional[TenantRegistry] = None,
-                 overload=None, prefix_import_cost: float = 0.0):
+                 overload=None, prefix_import_cost: float = 0.0,
+                 transport=None, lease_config: Optional[LeaseConfig] = None,
+                 warmup_chains: int = 4):
         self.pool = pool
         self.policy = policy
         self.monitor = monitor
+        # control-plane transport (docs/SERVING.md "Control-plane
+        # transport"): with one attached, the router stops observing
+        # replicas perfectly — health is heartbeat leases with fencing,
+        # load_stats are last-known-good + age, prefix publishes are a
+        # seq-numbered feed with gap-resync, migration chunks flow
+        # ack/retry.  None (default) keeps every pre-r16 direct path.
+        self.transport = transport
+        if pool.transport is not transport:
+            # BOTH directions are misconfigurations: a router-only
+            # transport would read a fabric nobody heartbeats into, and a
+            # pool-only one would send every heartbeat/publish into a
+            # fabric nobody drains — silent 100% cold routing plus an
+            # unboundedly growing in-flight queue, not an error anyone
+            # would see
+            raise ValueError(
+                "the Router's ControlTransport must be the ReplicaPool's: "
+                "pass the SAME transport= to both ReplicaPool(...) and "
+                "Router(...) (or to neither) so replicas heartbeat and "
+                "publish into the fabric the router reads")
+        self.lease: Optional[FleetHealthView] = None
+        #: fid -> assembling router-side migration snapshot
+        #: {"next": chunk idx expected, "snap": KVSnapshot}
+        self._mig_rx: Dict[int, dict] = {}
+        #: rid -> [(fid, displaced ServingRequest)] at the last lease
+        #: expiry — audited at fence time for late (fenced) completions
+        self._lease_displaced: Dict[int, list] = {}
+        self._dir_feeds: Dict[int, _DirFeed] = {}
+        #: out-of-order publishes buffered before a gap is declared lost
+        self.dir_reorder_window = 4
+        #: clock time a seq gap may wait for the missing message before the
+        #: router declares it lost and pulls a resync
+        self.dir_gap_timeout = 2.0
+        #: clock time before an unanswered resync request is re-sent
+        self.dir_resync_retry = 4.0
+        #: min clock time between retransmits of an unacked migration chunk
+        self.mig_retry = 1.0
+        #: hottest directory chains pre-imported onto a recovering replica
+        self.warmup_chains = int(warmup_chains)
+        if transport is not None:
+            self.lease = FleetHealthView(
+                pool.rids, config=lease_config, clock=pool.clock,
+                emit=lambda name, value: self._emit(
+                    [(name, value, self._next_event_step())]))
+            self._dir_feeds = {rid: _DirFeed() for rid in pool.rids}
         # fleet prefix directory (docs/SERVING.md "Prefix directory"): a
         # directory-routing policy carries the directory it reads; the
         # POOL must carry the same one, or no replica would ever publish
@@ -239,6 +303,11 @@ class Router:
             "prefix_imports_noop": 0,
             "shed": 0, "brownout_capped": 0, "tenant_admission_faults": 0,
             "tenant_deferrals": 0,
+            "lease_expirations": 0, "fenced_replicas": 0,
+            "fenced_completions": 0, "fenced_requests": 0,
+            "publish_gaps": 0, "dir_resyncs": 0,
+            "warmup_imports": 0, "warmup_fallbacks": 0,
+            "partition_dispatch_skips": 0,
         }
         self.recovery_times: List[float] = []
 
@@ -339,6 +408,24 @@ class Router:
     # ------------------------------------------------------------ dispatch
 
     def _candidates(self):
+        if self.transport is not None:
+            # partition-tolerant view: dispatchability comes from the
+            # heartbeat LEASE (a replica the router cannot hear from takes
+            # no new work), and the load snapshot is LAST-KNOWN-GOOD from
+            # its newest heartbeat, annotated with its age — stale routing
+            # signals place work suboptimally (slower), never wrongly
+            out = []
+            for rid in self.pool.rids:
+                if not self.lease.dispatchable(rid):
+                    continue
+                rep = self.pool.replica(rid)
+                if rep.serve is None:
+                    continue
+                stats, age = self.lease.stats(rid)
+                if stats is None:
+                    continue   # never heard from it: nothing to go on yet
+                out.append((rid, rep, {**stats, "age": round(age, 9)}))
+            return out
         out = []
         for rid in self.pool.rids:
             if not self.pool.health.dispatchable(rid):
@@ -436,8 +523,20 @@ class Router:
                 placed += 1
                 outstanding_by_tenant[fr.tenant] = \
                     outstanding_by_tenant.get(fr.tenant, 0) + 1
-                candidates = [(r, rp, rp.serve.load_stats() if r == rid else st)
-                              for r, rp, st in candidates]
+                if self.transport is None:
+                    candidates = [(r, rp, rp.serve.load_stats() if r == rid else st)
+                                  for r, rp, st in candidates]
+                else:
+                    # no fresh probe exists under the transport: fold the
+                    # router's OWN dispatch into its stale estimate (the
+                    # one state change it knows about without a heartbeat)
+                    candidates = [
+                        (r, rp,
+                         {**st, "queue_depth": st["queue_depth"] + 1,
+                          "outstanding_tokens": st["outstanding_tokens"]
+                          + max(0, fr.max_new_tokens - len(fr.tokens))}
+                         if r == rid else st)
+                        for r, rp, st in candidates]
         return placed
 
     def _dispatch_to(self, fr: FleetRequest, rid: int, info: dict, now: float) -> bool:
@@ -448,6 +547,15 @@ class Router:
             self._pending.remove(fr)
             fr.finish_ts = fr.finish_ts if fr.finish_ts is not None else now
             self._finish(fr, FleetState.DONE, now)
+            return False
+        if self.transport is not None and \
+                not self.transport.connected("router", rid, now):
+            # the dispatch RPC vanished into a partition the lease has not
+            # yet diagnosed: to the router it is a timeout — the request
+            # stays pending and the replica goes SUSPECT before this can
+            # loop for long (the same shape as a transient dispatch fault)
+            self.stats["partition_dispatch_skips"] += 1
+            self.stats["dispatch_faults"] += 1
             return False
         att = None
         if fr.trace is not None:
@@ -467,7 +575,12 @@ class Router:
         sr = rep.serve.submit(
             fr.prompt, max_new_tokens=fr.max_new_tokens, deadline=fr.deadline,
             arrival_ts=fr.arrival_ts, priority=fr.priority,
-            stream=self._make_stream(fr, rep.generation),
+            # under the transport, token deliveries are observed by POLL
+            # re-sync (sequence = len(tokens)) instead of push callbacks —
+            # a stream delivered into a partition would either vanish or
+            # double; the re-sync is idempotent by construction
+            stream=None if self.transport is not None
+            else self._make_stream(fr, rep.generation),
             resume_tokens=list(fr.tokens) or None,
             trace_id=fr.trace["trace_id"] if fr.trace is not None else None,
             parent_span_id=att["span_id"] if att is not None else None,
@@ -633,6 +746,13 @@ class Router:
             self._start_migrations(now)
         for fr in list(self._dispatched.values()):
             rid, sr, _gen = fr._current
+            if self.transport is not None:
+                if not self.transport.connected(rid, "router", now):
+                    # partitioned: the router cannot observe this attempt —
+                    # its tokens and terminal state wait for the heal (or
+                    # for the lease to expire and re-home the request)
+                    continue
+                self._sync_tokens(fr, sr, now)
             if sr.state is RequestState.DONE:
                 del self._dispatched[fr.fid]
                 fr._current = None
@@ -649,6 +769,469 @@ class Router:
                 t_out = sr.history[-1][1]
                 self._close_attempt(fr, "timed_out", t_out)
                 self._finish(fr, FleetState.TIMED_OUT, t_out)
+
+    # ------------------------------------------------------- control plane
+
+    def _sync_tokens(self, fr: FleetRequest, sr: ServingRequest,
+                     now: float) -> None:
+        """Sequence-numbered token sync: ``sr.tokens`` always EXTENDS the
+        resume seed the router dispatched with, so ``len(tokens)`` is the
+        stream's sequence number and catch-up after a healed partition is
+        one idempotent list copy — no delivery can be lost or applied
+        twice.  First-token time is the router's OBSERVATION instant (the
+        client cannot see a token before the router does)."""
+        toks = sr.tokens
+        have = len(fr.tokens)
+        if len(toks) > have:
+            if fr.first_token_ts is None:
+                fr.first_token_ts = now
+            # append only the unseen suffix: a full-list rebuild per poll
+            # round would be O(T^2) over a T-token generation
+            fr.tokens.extend(int(t) for t in toks[have:])
+
+    def transport_poll(self, now: Optional[float] = None) -> None:
+        """One control-plane round: drain due message deliveries, sweep the
+        heartbeat leases (expiry re-homes work and bumps fencing epochs),
+        (re)send unacked fences, and fire gap-timeout directory resyncs.
+        The fleet driver calls this once per round, before dispatch; no-op
+        without a transport."""
+        if self.transport is None:
+            return
+        now = self.clock.now() if now is None else now
+        for msg in self.transport.deliver(now):
+            self._on_message(msg, now)
+        # generation fencing: a replica that died and came back INSIDE its
+        # lease window renews the lease, but its heartbeat's bumped engine
+        # generation betrays the restart — attempts dispatched to the old
+        # generation died with it and must re-home now, not at an expiry
+        # that will never come
+        for fr in list(self._dispatched.values()):
+            if fr._current is None:
+                continue
+            rid, _sr, gen = fr._current
+            g = self.lease.generation(rid)
+            if g is not None and g > gen:
+                self._requeue_attempt(fr, now, "replica_restarted")
+                self._emit([("fleet/failover_requeued", 1.0,
+                             self._next_event_step())])
+        for rid in self.lease.tick(now):
+            self.on_lease_expired(rid, now)
+        for rid in self.lease.fence_pending(now):
+            self._send_fence(rid, now)
+        for rid, feed in self._dir_feeds.items():
+            self._check_dir_feed(rid, feed, now)
+
+    def _on_message(self, msg, now: float) -> None:
+        """Route one delivered message to its handler.  ``dst == "router"``
+        is the router's inbox; an integer dst is a replica's (the replicas
+        are in-process, so their inbox handling lives here too)."""
+        kind, p = msg.kind, msg.payload
+        if msg.dst == "router":
+            if kind == "heartbeat":
+                # a "zombie" verdict flips the view to FENCING; the fence
+                # itself goes out in this same transport_poll round via the
+                # fence_pending sweep (and retries on its timer)
+                self.lease.observe_heartbeat(
+                    msg.src, msg.seq, p["state"], p["stats"], msg.send_ts, now,
+                    generation=p.get("generation"))
+            elif kind == "dir_publish":
+                self._on_dir_publish(msg.src, msg.seq, p, now)
+            elif kind == "dir_resync":
+                self._on_dir_resync(msg.src, p, now)
+            elif kind == "fence_ack":
+                self._on_fence_ack(msg.src, p, now)
+            elif kind == "mig_chunk":
+                self._on_mig_chunk(msg.src, p, now)
+            return
+        rid = msg.dst
+        if kind == "fence":
+            # replica-side fence execution: cancel ALL in-flight work (every
+            # dispatch on this replica predates the epoch bump) and ack.
+            # Idempotent per epoch — a duplicated/late fence copy delivered
+            # after the rejoin must NOT cancel re-dispatched work.
+            counts = self.pool.fence_replica(rid, epoch=p["epoch"])
+            n = counts["queued"] + counts["active"]
+            if n:
+                self.stats["fenced_requests"] += n
+                self._emit([("fleet/fenced_request", float(n),
+                             self._next_event_step())])
+            self.transport.send("fence_ack", rid, "router",
+                                {"epoch": p["epoch"], **counts})
+        elif kind == "dir_resync_req":
+            snap = self.pool.dir_snapshot(rid)
+            if snap is not None:   # dead replicas answer nothing; retry finds
+                self.transport.send("dir_resync", rid, "router", snap)
+        elif kind == "mig_ack":
+            m = self._migrations.get(p["fid"])
+            if m is not None and m["rid"] == rid:
+                ch = m["chan"]
+                if p["next"] > ch["base"]:
+                    ch["base"] = p["next"]
+                    ch["sent_idx"], ch["sent_ts"] = None, None
+
+    # -------------------------------------------------- lease expiry + fence
+
+    def on_lease_expired(self, rid: int, now: float) -> None:
+        """Fleet-declared death of ``rid``: the router has not heard a
+        heartbeat for a full lease window.  Unlike :meth:`on_replica_dead`
+        this does NOT touch the replica's engine — the replica may be a
+        perfectly healthy zombie on the far side of a partition.  Its
+        in-flight fleet requests are re-homed (tokens preserved up to the
+        last connected sync; recompute-on-resume keeps outputs
+        byte-identical), its dispatch epoch was bumped by the lease sweep
+        (fencing every outstanding attempt), and its directory entries and
+        publish feed are invalidated pending a post-rejoin resync."""
+        self.stats["lease_expirations"] += 1
+        displaced = []
+        victims = []
+        for fr in list(self._dispatched.values()):
+            if fr._current is None or fr._current[0] != rid:
+                continue
+            sr = self._requeue_attempt(fr, now, "lease_expired")
+            displaced.append((fr.fid, sr))
+            victims.append(fr)
+        #: audited when the fence completes: any of these that reached DONE
+        #: on the zombie is a LATE COMPLETION the fencing discarded
+        self._lease_displaced[rid] = displaced
+        # surviving export records anchored on the lease-dead source are
+        # unpumpable (and its chunks unackable) — drop them
+        for fid in [f for f, m in self._migrations.items() if m["rid"] == rid]:
+            self._migrations.pop(fid)
+            self._mig_rx.pop(fid, None)
+        if self.directory is not None:
+            self.directory.purge(rid)
+        feed = self._dir_feeds.get(rid)
+        if feed is not None:
+            feed.expect = None
+            feed.buffer.clear()
+            feed.gap_since = feed.resync_since = None
+        record = {"rid": rid, "ts": now, "reason": "lease expired",
+                  "victims": {fr.fid for fr in victims},
+                  "n_victims": len(victims), "recovered_ts": None}
+        if not victims:
+            record["recovered_ts"] = now
+            self.recovery_times.append(0.0)
+        self.kill_records.append(record)
+        self._emit([("fleet/failover_requeued", float(len(victims)),
+                     self._next_event_step())])
+
+    def _requeue_attempt(self, fr: FleetRequest, now: float,
+                         outcome: str) -> ServingRequest:
+        """Displace one DISPATCHED attempt back to PENDING (lease expiry or
+        an in-lease restart): tokens preserved up to the last connected
+        sync, a COMPLETE router-side migration snapshot harvested for the
+        KV-import fast path, the attempt span closed WITHOUT folding
+        replica-side phase history (the router cannot observe it).
+        Returns the displaced ServingRequest for the fencing audit."""
+        del self._dispatched[fr.fid]
+        sr = fr._current[1]
+        fr._current = None
+        self._migrations.pop(fr.fid, None)
+        rx = self._mig_rx.pop(fr.fid, None)
+        if rx is not None and rx["snap"].complete and fr._kv_snapshot is None:
+            fr._kv_snapshot = rx["snap"]
+            self.stats["migration_failover_reuse"] += 1
+        fr.failovers += 1
+        self._taccount(fr.tenant)["failovers"] += 1
+        fr.state = FleetState.PENDING
+        fr.history.append((FleetState.PENDING, now))
+        self._close_attempt(fr, outcome, now)
+        if fr.trace is not None and fr.trace["attempts"]:
+            fr.trace["last_dead"] = fr.trace["attempts"][-1]["span_id"]
+        self._pending.append(fr)
+        self.stats["failovers"] += 1
+        return sr
+
+    def _send_fence(self, rid: int, now: float) -> None:
+        epoch = self.lease.epoch[rid]
+        first = self.lease.note_fence_sent(rid, now)
+        if first:
+            self.stats["fenced_replicas"] += 1
+            self._emit([("fleet/fenced_replica", float(rid),
+                         self._next_event_step())])
+        else:
+            self.transport.note_retransmit()
+        self.transport.send("fence", "router", rid, {"epoch": epoch})
+
+    def _on_fence_ack(self, rid: int, p: dict, now: float) -> None:
+        if not self.lease.on_fence_ack(rid, p["epoch"], now):
+            return   # stale/duplicate ack from an earlier episode
+        # the late-completion audit: displaced attempts that reached DONE
+        # on the zombie are exactly the completions fencing discarded —
+        # each is an auditable event, never a second serve
+        late = [fid for fid, sr in self._lease_displaced.pop(rid, [])
+                if sr.state is RequestState.DONE]
+        if late:
+            self.stats["fenced_completions"] += len(late)
+            self._emit([("fleet/fenced_completion", float(len(late)),
+                         self._next_event_step())])
+            logger.warning(f"fleet: discarded {len(late)} fenced late "
+                           f"completion(s) from replica {rid}: fids {late}")
+        # the zombie's cache may still be warm, but the router purged its
+        # entries at expiry: pull a fresh full-digest snapshot
+        self._request_dir_resync(rid, now)
+
+    # --------------------------------------------- directory feed + resync
+
+    def _dir_apply(self, rid: int, op: str, digest: int) -> None:
+        try:
+            if op == "publish":
+                self.directory.publish(rid, digest)
+            else:
+                self.directory.retract(rid, digest)
+        except _fi.InjectedCrash:
+            raise  # simulated death of THIS driver process
+        except OSError as e:
+            # a transient table-write fault drops THIS update (stale —
+            # absorbed by the routing staleness ladder, never wrong)
+            logger.warning(f"fleet: prefix directory {op} dropped for "
+                           f"replica {rid}: {e}")
+
+    def _on_dir_publish(self, rid: int, seq: int, p: dict, now: float) -> None:
+        if self.directory is None:
+            return
+        feed = self._dir_feeds[rid]
+        if feed.expect is None:
+            return   # stream broken: awaiting resync, deliveries dropped
+        if seq < feed.expect:
+            return   # duplicate of an already-applied message
+        if seq > feed.expect:
+            feed.buffer[seq] = (p["op"], p["digest"])
+            if feed.gap_since is None:
+                feed.gap_since = now
+            return
+        self._dir_apply(rid, p["op"], p["digest"])
+        feed.expect += 1
+        while feed.expect in feed.buffer:
+            op, digest = feed.buffer.pop(feed.expect)
+            self._dir_apply(rid, op, digest)
+            feed.expect += 1
+        # a drain that exposes a FURTHER gap (buffer still non-empty)
+        # restarts that gap's clock: it just formed, and inheriting the
+        # old stamp would declare it lost dir_gap_timeout too early
+        feed.gap_since = now if feed.buffer else None
+
+    def _check_dir_feed(self, rid: int, feed: _DirFeed, now: float) -> None:
+        """Declare a lost publish (gap outlived the reorder window or its
+        timeout) and drive the resync request/retry timers."""
+        if self.directory is None:
+            return
+        if feed.resync_since is not None:
+            if now - feed.resync_since >= self.dir_resync_retry:
+                self.transport.note_retransmit()
+                self._request_dir_resync(rid, now)
+            return
+        if feed.expect is None:
+            # broken stream with no outstanding request (the resync send
+            # itself was eaten, or the break predates the rejoin)
+            if self.lease.state(rid) is LeaseState.ALIVE:
+                self._request_dir_resync(rid, now)
+            return
+        if feed.gap_since is None:
+            return
+        if len(feed.buffer) >= self.dir_reorder_window or \
+                now - feed.gap_since >= self.dir_gap_timeout:
+            # the missing publish is LOST, not late: detected, not absorbed
+            self.stats["publish_gaps"] += 1
+            self._emit([("prefix/publish_gap", float(rid),
+                         self._next_event_step())])
+            logger.warning(f"fleet: publish gap on replica {rid}'s prefix "
+                           f"stream at seq {feed.expect} — pulling resync")
+            feed.expect = None
+            feed.buffer.clear()
+            feed.gap_since = None
+            self._request_dir_resync(rid, now)
+
+    def _request_dir_resync(self, rid: int, now: float) -> None:
+        if self.directory is None:
+            return
+        feed = self._dir_feeds[rid]
+        feed.resync_since = now
+        self.transport.send("dir_resync_req", "router", rid, {})
+
+    def _on_dir_resync(self, rid: int, p: dict, now: float) -> None:
+        if self.directory is None:
+            return
+        feed = self._dir_feeds[rid]
+        if feed.resync_since is None or \
+                (feed.expect is not None and p["barrier"] + 1 < feed.expect):
+            # a duplicated (or badly reordered) resync reply: the first
+            # copy already applied and the feed has moved on — applying
+            # this one would purge live state, resurrect retracted digests
+            # as ghost holders, and REWIND the sequence past messages
+            # already consumed
+            return
+        feed.resync_since = None
+        # the snapshot REPLACES this replica's view wholesale and
+        # re-anchors the stream at its barrier; buffered ops past the
+        # barrier (published while the snapshot traveled) apply on top
+        self.directory.purge(rid)
+        for digest in p["digests"]:
+            self._dir_apply(rid, "publish", digest)
+        feed.expect = p["barrier"] + 1
+        feed.buffer = {s: v for s, v in feed.buffer.items() if s >= feed.expect}
+        feed.gap_since = now if feed.buffer else None
+        while feed.expect in feed.buffer:
+            op, digest = feed.buffer.pop(feed.expect)
+            self._dir_apply(rid, op, digest)
+            feed.expect += 1
+        if not feed.buffer:
+            feed.gap_since = None
+        self.stats["dir_resyncs"] += 1
+        self._emit([("prefix/resync", float(rid), self._next_event_step())])
+
+    # ----------------------------------------------------- migration chunks
+
+    def _on_mig_chunk(self, rid: int, p: dict, now: float) -> None:
+        """Idempotent chunk import on the router-side assembly: only the
+        exactly-expected index appends (duplicates and reordered copies
+        re-ack without touching the snapshot), so loss costs retransmits,
+        never torn or double-applied chunks."""
+        fid = p["fid"]
+        rx = self._mig_rx.get(fid)
+        if rx is None:
+            return   # migration gone (fallback/lease harvest): no ack —
+            # the source's exporter record died with it
+        if p["idx"] == rx["next"]:
+            rx["snap"].chunks.append(p["chunk"])
+            rx["snap"].crcs.append(p["crc"])
+            rx["next"] += 1
+            if p["last"]:
+                rx["snap"].complete = True
+            self.stats["migration_chunks"] += 1
+        self.transport.send("mig_ack", "router", rid,
+                            {"fid": fid, "next": rx["next"]})
+
+    # ----------------------------------------------------------- staleness
+
+    def fleet_load_stats(self) -> Dict[int, dict]:
+        """Per-replica load snapshot with a staleness ``age`` annotation —
+        the autoscaler's (and any control consumer's) input.  Without a
+        transport this is a live probe at age 0; with one it is each
+        replica's LAST-KNOWN-GOOD heartbeat payload, however old (the
+        consumer sees the age and can discount accordingly)."""
+        if self.transport is None:
+            return {rid: {**st, "age": 0.0}
+                    for rid, st in self.pool.load_stats().items()}
+        out = {}
+        for rid in self.pool.rids:
+            if self.lease.state(rid) is LeaseState.DEAD:
+                continue
+            stats, age = self.lease.stats(rid)
+            if stats is not None:
+                out[rid] = {**stats, "age": round(age, 9)}
+        return out
+
+    def dispatchable_rids(self) -> List[int]:
+        if self.transport is None:
+            return [r for r in self.pool.rids if self.pool.health.dispatchable(r)]
+        return [r for r in self.pool.rids
+                if self.lease.dispatchable(r)
+                and self.pool.replica(r).serve is not None]
+
+    # -------------------------------------------------------------- warm-up
+
+    def warmup_replica(self, rid: int, max_chains: Optional[int] = None) -> int:
+        """Directory-driven warm-up: pre-import the directory's hottest
+        prefix chains onto replica ``rid`` (typically RECOVERING — a fresh
+        engine with a stone-cold cache) from live donors, so its first
+        dispatches land warm instead of eating cold-start recomputes.
+        Every failure rung falls back to skipping the chain (the replica
+        merely joins colder); returns chains imported."""
+        if self.directory is None:
+            return 0
+        max_chains = self.warmup_chains if max_chains is None else max_chains
+        if max_chains <= 0:
+            return 0
+        target = self.pool.replica(rid)
+        if target.serve is None:
+            return 0
+        from ...resilience.fault_injection import DeviceLossError
+        from ..kvtransfer import SnapshotError, export_prefix
+        imported = 0
+        for digest, holders in self.directory.hottest(4 * max_chains):
+            if imported >= max_chains:
+                break
+            donor_rid = next((h for h in holders if h != rid
+                              and self.pool.replica(h).serve is not None), None)
+            if donor_rid is None:
+                continue
+            donor = self.pool.replica(donor_rid)
+            pc = donor.serve.engine.kv.prefix_cache
+            tokens = pc.chain_tokens(digest) if pc is not None else None
+            if not tokens:
+                continue   # evict-after-publish staleness: chain gone
+            try:
+                # one sentinel token past the chain: the export walk shares
+                # match()'s last-token cap (a prompt of EXACTLY the chain
+                # could only reuse all-but-one page, since the engine must
+                # still compute >= 1 token) — warm-up wants the WHOLE
+                # chain, and real matching prompts will be longer anyway
+                snapshot = export_prefix(donor.serve.engine, tokens + [0],
+                                         source=f"replica{donor_rid}")
+                if snapshot is None:
+                    continue
+                n = target.serve.import_prefix(snapshot)
+            except _fi.InjectedCrash:
+                raise  # simulated death of THIS driver process
+            except (DeviceLossError, SnapshotError, OSError) as e:
+                # warm-up is strictly best-effort: any staging fault means
+                # the replica joins colder, never later or wrong
+                self.stats["warmup_fallbacks"] += 1
+                logger.warning(f"fleet: warm-up import onto replica {rid} "
+                               f"fell back ({e})")
+                continue
+            if n == 0:
+                continue   # already held (a deeper chain covered it)
+            if self.prefix_import_cost > 0:
+                donor.clock.on_step(self.prefix_import_cost * snapshot.n_pages)
+                target.clock.on_step(self.prefix_import_cost * n)
+            imported += 1
+        if imported:
+            self.stats["warmup_imports"] += imported
+            self._emit([("fleet/prefix_warmup", float(rid),
+                         self._next_event_step())])
+        return imported
+
+    # ------------------------------------------------------------- schedule
+
+    def control_timestamps(self, now: float) -> List[float]:
+        """Future instants at which the CONTROL plane can make progress on
+        its own — in-flight deliveries, partition boundaries, lease
+        deadlines, fence/resync retry timers.  The simulator folds these
+        into its idle-jump waits so a quiet fleet still wakes to expire a
+        lease or heal a partition."""
+        if self.transport is None:
+            return []
+        out = self.transport.next_wake(now) + self.lease.deadlines(now)
+        for feed in self._dir_feeds.values():
+            if feed.resync_since is not None:
+                out.append(feed.resync_since + self.dir_resync_retry)
+            if feed.gap_since is not None:
+                out.append(feed.gap_since + self.dir_gap_timeout)
+        for m in self._migrations.values():
+            ch = m.get("chan")
+            if ch is not None and ch["sent_ts"] is not None:
+                out.append(ch["sent_ts"] + self.mig_retry)
+        # already-due wake-ups clamp to ``now`` (a zero-width jump: the
+        # next round's transport_poll resolves them) rather than being
+        # dropped — dropping one would let the idle-jump leap PAST a due
+        # delivery and, e.g., suspect a replica whose heartbeat was
+        # sitting undelivered in the inbox
+        return [max(t, now) for t in out]
+
+    def control_marker(self):
+        """Discrete control-plane transitions for the simulator's stall
+        detector (deliberately EXCLUDES raw send/deliver counters: a
+        heartbeat per round is traffic, not progress — counting it would
+        disable the idle-jump and spin the simulator through quiet
+        stretches one round at a time)."""
+        if self.transport is None:
+            return None
+        return (self.stats["lease_expirations"], self.stats["fenced_replicas"],
+                self.stats["fenced_completions"], self.stats["fenced_requests"],
+                self.stats["publish_gaps"], self.stats["dir_resyncs"],
+                tuple(s.value for _, s in sorted(self.lease.states().items())))
 
     # ----------------------------------------------------------- migration
 
@@ -705,8 +1288,24 @@ class Router:
                 source=f"replica{rid}")
             if exporter is None:
                 continue
-            self._migrations[fr.fid] = {"rid": rid, "sr": sr, "generation": gen,
-                                        "exporter": exporter, "started_ts": now}
+            m = {"rid": rid, "sr": sr, "generation": gen,
+                 "exporter": exporter, "started_ts": now}
+            if self.transport is not None:
+                # the chunks will cross the lossy fabric stop-and-wait; the
+                # ROUTER assembles its own snapshot copy from delivered
+                # chunks (idempotent by index) — the handoff uses THAT, so
+                # a lost/duplicated chunk costs retransmits, never tearing
+                from ..kvtransfer import KVSnapshot
+                src = exporter.snapshot
+                m["chan"] = {"base": 0, "sent_idx": None, "sent_ts": None}
+                self._mig_rx[fr.fid] = {
+                    "next": 0,
+                    "snap": KVSnapshot(tokens=list(src.tokens),
+                                       seen_tokens=src.seen_tokens,
+                                       page_size=src.page_size,
+                                       block_shape=src.block_shape,
+                                       dtype=src.dtype, source=src.source)}
+            self._migrations[fr.fid] = m
             fr.migrations += 1
             self.stats["migrations_started"] += 1
             self._emit([("fleet/migration_start", float(rid),
@@ -734,6 +1333,7 @@ class Router:
             if fr is None or fr._current is None or fr._current[1] is not m["sr"]:
                 # displaced (replica death harvested the record) or terminal
                 self._migrations.pop(fid, None)
+                self._mig_rx.pop(fid, None)
                 continue
             sr, rid = m["sr"], m["rid"]
             if sr.state is not RequestState.MIGRATING:
@@ -743,27 +1343,48 @@ class Router:
                 continue
             rep = self.pool.replica(rid)
             exporter = m["exporter"]
-            try:
-                done = exporter.step_chunk()
-            except _fi.InjectedCrash:
-                raise  # simulated death of THIS driver process
-            except DeviceLossError as e:
-                # the d2h staging found the source device gone — replica
-                # death; on_replica_dead harvests the migration record
-                self.on_replica_dead(rid, now, reason=str(e))
+            if self.transport is not None and \
+                    not self.transport.connected("router", rid, now):
+                # partitioned source: chunks could neither deliver nor ack
+                # — the pump waits for the heal (or the lease harvest)
                 continue
-            except SnapshotAborted as e:
-                self._migration_fallback(fid, str(e))
-                continue
-            except OSError as e:
-                # transient staging fault: resume decode in place
-                if rep.serve is not None:
-                    rep.serve.abort_migration(sr.uid)
-                self._migration_fallback(fid, f"export fault: {e}")
-                continue
-            self.stats["migration_chunks"] += 1
-            if not done:
-                continue
+            if not exporter.snapshot.complete:
+                try:
+                    done = exporter.step_chunk()
+                except _fi.InjectedCrash:
+                    raise  # simulated death of THIS driver process
+                except DeviceLossError as e:
+                    # the d2h staging found the source device gone — replica
+                    # death; on_replica_dead harvests the migration record
+                    self.on_replica_dead(rid, now, reason=str(e))
+                    continue
+                except SnapshotAborted as e:
+                    self._migration_fallback(fid, str(e))
+                    continue
+                except OSError as e:
+                    # transient staging fault: resume decode in place
+                    if rep.serve is not None:
+                        rep.serve.abort_migration(sr.uid)
+                    self._migration_fallback(fid, f"export fault: {e}")
+                    continue
+                if self.transport is None:
+                    self.stats["migration_chunks"] += 1
+            else:
+                done = True
+            if self.transport is not None:
+                # ack/retry delivery: one unacked chunk in flight at a time
+                # (stop-and-wait), receiver-side assembly idempotent by
+                # index; migration_chunks counts RECEIPTS, and completion
+                # is the ROUTER-side snapshot's, not the exporter's
+                self._pump_chunk_channel(fid, m, rid, now)
+                rx = self._mig_rx.get(fid)
+                if rx is None or not rx["snap"].complete:
+                    continue
+                snapshot = rx["snap"]
+            else:
+                if not done:
+                    continue
+                snapshot = exporter.snapshot
             targets = self._decode_candidates(rid)
             if not targets:
                 # the decode pool vanished mid-export: decode continues on
@@ -772,9 +1393,9 @@ class Router:
                     rep.serve.abort_migration(sr.uid)
                 self._migration_fallback(fid, "no decode replica for handoff")
                 continue
-            snapshot = exporter.snapshot
             rep.serve.complete_migration(sr.uid)
             self._migrations.pop(fid)
+            self._mig_rx.pop(fid, None)
             del self._dispatched[fid]
             fr._current = None
             fr.state = FleetState.PENDING
@@ -797,8 +1418,32 @@ class Router:
             self._dispatch_to(fr, tid, {"phase": "decode", "role_match": True,
                                         "migration": True}, now)
 
+    def _pump_chunk_channel(self, fid: int, m: dict, rid: int,
+                            now: float) -> None:
+        """Send (or retransmit) the next unacked staged chunk of one
+        migration over the transport — stop-and-wait with cumulative acks
+        (``mig_ack.next``); the retransmit timer, not delivery failure
+        notices, paces recovery from loss."""
+        ch = m["chan"]
+        exporter = m["exporter"]
+        chunks = exporter.snapshot.chunks
+        idx = ch["base"]
+        if idx >= len(chunks):
+            return   # every staged chunk acked; the exporter still staging
+        if ch["sent_idx"] == idx and ch["sent_ts"] is not None:
+            if now < ch["sent_ts"] + self.mig_retry:
+                return   # in flight, not yet timed out
+            self.transport.note_retransmit()
+        last = exporter.snapshot.complete and idx == len(chunks) - 1
+        self.transport.send("mig_chunk", rid, "router",
+                            {"fid": fid, "idx": idx, "chunk": chunks[idx],
+                             "crc": exporter.snapshot.crcs[idx],
+                             "last": last}, seq=idx)
+        ch["sent_idx"], ch["sent_ts"] = idx, now
+
     def _migration_fallback(self, fid: int, reason: str) -> None:
         self._migrations.pop(fid, None)
+        self._mig_rx.pop(fid, None)
         self.stats["migration_fallbacks"] += 1
         logger.warning(f"fleet: migration of fid={fid} fell back ({reason})")
         self._emit([("fleet/migration_fallback", 1.0, self._next_event_step())])
@@ -820,6 +1465,12 @@ class Router:
             and self.pool.replica(rid).serve is None
         if not was_dead:
             self.pool.kill(rid, reason=reason)
+        if self.transport is not None:
+            # the router OBSERVED this death directly (a device loss on a
+            # synchronous dispatch/staging RPC) — fold it into the lease
+            # view now, with the epoch bump, so the eventual heartbeat
+            # silence does not declare and account the same death twice
+            self.lease.declare_dead(rid, now, reason=f"router-observed: {reason}")
         victims: List[FleetRequest] = []
         for fr in list(self._dispatched.values()):
             if fr._current is not None and fr._current[0] == rid:
@@ -849,9 +1500,18 @@ class Router:
                 # req.kv_snapshot) — both resume the survivor through the
                 # KV-import fast path instead of a full recompute.
                 m = self._migrations.pop(fr.fid, None)
-                if m is not None and m["exporter"].snapshot.complete \
-                        and fr._kv_snapshot is None:
-                    fr._kv_snapshot = m["exporter"].snapshot
+                rx = self._mig_rx.pop(fr.fid, None)
+                # under the transport only chunks that actually DELIVERED
+                # count: the router-side assembly must be complete, not
+                # merely the dead source's local staging
+                snap = None
+                if self.transport is not None:
+                    if rx is not None and rx["snap"].complete:
+                        snap = rx["snap"]
+                elif m is not None and m["exporter"].snapshot.complete:
+                    snap = m["exporter"].snapshot
+                if snap is not None and fr._kv_snapshot is None:
+                    fr._kv_snapshot = snap
                     self.stats["migration_failover_reuse"] += 1
                 elif getattr(displaced_sr, "kv_snapshot", None) is not None:
                     fr._kv_snapshot = displaced_sr.kv_snapshot
@@ -874,6 +1534,7 @@ class Router:
         # engine is gone and the next step_chunk would abort anyway
         for fid in [f for f, m in self._migrations.items() if m["rid"] == rid]:
             self._migrations.pop(fid)
+            self._mig_rx.pop(fid, None)
         if was_dead and not victims:
             return []
         record = {"rid": rid, "ts": now, "reason": reason,
@@ -1001,7 +1662,13 @@ class Router:
         return self.on_replica_dead(rid, reason=reason)
 
     def recover_replica(self, rid: int) -> None:
+        """Attach a fresh engine to a parked/dead replica and — when a
+        prefix directory is attached — pre-import the directory's hottest
+        chains so the replica joins the fleet WARM (directory-driven
+        autoscale warm-up): its first post-recovery dispatches hit cache
+        instead of paying the cold-start recompute."""
         self.pool.recover(rid)
+        self.warmup_replica(rid)
 
     def drain(self, rid: int) -> None:
         """Rolling-restart entry: no NEW dispatches to ``rid``; its
@@ -1116,6 +1783,20 @@ class Router:
             "tpot": percentile_summary([r.tpot for r in done if r.tpot is not None]),
             "e2e": percentile_summary([r.e2e for r in done if r.e2e is not None]),
             "tenants": self._tenant_summary(done),
+            "control_plane": None if self.transport is None else {
+                "transport": self.transport.summary(),
+                "lease": self.lease.summary(),
+                "lease_expirations": self.stats["lease_expirations"],
+                "fenced_replicas": self.stats["fenced_replicas"],
+                "fenced_completions": self.stats["fenced_completions"],
+                "fenced_requests": self.stats["fenced_requests"],
+                "publish_gaps": self.stats["publish_gaps"],
+                "dir_resyncs": self.stats["dir_resyncs"],
+                "warmup_imports": self.stats["warmup_imports"],
+                "warmup_fallbacks": self.stats["warmup_fallbacks"],
+                "partition_dispatch_skips":
+                    self.stats["partition_dispatch_skips"],
+            },
             "overload": None if self.overload is None else self.overload.summary(),
             "shed": self.stats["shed"],
             "brownout_capped": self.stats["brownout_capped"],

@@ -300,6 +300,12 @@ class FleetSimulator:
                     deferred_restarts.remove(rid)
                     pool.restart(rid)
 
+            # 1.4 control-plane transport: drain due message deliveries
+            # (heartbeats, publishes, fences, chunks), sweep the leases,
+            # run the fence/resync retry timers — BEFORE dispatch, so this
+            # round's placement sees the freshest view the fabric allows
+            router.transport_poll(now)
+
             # 1.5 control plane: the autoscaler reads last round's signals
             # and acts (recover/drain/park, ladder moves) before this
             # round's dispatch sees the fleet
@@ -322,7 +328,12 @@ class FleetSimulator:
                 if not pool.health.serving(rid):
                     continue
                 _out, victims = pool.tick(rid)
-                if victims:
+                if victims and router.transport is None:
+                    # perfect observation: the router learns of the death
+                    # instantly.  Under the transport it must NOT — the
+                    # replica simply stops heartbeating and the router's
+                    # lease machinery diagnoses the silence (the victims'
+                    # fleet records re-home at lease expiry, tokens intact)
                     router.on_replica_dead(rid, reason="health-declared death")
                 view = pool.replica(rid).clock
                 cost = view.take_cost() if hasattr(view, "take_cost") else 0.0
@@ -355,6 +366,11 @@ class FleetSimulator:
                 # nothing moved: only the passage of time can help — jump to
                 # the next known event, or fail loudly instead of spinning
                 waits = router.pending_timestamps()
+                # control-plane wake-ups: in-flight deliveries, partition
+                # boundaries, lease deadlines, fence/resync retries — a
+                # quiet fleet must still wake to expire a lease or see a
+                # partition heal (empty without a transport)
+                waits.extend(router.control_timestamps(clock.now()))
                 if a_i < len(pending_arrivals):
                     waits.append(pending_arrivals[a_i]["arrival_ts"])
                 if e_i < len(events):
@@ -378,10 +394,20 @@ class FleetSimulator:
         pool, router = self.pool, self.router
         state = pool.health.state(ev.rid)
         if ev.action == "kill":
-            router.on_replica_dead(ev.rid, reason="scheduled kill")
+            if router.transport is not None:
+                # a scheduled kill under the transport is a silent host
+                # loss: the engine dies, heartbeats stop, and the ROUTER
+                # finds out the only way a partitioned-or-dead replica can
+                # be found out — its lease expires
+                pool.kill(ev.rid, reason="scheduled kill")
+            else:
+                router.on_replica_dead(ev.rid, reason="scheduled kill")
         elif ev.action == "recover":
             if state is ReplicaState.DEAD:
-                pool.recover(ev.rid)
+                # via the router: a prefix directory triggers the
+                # directory-driven warm-up (hottest chains pre-imported
+                # while the replica is still RECOVERING)
+                router.recover_replica(ev.rid)
             # recovering a live replica is a schedule no-op, not an error —
             # chaos schedules are random and may recover before the kill
         elif ev.action == "drain":
@@ -416,4 +442,8 @@ class FleetSimulator:
                 # control-plane progress: scale decisions and ladder moves
                 # advance no clock and deliver no tokens, but they ARE
                 # progress (a recover this round changes next round)
-                self.autoscaler.marker() if self.autoscaler is not None else None)
+                self.autoscaler.marker() if self.autoscaler is not None else None,
+                # transport control transitions (lease/fence/resync) — same
+                # stance; raw send counters are deliberately excluded (see
+                # Router.control_marker)
+                router.control_marker())

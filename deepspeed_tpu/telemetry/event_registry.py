@@ -137,6 +137,39 @@ EVENTS = {
     "fleet/migration_fallback": ("event", "serving/fleet/router.py",
                                  "migration abandoned; recompute/in-place "
                                  "decode owns the request"),
+    # ---- control-plane transport (serving/fleet/transport.py +
+    #      health.py + router.py) — docs/SERVING.md "Control-plane
+    #      transport"; the per-counter transport/* family is DYNAMIC
+    "fleet/lease_suspect": ("event", "serving/fleet/health.py",
+                            "heartbeat silence passed suspect_after; no "
+                            "new dispatches (value = rid)"),
+    "fleet/lease_expired": ("event", "serving/fleet/health.py",
+                            "lease expired: fleet-declared death, work "
+                            "re-dispatched, dispatch epoch bumped "
+                            "(value = rid)"),
+    "fleet/lease_renewed": ("event", "serving/fleet/health.py",
+                            "heartbeats resumed (SUSPECT healed, or a "
+                            "fenced replica rejoined) (value = rid)"),
+    "fleet/fenced_replica": ("event", "serving/fleet/router.py",
+                             "a fleet-dead replica heartbeated again; a "
+                             "FENCE is in flight (value = rid)"),
+    "fleet/fenced_request": ("event", "serving/fleet/router.py",
+                             "in-flight zombie requests cancelled by a "
+                             "fence (value = count)"),
+    "fleet/fenced_completion": ("event", "serving/fleet/router.py",
+                                "late zombie completions discarded by "
+                                "fencing — never double-served "
+                                "(value = count)"),
+    "prefix/publish_gap": ("event", "serving/fleet/router.py",
+                           "a sequence gap in a replica's prefix-publish "
+                           "stream was declared lost (value = rid)"),
+    "prefix/resync": ("event", "serving/fleet/router.py",
+                      "full-digest directory resync applied for a replica "
+                      "(value = rid)"),
+    "fleet/prefix_warmup": ("event", "serving/fleet/router.py",
+                            "directory-driven warm-up pre-imported hot "
+                            "chains onto a recovering replica "
+                            "(value = rid)"),
     # ---- overload control plane (serving/fleet/autoscale.py + router.py)
     "fleet/scale_up": ("event", "serving/fleet/autoscale.py",
                        "autoscaler provisioned a replica through "
@@ -203,6 +236,15 @@ DYNAMIC = [
                     "fleet/health/draining", "fleet/health/dead",
                     "fleet/health/recovering"],
      "doc": "replica health transition (value = rid)"},
+    {"prefix": "transport/", "template": "transport/<counter>",
+     "kind": "counter", "source": "serving/fleet/transport.py",
+     "expansions": ["transport/sent", "transport/delivered",
+                    "transport/dropped", "transport/partition_dropped",
+                    "transport/duplicated", "transport/reordered",
+                    "transport/delayed", "transport/send_faults",
+                    "transport/deliver_faults", "transport/retransmits"],
+     "doc": "control-plane fabric accounting, one counter per fate a "
+            "message can meet (docs/SERVING.md 'Control-plane transport')"},
     {"prefix": "telemetry/", "template": "telemetry/<metric>[_p50|_p95|_p99|_count]",
      "kind": "event", "source": "telemetry/metrics.py",
      "expansions": ["..."],

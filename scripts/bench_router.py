@@ -56,7 +56,7 @@ directory hit rate >= 0.95 with the probe baseline recorded beside it,
 p99 TTFT strictly better at equal goodput, >= 1 cold-replica prefix
 import, zero output divergence, and byte-identical repeats.
 
-Writes BENCH_ROUTER.json (schema v4 — scripts/check_bench_schema.py
+Writes BENCH_ROUTER.json (schema v5 — scripts/check_bench_schema.py
 validates it, incl. affinity hit rate > 0 on the prefix_affinity points
 and finite recovery on every kill) and prints one JSON line.
 """
@@ -368,6 +368,109 @@ def run_prefix_directory_leg(factory, clock_factory, seed, vocab, page_size,
     return rec
 
 
+def _partition_point(factory, clock_factory, arrivals, serving_config, seed,
+                     loss_p, partition_spec, lease):
+    """One partition-leg run over the control-plane transport: 4 replicas,
+    least-outstanding routing from heartbeat-carried (stale) load stats.
+    ``loss_p`` / ``partition_spec`` empty = the CLEAN leg (perfect fabric,
+    zero delay/loss — the apples-to-apples baseline with the same lease
+    machinery active).  Returns (summary, per-request outputs)."""
+    from deepspeed_tpu.serving.fleet import (ControlTransport, FleetSimulator,
+                                             LeaseConfig, LinkFaults,
+                                             PartitionWindow, ReplicaPool,
+                                             Router, make_policy)
+    clock = clock_factory()
+    partitions = []
+    if partition_spec is not None:
+        partitions = [PartitionWindow(partition_spec["name"],
+                                      partition_spec["t0"], partition_spec["t1"],
+                                      (("router", partition_spec["rid"]),))]
+    transport = ControlTransport(clock, faults=LinkFaults(loss_p=loss_p),
+                                 seed=seed, partitions=partitions)
+    pool = ReplicaPool(factory, 4, clock=clock, serving_config=serving_config,
+                       transport=transport)
+    pool.rebase_clock()
+    router = Router(pool, make_policy("least_outstanding"), transport=transport,
+                    lease_config=LeaseConfig(**lease))
+    reqs = FleetSimulator(router).run([dict(a) for a in arrivals])
+    rec = router.summary()
+    rec["offered_rps"] = round(len(arrivals) / max(arrivals[-1]["arrival_ts"], 1e-9), 6)
+    return rec, [list(r.tokens) for r in reqs]
+
+
+def run_partition_leg(factory, clock_factory, seed, vocab, dryrun):
+    """The partition-tolerance receipt (schema-v5 ``partition`` record,
+    docs/SERVING.md "Control-plane transport"): the same diurnal workload
+    served over a PERFECT control fabric vs a degraded one — 5% uniform
+    message loss plus one ~10-round partition window severing the router
+    from one healthy replica (lease expiry + re-dispatch + fencing all
+    fire mid-run).  The acceptance bars: ZERO output divergence (the
+    degraded fleet is slower, never wrong), goodput within the declared
+    degradation bound of the clean run, and the lossy leg byte-identical
+    when repeated."""
+    from deepspeed_tpu.serving import ServingConfig
+    from deepspeed_tpu.serving.fleet import diurnal_arrivals
+    # no deadlines: every request runs to completion in BOTH legs, so the
+    # output comparison covers the full workload (degradation shows up as
+    # elapsed time / goodput, not as dropped work)
+    wl = {"kind": "diurnal", "seed": seed,
+          "n_requests": 60 if dryrun else 64,
+          "base_rate": 2.5 if dryrun else 8.0,
+          "amplitude": 0.6, "period": 20.0 if dryrun else 8.0,
+          "deadline_slack": None}
+    arrivals = diurnal_arrivals(
+        seed=wl["seed"], n_requests=wl["n_requests"], base_rate=wl["base_rate"],
+        amplitude=wl["amplitude"], period=wl["period"], vocab=vocab)
+    scfg = ServingConfig(step_cost=(lambda toks: 0.25 + 0.015 * toks)
+                         if dryrun else None)
+    lease = {"suspect_after": 2.5, "lease": 6.0, "fence_retry": 2.0}
+    # ~10 fleet rounds at the leg's typical ~0.6-0.9 step cost, and longer
+    # than the lease so the split-brain machinery (expiry -> re-dispatch ->
+    # fence on heal) demonstrably fires inside the committed receipt
+    partition = {"name": "bench_cut", "rid": 3, "t0": 14.0, "t1": 22.0}
+    loss_p = 0.05
+    clean_rec, clean_out = _partition_point(
+        factory, clock_factory, arrivals, scfg, seed,
+        loss_p=0.0, partition_spec=None, lease=lease)
+    lossy_rec, lossy_out = _partition_point(
+        factory, clock_factory, arrivals, scfg, seed,
+        loss_p=loss_p, partition_spec=partition, lease=lease)
+    lossy_rec2, lossy_out2 = _partition_point(
+        factory, clock_factory, arrivals, scfg, seed,
+        loss_p=loss_p, partition_spec=partition, lease=lease)
+    for r in (clean_rec, lossy_rec, lossy_rec2):
+        r["arrival_rate"] = wl["base_rate"]
+    divergent = sum(1 for a, b in zip(clean_out, lossy_out) if a != b)
+    ratio = lossy_rec["goodput_rps"] / max(clean_rec["goodput_rps"], 1e-9)
+    cp = lossy_rec["control_plane"]
+    rec = {
+        "workload": wl,
+        "step_cost": "0.25 + 0.015 * planned_tokens" if dryrun else "wall",
+        "lease": lease,
+        "loss_p": loss_p,
+        "partition_window": partition,
+        "clean": clean_rec,
+        "lossy": lossy_rec,
+        "goodput_ratio": round(ratio, 6),
+        #: the DECLARED degradation bound: 5% loss + an 8s partition may
+        #: cost at most half the clean goodput (measured ~0.9; the bound
+        #: leaves room for workload growth without inviting regressions)
+        "goodput_bound": 0.5,
+        "zero_divergence": divergent == 0,
+        "divergent_requests": divergent,
+        "determinism_repeat_identical": (lossy_rec == lossy_rec2
+                                         and lossy_out == lossy_out2),
+        "control_plane": cp,
+    }
+    print(f"# partition: clean goodput={clean_rec['goodput_rps']} lossy="
+          f"{lossy_rec['goodput_rps']} ratio={ratio:.3f} | dropped="
+          f"{cp['transport']['dropped']} partition_dropped="
+          f"{cp['transport']['partition_dropped']} lease_expirations="
+          f"{cp['lease_expirations']} fenced={cp['fenced_replicas']} "
+          f"divergent={divergent}", flush=True)
+    return rec
+
+
 AUTOSCALE_TENANTS = (
     # (name, mix probability, deadline slack, weight, max_outstanding,
     #  ttft_slo, best_effort)
@@ -564,6 +667,25 @@ def main():
                                   args.dryrun)
     prefix_dir = run_prefix_directory_leg(factory, clock_factory, args.seed,
                                           vocab, kv.page_size, args.dryrun)
+    partition = run_partition_leg(factory, clock_factory, args.seed, vocab,
+                                  args.dryrun)
+    if args.dryrun:
+        # the partition-tolerance receipts (deterministic on the virtual
+        # clock — fail the run, not just CI; wall mode records only)
+        assert partition["determinism_repeat_identical"], \
+            "lossy partition leg is not byte-reproducible"
+        assert partition["zero_divergence"], \
+            f"{partition['divergent_requests']} request(s) diverged between " \
+            "clean and degraded control-plane transport"
+        assert partition["goodput_ratio"] >= partition["goodput_bound"], \
+            f"goodput ratio {partition['goodput_ratio']} under the declared " \
+            f"degradation bound {partition['goodput_bound']}"
+        ptr = partition["control_plane"]["transport"]
+        assert ptr["dropped"] > 0 and ptr["partition_dropped"] > 0, \
+            f"the degraded leg exercised no loss/partition: {ptr}"
+        assert partition["control_plane"]["lease_expirations"] >= 1, \
+            "the partition window never expired a lease — the split-brain " \
+            "machinery did not fire"
     if args.dryrun:
         # the prefix-directory receipts (deterministic on the virtual
         # clock — fail the run, not just CI; wall mode records only)
@@ -638,7 +760,7 @@ def main():
         "metric": "fleet_goodput_rps",
         "value": best["goodput_rps"],
         "unit": "requests/s" if not args.dryrun else "requests/step",
-        "schema_version": 4,
+        "schema_version": 5,
         "sla": {"ttft_budget": ttft_budget, "tpot_budget": tpot_budget},
         "workload": {"n_requests": n_requests, "seed": args.seed,
                      "arrival_rate": rate,
@@ -661,6 +783,7 @@ def main():
         "disaggregation": disagg,
         "autoscale": autoscale,
         "prefix_directory": prefix_dir,
+        "partition": partition,
     }
     print(json.dumps({k: result[k] for k in ("metric", "value", "unit")} |
                      {"best": {"policy": best["policy"],

@@ -235,6 +235,62 @@ def _prefix_directory_record(v):
     return None
 
 
+def _partition_record(v):
+    """The partition-tolerance receipt (bench_router.py run_partition_leg,
+    docs/SERVING.md "Control-plane transport"): the same diurnal workload
+    over a perfect vs a degraded control fabric (5% loss + one partition
+    window with lease expiry, re-dispatch and fencing firing mid-run).
+    The committed record must show ZERO output divergence (degradation is
+    allowed to cost time, never tokens), goodput within the declared
+    degradation bound of the clean run, the loss/partition/lease machinery
+    actually exercised, and the lossy leg byte-identical when repeated."""
+    if not isinstance(v, dict):
+        return f"expected partition object, got {type(v).__name__}"
+    for k in ("workload", "lease", "loss_p", "partition_window", "clean",
+              "lossy", "goodput_ratio", "goodput_bound", "zero_divergence",
+              "divergent_requests", "determinism_repeat_identical",
+              "control_plane"):
+        if k not in v:
+            return f"missing partition key {k!r}"
+    if v["determinism_repeat_identical"] is not True:
+        return "lossy partition leg not byte-identical across runs"
+    if v["zero_divergence"] is not True or v["divergent_requests"] != 0:
+        return (f"output divergence recorded ({v['divergent_requests']} "
+                "request(s)) — the degraded control plane changed tokens")
+    bound = v["goodput_bound"]
+    if not isinstance(bound, (int, float)) or isinstance(bound, bool) \
+            or not 0 < bound <= 1:
+        return f"goodput_bound {bound!r} is not a declared ratio in (0, 1]"
+    ratio = v["goodput_ratio"]
+    if not isinstance(ratio, (int, float)) or isinstance(ratio, bool) \
+            or ratio < bound:
+        return (f"goodput ratio {ratio!r} under the declared degradation "
+                f"bound {bound} — the fleet degraded more than it promised")
+    errors = []
+    for side in ("clean", "lossy"):
+        _check(v[side], _ROUTER_POINT, f"partition.{side}", errors)
+    if errors:
+        return "; ".join(errors)
+    clean, lossy = v["clean"], v["lossy"]
+    if clean["completed"] != lossy["completed"] or lossy["timed_out"] or \
+            lossy["rejected"]:
+        return (f"not an equal-completion pair: clean {clean['completed']} "
+                f"vs lossy {lossy['completed']} (timed_out="
+                f"{lossy['timed_out']}, rejected={lossy['rejected']}) — "
+                "degradation may only cost time")
+    cp = v["control_plane"]
+    tr = cp.get("transport") if isinstance(cp, dict) else None
+    if not isinstance(tr, dict) or tr.get("dropped", 0) <= 0 \
+            or tr.get("partition_dropped", 0) <= 0:
+        return (f"the degraded leg exercised no loss/partition: {tr} — "
+                "an unperturbed 'degradation' receipt proves nothing")
+    if cp.get("lease_expirations", 0) < 1:
+        return ("no lease expired inside the partition window — the "
+                "split-brain machinery (expiry/re-dispatch/fencing) did "
+                "not fire in the committed receipt")
+    return None
+
+
 def _router_sweep_invariants(v):
     """The fleet bench's acceptance receipts: >= 3 points, the
     prefix_affinity policy actually hit its cache somewhere, and every
@@ -389,10 +445,10 @@ SCHEMAS = {
                         "concurrency": INT},
         "engine_throughput": ("nullable", _LEGACY_THROUGHPUT),
     },
-    # the fleet router harness (scripts/bench_router.py, schema v4)
+    # the fleet router harness (scripts/bench_router.py, schema v5)
     "BENCH_ROUTER.json": {
         "metric": STR, "value": NUM, "unit": STR,
-        "schema_version": lambda v: None if v == 4 else f"schema_version {v} != 4",
+        "schema_version": lambda v: None if v == 5 else f"schema_version {v} != 5",
         "sla": {"ttft_budget": NUM, "tpot_budget": NUM},
         "workload": {"n_requests": INT, "seed": INT, "arrival_rate": NUM,
                      "prefix_groups": INT, "prefix_pages": INT, "dryrun": BOOL,
@@ -404,6 +460,7 @@ SCHEMAS = {
         "disaggregation": _disagg_record,
         "autoscale": _autoscale_record,
         "prefix_directory": _prefix_directory_record,
+        "partition": _partition_record,
     },
 }
 

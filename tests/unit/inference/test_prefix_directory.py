@@ -408,8 +408,14 @@ def test_property_random_publish_evict_kill(trained_params, golden_engine, seed)
     for r, g in zip(reqs, golden):
         assert r.tokens == g[:r.max_new_tokens], (seed, r.fid)
         assert sum(1 for st, _ in r.history if st.terminal) == 1
-    assert directory.stats["purged"] > 0 or not any(
-        rid == victim for rid, _ in directory._lru)
+    # no GHOST entries: anything the directory still claims for the victim
+    # must be genuinely held by its post-recovery cache (the r16
+    # directory-driven warm-up legitimately re-warms a recovered replica,
+    # so "no victim entries at all" is no longer the invariant — honesty is)
+    pc = pool.replica(victim).serve.engine.kv.prefix_cache
+    held = set(pc.held_digests())
+    assert all(digest in held for rid, digest in directory._lru
+               if rid == victim)
     for tokens in [g + [1] for g in groups]:
         for rid, rep in pool.replicas.items():
             if rep.serve is None:

@@ -1,0 +1,303 @@
+"""Partition-tolerance chaos suite (docs/RESILIENCE.md "Partition
+tolerance", docs/SERVING.md "Control-plane transport").
+
+The standing contract, now extended to a lossy control plane: whatever
+the fabric does — random loss/duplication/reordering/delay, named
+partition windows, replica kills and recoveries composed on top — the
+fleet's final outputs stay byte-identical to the unperturbed golden run,
+every request reaches exactly one terminal state exactly once, no request
+is ever served twice (the split-brain fencing property), and per-tenant
+accounting closes.  Plus the ``transport.send`` / ``transport.deliver``
+injection-site contracts: transient faults are absorbed as message loss,
+simulated driver death propagates through everything."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, build_engine
+from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.models.llama_cache import PagedKVConfig
+from deepspeed_tpu.resilience.fault_injection import (INJECTION_SITES, FaultSpec,
+                                                      InjectedCrash,
+                                                      configure_fault_injection)
+from deepspeed_tpu.serving import VirtualClock
+from deepspeed_tpu.serving.fleet import (ControlTransport, FleetSimulator,
+                                         FleetState, LeaseConfig,
+                                         LeastOutstandingPolicy, LinkFaults,
+                                         PartitionWindow, ReplicaPool, Router,
+                                         TenantRegistry, TenantSpec)
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128,
+                  rope_theta=1e4, dtype=jnp.float32, scan_layers=True, remat=False)
+
+
+@pytest.fixture(scope="module")
+def trained_params():
+    model = LlamaForCausalLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    configure_fault_injection(None)
+
+
+def _factory(trained_params):
+    def make():
+        kv = PagedKVConfig(num_pages=64, page_size=8, max_pages_per_seq=8)
+        sched = SchedulerConfig(token_budget=64, max_seqs=8, prefill_chunk=8,
+                                decode_bucket=4)
+        return build_engine(CFG, trained_params, RaggedInferenceEngineConfig(
+            kv=kv, scheduler=sched, kv_dtype=jnp.float32, decode_steps_per_dispatch=1))
+    return make
+
+
+def _fleet(trained_params, n_replicas, faults=None, partitions=(), seed=0,
+           lease=None, tenants=None):
+    clock = VirtualClock()
+    transport = ControlTransport(clock, faults=faults, seed=seed,
+                                 partitions=partitions)
+    pool = ReplicaPool(_factory(trained_params), n_replicas, clock=clock,
+                       transport=transport)
+    router = Router(pool, LeastOutstandingPolicy(), transport=transport,
+                    tenants=tenants,
+                    lease_config=lease or LeaseConfig(suspect_after=2.5,
+                                                      lease=8.0))
+    return router, pool, transport
+
+
+PROMPTS = [[5, 9, 2, 7, 1], [3, 3, 8, 1], [2, 4, 6, 8, 10, 12], [13, 1, 1, 2]]
+
+
+def _arrivals(prompts, max_new=8, spacing=1.0):
+    return [dict(prompt=p, max_new_tokens=max_new, arrival_ts=round(i * spacing, 6))
+            for i, p in enumerate(prompts)]
+
+
+class _EventLog:
+    """Minimal monitor capturing (name, value) event tuples."""
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def write_events(self, events):
+        self.events.extend((n, v) for n, v, _ in events)
+
+    def names(self):
+        return [n for n, _ in self.events]
+
+
+# ------------------------------------------------------------------- sites
+
+
+def test_transport_sites_registered():
+    assert "transport.send" in INJECTION_SITES
+    assert "transport.deliver" in INJECTION_SITES
+    FaultSpec(site="transport.send", kind="os_error")     # validates
+    FaultSpec(site="transport.deliver", kind="crash")
+    with pytest.raises(ValueError, match="unknown injection site"):
+        FaultSpec(site="transport.loss", kind="os_error")
+
+
+def test_send_fault_is_message_loss_not_wrongness(trained_params):
+    """An injected ``os_error`` at ``transport.send`` means the datagram
+    never left the host: counted, absorbed by the lease/resync machinery,
+    and invisible in the outputs."""
+    golden = _factory(trained_params)().generate(PROMPTS, max_new_tokens=8)
+    configure_fault_injection({"sites": [
+        {"site": "transport.send", "kind": "os_error", "at": 3, "times": 4}]})
+    router, pool, tr = _fleet(trained_params, 2)
+    reqs = FleetSimulator(router).run(_arrivals(PROMPTS))
+    assert [r.state for r in reqs] == [FleetState.DONE] * 4
+    assert [r.tokens for r in reqs] == golden
+    assert tr.stats["send_faults"] == 4
+    assert tr.stats["dropped"] >= 4
+
+
+def test_deliver_fault_is_message_loss_not_wrongness(trained_params):
+    golden = _factory(trained_params)().generate(PROMPTS, max_new_tokens=8)
+    configure_fault_injection({"sites": [
+        {"site": "transport.deliver", "kind": "os_error", "at": 2, "times": 3}]})
+    router, pool, tr = _fleet(trained_params, 2)
+    reqs = FleetSimulator(router).run(_arrivals(PROMPTS))
+    assert [r.state for r in reqs] == [FleetState.DONE] * 4
+    assert [r.tokens for r in reqs] == golden
+    assert tr.stats["deliver_faults"] == 3
+
+
+@pytest.mark.parametrize("site", ["transport.send", "transport.deliver"])
+def test_crash_transparency(trained_params, site):
+    """``InjectedCrash`` is simulated DRIVER death: nothing on the
+    transport path — send loops, delivery handlers, the simulator — may
+    absorb it."""
+    configure_fault_injection({"sites": [
+        {"site": site, "kind": "crash", "at": 4}]})
+    router, pool, tr = _fleet(trained_params, 2)
+    with pytest.raises(InjectedCrash):
+        FleetSimulator(router).run(_arrivals(PROMPTS))
+
+
+# -------------------------------------------------------------- split brain
+
+
+def test_split_brain_zombie_completion_fenced(trained_params):
+    """THE partition-tolerance acceptance leg: the router is partitioned
+    from a healthy replica mid-decode; the lease expires and the request
+    re-dispatches to a survivor; the partition heals AFTER the zombie
+    finished the request on its side.  The fencing contract: the zombie's
+    late completion is discarded with an auditable ``fleet/fenced_*``
+    event, the request reaches DONE exactly once, and the final output is
+    byte-identical to the unperturbed golden run."""
+    prompts = [PROMPTS[0], PROMPTS[1]]
+    golden = _factory(trained_params)().generate(prompts, max_new_tokens=16)
+    log = _EventLog()
+    clock = VirtualClock()
+    tr = ControlTransport(clock, partitions=[
+        PartitionWindow("splitbrain", 6.0, 30.0, (("router", 0),))])
+    pool = ReplicaPool(_factory(trained_params), 2, clock=clock, transport=tr,
+                       monitor=log)
+    router = Router(pool, LeastOutstandingPolicy(), transport=tr, monitor=log,
+                    lease_config=LeaseConfig(suspect_after=2.0, lease=6.0))
+    arr = [dict(prompt=prompts[0], max_new_tokens=16, arrival_ts=0.0),
+           # a trailing arrival past the heal keeps the simulation alive
+           # through the fence handshake
+           dict(prompt=prompts[1], max_new_tokens=16, arrival_ts=34.0)]
+    reqs = FleetSimulator(router).run(arr)
+    fr = reqs[0]
+    # dispatched to replica 0 BEFORE the cut, re-homed to 1 after expiry
+    assert fr.dispatches[0][0] == 0 and fr.dispatches[-1][0] == 1
+    assert fr.failovers == 1
+    assert [r.state for r in reqs] == [FleetState.DONE] * 2
+    assert [r.tokens for r in reqs] == golden        # byte-identical outputs
+    for r in reqs:                                   # served exactly once
+        assert sum(1 for st, _ in r.history if st.terminal) == 1
+    cp = router.summary()["control_plane"]
+    assert cp["lease_expirations"] == 1
+    assert cp["fenced_replicas"] == 1
+    assert cp["fenced_completions"] == 1             # the discarded late serve
+    assert router.lease.epoch[0] == 1                # the fencing token
+    names = log.names()
+    assert "fleet/lease_expired" in names
+    assert "fleet/fenced_replica" in names
+    assert "fleet/fenced_completion" in names
+    # accounting closes: nothing double-counted through the double serve
+    t = router.summary()["tenants"]["default"]
+    assert t["closed"] and t["completed"] == 2
+
+
+def test_partition_of_active_decode_cancels_zombie_work(trained_params):
+    """Heal BEFORE the zombie finishes: the fence cancels its still-active
+    work (``fleet/fenced_request``) instead of discarding a completion —
+    and the re-dispatched copy still matches the golden output."""
+    prompts = [PROMPTS[2]]
+    golden = _factory(trained_params)().generate(prompts, max_new_tokens=40)
+    log = _EventLog()
+    clock = VirtualClock()
+    tr = ControlTransport(clock, partitions=[
+        PartitionWindow("blip", 4.0, 13.0, (("router", 0),))])
+    pool = ReplicaPool(_factory(trained_params), 2, clock=clock, transport=tr,
+                       monitor=log)
+    router = Router(pool, LeastOutstandingPolicy(), transport=tr, monitor=log,
+                    lease_config=LeaseConfig(suspect_after=2.0, lease=6.0))
+    reqs = FleetSimulator(router).run(
+        [dict(prompt=prompts[0], max_new_tokens=40, arrival_ts=0.0)])
+    assert reqs[0].state is FleetState.DONE
+    assert reqs[0].tokens == golden[0]
+    assert sum(1 for st, _ in reqs[0].history if st.terminal) == 1
+    cp = router.summary()["control_plane"]
+    assert cp["fenced_requests"] >= 1
+    assert "fleet/fenced_request" in log.names()
+    # the fenced zombie's engine ended clean: the seq is gone and fencing
+    # released every page except the engine's build-time reserved one and
+    # the prefix cache's refcounts
+    eng = pool.replica(0).serve.engine
+    assert not eng.state.seqs and not pool.replica(0).serve._active
+    assert eng.kv.allocator.free_pages == eng.kv.allocator.num_pages \
+        - 1 - eng.kv.prefix_cache.cached_pages
+
+
+# ------------------------------------------------------------ property audit
+
+
+TENANTS = TenantRegistry
+
+
+def _random_partitions(rng, n_replicas):
+    out = []
+    for i in range(int(rng.integers(1, 3))):
+        rid = int(rng.integers(0, n_replicas))
+        t0 = round(float(rng.uniform(2.0, 18.0)), 6)
+        dur = round(float(rng.uniform(4.0, 12.0)), 6)
+        out.append(PartitionWindow(f"p{i}", t0, round(t0 + dur, 6),
+                                   (("router", rid),)))
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_property_random_chaos_schedules(trained_params, seed):
+    """3-seed property audit: random loss/dup/reorder/delay + random named
+    partition windows composed with a kill/recover schedule over a
+    3-replica fleet and a 2-tenant workload.  Invariants: every request
+    DONE exactly once, outputs byte-identical to the unperturbed goldens,
+    per-tenant accounting closes, zero duplicate serves (exactly-once +
+    token identity IS the no-double-serve receipt)."""
+    rng = np.random.default_rng(100 + seed)
+    n_requests = 10
+    arrivals = []
+    t = 0.0
+    for i in range(n_requests):
+        t += float(rng.exponential(1.5))
+        arrivals.append({
+            "arrival_ts": round(t, 6),
+            "prompt": [int(x) for x in rng.integers(1, CFG.vocab_size,
+                                                    int(rng.integers(3, 10)))],
+            "max_new_tokens": int(rng.integers(4, 10)),
+            "tenant": "premium" if rng.random() < 0.4 else "batch",
+        })
+    golden = _factory(trained_params)().generate(
+        [a["prompt"] for a in arrivals],
+        max_new_tokens=max(a["max_new_tokens"] for a in arrivals))
+    faults = LinkFaults(loss_p=round(float(rng.uniform(0.02, 0.2)), 6),
+                        dup_p=0.1, reorder_p=0.15,
+                        delay=round(float(rng.uniform(0.0, 0.3)), 6),
+                        reorder_delay=1.0)
+    victim = int(rng.integers(0, 3))
+    kill_at = round(float(rng.uniform(2.0, 10.0)), 6)
+    schedule = [(kill_at, "kill", victim),
+                (round(kill_at + float(rng.uniform(6.0, 14.0)), 6),
+                 "recover", victim)]
+    partitions = _random_partitions(rng, 3)
+
+    def run_once():
+        tenants = TenantRegistry([TenantSpec("premium", weight=3.0),
+                                  TenantSpec("batch", weight=1.0)])
+        router, pool, tr = _fleet(
+            trained_params, 3, faults=faults, seed=seed,
+            partitions=partitions, tenants=tenants,
+            lease=LeaseConfig(suspect_after=2.5, lease=8.0))
+        reqs = FleetSimulator(router).run([dict(a) for a in arrivals],
+                                          schedule=schedule)
+        return router, reqs
+
+    router, reqs = run_once()
+    assert [r.state for r in reqs] == [FleetState.DONE] * n_requests, \
+        (seed, [r.state.value for r in reqs])
+    for r, g in zip(reqs, golden):
+        assert r.tokens == g[:r.max_new_tokens], (seed, r.fid)
+        assert sum(1 for st, _ in r.history if st.terminal) == 1
+    s = router.summary()
+    for name, trec in s["tenants"].items():
+        assert trec["closed"], (seed, name, trec)
+    assert sum(trec["completed"] for trec in s["tenants"].values()) == n_requests
+    # determinism: the exact same chaos schedule replays byte-for-byte
+    router2, reqs2 = run_once()
+    assert [r.tokens for r in reqs2] == [r.tokens for r in reqs]
+    assert [r.dispatches for r in reqs2] == [r.dispatches for r in reqs]
+    assert router2.summary()["control_plane"]["transport"] == \
+        router.summary()["control_plane"]["transport"]

@@ -91,3 +91,43 @@ def test_checker_validates_trace_artifacts(tmp_path):
     assert any("BACKWARDS" in e for e in errors_for(bad))
     # a serving-side trace registers under its own filename too
     assert not errors_for(good, name="BENCH_SERVING_TRACE.json")
+
+
+def test_checker_catches_partition_drift(tmp_path):
+    """The r16 partition-tolerance receipt (schema-v5 ``partition``): the
+    validator must reject divergent outputs, goodput under the declared
+    degradation bound, a fabric that was never actually perturbed, a run
+    where no lease expired, and a non-reproducible lossy leg — breaking
+    the COMMITTED BENCH_ROUTER.json one way at a time."""
+    import json
+    mod = _load_checker()
+    with open(os.path.join(REPO_ROOT, "BENCH_ROUTER.json")) as f:
+        good = json.load(f)
+
+    def errors_for(doc):
+        p = tmp_path / "BENCH_ROUTER.json"
+        p.write_text(json.dumps(doc))
+        errs = mod.validate_all(str(tmp_path))
+        p.unlink()
+        return errs
+
+    assert not errors_for(good)
+    bad = json.loads(json.dumps(good))
+    bad["partition"]["zero_divergence"] = False
+    bad["partition"]["divergent_requests"] = 2
+    assert any("divergence" in e for e in errors_for(bad))
+    bad = json.loads(json.dumps(good))
+    bad["partition"]["goodput_ratio"] = 0.1      # under the declared bound
+    assert any("degradation bound" in e for e in errors_for(bad))
+    bad = json.loads(json.dumps(good))
+    bad["partition"]["control_plane"]["transport"]["partition_dropped"] = 0
+    assert any("exercised no loss" in e for e in errors_for(bad))
+    bad = json.loads(json.dumps(good))
+    bad["partition"]["control_plane"]["lease_expirations"] = 0
+    assert any("no lease expired" in e for e in errors_for(bad))
+    bad = json.loads(json.dumps(good))
+    bad["partition"]["determinism_repeat_identical"] = False
+    assert any("byte-identical" in e for e in errors_for(bad))
+    bad = json.loads(json.dumps(good))
+    bad["partition"]["lossy"]["timed_out"] = 1   # degradation cost WORK
+    assert any("equal-completion" in e for e in errors_for(bad))
