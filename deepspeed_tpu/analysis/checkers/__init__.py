@@ -4,17 +4,23 @@ here, then list it in ``ALL`` (docs/ANALYSIS.md walks through an example)."""
 from .atomic_write import AtomicWriteChecker
 from .bench_schema import BenchSchemaChecker
 from .crash_transparency import CrashTransparencyChecker
+from .crash_transparency_interproc import CrashTransparencyInterprocChecker
 from .determinism import DeterminismChecker
 from .event_registry import EventRegistryChecker
 from .fault_sites import FaultSiteChecker
+from .kv_lifetime import KVLifetimeChecker
+from .state_machine import StateMachineChecker
 
 ALL = (
     DeterminismChecker,
     CrashTransparencyChecker,
+    CrashTransparencyInterprocChecker,
     FaultSiteChecker,
     EventRegistryChecker,
     AtomicWriteChecker,
     BenchSchemaChecker,
+    KVLifetimeChecker,
+    StateMachineChecker,
 )
 
 

@@ -1,0 +1,122 @@
+"""Checker ``crash-transparency-interproc``: the r11 crash-transparency
+rule, lifted one call-hop through the project call graph.
+
+The r11 checker guards every broad handler *inside* ``resilience/``,
+``serving/`` and ``checkpoint/``.  What it structurally cannot see: a
+crash-guarded region in scope calling a helper **outside** the scoped
+directories (telemetry, monitor, utils) whose own ``except Exception``
+swallows — the :class:`InjectedCrash` dies inside the helper and the
+carefully-written ``except InjectedCrash: raise`` guard one frame up
+never fires.  The simulated process death silently becomes a no-op and
+the chaos suite tests nothing, which is exactly the laundering the r11
+rule exists to forbid.
+
+Rule: inside the scoped directories, any call **lexically inside a
+``try`` that carries an InjectedCrash guard** (the author explicitly
+demanded crash transparency there) resolving to a project function
+defined *outside* the scoped directories whose body contains a broad
+handler that neither re-raises nor is guarded (the r11 predicate,
+shared via :mod:`..flow.callgraph`) is a finding at the call site.
+
+Resolution is conservative on purpose (same-file bare names,
+``self.method`` against the enclosing class, imported module-level
+functions) — a missed resolution is a missed finding, never a false
+one.  Helpers *inside* the scope are the plain checker's job; helpers
+whose swallow is already suppressed with a reasoned marker in their own
+file are respected here too.
+"""
+
+import ast
+
+from ..core import Checker, FileContext, Runner
+from ..flow import project_index
+from .crash_transparency import SCOPE_SEGMENTS, _is_crash_guard
+
+
+def _in_scope(rel: str) -> bool:
+    r = "/" + rel
+    return any(seg in r for seg in SCOPE_SEGMENTS)
+
+
+def _guarded_region_calls(tnode: ast.Try):
+    """Calls lexically inside ``tnode``'s body/else — the region its
+    crash guard actually protects — without descending into nested
+    crash-guarded trys (each is its own region, reported once)."""
+    stack = list(tnode.body) + list(tnode.orelse)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Try) and \
+                any(_is_crash_guard(h) for h in node.handlers):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class CrashTransparencyInterprocChecker(Checker):
+    name = "crash-transparency-interproc"
+    description = ("helpers called from crash-guarded code must not "
+                   "swallow InjectedCrash one hop down")
+
+    def applies(self, rel: str) -> bool:
+        return True  # out-of-scope files feed the call graph
+
+    def finish(self, run: Runner) -> None:
+        index = project_index(run)
+        for rel in sorted(run.contexts):
+            if not _in_scope(rel):
+                continue
+            ctx = run.contexts[rel]
+            if ctx.tree is None:
+                continue
+            self._check_file(run, ctx, index)
+
+    def _check_file(self, run: Runner, ctx: FileContext, index) -> None:
+        # enclosing-function map comes from the index; guarded-try regions
+        # from a single walk here
+        funcs_here = index.by_rel.get(ctx.rel, ())
+
+        def enclosing(node):
+            best = None
+            for f in funcs_here:
+                if f.node.lineno <= node.lineno <= \
+                        max(f.node.lineno,
+                            getattr(f.node, "end_lineno", f.node.lineno)):
+                    if best is None or f.node.lineno > best.node.lineno:
+                        best = f
+            return best
+
+        for tnode in ast.walk(ctx.tree):
+            if not isinstance(tnode, ast.Try):
+                continue
+            if not any(_is_crash_guard(h) for h in tnode.handlers):
+                continue
+            # only the BODY (and else) is under this guard's protection —
+            # a crash raised from a handler or finally propagates past the
+            # guard regardless; and nested crash-guarded trys are their
+            # own protected regions (walked on their own iteration), so
+            # skipping them here keeps every finding single-reported
+            for call in _guarded_region_calls(tnode):
+                caller = enclosing(call)
+                for target in index.resolve(call, caller,
+                                            imports=ctx.imports):
+                    if _in_scope(target.rel) or not target.swallows:
+                        continue
+                    # respect a reasoned suppression at the helper's own
+                    # handler line (the helper's author already decided)
+                    helper_ctx = run.contexts.get(target.rel)
+                    live = [
+                        (ln, caught) for ln, caught in target.swallows
+                        if helper_ctx is None
+                        or not (helper_ctx.suppressed(ln, self.name)
+                                or helper_ctx.suppressed(
+                                    ln, "crash-transparency"))]
+                    if not live:
+                        continue
+                    ln, caught = live[0]
+                    ctx.report(
+                        self.name, call.lineno,
+                        f"call to {target.qualname}() ({target.rel}:{ln}) "
+                        f"from a crash-guarded try: its '{caught}' absorbs "
+                        "InjectedCrash one hop down — add the guard there "
+                        "or re-raise")

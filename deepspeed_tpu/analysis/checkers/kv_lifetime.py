@@ -1,0 +1,263 @@
+"""Checker ``kv-lifetime``: paged-KV acquisitions must reach a release or
+an ownership transfer on EVERY path out of the acquiring function —
+including the raise paths the chaos suites otherwise have to re-prove
+leak-free by simulation ("zero refcount drift").
+
+Acquire sites (the call's terminal name):
+
+* ``allocate``       — a :class:`BlockedAllocator` page grant;
+* ``export_prefix``  — a host-staged prefix :class:`KVSnapshot` (None =
+  nothing staged: paths guarded by ``if x is None`` are exempt);
+* ``begin_migration``— a paused-sequence :class:`KVExporter` (same
+  Optional contract).
+
+A path is *settled* when the tracked name passes through any of:
+
+* a RELEASE/TRANSFER-named call (``free``/``release``/``release_tail``/
+  ``truncate`` / ``adopt``/``register``/``import_*``/``put``/… —
+  :mod:`..flow.callgraph`), or a project helper whose matching parameter
+  is **consuming** (the call-graph fixpoint: helpers in
+  ``serving/engine.py``, ``serving/kvtransfer/`` and ``fleet/router.py``
+  release one hop — or several — down);
+* a store into an attribute or subscript (``fr._kv_snapshot = snap``,
+  ``self._migrations[fid] = m``), a plain alias (``x = snap``), or
+  packing into a container literal (``m = {"exporter": exporter}``) —
+  ownership moved beyond this checker's tracking, deliberately: a
+  handoff, not a leak.  A value merely *derived* from the name
+  (``n = len(pages)``) settles nothing;
+* a ``return``/``yield`` carrying the name;
+* an exit taken inside an ``if <name> is None`` / ``if not <name>``
+  branch (the resource was never acquired on that path).
+
+Passing the name to a *sink call that then raises* still settles the
+path: ownership moved to the callee, whose own failure handling is
+responsible (``import_snapshot`` frees what it allocated before
+re-raising — checked on its own CFG).
+
+Scope: ``serving/`` and ``inference/v2/`` — the paged-KV data plane.
+An acquisition whose result is discarded outright (a bare expression
+statement) is always a finding.
+"""
+
+import ast
+
+from ..core import Checker, FileContext, Runner
+from ..flow import build_cfg, call_name, project_index
+from ..flow.callgraph import SINK_NAMES
+
+SCOPE_SEGMENTS = ("/serving/", "/inference/v2/")
+ACQUIRE_NAMES = frozenset({"allocate", "export_prefix", "begin_migration"})
+
+
+def _assign_target_name(stmt: ast.AST, call: ast.Call):
+    """The plain Name an acquire call's result is bound to, or a
+    classification for the unbound cases."""
+    if isinstance(stmt, ast.Assign) and stmt.value is call:
+        if len(stmt.targets) == 1 and isinstance(stmt.targets[0], ast.Name):
+            return stmt.targets[0].id
+        if all(isinstance(t, (ast.Attribute, ast.Subscript))
+               for t in stmt.targets):
+            return "__stored__"       # stored straight into owner state
+        return "__untracked__"        # tuple-unpack etc.: out of scope
+    if isinstance(stmt, ast.Expr) and stmt.value is call:
+        return "__discarded__"
+    return "__untracked__"            # nested in a larger expression
+
+
+def _contains_name(expr: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(expr))
+
+
+def _is_name_or_slice(expr: ast.AST, name: str) -> bool:
+    """The tracked resource ITSELF handed over: the bare name, a slice/
+    element of it (``pages[off:off + cnt]``), or a starred spread —
+    distinct from a value merely DERIVED from it (``len(pages)``), which
+    transfers nothing."""
+    if isinstance(expr, ast.Starred):
+        expr = expr.value
+    while isinstance(expr, ast.Subscript):
+        expr = expr.value
+    return isinstance(expr, ast.Name) and expr.id == name
+
+
+def _is_packing(expr: ast.AST, name: str) -> bool:
+    """The name packed into a fresh container literal (``m = {...,
+    "exporter": exporter}``) — ownership moves into the new object."""
+    if not isinstance(expr, (ast.Dict, ast.List, ast.Tuple, ast.Set)):
+        return False
+    return _contains_name(expr, name)
+
+
+def _is_absence_test(test: ast.AST, name: str) -> bool:
+    """``name is None`` / ``not name`` — the branch where the Optional
+    acquire returned nothing."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+            and isinstance(test.ops[0], ast.Is) \
+            and isinstance(test.left, ast.Name) and test.left.id == name \
+            and isinstance(test.comparators[0], ast.Constant) \
+            and test.comparators[0].value is None:
+        return True
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+            and isinstance(test.operand, ast.Name) \
+            and test.operand.id == name:
+        return True
+    return False
+
+
+class KVLifetimeChecker(Checker):
+    name = "kv-lifetime"
+    description = ("page/snapshot acquisitions reach a release or "
+                   "ownership transfer on every path, raise paths included")
+
+    def applies(self, rel: str) -> bool:
+        # index every file (the call graph needs the helpers), report
+        # only inside the scope segments
+        return True
+
+    def _in_scope(self, rel: str) -> bool:
+        r = "/" + rel
+        return any(seg in r for seg in SCOPE_SEGMENTS)
+
+    def finish(self, run: Runner) -> None:
+        index = project_index(run)
+        for rel in sorted(run.contexts):
+            if not self._in_scope(rel):
+                continue
+            ctx = run.contexts[rel]
+            if ctx.tree is None:
+                continue
+            for info in index.by_rel.get(rel, ()):
+                self._check_function(run, ctx, index, info)
+
+    # ------------------------------------------------------------ per-func
+
+    def _check_function(self, run: Runner, ctx: FileContext, index,
+                        info) -> None:
+        acquires = []
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Call) \
+                    and call_name(node.func) in ACQUIRE_NAMES:
+                acquires.append(node)
+        # the definition of an acquire primitive is not a use of it
+        acquires = [c for c in acquires
+                    if call_name(c.func) != info.name]
+        if not acquires:
+            return
+        cfg = build_cfg(info.node)
+        # map call -> its CFG node (the node whose exprs contain the call)
+        call_node = {}
+        for n in cfg.nodes:
+            for e in n.exprs:
+                for sub in ast.walk(e):
+                    if isinstance(sub, ast.Call):
+                        call_node.setdefault(id(sub), n)
+        for call in acquires:
+            node = call_node.get(id(call))
+            if node is None:
+                continue  # in a nested def / comprehension: its own scope
+            kind = call_name(call.func)
+            stmt = node.stmt
+            target = _assign_target_name(stmt, call) \
+                if stmt is not None else "__untracked__"
+            if target == "__discarded__":
+                ctx.report(self.name, call.lineno,
+                           f"result of {kind}() is discarded — the "
+                           "acquired pages/snapshot can never be released")
+                continue
+            if target in ("__stored__", "__untracked__"):
+                continue  # stored/handed off in the same statement
+            kills = self._kill_nodes(ctx, cfg, index, info, target)
+            escape = cfg.reach_escape(node.idx, kills)
+            if escape is not None:
+                where = "the function exit" if escape == "exit" \
+                    else "an exception exit"
+                ctx.report(self.name, call.lineno,
+                           f"'{target}' acquired by {kind}() may leak: a "
+                           f"path reaches {where} without a release, "
+                           "ownership transfer, or None-guard")
+
+    def _kill_nodes(self, ctx: FileContext, cfg, index, info,
+                    name: str) -> set:
+        imports = index.imports_by_rel.get(info.rel)
+        kills = set()
+        for n in cfg.nodes:
+            if n.stmt is None:
+                continue
+            settled = False
+            for e in n.exprs:
+                for sub in ast.walk(e):
+                    if isinstance(sub, ast.Call) and \
+                            self._consuming_call(sub, name, index, info,
+                                                 imports):
+                        settled = True
+            stmt = n.stmt
+            if isinstance(stmt, ast.Assign):
+                stored = any(isinstance(t, (ast.Attribute, ast.Subscript))
+                             for t in stmt.targets)
+                if (stored and _contains_name(stmt.value, name)) \
+                        or isinstance(stmt.value, ast.Name) \
+                        and stmt.value.id == name \
+                        or _is_packing(stmt.value, name) \
+                        or any(isinstance(t, ast.Name) and t.id == name
+                               for t in stmt.targets):
+                    # ownership moved: stored into owner state, aliased
+                    # outright, packed into a container, or rebound —
+                    # but a value merely DERIVED from the name
+                    # (`n = len(pages)`) settles nothing
+                    settled = True
+            elif isinstance(stmt, ast.Return) and stmt.value is not None \
+                    and _contains_name(stmt.value, name):
+                settled = True
+            elif isinstance(stmt, ast.Expr) \
+                    and isinstance(stmt.value, (ast.Yield, ast.YieldFrom)) \
+                    and stmt.value.value is not None \
+                    and _contains_name(stmt.value.value, name):
+                settled = True
+            if not settled and self._under_absence_guard(ctx, stmt, name):
+                settled = True
+            if settled:
+                kills.add(n.idx)
+        return kills
+
+    def _consuming_call(self, call: ast.Call, name: str, index, info,
+                        imports) -> bool:
+        # the resource itself must be an argument — a derived value
+        # (`stats.append(len(pages))`) consumes nothing
+        appears = any(
+            _is_name_or_slice(a, name)
+            for a in list(call.args) + [k.value for k in call.keywords])
+        if not appears:
+            return False
+        if call_name(call.func) in SINK_NAMES:
+            return True
+        for target in index.resolve(call, info, imports=imports):
+            pos = None
+            kw = None
+            for i, a in enumerate(call.args):
+                if isinstance(a, ast.Name) and a.id == name:
+                    pos = i
+            for k in call.keywords:
+                if k.arg is not None and isinstance(k.value, ast.Name) \
+                        and k.value.id == name:
+                    kw = k.arg
+            params = target.params
+            if params and params[0] == "self" \
+                    and not isinstance(call.func, ast.Name):
+                params = params[1:]
+            if pos is not None and pos < len(params) \
+                    and params[pos] in target.consuming:
+                return True
+            if kw is not None and kw in target.consuming:
+                return True
+        return False
+
+    def _under_absence_guard(self, ctx: FileContext, stmt, name: str) -> bool:
+        node = stmt
+        while node is not None:
+            parent = ctx.parent(node)
+            if isinstance(parent, ast.If) and node in parent.body \
+                    and _is_absence_test(parent.test, name):
+                return True
+            node = parent
+        return False

@@ -1,0 +1,449 @@
+"""Checker ``state-machine``: the serving stack's lifecycle enums must
+declare their transition tables, every transition site must agree with
+the declared table, and the generated ``docs/STATE_MACHINES.md`` must
+match what the AST actually declares — the same drift-as-finding
+contract as the r11 event table.
+
+What counts as a *state machine* here:
+
+* an ``enum.Enum`` subclass with a **declared transition table** — a
+  dict literal whose keys are ``Enum.MEMBER`` attributes and whose
+  values are sets of members of the same enum (``_ALLOWED`` in
+  ``serving/request.py`` is the canonical shape); or
+* an enum that appears at a **transition site** — a ``to(...)`` /
+  ``_to(...)`` call taking an ``Enum.MEMBER`` argument, or a literal
+  ``<obj>.state = Enum.MEMBER`` store — whether or not anyone declared
+  a table for it yet (that omission is finding #1 below).
+
+Rules, each its own finding class:
+
+1. *no declared table* — an enum with transition sites but no table
+   (``LeaseState`` before r17: ``FleetHealthView._to`` accepted any
+   hop);
+2. *table exhaustiveness* — every member is a key (terminals map to the
+   empty set), and keys/values name only real members;
+3. *direct state write* — a literal ``.state = Enum.MEMBER`` store
+   anywhere but a ``to``/``_to`` transition method (or ``__init__`` /
+   ``__post_init__`` stamping the initial state) bypasses table
+   validation (``router.py``'s ``fr.state = FleetState.…`` sites before
+   r17);
+4. *undeclared transition target* — a ``to``/``_to`` call whose literal
+   target member appears in no table entry's allowed set: statically
+   unreachable per the declared machine;
+5. *non-exhaustive dispatch* — an ``if``/``elif`` chain whose arms are
+   all ``<subject> is Enum.MEMBER`` tests (≥2 of them, one subject, no
+   ``else``) that covers only part of the enum: the unhandled members
+   fall through silently;
+6. *doc drift* — ``docs/STATE_MACHINES.md``'s generated block differs
+   from :func:`render_state_table` over the scanned tree (full-repo
+   scans only; regenerate with ``scripts/dslint.py
+   --sync-state-machines``).
+
+Graceful-degradation **ladders** (``RUNGS = ("normal", …)`` in
+``fleet/autoscale.py``) are extracted into the doc table too — their
+transition rule (moves of ±1 rung) is structural, so only the doc-sync
+direction applies to them.
+"""
+
+import ast
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Checker, FileContext, Runner, collect_files
+
+ENUM_BASES = {"Enum", "IntEnum", "StrEnum", "Flag", "IntFlag"}
+TRANSITION_METHODS = {"to", "_to"}
+INIT_METHODS = {"to", "_to", "__init__", "__post_init__"}
+DOC_REL = "docs/STATE_MACHINES.md"
+DOC_BEGIN = "<!-- dslint:state-machines:begin -->"
+DOC_END = "<!-- dslint:state-machines:end -->"
+
+DOC_HEADER = """# State machines (generated)
+
+Declared lifecycle state machines of the serving stack, extracted from
+the AST by the ``state-machine`` flow checker (docs/ANALYSIS.md).  Do
+not edit the table block by hand — regenerate with::
+
+    python scripts/dslint.py --sync-state-machines
+
+Drift between this file and the declared tables is a tier-1 dslint
+finding, exactly like the OBSERVABILITY.md event table.  ``FleetHealthView``
+pairs its ``LeaseState`` machine with a per-replica **dispatch epoch**
+that bumps on every ALIVE/SUSPECT → DEAD lease expiry — the fencing
+token that makes a zombie's late completions discardable.
+"""
+
+
+class _Machine:
+    def __init__(self, name: str, rel: str, lineno: int,
+                 members: List[str]):
+        self.name = name
+        self.rel = rel
+        self.lineno = lineno
+        self.members = members            # declaration order
+        self.table: Optional[Dict[str, List[str]]] = None
+        self.table_rel: Optional[str] = None
+        self.table_line: int = 0
+
+
+def _enum_bases(cls: ast.ClassDef) -> bool:
+    for b in cls.bases:
+        if isinstance(b, ast.Name) and b.id in ENUM_BASES:
+            return True
+        if isinstance(b, ast.Attribute) and b.attr in ENUM_BASES:
+            return True
+    return False
+
+
+def _enum_members(cls: ast.ClassDef) -> List[str]:
+    out = []
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and not stmt.targets[0].id.startswith("_"):
+            out.append(stmt.targets[0].id)
+    return out
+
+
+def _member_ref(node: ast.AST) -> Optional[Tuple[str, str]]:
+    """``Enum.MEMBER`` -> ("Enum", "MEMBER")."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return (node.value.id, node.attr)
+    return None
+
+
+def _parse_table(value: ast.AST) -> Optional[Tuple[str, Dict[str, List[str]],
+                                                   List[Tuple[str, str]]]]:
+    """A transition-table dict literal -> (enum name, {member: targets},
+    [(enum, member) refs that named a foreign/unknown enum]) or None."""
+    if not isinstance(value, ast.Dict) or not value.keys:
+        return None
+    enum_name = None
+    table: Dict[str, List[str]] = {}
+    refs: List[Tuple[str, str]] = []
+    for k, v in zip(value.keys, value.values):
+        ref = _member_ref(k)
+        if ref is None:
+            return None
+        refs.append(ref)
+        if enum_name is None:
+            enum_name = ref[0]
+        if isinstance(v, ast.Set):
+            targets = []
+            for e in v.elts:
+                r = _member_ref(e)
+                if r is None:
+                    return None
+                refs.append(r)
+                targets.append(r[1])
+        elif isinstance(v, ast.Call) and isinstance(v.func, ast.Name) \
+                and v.func.id == "set" and not v.args:
+            targets = []
+        else:
+            return None
+        table[ref[1]] = targets
+    if any(r[0] != enum_name for r in refs):
+        return None
+    return enum_name, table, refs
+
+
+class StateMachineChecker(Checker):
+    name = "state-machine"
+    description = ("declared transition tables are exhaustive, every "
+                   "transition site agrees with them, STATE_MACHINES.md "
+                   "in sync")
+
+    def __init__(self):
+        self.machines: Dict[str, _Machine] = {}
+        self.ladders: List[Tuple[str, str, int, List[str]]] = []
+        #: enum name -> every (rel, lineno) that declared it; a name
+        #: declared in two files cannot be validated by bare-name keying
+        self._decls: Dict[str, List[Tuple[str, int]]] = {}
+
+    def applies(self, rel: str) -> bool:
+        return True
+
+    # ------------------------------------------------------------- extract
+
+    def _extract(self, run: Runner) -> None:
+        for rel in sorted(run.contexts):
+            ctx = run.contexts[rel]
+            if ctx.tree is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.ClassDef) and _enum_bases(node):
+                    members = _enum_members(node)
+                    if members:
+                        self._decls.setdefault(node.name, []).append(
+                            (rel, node.lineno))
+                        if node.name not in self.machines:
+                            self.machines[node.name] = _Machine(
+                                node.name, rel, node.lineno, members)
+                elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    if isinstance(t, ast.Name) and t.id == "RUNGS" \
+                            and isinstance(node.value, (ast.Tuple, ast.List)) \
+                            and node.value.elts \
+                            and all(isinstance(e, ast.Constant)
+                                    and isinstance(e.value, str)
+                                    for e in node.value.elts):
+                        self.ladders.append(
+                            (t.id, rel, node.lineno,
+                             [e.value for e in node.value.elts]))
+        # a name declared in several files cannot be validated by bare-
+        # name keying: drop it from the machine set (no wrong-member
+        # false findings) and flag it below IF a table claims it
+        ambiguous = {name for name, decls in self._decls.items()
+                     if len({r for r, _ in decls}) > 1}
+        for name in ambiguous:
+            self.machines.pop(name, None)
+        # second pass: tables (enums may be declared in another file)
+        for rel in sorted(run.contexts):
+            ctx = run.contexts[rel]
+            if ctx.tree is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1):
+                    continue
+                parsed = _parse_table(node.value)
+                if parsed is None:
+                    continue
+                enum_name, table, refs = parsed
+                if enum_name in ambiguous:
+                    rels = sorted({r for r, _ in self._decls[enum_name]})
+                    run.report(rel, node.lineno, self.name,
+                               f"transition table for {enum_name} cannot "
+                               f"be validated: the enum name is declared "
+                               f"in multiple files ({', '.join(rels)}) — "
+                               "rename one so the tables key unambiguously")
+                    continue
+                m = self.machines.get(enum_name)
+                if m is None:
+                    continue  # a dict of someone else's constants
+                if m.table is None:
+                    m.table = table
+                    m.table_rel = rel
+                    m.table_line = node.lineno
+                for ename, member in refs:
+                    if member not in m.members:
+                        run.report(rel, node.lineno, self.name,
+                                   f"transition table for {enum_name} names "
+                                   f"unknown member '{member}' (members: "
+                                   f"{', '.join(m.members)})")
+        self.ladders.sort()
+
+    # -------------------------------------------------------------- finish
+
+    def finish(self, run: Runner) -> None:
+        self._extract(run)
+        sites: Dict[str, Tuple[str, int]] = {}  # enum -> first site
+        for rel in sorted(run.contexts):
+            ctx = run.contexts[rel]
+            if ctx.tree is None:
+                continue
+            self._check_file(run, ctx, sites)
+        # rule 1: transitions without a declared table
+        for enum_name in sorted(sites):
+            m = self.machines.get(enum_name)
+            if m is not None and m.table is None:
+                rel, line = sites[enum_name]
+                run.report(rel, line, self.name,
+                           f"{enum_name} has transition sites but no "
+                           "declared transition table — declare an "
+                           "_ALLOWED-style dict next to the enum (pattern: "
+                           "serving/request.py) and validate in the "
+                           "transition method")
+        # rule 2: table exhaustiveness
+        for name in sorted(self.machines):
+            m = self.machines[name]
+            if m.table is None:
+                continue
+            missing = [mem for mem in m.members if mem not in m.table]
+            if missing:
+                run.report(m.table_rel, m.table_line, self.name,
+                           f"transition table for {name} is missing "
+                           f"member(s): {', '.join(missing)} (terminals "
+                           "map to the empty set, never go missing)")
+        self._check_doc_sync(run)
+
+    # ------------------------------------------------------------ per-file
+
+    def _check_file(self, run: Runner, ctx: FileContext,
+                    sites: Dict[str, Tuple[str, int]]) -> None:
+        func_stack: List[str] = []
+
+        def record_site(enum_name: str, line: int) -> None:
+            if enum_name not in sites:
+                sites[enum_name] = (ctx.rel, line)
+
+        def walk(node, funcs):
+            for child in ast.iter_child_nodes(node):
+                inner = funcs
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    inner = funcs + [child.name]
+                self._visit_node(ctx, child, inner, record_site)
+                walk(child, inner)
+
+        walk(ctx.tree, func_stack)
+
+    def _visit_node(self, ctx: FileContext, node, funcs, record_site) -> None:
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in TRANSITION_METHODS:
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                ref = _member_ref(a)
+                if ref is None or ref[0] not in self.machines:
+                    continue
+                m = self.machines[ref[0]]
+                if ref[1] not in m.members:
+                    continue
+                record_site(ref[0], node.lineno)
+                if m.table is not None:
+                    reachable = {t for targets in m.table.values()
+                                 for t in targets}
+                    if ref[1] not in reachable:
+                        ctx.report(self.name, node.lineno,
+                                   f"transition to {ref[0]}.{ref[1]} is "
+                                   "declared unreachable: no table entry "
+                                   "allows it as a target")
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "state":
+                    ref = _member_ref(node.value)
+                    if ref is None or ref[0] not in self.machines \
+                            or ref[1] not in self.machines[ref[0]].members:
+                        continue
+                    record_site(ref[0], node.lineno)
+                    if not (funcs and funcs[-1] in INIT_METHODS):
+                        ctx.report(
+                            self.name, node.lineno,
+                            f"direct state write .state = {ref[0]}."
+                            f"{ref[1]} bypasses the validated transition "
+                            "method — route it through to()/_to() so the "
+                            "declared table is enforced")
+        elif isinstance(node, ast.If):
+            self._check_dispatch_chain(ctx, node)
+
+    def _check_dispatch_chain(self, ctx: FileContext, node: ast.If) -> None:
+        parent = ctx.parent(node)
+        if isinstance(parent, ast.If) and parent.orelse == [node]:
+            return  # not the chain head
+        subject = None
+        enum_name = None
+        covered: List[str] = []
+        cur = node
+        while True:
+            test = cur.test
+            ok = (isinstance(test, ast.Compare) and len(test.ops) == 1
+                  and isinstance(test.ops[0], (ast.Is, ast.Eq)))
+            ref = _member_ref(test.comparators[0]) if ok else None
+            if ref is None or ref[0] not in self.machines \
+                    or ref[1] not in self.machines[ref[0]].members:
+                return
+            subj = ast.dump(test.left)
+            if subject is None:
+                subject, enum_name = subj, ref[0]
+            elif subj != subject or ref[0] != enum_name:
+                return
+            covered.append(ref[1])
+            if len(cur.orelse) == 1 and isinstance(cur.orelse[0], ast.If):
+                cur = cur.orelse[0]
+                continue
+            if cur.orelse:
+                return  # has a final else: exhaustive by construction
+            break
+        if len(covered) < 2:
+            return
+        members = self.machines[enum_name].members
+        missing = [m for m in members if m not in covered]
+        if missing:
+            ctx.report(self.name, node.lineno,
+                       f"state dispatch over {enum_name} handles "
+                       f"{', '.join(covered)} but not "
+                       f"{', '.join(missing)} — add the missing arm(s) "
+                       "or a final else")
+
+    # ------------------------------------------------------------ doc sync
+
+    def render_state_table(self) -> str:
+        lines = [DOC_BEGIN, ""]
+        for name in sorted(self.machines,
+                           key=lambda n: (self.machines[n].rel, n)):
+            m = self.machines[name]
+            if m.table is None:
+                continue
+            lines.append(f"### `{name}` — `{m.rel}`")
+            lines.append("")
+            lines.append("| from | allowed to |")
+            lines.append("|---|---|")
+            for mem in m.members:
+                targets = m.table.get(mem)
+                if targets is None:
+                    cell = "*(missing from table)*"
+                elif not targets:
+                    cell = "— *(terminal)*"
+                else:
+                    ordered = [t for t in m.members if t in targets]
+                    cell = ", ".join(f"`{t}`" for t in ordered)
+                lines.append(f"| `{mem}` | {cell} |")
+            lines.append("")
+        for name, rel, _line, rungs in self.ladders:
+            lines.append(f"### ladder `{name}` — `{rel}`")
+            lines.append("")
+            lines.append(" → ".join(f"`{i} {r}`"
+                                    for i, r in enumerate(rungs)))
+            lines.append("")
+            lines.append("Moves are ±1 rung per update (no skipping), "
+                         "symmetric up and down.")
+            lines.append("")
+        lines.append(DOC_END)
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def extract_doc_block(text: str) -> Optional[str]:
+        i = text.find(DOC_BEGIN)
+        j = text.find(DOC_END)
+        if i < 0 or j < 0 or j < i:
+            return None
+        return text[i:j + len(DOC_END)] + "\n"
+
+    def sync_doc(self, root: str) -> str:
+        """Write the generated doc; returns the path (dslint
+        --sync-state-machines)."""
+        path = os.path.join(root, DOC_REL)
+        content = DOC_HEADER + "\n" + self.render_state_table()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(content)
+        return path
+
+    def _scanned_full_scope(self, run: Runner) -> bool:
+        pkg = os.path.join(run.root, "deepspeed_tpu")
+        if not os.path.isdir(pkg):
+            return True
+        expected = collect_files([pkg], run.root)
+        scanned = set(run.contexts)
+        return all(
+            os.path.relpath(f, run.root).replace(os.sep, "/") in scanned
+            for f in expected)
+
+    def _check_doc_sync(self, run: Runner) -> None:
+        doc_path = os.path.join(run.root, DOC_REL)
+        if not os.path.isfile(doc_path):
+            return  # fixture trees / pre-sync repos: nothing to drift
+        if not self._scanned_full_scope(run):
+            return  # partial scan: absent machines are a scope artifact
+        with open(doc_path, encoding="utf-8") as f:
+            text = f.read()
+        block = self.extract_doc_block(text)
+        if block is None:
+            run.report(DOC_REL, 1, self.name,
+                       "state-machine table markers missing — regenerate "
+                       "with `python scripts/dslint.py "
+                       "--sync-state-machines`")
+        elif block != self.render_state_table():
+            run.report(DOC_REL, 1, self.name,
+                       "committed state-machine table differs from the "
+                       "declared transition tables — run `python "
+                       "scripts/dslint.py --sync-state-machines`")

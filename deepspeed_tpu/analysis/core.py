@@ -250,20 +250,35 @@ class Runner:
     # -------------------------------------------------------------- output
 
     def to_json(self) -> str:
-        doc = {
-            "version": 1,
-            "checkers": sorted(c.name for c in self.checkers),
-            "files_scanned": len(self.files),
-            "suppressions_honored": self.suppressed_count,
-            "findings": [f.to_dict() for f in self.findings],
-        }
-        return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+        return render_json([c.name for c in self.checkers], len(self.files),
+                           self.suppressed_count, self.findings)
 
     def summary(self) -> str:
-        status = "FAIL" if self.findings else "OK"
-        return (f"dslint: {status} — {len(self.findings)} finding(s), "
-                f"{len(self.files)} file(s) scanned, "
-                f"{self.suppressed_count} suppression(s) honored")
+        return render_summary(len(self.files), self.suppressed_count,
+                              self.findings)
+
+
+def render_json(checker_names, files_scanned: int, suppressed: int,
+                findings: Sequence[Finding]) -> str:
+    """THE dslint json format — one renderer shared by the live Runner
+    and the cache's replay (analysis/cache.py), so warm output is
+    byte-identical to cold by construction, not by copy-paste."""
+    doc = {
+        "version": 1,
+        "checkers": sorted(checker_names),
+        "files_scanned": files_scanned,
+        "suppressions_honored": suppressed,
+        "findings": [f.to_dict() for f in findings],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+
+def render_summary(files_scanned: int, suppressed: int,
+                   findings: Sequence[Finding]) -> str:
+    status = "FAIL" if findings else "OK"
+    return (f"dslint: {status} — {len(findings)} finding(s), "
+            f"{files_scanned} file(s) scanned, "
+            f"{suppressed} suppression(s) honored")
 
 
 def collect_files(paths: Iterable[str], root: str) -> List[str]:

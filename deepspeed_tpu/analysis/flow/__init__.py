@@ -1,0 +1,23 @@
+"""Flow-sensitive analysis substrate (r17): per-function CFGs with
+exception edges (:mod:`.cfg`) and a project call-graph index
+(:mod:`.callgraph`), built once per dslint run and shared by the three
+flow checkers (kv-lifetime, state-machine, crash-transparency-interproc).
+
+Kept import-light on purpose: like the rest of ``analysis/``, nothing
+here may import jax or the serving package — dslint's whole-repo run
+budget depends on it (docs/ANALYSIS.md)."""
+
+from .callgraph import ProjectIndex, RELEASE_NAMES, TRANSFER_NAMES, call_name
+from .cfg import CFG, build_cfg
+
+__all__ = ["CFG", "build_cfg", "ProjectIndex", "RELEASE_NAMES",
+           "TRANSFER_NAMES", "call_name"]
+
+
+def project_index(run) -> ProjectIndex:
+    """The run-wide index, built lazily on first use and cached on the
+    Runner — every flow checker's ``finish`` shares one build."""
+    idx = getattr(run, "_flow_index", None)
+    if idx is None:
+        idx = run._flow_index = ProjectIndex.build(run.contexts)
+    return idx

@@ -1,0 +1,279 @@
+"""Project call-graph index, built once per dslint run.
+
+The flow checkers need three cross-file answers the per-file walk cannot
+give:
+
+* *who is this call?* — resolve a call site to the project function(s) it
+  plausibly names (import-alias dotted path, same-file bare name, or a
+  ``self.method`` against the enclosing class);
+* *does the callee consume this argument?* — which parameters of each
+  function are **consuming**: released / ownership-transferred inside the
+  body (directly, or by forwarding to another consuming function — a
+  deterministic fixpoint over the sorted function list);
+* *does the callee swallow broad exceptions?* — the crash-transparency
+  facts of each function body, so the interprocedural checker can follow
+  a guarded handler one call-hop down.
+
+Everything is indexed from the ``FileContext`` objects the Runner already
+holds, so the index costs one extra pass over already-parsed ASTs.  All
+iteration orders are sorted — the index is deterministic for a given file
+set regardless of argument order (asserted in tier-1).
+"""
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+#: call names that end a tracked resource's lifetime when it appears as an
+#: argument: allocator/page release and rollback ...
+RELEASE_NAMES = frozenset({"free", "release", "release_tail", "truncate"})
+#: ... and ownership transfer: registration into a cache/descriptor/
+#: container, or handing the staged payload to an importer that owns its
+#: own failure cleanup
+TRANSFER_NAMES = frozenset({
+    "adopt", "register", "extend", "append", "insert", "add", "add_chunk",
+    "import_prefix", "import_snapshot", "import_pages", "put", "submit",
+    "SequenceDescriptor",
+})
+SINK_NAMES = RELEASE_NAMES | TRANSFER_NAMES
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    rel: str                      # root-relative path of the defining file
+    module: str                   # dotted module tail ("serving.engine")
+    qualname: str                 # "Class.method" or "func"
+    name: str
+    cls: Optional[str]
+    lineno: int
+    node: ast.AST
+    params: Tuple[str, ...]       # positional-or-keyword names, self included
+    consuming: Set[str] = dataclasses.field(default_factory=set)
+    #: (lineno, description) per broad handler that can absorb an
+    #: exception (not guarded, not unavoidably re-raising)
+    swallows: List[Tuple[int, str]] = dataclasses.field(default_factory=list)
+
+
+def _module_of(rel: str) -> str:
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace("/", ".")
+    for prefix in ("deepspeed_tpu.", ):
+        if mod.startswith(prefix):
+            mod = mod[len(prefix):]
+    if mod.endswith(".__init__"):
+        mod = mod[:-len(".__init__")]
+    return mod
+
+
+def call_name(func: ast.AST) -> str:
+    """Terminal name of a call target: ``kv.allocator.allocate`` ->
+    ``allocate``; bare ``export_prefix`` -> ``export_prefix``."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+class ProjectIndex:
+    """All function definitions across the scanned files."""
+
+    def __init__(self):
+        self.functions: List[FunctionInfo] = []
+        self.by_name: Dict[str, List[FunctionInfo]] = {}
+        self.by_rel: Dict[str, List[FunctionInfo]] = {}
+        #: rel -> the file's import-alias map (FileContext.imports)
+        self.imports_by_rel: Dict[str, dict] = {}
+
+    # ------------------------------------------------------------ building
+
+    @classmethod
+    def build(cls, contexts) -> "ProjectIndex":
+        """``contexts``: mapping rel -> FileContext (parsed)."""
+        index = cls()
+        for rel in sorted(contexts):
+            ctx = contexts[rel]
+            if ctx.tree is None:
+                continue
+            index.imports_by_rel[rel] = dict(ctx.imports)
+            index._collect_file(rel, ctx.tree)
+        index.functions.sort(key=lambda f: (f.rel, f.lineno, f.qualname))
+        for f in index.functions:
+            index.by_name.setdefault(f.name, []).append(f)
+            index.by_rel.setdefault(f.rel, []).append(f)
+        index._consuming_fixpoint()
+        return index
+
+    def _collect_file(self, rel: str, tree: ast.AST) -> None:
+        module = _module_of(rel)
+
+        def walk(node, cls_name, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    params = tuple(a.arg for a in
+                                   child.args.posonlyargs + child.args.args)
+                    info = FunctionInfo(
+                        rel=rel, module=module, qualname=qual,
+                        name=child.name, cls=cls_name,
+                        lineno=child.lineno, node=child, params=params)
+                    info.consuming = _direct_consuming(child, params)
+                    info.swallows = _swallowing_handlers(child)
+                    self.functions.append(info)
+                    walk(child, cls_name, qual + ".")
+                elif isinstance(child, ast.ClassDef):
+                    walk(child, child.name, child.name + ".")
+                else:
+                    walk(child, cls_name, prefix)
+
+        walk(tree, None, "")
+
+    def _consuming_fixpoint(self) -> None:
+        """Propagate consumption through forwarding helpers: if ``f(p)``
+        passes ``p`` to a consuming parameter of ``g``, then ``p`` is
+        consuming in ``f`` too.  Iterated to a fixpoint (bounded by the
+        total parameter count; function order is sorted, so the result is
+        order-independent)."""
+        changed = True
+        guard = 0
+        while changed and guard < 20:
+            changed = False
+            guard += 1
+            for f in self.functions:
+                for call in ast.walk(f.node):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    for param in f.params:
+                        if param in f.consuming:
+                            continue
+                        if self._call_consumes(call, param, f):
+                            f.consuming.add(param)
+                            changed = True
+
+    def _call_consumes(self, call: ast.Call, name: str,
+                       caller: Optional[FunctionInfo] = None) -> bool:
+        """Does this call consume the plain-Name argument ``name``?"""
+        cname = call_name(call.func)
+        pos = None
+        kw = None
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Name) and a.id == name:
+                pos = i
+        for k in call.keywords:
+            if isinstance(k.value, ast.Name) and k.value.id == name \
+                    and k.arg is not None:
+                kw = k.arg
+        if pos is None and kw is None:
+            return False
+        if cname in SINK_NAMES:
+            return True
+        imports = self.imports_by_rel.get(caller.rel) if caller else None
+        for target in self.resolve(call, caller, imports=imports):
+            params = target.params
+            if params and params[0] == "self" and \
+                    not isinstance(call.func, ast.Name):
+                params = params[1:]
+            if pos is not None and pos < len(params) \
+                    and params[pos] in target.consuming:
+                return True
+            if kw is not None and kw in target.consuming:
+                return True
+        return False
+
+    # ----------------------------------------------------------- resolving
+
+    def resolve(self, call: ast.Call,
+                caller: Optional[FunctionInfo] = None,
+                imports: Optional[dict] = None) -> List[FunctionInfo]:
+        """Project functions a call site plausibly names.  Conservative:
+        bare names match same-file functions; ``self.m()`` matches methods
+        of the caller's class; dotted/imported names match by module tail
+        + function name (``imports`` is the FileContext alias map)."""
+        func = call.func
+        out: List[FunctionInfo] = []
+        if isinstance(func, ast.Name):
+            dotted = (imports or {}).get(func.id, func.id)
+            name = dotted.split(".")[-1]
+            # "kvtransfer.export_prefix" (a package re-export) must still
+            # find serving/kvtransfer/snapshot.py — match the import's
+            # module segment against any segment of the defining module
+            mod_seg = dotted.rsplit(".", 1)[0].split(".")[-1] \
+                if "." in dotted else None
+            for cand in self.by_name.get(name, ()):
+                if cand.cls is not None:
+                    continue
+                if caller is not None and cand.rel == caller.rel:
+                    out.append(cand)
+                elif mod_seg is not None and \
+                        mod_seg in cand.module.split("."):
+                    out.append(cand)
+        elif isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and caller is not None and caller.cls is not None:
+                for cand in self.by_name.get(func.attr, ()):
+                    if cand.rel == caller.rel and cand.cls == caller.cls:
+                        out.append(cand)
+            elif isinstance(base, ast.Name) and imports is not None:
+                # module-attribute call through an import alias:
+                # ``_fi.check(...)`` after ``import fault_injection as _fi``
+                dotted_mod = imports.get(base.id, base.id)
+                tail = dotted_mod.split(".")[-1]
+                for cand in self.by_name.get(func.attr, ()):
+                    if cand.cls is None and cand.module.split(".")[-1] == tail:
+                        out.append(cand)
+        return out
+
+
+# -------------------------------------------------- per-function fact pass
+
+
+def _direct_consuming(func: ast.AST, params: Sequence[str]) -> Set[str]:
+    """Parameters directly released/transferred in ``func``'s own body:
+    passed to a RELEASE/TRANSFER-named call, stored into an attribute or
+    subscript, or returned/yielded."""
+    wanted = set(params) - {"self", "cls"}
+    out: Set[str] = set()
+    if not wanted:
+        return out
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call) and call_name(node.func) in SINK_NAMES:
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                for n in ast.walk(a):
+                    if isinstance(n, ast.Name) and n.id in wanted:
+                        out.add(n.id)
+        elif isinstance(node, ast.Assign):
+            if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                   for t in node.targets):
+                for n in ast.walk(node.value):
+                    if isinstance(n, ast.Name) and n.id in wanted:
+                        out.add(n.id)
+        elif isinstance(node, (ast.Return, ast.Yield)) and node.value is not None:
+            for n in ast.walk(node.value):
+                if isinstance(n, ast.Name) and n.id in wanted:
+                    out.add(n.id)
+    return out
+
+
+# crash-transparency handler facts (shared shape with the r11 checker —
+# imported from it so the two stay one rule)
+def _swallowing_handlers(func: ast.AST) -> List[Tuple[int, str]]:
+    from ..checkers.crash_transparency import (_is_broad, _is_crash_guard,
+                                               _reraises)
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Try):
+            continue
+        guarded = False
+        for handler in node.handlers:
+            if _is_crash_guard(handler):
+                guarded = True
+                continue
+            if not _is_broad(handler):
+                continue
+            if guarded or _reraises(handler):
+                continue
+            caught = "bare except" if handler.type is None else \
+                f"except {ast.unparse(handler.type)}"
+            out.append((handler.lineno, caught))
+    return out

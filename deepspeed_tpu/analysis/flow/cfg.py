@@ -1,0 +1,326 @@
+"""Per-function control-flow graph with exception edges.
+
+One :class:`CFG` per ``def``: nodes are *simple statements* (compound
+statements contribute a **head** node carrying only their test/iter
+expressions), edges split into normal flow (``succ``) and exception flow
+(``esucc``).  Three synthetic nodes anchor the graph: ``entry``, ``exit``
+(every ``return`` and normal fall-off), and ``raise_exit`` (an exception
+leaving the function).  This is what lets the flow checkers ask the
+question the single-AST-walk checkers structurally cannot: *does every
+path from HERE — including the raise paths — pass through one of THESE
+nodes before leaving the function?*
+
+Exception-edge model (documented over-approximation, tuned to this
+repo's invariants rather than the full language):
+
+* a statement **can raise** iff its own expressions contain a ``Call``,
+  ``Subscript``, ``Await``, ``Raise`` or ``Assert`` — the things that
+  actually throw in this codebase (engine ops, fault-injection probes,
+  ``dict``/page-table lookups).  Attribute reads and arithmetic are
+  treated as total.
+* a raising statement's exception edge goes to every handler of the
+  innermost enclosing ``try`` (any handler *could* match) and — unless
+  one of the handlers is broad (bare / ``Exception`` / ``BaseException``)
+  — onward to the next level out;
+* ``finally`` blocks are duplicated per continuation kind (normal /
+  exception / return / break / continue), so a path through ``finally``
+  cannot teleport between continuations — a body that completes normally
+  can never appear to jump to the function exit through the exception
+  copy of the ``finally``.
+
+Determinism: node indices follow source order, successor sets are
+iterated sorted, and the builder touches no global state — two builds of
+the same function are structurally identical.
+"""
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+_BROAD_NAMES = ("Exception", "BaseException")
+
+
+@dataclasses.dataclass
+class Node:
+    """One CFG node.  ``stmt`` is None for synthetic nodes (entry/exit/
+    raise_exit/finally joins); ``exprs`` holds only the expressions that
+    belong to THIS node (a compound statement's head excludes its body),
+    so checkers walk ``exprs``, never ``stmt`` wholesale."""
+    idx: int
+    stmt: Optional[ast.AST]
+    kind: str                    # "stmt" | "entry" | "exit" | "raise"
+    exprs: Tuple[ast.AST, ...] = ()
+    succ: Set[int] = dataclasses.field(default_factory=set)
+    esucc: Set[int] = dataclasses.field(default_factory=set)
+
+    @property
+    def line(self) -> int:
+        return getattr(self.stmt, "lineno", 0)
+
+
+@dataclasses.dataclass
+class _Frame:
+    """Where the non-local continuations of the current statement list go
+    (already routed through any enclosing ``finally`` copies)."""
+    ret: int                     # target of `return`
+    exc: Tuple[int, ...]         # exception targets (handlers + escape)
+    brk: Optional[int] = None
+    cont: Optional[int] = None
+
+
+def _type_name(node) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _catches_all(handlers: Sequence[ast.ExceptHandler]) -> bool:
+    for h in handlers:
+        if h.type is None or _type_name(h.type) in _BROAD_NAMES:
+            return True
+        if isinstance(h.type, ast.Tuple) and \
+                any(_type_name(e) in _BROAD_NAMES for e in h.type.elts):
+            return True
+    return False
+
+
+def _can_raise(exprs: Sequence[ast.AST]) -> bool:
+    for e in exprs:
+        for n in ast.walk(e):
+            if isinstance(n, (ast.Call, ast.Subscript, ast.Await)):
+                return True
+    return False
+
+
+class CFG:
+    def __init__(self, func: ast.AST):
+        self.func = func
+        self.nodes: List[Node] = []
+        #: finally-copy join -> that copy's live-outs (normal continuation
+        #: copies are wired by the caller once the after-set is known)
+        self._copy_outs: Dict[int, List[int]] = {}
+        self.entry = self._new(None, "entry")
+        self.exit = self._new(None, "exit")
+        self.raise_exit = self._new(None, "raise")
+        frame = _Frame(ret=self.exit, exc=(self.raise_exit, ))
+        outs = self._stmts(func.body, [self.entry], frame)
+        for o in outs:
+            self.nodes[o].succ.add(self.exit)
+
+    # ------------------------------------------------------------ plumbing
+
+    def _new(self, stmt, kind="stmt", exprs=()) -> int:
+        n = Node(idx=len(self.nodes), stmt=stmt, kind=kind,
+                 exprs=tuple(exprs))
+        self.nodes.append(n)
+        return n.idx
+
+    def _connect(self, preds: Sequence[int], target: int) -> None:
+        for p in preds:
+            self.nodes[p].succ.add(target)
+
+    def _stmt_node(self, stmt, frame: _Frame, exprs) -> int:
+        idx = self._new(stmt, "stmt", exprs)
+        if _can_raise(exprs) or isinstance(stmt, (ast.Raise, ast.Assert)):
+            self.nodes[idx].esucc.update(frame.exc)
+        return idx
+
+    # ---------------------------------------------------------- statements
+
+    def _stmts(self, body: Sequence[ast.stmt], preds: List[int],
+               frame: _Frame) -> List[int]:
+        """Wire ``body`` after ``preds``; returns the live-out node set
+        (empty when every path diverted: return/raise/break/continue)."""
+        cur = list(preds)
+        for stmt in body:
+            if not cur:
+                break  # unreachable code: keep walk cheap, skip it
+            cur = self._stmt(stmt, cur, frame)
+        return cur
+
+    def _stmt(self, stmt: ast.stmt, preds: List[int],
+              frame: _Frame) -> List[int]:
+        if isinstance(stmt, ast.If):
+            head = self._stmt_node(stmt, frame, [stmt.test])
+            self._connect(preds, head)
+            outs = self._stmts(stmt.body, [head], frame)
+            if stmt.orelse:
+                outs += self._stmts(stmt.orelse, [head], frame)
+            else:
+                outs.append(head)
+            return outs
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._loop(stmt, preds, frame)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, preds, frame)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            head = self._stmt_node(stmt, frame,
+                                   [i.context_expr for i in stmt.items])
+            self._connect(preds, head)
+            return self._stmts(stmt.body, [head], frame)
+        if hasattr(ast, "Match") and isinstance(stmt, ast.Match):
+            head = self._stmt_node(stmt, frame, [stmt.subject])
+            self._connect(preds, head)
+            outs = [head]  # no case may match
+            for case in stmt.cases:
+                outs += self._stmts(case.body, [head], frame)
+            return outs
+        if isinstance(stmt, ast.Return):
+            exprs = [stmt.value] if stmt.value is not None else []
+            idx = self._stmt_node(stmt, frame, exprs)
+            self._connect(preds, idx)
+            self.nodes[idx].succ.add(frame.ret)
+            return []
+        if isinstance(stmt, ast.Raise):
+            exprs = [e for e in (stmt.exc, stmt.cause) if e is not None]
+            idx = self._stmt_node(stmt, frame, exprs)
+            self._connect(preds, idx)
+            self.nodes[idx].esucc.update(frame.exc)
+            return []
+        if isinstance(stmt, ast.Break):
+            idx = self._stmt_node(stmt, frame, [])
+            self._connect(preds, idx)
+            if frame.brk is not None:
+                self.nodes[idx].succ.add(frame.brk)
+            return []
+        if isinstance(stmt, ast.Continue):
+            idx = self._stmt_node(stmt, frame, [])
+            self._connect(preds, idx)
+            if frame.cont is not None:
+                self.nodes[idx].succ.add(frame.cont)
+            return []
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # nested scope: a single opaque node (decorators/defaults run
+            # here; the body is someone else's CFG)
+            exprs = list(stmt.decorator_list)
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                exprs += [d for d in stmt.args.defaults if d is not None]
+            idx = self._stmt_node(stmt, frame, exprs)
+            self._connect(preds, idx)
+            return [idx]
+        # simple statement: Assign/AugAssign/AnnAssign/Expr/Assert/Delete/
+        # Import/Global/Nonlocal/Pass — one node carrying itself
+        idx = self._stmt_node(stmt, frame, [stmt])
+        self._connect(preds, idx)
+        if isinstance(stmt, ast.Assert):
+            self.nodes[idx].esucc.update(frame.exc)  # a failing assert raises
+        return [idx]
+
+    def _loop(self, stmt, preds: List[int], frame: _Frame) -> List[int]:
+        exprs = [stmt.test] if isinstance(stmt, ast.While) \
+            else [stmt.target, stmt.iter]
+        head = self._stmt_node(stmt, frame, exprs)
+        self._connect(preds, head)
+        after: List[int] = []
+        infinite = isinstance(stmt, ast.While) \
+            and isinstance(stmt.test, ast.Constant) and bool(stmt.test.value)
+        join = self._new(None, "stmt")  # break target placeholder
+        inner = dataclasses.replace(frame, brk=join, cont=head)
+        body_outs = self._stmts(stmt.body, [head], inner)
+        self._connect(body_outs, head)       # loop back edge
+        if stmt.orelse:
+            after += self._stmts(stmt.orelse, [head], frame)
+        elif not infinite:
+            after.append(head)               # test false / iterator empty
+        after.append(join)
+        return after
+
+    def _try(self, stmt: ast.Try, preds: List[int],
+             frame: _Frame) -> List[int]:
+        # finally copies, one per continuation kind — a synthetic join
+        # node enters each copy and the copy's live-outs land on that
+        # continuation's ORIGINAL target only, so a normally-completing
+        # body can never appear to jump to the function exit through the
+        # exception copy of the finally
+        if stmt.finalbody:
+            fin_exc = self._finally_copy(stmt, list(frame.exc), frame)
+            exc_escape = (fin_exc, )
+            ret_target = self._finally_copy(stmt, [frame.ret], frame)
+            brk_target = self._finally_copy(stmt, [frame.brk], frame) \
+                if frame.brk is not None else None
+            cont_target = self._finally_copy(stmt, [frame.cont], frame) \
+                if frame.cont is not None else None
+        else:
+            exc_escape = frame.exc
+            ret_target = frame.ret
+            brk_target, cont_target = frame.brk, frame.cont
+
+        handler_heads: List[int] = []
+        for h in stmt.handlers:
+            exprs = [h.type] if h.type is not None else []
+            handler_heads.append(self._new(h, "stmt", exprs))
+        body_exc = tuple(handler_heads) + \
+            (() if _catches_all(stmt.handlers) else tuple(exc_escape))
+        body_frame = _Frame(ret=ret_target, exc=body_exc,
+                            brk=brk_target, cont=cont_target)
+        body_outs = self._stmts(stmt.body, preds, body_frame)
+
+        outer_frame = _Frame(ret=ret_target, exc=tuple(exc_escape),
+                             brk=brk_target, cont=cont_target)
+        outs: List[int] = []
+        for head_idx, h in zip(handler_heads, stmt.handlers):
+            outs += self._stmts(h.body, [head_idx], outer_frame)
+        if stmt.orelse:
+            outs += self._stmts(stmt.orelse, body_outs, outer_frame)
+        else:
+            outs += body_outs
+        if stmt.finalbody:
+            fin_norm = self._finally_copy(stmt, [], frame)
+            self._connect(outs, fin_norm)
+            return self._copy_outs.pop(fin_norm)
+        return outs
+
+    def _finally_copy(self, stmt: ast.Try, targets: List[int],
+                      frame: _Frame) -> int:
+        """Build one duplicate of ``stmt.finalbody`` entered via a fresh
+        join node; its live-outs connect to ``targets`` (empty = the
+        caller wires them itself via ``_copy_outs``)."""
+        join = self._new(None, "stmt")
+        f = _Frame(ret=frame.ret, exc=frame.exc,
+                   brk=frame.brk, cont=frame.cont)
+        outs = self._stmts(stmt.finalbody, [join], f)
+        for o in outs:
+            for t in targets:
+                self.nodes[o].succ.add(t)
+        if not targets:
+            self._copy_outs[join] = outs
+        return join
+
+    # ------------------------------------------------------------- queries
+
+    def reach_escape(self, start: int, kills: Set[int]) -> Optional[str]:
+        """From node ``start``'s *normal* successors (an exception inside
+        the start statement itself means the resource was never acquired),
+        follow both flow and exception edges; return ``"exit"`` /
+        ``"raise"`` for the first function escape reachable without
+        passing through a ``kills`` node, or None when every path is
+        killed first.  Deterministic: successors visited in sorted order,
+        exit checked before raise."""
+        seen: Set[int] = set()
+        stack = sorted(self.nodes[start].succ)
+        escapes: Set[str] = set()
+        while stack:
+            idx = stack.pop()
+            if idx in seen or idx in kills:
+                continue
+            seen.add(idx)
+            node = self.nodes[idx]
+            if node.kind == "exit":
+                escapes.add("exit")
+                continue
+            if node.kind == "raise":
+                escapes.add("raise")
+                continue
+            stack.extend(sorted(node.succ | node.esucc))
+        if "exit" in escapes:
+            return "exit"
+        if "raise" in escapes:
+            return "raise"
+        return None
+
+
+def build_cfg(func: ast.AST) -> CFG:
+    return CFG(func)

@@ -779,6 +779,16 @@ class ServingEngine:
                            f"{counts['active']} active request(s)")
         return counts
 
+    def drop_trace(self, uid: int) -> None:
+        """Discard this frontend's trace context for ``uid`` WITHOUT
+        emitting phase spans — the router calls it when it fences or
+        re-homes an attempt it can no longer trust (lease expiry): the
+        router folds the attempt's observed history into the client trace
+        itself, so a zombie's eventual terminal emission here would
+        double-tile the attempt window.  Telemetry-only: request and
+        engine state are untouched (the fence/kill path owns those)."""
+        self._trace_ctx.pop(uid, None)
+
     def close(self) -> None:
         """Detach from the engine: restore dict-insertion step ordering and
         release the scheduler's reference to this frontend (a long-lived

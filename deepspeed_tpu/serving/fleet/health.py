@@ -54,6 +54,19 @@ class LeaseState(enum.Enum):
     #                        flight; the replica rejoins only after the ack
 
 
+#: validated lease transitions (dslint state-machine table; the generated
+#: docs/STATE_MACHINES.md renders it).  ALIVE can expire straight to DEAD:
+#: a long idle jump may land past the whole suspect window in one tick.
+#: DEAD leaves only through FENCING — a fleet-dead replica's first
+#: heartbeat starts a fencing episode, never a silent rejoin.
+_LEASE_ALLOWED = {
+    LeaseState.ALIVE: {LeaseState.SUSPECT, LeaseState.DEAD},
+    LeaseState.SUSPECT: {LeaseState.ALIVE, LeaseState.DEAD},
+    LeaseState.DEAD: {LeaseState.FENCING},
+    LeaseState.FENCING: {LeaseState.ALIVE},
+}
+
+
 @dataclasses.dataclass(frozen=True)
 class LeaseConfig:
     #: heartbeat silence (seconds of clock time since the newest heartbeat's
@@ -149,6 +162,9 @@ class FleetHealthView:
         cur = self._state[rid]
         if state is cur:
             return
+        if state not in _LEASE_ALLOWED[cur]:
+            raise ValueError(f"replica {rid}: illegal lease transition "
+                             f"{cur.value} -> {state.value} ({reason})")
         self._state[rid] = state
         self.history.append((rid, cur, state, ts, reason))
         logger.info(f"fleet lease: replica {rid} {cur.value} -> {state.value} "
@@ -293,6 +309,9 @@ class FleetHealthView:
                 sent = self._fence_sent_ts[rid]
                 out.append(now if sent is None
                            else sent + self.config.fence_retry)
+            elif cur is LeaseState.DEAD:
+                pass  # no self-scheduled wake-up: a rejoin is driven by the
+                # zombie's own heartbeat, which is a delivery, not a timer
         return [t for t in out if t > now]
 
     def summary(self) -> dict:

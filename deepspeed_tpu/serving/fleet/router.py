@@ -58,6 +58,21 @@ class FleetState(enum.Enum):
         return self in (FleetState.DONE, FleetState.TIMED_OUT, FleetState.REJECTED)
 
 
+#: validated fleet transitions (dslint state-machine table; rendered into
+#: docs/STATE_MACHINES.md).  PENDING -> DONE covers the one legitimate
+#: shortcut: a failover victim displaced with its output already complete
+#: is closed out at its next dispatch attempt without re-serving a token.
+_FLEET_ALLOWED = {
+    FleetState.PENDING: {FleetState.DISPATCHED, FleetState.DONE,
+                         FleetState.TIMED_OUT, FleetState.REJECTED},
+    FleetState.DISPATCHED: {FleetState.PENDING, FleetState.DONE,
+                            FleetState.TIMED_OUT},
+    FleetState.DONE: set(),
+    FleetState.TIMED_OUT: set(),
+    FleetState.REJECTED: set(),
+}
+
+
 @dataclasses.dataclass
 class FleetRequest:
     """One client request as the FLEET sees it.  ``tokens`` accumulates
@@ -105,6 +120,18 @@ class FleetRequest:
     def __post_init__(self):
         self.prompt = list(self.prompt)
         self.history.append((self.state, self.arrival_ts))
+
+    def to(self, state: FleetState, ts: float) -> None:
+        """The ONLY sanctioned way to move a fleet request: validates the
+        hop against ``_FLEET_ALLOWED`` (an illegal one is a router bug and
+        raises — the exactly-once-terminal property the chaos suite pins
+        is enforced here, not merely asserted at ``_finish``) and appends
+        the auditable history entry in the same step."""
+        if state not in _FLEET_ALLOWED[self.state]:
+            raise ValueError(f"fleet request {self.fid}: illegal transition "
+                             f"{self.state.value} -> {state.value}")
+        self.state = state
+        self.history.append((state, ts))
 
     @property
     def ttft(self) -> Optional[float]:
@@ -607,8 +634,7 @@ class Router:
             fr.trace["last_dead"] = None
         fr._current = (rid, sr, rep.generation)
         fr.dispatches.append((rid, now))
-        fr.state = FleetState.DISPATCHED
-        fr.history.append((FleetState.DISPATCHED, now))
+        fr.to(FleetState.DISPATCHED, now)
         self._dispatched[fr.fid] = fr
         self.stats["dispatches"] += 1
         self._taccount(fr.tenant)["dispatches"] += 1
@@ -753,7 +779,7 @@ class Router:
                     # for the lease to expire and re-home the request)
                     continue
                 self._sync_tokens(fr, sr, now)
-            if sr.state is RequestState.DONE:
+            if sr.state is RequestState.DONE:  # dslint-ok(state-machine): poll folds only replica-TERMINAL outcomes; every other state means the attempt is still live and stays dispatched (MIGRATED/EVICTED are resolved by the migration pump and the replica's own requeue)
                 del self._dispatched[fr.fid]
                 fr._current = None
                 fr.finish_ts = sr.finish_ts if sr.finish_ts is not None else now
@@ -920,12 +946,31 @@ class Router:
         """Displace one DISPATCHED attempt back to PENDING (lease expiry or
         an in-lease restart): tokens preserved up to the last connected
         sync, a COMPLETE router-side migration snapshot harvested for the
-        KV-import fast path, the attempt span closed WITHOUT folding
-        replica-side phase history (the router cannot observe it).
-        Returns the displaced ServingRequest for the fencing audit."""
+        KV-import fast path, the attempt span closed with the replica-side
+        phase history folded and its open tail attributed to
+        ``phase/fenced`` — on BOTH outcomes: an expired lease's work is
+        discarded by the fence proper, and an in-lease restart's old-
+        generation work is discarded by the epoch/generation fencing
+        (transport_poll) — so transport-mode traces still tile
+        [arrival, terminal] (scripts/trace_report.py).  Returns the
+        displaced ServingRequest for the fencing audit."""
         del self._dispatched[fr.fid]
-        sr = fr._current[1]
+        rid, sr, gen = fr._current
         fr._current = None
+        if fr.trace is not None:
+            # the zombie frontend must not ALSO emit this attempt's phase
+            # spans at its own (fenced, discarded) terminal — the tracer
+            # is fleet-shared state, exactly like the request record the
+            # fence audit reads, so dropping the ctx is bookkeeping on
+            # this side of the partition, not a message through it.
+            # Generation-gated: after an in-lease restart the frontend is
+            # a NEW engine whose uids restart at 0 — a blind drop by uid
+            # could discard a live new-generation request's trace ctx
+            # (the old frontend died with the old generation; there is
+            # nothing to drop there)
+            rep = self.pool.replica(rid)
+            if rep.serve is not None and rep.generation == gen:
+                rep.serve.drop_trace(sr.uid)
         self._migrations.pop(fr.fid, None)
         rx = self._mig_rx.pop(fr.fid, None)
         if rx is not None and rx["snap"].complete and fr._kv_snapshot is None:
@@ -933,9 +978,9 @@ class Router:
             self.stats["migration_failover_reuse"] += 1
         fr.failovers += 1
         self._taccount(fr.tenant)["failovers"] += 1
-        fr.state = FleetState.PENDING
-        fr.history.append((FleetState.PENDING, now))
-        self._close_attempt(fr, outcome, now)
+        fr.to(FleetState.PENDING, now)
+        self._close_attempt(fr, outcome, now, displaced_sr=sr,
+                            tail_phase="fenced")
         if fr.trace is not None and fr.trace["attempts"]:
             fr.trace["last_dead"] = fr.trace["attempts"][-1]["span_id"]
         self._pending.append(fr)
@@ -1398,8 +1443,7 @@ class Router:
             self._mig_rx.pop(fid, None)
             del self._dispatched[fid]
             fr._current = None
-            fr.state = FleetState.PENDING
-            fr.history.append((FleetState.PENDING, now))
+            fr.to(FleetState.PENDING, now)
             fr._kv_snapshot = snapshot
             self._close_attempt(fr, "migrated", now)
             if fr.trace is not None and fr.trace["attempts"]:
@@ -1519,8 +1563,7 @@ class Router:
                     self.stats["migration_failover_reuse"] += 1
                 fr.failovers += 1
                 self._taccount(fr.tenant)["failovers"] += 1
-                fr.state = FleetState.PENDING
-                fr.history.append((FleetState.PENDING, now))
+                fr.to(FleetState.PENDING, now)
                 # the dead attempt's spans close NOW (its frontend is
                 # discarded, so the router folds the partial history); the
                 # resumed attempt on a survivor will link back to this
@@ -1566,8 +1609,15 @@ class Router:
         assert not fr.state.terminal, \
             f"fleet request {fr.fid} reached a second terminal state " \
             f"({fr.state.value} then {state.value})"
-        fr.state = state
-        fr.history.append((state, now))
+        if not state.terminal:
+            # checked BEFORE the transition commits: _finish is the
+            # terminal edge and nothing else — a PENDING/DISPATCHED
+            # target would corrupt the conservation receipt (submitted ==
+            # completed + timed_out + rejected), and it must fail with
+            # the request record unmutated (no bogus history entry)
+            raise ValueError(f"_finish called with non-terminal state "
+                             f"{state.value} for fid={fr.fid}")
+        fr.to(state, now)
         t = self._taccount(fr.tenant)
         if state is FleetState.DONE:
             t["completed"] += 1
@@ -1580,6 +1630,9 @@ class Router:
             t["timed_out"] += 1
         elif state is FleetState.REJECTED:
             t["rejected"] += 1
+        else:
+            raise AssertionError(f"unreachable: {state} passed the "
+                                 "terminal precheck")  # guard above
         self._note_victim_resolved(fr, now)
         if fr.trace is not None:
             self._trace_finish(fr, state, now)
@@ -1588,11 +1641,14 @@ class Router:
     # ----------------------------------------------------------- telemetry
 
     def _close_attempt(self, fr: FleetRequest, outcome: str, end_ts: float,
-                       displaced_sr: Optional[ServingRequest] = None) -> None:
+                       displaced_sr: Optional[ServingRequest] = None,
+                       tail_phase: Optional[str] = None) -> None:
         """Materialize the current (last) attempt span.  For a displaced
-        attempt the replica frontend is already discarded, so its partial
-        phase spans are folded here from the ServingRequest history,
-        clamped to the dispatch instant."""
+        attempt the replica frontend is already discarded (kill) or no
+        longer trusted (lease expiry), so its partial phase spans are
+        folded here from the ServingRequest history, clamped to the
+        dispatch instant; ``tail_phase="fenced"`` attributes the open
+        tail past the last observed transition to ``phase/fenced``."""
         tr = fr.trace
         if tr is None or not tr["attempts"]:
             return
@@ -1611,7 +1667,19 @@ class Router:
             if not displaced_sr.state.terminal:
                 emit_attempt_spans(self.tracer, displaced_sr, tr["trace_id"],
                                    att["span_id"], track, end_ts=end_ts,
-                                   clamp_start=att["dispatch_ts"])
+                                   clamp_start=att["dispatch_ts"],
+                                   tail_phase=tail_phase)
+            elif tail_phase is not None:
+                # the zombie finished BEFORE the lease expired (its own
+                # frontend already emitted phases up to its terminal); the
+                # stretch from that discarded terminal to the displacement
+                # is fenced time, or the attempt window under-tiles
+                t_term = displaced_sr.history[-1][1]
+                if end_ts > t_term:
+                    self.tracer.add_span(f"phase/{tail_phase}",
+                                         tr["trace_id"], t_term, end_ts,
+                                         parent_id=att["span_id"],
+                                         track=track)
             tr["last_dead"] = att["span_id"]
         attrs = {"rid": att["rid"], "generation": att["generation"],
                  "outcome": outcome, "resume_tokens": att["resume_tokens"]}

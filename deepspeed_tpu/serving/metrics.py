@@ -55,7 +55,7 @@ class ServingStats:
         self.reject_reasons[reason] = self.reject_reasons.get(reason, 0) + 1
 
     def record_terminal(self, req: ServingRequest) -> None:
-        if req.state is RequestState.TIMED_OUT:
+        if req.state is RequestState.TIMED_OUT:  # dslint-ok(state-machine): only the timed_out/migrated tallies live here — DONE is derived from `finished` and REJECTED is counted in record_reject
             self.timed_out += 1
         elif req.state is RequestState.MIGRATED:
             self.migrated += 1

@@ -49,7 +49,8 @@ PHASE_OF_STATE = {
 
 def phase_intervals(history: List[Tuple[RequestState, float]],
                     end_ts: Optional[float] = None,
-                    clamp_start: Optional[float] = None
+                    clamp_start: Optional[float] = None,
+                    tail_phase: Optional[str] = None
                     ) -> List[Tuple[str, float, float]]:
     """Fold a state history into ``(phase, t0, t1)`` intervals.
 
@@ -57,12 +58,23 @@ def phase_intervals(history: List[Tuple[RequestState, float]],
     attempts whose history never reached a terminal entry); terminal
     entries are points and close the walk.  Zero-length intervals are
     dropped.  ``clamp_start`` clips every interval's start (see module
-    docstring)."""
+    docstring).
+
+    ``tail_phase`` relabels the OPEN tail — the stretch from the last
+    recorded transition to ``end_ts`` — with a caller-supplied phase
+    name.  The fleet router uses ``"fenced"`` for lease-expired/fenced
+    attempts: the router credits the phases it observed up to the last
+    transition it could know about, and attributes the remainder of the
+    attempt window — work served outside the replica's lease, later
+    discarded by the fence — to ``phase/fenced``, so transport-mode
+    traces still tile [arrival, terminal] exactly
+    (scripts/trace_report.py)."""
     out: List[Tuple[str, float, float]] = []
     for i, (state, ts) in enumerate(history):
         if state.terminal:
             break
-        if i + 1 < len(history):
+        open_tail = i + 1 >= len(history)
+        if not open_tail:
             nxt = history[i + 1][1]
         elif end_ts is not None:
             nxt = end_ts
@@ -70,21 +82,26 @@ def phase_intervals(history: List[Tuple[RequestState, float]],
             break  # open-ended non-terminal tail with no close time: skip
         t0 = ts if clamp_start is None else max(ts, clamp_start)
         if nxt > t0 and state in PHASE_OF_STATE:
-            out.append((PHASE_OF_STATE[state], t0, nxt))
+            phase = tail_phase if (open_tail and tail_phase is not None) \
+                else PHASE_OF_STATE[state]
+            out.append((phase, t0, nxt))
     return out
 
 
 def emit_attempt_spans(tracer: Tracer, req: ServingRequest, trace_id: int,
                        parent_id: Optional[int], track: str,
                        end_ts: Optional[float] = None,
-                       clamp_start: Optional[float] = None) -> List[Span]:
+                       clamp_start: Optional[float] = None,
+                       tail_phase: Optional[str] = None) -> List[Span]:
     """Materialize one serving attempt's phase spans (children of
     ``parent_id``) plus its preemption span events.  Used by the serving
     frontend at request terminal and by the fleet router for the partial
-    attempt a replica death displaced."""
+    attempt a replica death (or lease expiry — ``tail_phase="fenced"``)
+    displaced."""
     spans = []
     for phase, t0, t1 in phase_intervals(req.history, end_ts=end_ts,
-                                         clamp_start=clamp_start):
+                                         clamp_start=clamp_start,
+                                         tail_phase=tail_phase):
         spans.append(tracer.add_span(f"phase/{phase}", trace_id, t0, t1,
                                      parent_id=parent_id, track=track))
     return spans

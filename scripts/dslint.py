@@ -37,9 +37,12 @@ def load_analysis(root: str = REPO_ROOT):
     return analysis
 
 
-def run_dslint(paths, root=REPO_ROOT, checkers=None):
+def run_dslint(paths, root=REPO_ROOT, checkers=None, use_cache=False):
     """Programmatic entry (the tier-1 test and the atomic-write shim use
-    this): returns the populated ``analysis.core.Runner``."""
+    this): returns the populated ``analysis.core.Runner`` — or, on a warm
+    ``use_cache=True`` hit, an ``analysis.cache.CachedResult`` with the
+    identical output surface (same ``--json`` bytes; see
+    analysis/cache.py for the conservative invalidation stance)."""
     analysis = load_analysis()
     everything = analysis.all_checkers()
     selected = everything
@@ -52,9 +55,25 @@ def run_dslint(paths, root=REPO_ROOT, checkers=None):
                 f"unknown checker(s): {', '.join(unknown)} "
                 f"(known: {', '.join(sorted(c.name for c in everything))})")
         selected = [c for c in everything if c.name in wanted]
+    cache = key = hashes = None
+    if use_cache:
+        from analysis.cache import DslintCache
+        names = [c.name for c in selected]
+        cache = DslintCache(root)
+        files = analysis.core.collect_files(
+            [p if os.path.isabs(p) else os.path.join(root, p)
+             for p in paths], root)
+        hashes = cache.file_hashes(files)
+        key = cache.scan_key(names, hashes)
+        rec = cache.lookup(key, hashes)
+        if rec is not None:
+            return cache.result_of(rec)
     runner = analysis.Runner(root, selected,
                              known_checker_names=[c.name for c in everything])
     runner.run(paths)
+    if cache is not None:
+        cache.store(key, [c.name for c in selected], hashes, runner.files,
+                    runner.findings, runner.suppressed_count)
     return runner
 
 
@@ -70,19 +89,33 @@ def main() -> int:
     ap.add_argument("--checkers", default=None,
                     help="comma-separated subset of checkers to run")
     ap.add_argument("--list-checkers", action="store_true")
+    ap.add_argument("--sync-state-machines", action="store_true",
+                    help="regenerate docs/STATE_MACHINES.md from the "
+                         "declared transition tables, then exit")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the .dslint_cache/ incremental cache "
+                         "(reads and writes)")
     args = ap.parse_args()
 
     analysis = load_analysis()
     if args.list_checkers:
         for c in analysis.all_checkers():
-            print(f"{c.name:20s} {c.description}")
+            print(f"{c.name:30s} {c.description}")
+        return 0
+    if args.sync_state_machines:
+        root = os.path.abspath(args.root)
+        runner = run_dslint(args.paths or ["deepspeed_tpu", "scripts"],
+                            root=root, checkers=["state-machine"])
+        sm = next(c for c in runner.checkers if c.name == "state-machine")
+        print(f"wrote {sm.sync_doc(root)}")
         return 0
 
     paths = args.paths or ["deepspeed_tpu", "scripts"]
     checkers = args.checkers.split(",") if args.checkers else None
     try:
         runner = run_dslint(paths, root=os.path.abspath(args.root),
-                            checkers=checkers)
+                            checkers=checkers,
+                            use_cache=not args.no_cache)
     except ValueError as e:
         print(f"dslint: error: {e}", file=sys.stderr)
         return 2

@@ -8,7 +8,11 @@ Input: a trace exported by ``deepspeed_tpu.telemetry.write_chrome_trace``
 failover re-dispatch wait), ``queued`` (replica admission queue, incl.
 preemption requeue and submit backoff), ``prefill``, ``decode``,
 ``migrating`` (paused for chunked KV export — the per-request
-cost of a disaggregated prefill→decode handoff), ``evicted`` — are
+cost of a disaggregated prefill→decode handoff), ``evicted``,
+``fenced`` (the open tail of an attempt the router displaced without
+observing its end: a lease expiry, or an in-lease restart detected by
+the heartbeat's generation bump — either way the fencing discipline
+discarded that work rather than crediting it to a served phase) — are
 summed into a per-request breakdown, then aggregated
 into the fleet-level critical path: where does a request's latency
 actually go — queueing, prompt processing, token generation, or
@@ -39,7 +43,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 from deepspeed_tpu.serving.metrics import percentile_summary  # noqa: E402
 
-PHASES = ("pending", "queued", "prefill", "decode", "migrating", "evicted")
+PHASES = ("pending", "queued", "prefill", "decode", "migrating", "evicted",
+          "fenced")
 _US = 1e6
 
 

@@ -1,0 +1,22 @@
+from ..telemetry.util import emit_reraise, emit_swallow
+
+
+class InjectedCrash(BaseException):
+    pass
+
+
+def tick(monitor, events, work):
+    try:
+        work()
+        emit_reraise(monitor, events)   # the helper re-raises: no hole
+    except InjectedCrash:
+        raise
+    except Exception:
+        return None
+
+
+def untick(monitor, events):
+    # a swallowing helper called OUTSIDE any crash-guarded try is the
+    # plain checker's territory (where the caller never promised
+    # transparency), not this checker's
+    emit_swallow(monitor, events)

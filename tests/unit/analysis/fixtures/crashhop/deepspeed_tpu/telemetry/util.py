@@ -1,0 +1,18 @@
+"""crash-transparency-interproc fixture: helpers OUTSIDE the scoped
+dirs — the single-hop checker never scans this file, which is exactly
+the laundering gap the interprocedural lift closes."""
+
+
+def emit_swallow(monitor, events):
+    try:
+        monitor.write(events)
+    except Exception:
+        pass  # absorbs InjectedCrash one hop below the caller's guard
+
+
+def emit_reraise(monitor, events):
+    try:
+        monitor.write(events)
+    except Exception:
+        monitor.drop()
+        raise

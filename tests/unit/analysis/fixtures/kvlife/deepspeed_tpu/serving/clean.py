@@ -1,0 +1,39 @@
+"""kv-lifetime fixture: the sanctioned lifetime patterns, zero findings."""
+
+
+def guarded_release(kv, n, scatter):
+    # the repo's canonical import pattern: allocate after every
+    # validation, free-and-reraise if the scatter fails
+    pages = kv.allocator.allocate(n)
+    try:
+        scatter(pages)
+    except BaseException:
+        kv.allocator.free(pages)
+        raise
+    return pages
+
+
+def transfer_in_same_statement(kv, seq, n):
+    seq.pages.extend(kv.allocator.allocate(n))
+
+
+def optional_with_none_guard(engine, tokens):
+    snap = export_prefix(engine, tokens)
+    if snap is None:
+        return 0
+    return engine.import_prefix(snap)
+
+
+def ownership_store(kv, n, table, fid):
+    pages = kv.allocator.allocate(n)
+    table[fid] = pages            # owner state holds them now
+    return fid
+
+
+def released_through_helper(kv, n):
+    pages = kv.allocator.allocate(n)
+    _give_back(kv, pages)         # consuming-param helper, one hop down
+
+
+def _give_back(kv, pages):
+    kv.allocator.free(pages)

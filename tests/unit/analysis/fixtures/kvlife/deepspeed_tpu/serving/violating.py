@@ -1,0 +1,29 @@
+"""kv-lifetime fixture: every leak class the checker must catch."""
+
+
+def leak_on_exception_edge(kv, n, seqs, uid):
+    # the validate() call can raise BEFORE the free: the pages leak on
+    # the exception edge even though the happy path releases them
+    pages = kv.allocator.allocate(n)
+    seqs[uid].validate(pages)
+    kv.allocator.free(pages)
+
+
+def leak_discarded(kv, n):
+    kv.allocator.allocate(n)
+
+
+def leak_optional_before_guard(engine, tokens, log):
+    snap = export_prefix(engine, tokens)
+    log.write(str(len(tokens)))   # can raise while the snapshot is live
+    if snap is None:
+        return 0
+    return engine.import_prefix(snap)
+
+
+def leak_on_conditional_return(kv, n, ready):
+    pages = kv.allocator.allocate(n)
+    if not ready:
+        return None               # walks out holding the pages
+    kv.allocator.free(pages)
+    return n

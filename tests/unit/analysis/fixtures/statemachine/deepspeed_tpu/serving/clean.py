@@ -1,0 +1,40 @@
+"""state-machine fixture: a fully-declared machine, zero findings."""
+
+import enum
+
+
+class FlowState(enum.Enum):
+    COLD = "cold"
+    WARM = "warm"
+    CLOSED = "closed"
+
+
+_ALLOWED = {
+    FlowState.COLD: {FlowState.WARM},
+    FlowState.WARM: {FlowState.COLD, FlowState.CLOSED},
+    FlowState.CLOSED: set(),
+}
+
+
+class Stream:
+    def __init__(self):
+        self.state = FlowState.COLD
+
+    def to(self, state, ts):
+        if state not in _ALLOWED[self.state]:
+            raise ValueError(f"illegal {self.state} -> {state}")
+        self.state = state
+
+    def warm_up(self, ts):
+        self.to(FlowState.WARM, ts)
+
+    def close(self, ts):
+        self.to(FlowState.CLOSED, ts)
+
+    def label(self):
+        if self.state is FlowState.COLD:
+            return "cold"
+        elif self.state is FlowState.WARM:
+            return "warm"
+        elif self.state is FlowState.CLOSED:
+            return "closed"

@@ -1,0 +1,42 @@
+"""state-machine fixture: one violation per rule class."""
+
+import enum
+
+
+class PhaseState(enum.Enum):
+    IDLE = "idle"
+    RUNNING = "running"
+    DRAINING = "draining"
+    DONE = "done"
+
+
+# rule: table must key every member — DRAINING is missing
+_ALLOWED = {
+    PhaseState.IDLE: {PhaseState.RUNNING},
+    PhaseState.RUNNING: {PhaseState.DRAINING, PhaseState.DONE},
+    PhaseState.DONE: set(),
+}
+
+
+class Job:
+    def __init__(self):
+        self.state = PhaseState.IDLE
+
+    def to(self, state, ts):
+        self.state = state
+
+    def shortcut(self, ts):
+        # rule: a literal state write outside to()/_to() bypasses the table
+        self.state = PhaseState.DONE
+
+    def rewind(self, ts):
+        # rule: IDLE appears in no table entry's allowed set — the
+        # declared machine says this hop cannot exist
+        self.to(PhaseState.IDLE, ts)
+
+    def report(self):
+        # rule: dispatch chain with no else covers only part of the enum
+        if self.state is PhaseState.IDLE:
+            return "cold"
+        elif self.state is PhaseState.RUNNING:
+            return "hot"

@@ -1,0 +1,154 @@
+"""Targeted CFG + call-graph unit tests (analysis/flow/): the exception-
+edge and finally-duplication semantics the kv-lifetime checker's verdicts
+rest on.  dslint-level behaviour (fixtures, determinism, doc sync) lives
+in tests/unit/test_dslint.py."""
+
+import ast
+import os
+import sys
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                         "..", "..", ".."))
+PKG_DIR = os.path.join(REPO_ROOT, "deepspeed_tpu")
+if PKG_DIR not in sys.path:
+    sys.path.insert(0, PKG_DIR)
+
+from analysis.flow import build_cfg  # noqa: E402
+from analysis.flow.callgraph import ProjectIndex  # noqa: E402
+
+
+def _cfg_of(src):
+    tree = ast.parse(src)
+    func = next(n for n in ast.walk(tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+    return build_cfg(func)
+
+
+def _node_at(cfg, line):
+    return next(n for n in cfg.nodes if n.stmt is not None and n.line == line)
+
+
+def _kill_lines(cfg, lines):
+    return {n.idx for n in cfg.nodes
+            if n.stmt is not None and n.line in lines}
+
+
+def test_call_gets_exception_edge_raise_reaches_exit():
+    cfg = _cfg_of(
+        "def f(x, release):\n"
+        "    r = acquire(x)\n"      # line 2
+        "    probe(r)\n"            # line 3: can raise past the release
+        "    release(r)\n")         # line 4
+    acq = _node_at(cfg, 2)
+    assert cfg.reach_escape(acq.idx, _kill_lines(cfg, {4})) == "raise"
+    # killing the raising probe itself still leaves the normal path,
+    # which IS killed by the release — no escape
+    assert cfg.reach_escape(acq.idx, _kill_lines(cfg, {3, 4})) is None
+
+
+def test_handler_that_releases_covers_the_raise_path():
+    cfg = _cfg_of(
+        "def f(x, release):\n"
+        "    r = acquire(x)\n"        # 2
+        "    try:\n"
+        "        probe(r)\n"          # 4
+        "    except BaseException:\n"
+        "        release(r)\n"        # 6
+        "        raise\n"
+        "    release(r)\n")           # 8
+    acq = _node_at(cfg, 2)
+    assert cfg.reach_escape(acq.idx, _kill_lines(cfg, {6, 8})) is None
+    # drop the handler release and the raise path escapes
+    assert cfg.reach_escape(acq.idx, _kill_lines(cfg, {8})) == "raise"
+
+
+def test_finally_copies_do_not_teleport_between_continuations():
+    # the finally is NOT a release; the normal path's release after the
+    # try must still be reachable-through — a naive single-copy finally
+    # would let the normal path exit through the exception copy
+    cfg = _cfg_of(
+        "def f(x, release, log):\n"
+        "    r = acquire(x)\n"        # 2
+        "    try:\n"
+        "        probe(r)\n"          # 4
+        "    finally:\n"
+        "        log()\n"             # 6
+        "    release(r)\n")           # 7
+    acq = _node_at(cfg, 2)
+    # raise path: probe raises -> finally -> escape (release never runs)
+    assert cfg.reach_escape(acq.idx, _kill_lines(cfg, {7})) == "raise"
+    # but the NORMAL path must be killed by line 7 — only the raise
+    # escape may remain, never a normal-exit one
+    kills = _kill_lines(cfg, {7})
+    seen, stack, escapes = set(), sorted(cfg.nodes[acq.idx].succ), set()
+    while stack:
+        i = stack.pop()
+        if i in seen or i in kills:
+            continue
+        seen.add(i)
+        n = cfg.nodes[i]
+        if n.kind in ("exit", "raise"):
+            escapes.add(n.kind)
+            continue
+        stack.extend(sorted(n.succ | n.esucc))
+    assert escapes == {"raise"}
+
+
+def test_loop_break_and_while_true():
+    cfg = _cfg_of(
+        "def f(xs, release):\n"
+        "    r = acquire(xs)\n"       # 2
+        "    while True:\n"
+        "        if step(r):\n"       # 4
+        "            break\n"
+        "    release(r)\n")           # 6
+    acq = _node_at(cfg, 2)
+    # the only escapes are step()'s raise edge; the break lands on the
+    # release, and `while True` has no test-false exit
+    assert cfg.reach_escape(acq.idx, _kill_lines(cfg, {4, 6})) is None
+    assert cfg.reach_escape(acq.idx, _kill_lines(cfg, {6})) == "raise"
+
+
+def test_consuming_param_fixpoint_propagates_through_forwarders():
+    src = (
+        "def sink(kv, pages):\n"
+        "    kv.allocator.free(pages)\n"
+        "def forward(kv, pages):\n"
+        "    sink(kv, pages)\n"
+        "def forward2(kv, pages):\n"
+        "    forward(kv, pages)\n")
+
+    class _Ctx:
+        tree = ast.parse(src)
+        imports = {}
+
+    index = ProjectIndex.build({"serving/mod.py": _Ctx()})
+    by = {f.name: f for f in index.functions}
+    assert "pages" in by["sink"].consuming
+    assert "pages" in by["forward"].consuming
+    assert "pages" in by["forward2"].consuming
+    assert "kv" not in by["sink"].consuming
+
+
+def test_swallowing_handler_facts():
+    src = (
+        "def bad(m, e):\n"
+        "    try:\n"
+        "        m.write(e)\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "def good(m, e):\n"
+        "    try:\n"
+        "        m.write(e)\n"
+        "    except Exception:\n"
+        "        m.drop()\n"
+        "        raise\n")
+
+    class _Ctx:
+        tree = ast.parse(src)
+        imports = {}
+
+    index = ProjectIndex.build({"telemetry/mod.py": _Ctx()})
+    by = {f.name: f for f in index.functions}
+    assert by["bad"].swallows and by["bad"].swallows[0][0] == 4
+    assert not by["good"].swallows

@@ -1,0 +1,98 @@
+"""Regression tests for the r17 state-machine findings' fixes: the
+transitions that used to be bare assignments are now validated against
+declared tables (dslint ``state-machine``; docs/STATE_MACHINES.md).
+
+* ``FleetRequest.to`` replaced five direct ``fr.state =`` writes in
+  router.py — an illegal hop (any terminal -> anything, or a skip the
+  table forbids) is a router bug and raises;
+* ``FleetHealthView._to`` validates against ``_LEASE_ALLOWED`` — before
+  r17 it recorded ANY hop, so a zombie could e.g. rejoin ALIVE straight
+  from DEAD without a fencing episode;
+* ``Router._finish`` rejects non-terminal targets instead of silently
+  corrupting the conservation receipt.
+"""
+
+import types
+
+import pytest
+
+from deepspeed_tpu.serving.fleet.health import (FleetHealthView, LeaseState,
+                                                _LEASE_ALLOWED)
+from deepspeed_tpu.serving.fleet.router import (FleetRequest, FleetState,
+                                                Router, _FLEET_ALLOWED)
+
+
+def _fr(**kw):
+    kw.setdefault("fid", 0)
+    kw.setdefault("prompt", [1, 2])
+    kw.setdefault("max_new_tokens", 4)
+    kw.setdefault("arrival_ts", 0.0)
+    return FleetRequest(**kw)
+
+
+def test_fleet_request_failover_roundtrip_and_terminal_once():
+    fr = _fr()
+    fr.to(FleetState.DISPATCHED, 1.0)
+    fr.to(FleetState.PENDING, 2.0)       # failover displacement
+    fr.to(FleetState.DISPATCHED, 3.0)
+    fr.to(FleetState.DONE, 4.0)
+    assert [s for s, _ in fr.history] == [
+        FleetState.PENDING, FleetState.DISPATCHED, FleetState.PENDING,
+        FleetState.DISPATCHED, FleetState.DONE]
+    # terminal states are sinks: the exactly-once property is enforced,
+    # not merely asserted downstream
+    for nxt in FleetState:
+        with pytest.raises(ValueError, match="illegal transition"):
+            fr.to(nxt, 5.0)
+
+
+def test_fleet_request_illegal_hops_raise():
+    fr = _fr()
+    with pytest.raises(ValueError, match="illegal transition"):
+        fr.to(FleetState.PENDING, 1.0)     # self-loop is not a transition
+    fr.to(FleetState.REJECTED, 1.0)
+    with pytest.raises(ValueError, match="illegal transition"):
+        fr.to(FleetState.DISPATCHED, 2.0)  # resurrect a rejected request
+
+
+def test_fleet_table_covers_every_member():
+    assert set(_FLEET_ALLOWED) == set(FleetState)
+    for state in FleetState:
+        assert state.terminal == (not _FLEET_ALLOWED[state])
+
+
+def test_lease_transitions_validated():
+    view = FleetHealthView([0])
+    # ALIVE cannot jump straight into a fencing episode — FENCING is
+    # reachable only from DEAD (a fleet-dead replica's heartbeat)
+    with pytest.raises(ValueError, match="illegal lease transition"):
+        view._to(0, LeaseState.FENCING, 1.0, "test")
+    view._to(0, LeaseState.SUSPECT, 1.0, "silence")
+    view._to(0, LeaseState.DEAD, 2.0, "lease expired")
+    # the pre-r17 hole: a zombie must NOT rejoin without the fence
+    with pytest.raises(ValueError, match="illegal lease transition"):
+        view._to(0, LeaseState.ALIVE, 3.0, "zombie rejoin")
+    view._to(0, LeaseState.FENCING, 3.0, "heartbeat from the fleet-dead")
+    view._to(0, LeaseState.ALIVE, 4.0, "fence acked")
+    assert [s for _, _, s, _, _ in view.history] == [
+        LeaseState.SUSPECT, LeaseState.DEAD, LeaseState.FENCING,
+        LeaseState.ALIVE]
+    assert set(_LEASE_ALLOWED) == set(LeaseState)
+
+
+def test_router_finish_rejects_non_terminal_target():
+    fr = _fr()
+    fr.to(FleetState.DISPATCHED, 1.0)
+    fake = types.SimpleNamespace(
+        _taccount=lambda tenant: {"completed": 0, "tokens": 0,
+                                  "deadline_met": 0, "timed_out": 0,
+                                  "rejected": 0},
+        ttft_log=[])
+    # DISPATCHED -> PENDING passes the table (failover), but _finish is
+    # the terminal edge and must refuse to be used as a requeue — and it
+    # must refuse BEFORE mutating the request record
+    with pytest.raises(ValueError, match="non-terminal"):
+        Router._finish(fake, fr, FleetState.PENDING, 2.0)
+    assert fr.state is FleetState.DISPATCHED
+    assert [s for s, _ in fr.history] == [FleetState.PENDING,
+                                          FleetState.DISPATCHED]

@@ -247,6 +247,50 @@ def test_replica_timeout_trace_tiles_at_the_replica_stamp(trained_params):
     assert report["states"].get("timed_out", 0) == len(timed_out)
 
 
+def test_split_brain_trace_tiles_with_fenced_phase(trained_params):
+    """Regression (r17 lease-aware tracing): a lease-expired attempt's
+    replica-side phase spans are folded at displacement with the open
+    tail attributed to ``phase/fenced`` — time served outside the lease
+    and discarded by the fence — so a transport-mode split-brain trace
+    tiles [arrival, terminal] and the fold's verify passes, instead of
+    under-tiling by the whole zombie attempt window."""
+    from deepspeed_tpu.serving.fleet import (ControlTransport, LeaseConfig,
+                                             LeastOutstandingPolicy,
+                                             PartitionWindow)
+
+    def make():
+        kv = PagedKVConfig(num_pages=64, page_size=8, max_pages_per_seq=8)
+        sched = SchedulerConfig(token_budget=64, max_seqs=8, prefill_chunk=8,
+                                decode_bucket=4)
+        return build_engine(CFG, trained_params, RaggedInferenceEngineConfig(
+            kv=kv, scheduler=sched, kv_dtype=jnp.float32,
+            decode_steps_per_dispatch=1))
+
+    clock = VirtualClock()
+    tracer = Tracer(clock=clock)
+    transport = ControlTransport(clock, partitions=[
+        PartitionWindow("splitbrain", 6.0, 30.0, (("router", 0),))])
+    pool = ReplicaPool(make, 2, clock=clock, transport=transport,
+                       tracer=tracer)
+    router = Router(pool, LeastOutstandingPolicy(), transport=transport,
+                    lease_config=LeaseConfig(suspect_after=2.0, lease=6.0))
+    arrivals = [dict(prompt=PROMPTS[0], max_new_tokens=16, arrival_ts=0.0),
+                # a trailing arrival past the heal keeps the simulation
+                # alive through the fence handshake
+                dict(prompt=PROMPTS[1], max_new_tokens=16, arrival_ts=34.0)]
+    reqs = FleetSimulator(router).run(arrivals)
+    assert [r.state for r in reqs] == [FleetState.DONE] * 2
+    assert reqs[0].failovers == 1
+    assert router.summary()["control_plane"]["lease_expirations"] == 1
+    report = _script("trace_report.py").fold(to_chrome_trace(tracer.spans),
+                                             tol=1e-6)
+    assert report["verification"]["mismatches"] == 0, \
+        report["verification"]
+    assert report["n_requests"] == 2
+    # the displaced attempt's post-sync window landed in the new phase
+    assert report["critical_path"]["fenced"]["total_s"] > 0
+
+
 def test_trace_report_flags_unaccounted_time(trained_params):
     _, tracer, _ = _run_fleet(trained_params)
     doc = to_chrome_trace(tracer.spans)
