@@ -22,10 +22,10 @@ from ..core import Checker, FileContext, Runner, collect_files
 
 EVENT_RE = re.compile(
     r"^(resilience|serving|fleet|telemetry|monitor|profiler|spec|migration"
-    r"|prefix|transport)/[a-z0-9_]+(/[a-z0-9_]+)*$")
+    r"|prefix|transport|slo|ctrl|recorder)/[a-z0-9_]+(/[a-z0-9_]+)*$")
 _PREFIXES = ("resilience/", "serving/", "fleet/", "telemetry/",
              "monitor/", "profiler/", "spec/", "migration/", "prefix/",
-             "transport/")
+             "transport/", "slo/", "ctrl/", "recorder/")
 REGISTRY_REL = "telemetry/event_registry.py"
 
 

@@ -59,7 +59,8 @@ class ServingEngine:
     """Drives an :class:`InferenceEngineV2` as a servable endpoint."""
 
     def __init__(self, engine, clock=None, config: ServingConfig = None, monitor=None,
-                 tracer=None, metrics=None, trace_track: str = "serving"):
+                 tracer=None, metrics=None, trace_track: str = "serving",
+                 recorder=None):
         self.engine = engine
         self.clock = clock if clock is not None else VirtualClock()
         self.config = config or ServingConfig()
@@ -67,9 +68,13 @@ class ServingEngine:
         # telemetry (docs/OBSERVABILITY.md): ``tracer`` collects one trace
         # per request (phase spans derived from the request's state history
         # at terminal time — the per-token hot path does NO tracer work);
-        # ``metrics`` is a MetricsRegistry for always-on counters/histograms
+        # ``metrics`` is a MetricsRegistry for always-on counters/histograms;
+        # ``recorder`` is the fleet flight recorder (attached directly, not
+        # through the tracer, so a recorder-without-tracer fleet still gets
+        # the replica-side control events)
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.metrics = metrics
+        self.recorder = recorder
         self.trace_track = trace_track
         # uid -> (trace_id, parent_span_id, clamp_start): parent_span_id is
         # the fleet router's attempt span when this frontend is a replica
@@ -774,6 +779,14 @@ class ServingEngine:
             self._requests.pop(uid, None)
             self._trace_ctx.pop(uid, None)
         self._active.clear()
+        recorder = self.recorder if self.recorder is not None \
+            else getattr(self.tracer, "recorder", None)
+        if recorder is not None:
+            # the replica-side half of the fencing episode, on this
+            # frontend's own control track — pairs with the router-side
+            # lease interval flipping FENCING→ALIVE in the same dump
+            recorder.instant("ctrl/fence", f"ctrl/{self.trace_track}",
+                             self.clock.now(), attrs=dict(counts))
         if counts["queued"] or counts["active"]:
             logger.warning(f"serving: fenced {counts['queued']} queued + "
                            f"{counts['active']} active request(s)")

@@ -81,9 +81,15 @@ class OverloadConfig:
 class OverloadController:
     """Explicit brownout ladder; see module docstring for the rungs."""
 
-    def __init__(self, config: OverloadConfig = None, emit=None):
+    def __init__(self, config: OverloadConfig = None, emit=None,
+                 recorder=None):
         self.config = config or OverloadConfig()
         self._emit = emit            # emit(name, value) or None
+        #: optional flight recorder: rung occupancy becomes a first-class
+        #: interval track (``ctrl/overload/<rung>`` on track
+        #: ``ctrl/overload``) — how long the fleet sat on each brownout
+        #: rung is readable straight off the crash dump
+        self.recorder = recorder
         self.rung = 0
         self.shed_count = 0
         #: (ts, "up"/"down", new_rung, pressure) per move — the audit log
@@ -124,6 +130,9 @@ class OverloadController:
         """Fold elapsed occupancy and move at most ONE rung, respecting
         the hysteresis band and cooldown.  ``pressure`` is the control
         plane's scalar overload signal (1.0 = at the SLO boundary)."""
+        if self._last_ts is None and self.recorder is not None:
+            # first observation: open the current (normal) rung's interval
+            self._note_rung(now)
         if self._last_ts is not None and now > self._last_ts:
             self.occupancy[self.rung] += now - self._last_ts
         self._last_ts = now
@@ -136,6 +145,7 @@ class OverloadController:
             self.moves.append((round(now, 9), "up", self.rung,
                                round(pressure, 9)))
             self._last_move = now
+            self._note_rung(now, pressure)
             logger.warning(f"overload ladder UP -> rung {self.rung} "
                            f"({RUNGS[self.rung]}) at pressure {pressure:.3f}")
             if self._emit is not None:
@@ -146,10 +156,21 @@ class OverloadController:
             self.moves.append((round(now, 9), "down", self.rung,
                                round(pressure, 9)))
             self._last_move = now
+            self._note_rung(now, pressure)
             logger.info(f"overload ladder DOWN -> rung {self.rung} "
                         f"({RUNGS[self.rung]}) at pressure {pressure:.3f}")
             if self._emit is not None:
                 self._emit("fleet/overload_step_down", float(self.rung))
+
+    def _note_rung(self, now: float, pressure: Optional[float] = None) -> None:
+        if self.recorder is None:
+            return
+        attrs = {"rung": self.rung}
+        if pressure is not None:
+            attrs["pressure"] = round(pressure, 9)
+        self.recorder.note_state("ctrl/overload",
+                                 f"ctrl/overload/{RUNGS[self.rung]}", now,
+                                 attrs=attrs)
 
     def record_shed(self) -> None:
         self.shed_count += 1
@@ -264,6 +285,12 @@ class Autoscaler:
 
     def _decide(self, now: float, action: str, rid: int, reason: str) -> None:
         self.decisions.append((round(now, 9), action, rid, reason))
+        recorder = getattr(self.router, "recorder", None)
+        if recorder is not None:
+            # annotated instants on the dedicated control track: WHY the
+            # fleet changed size is part of the flight-recorder story
+            recorder.instant(f"ctrl/autoscale/{action}", "ctrl/autoscale",
+                             now, attrs={"rid": rid, "reason": reason})
         logger.info(f"autoscaler: {action} replica {rid} at t={now:.3f} ({reason})")
 
     # ------------------------------------------------------------- signals

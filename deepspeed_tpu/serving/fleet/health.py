@@ -102,12 +102,23 @@ class FleetHealthView:
     completions discardable."""
 
     def __init__(self, replica_ids, config: LeaseConfig = None, clock=None,
-                 emit: Optional[Callable[[str, float], None]] = None):
+                 emit: Optional[Callable[[str, float], None]] = None,
+                 recorder=None):
         self.config = config or LeaseConfig()
         self._clock = clock
         self._emit_cb = emit
+        #: optional flight recorder: lease lifecycles become first-class
+        #: interval tracks — one ``ctrl/lease/replica/<rid>`` track per
+        #: replica whose ``ctrl/lease/<state>`` intervals tile the run
+        #: (ALIVE→SUSPECT→DEAD→FENCING→ALIVE visible at a glance in the
+        #: crash dump, docs/OBSERVABILITY.md "Flight recorder")
+        self.recorder = recorder
         t0 = clock.now() if clock is not None else 0.0
         rids = list(replica_ids)
+        if recorder is not None:
+            for rid in rids:
+                recorder.note_state(f"ctrl/lease/replica/{rid}",
+                                    f"ctrl/lease/{LeaseState.ALIVE.value}", t0)
         # the initial lease is granted at construction: a replica that
         # never heartbeats at all still expires on schedule
         self._last_hb: Dict[int, float] = {r: t0 for r in rids}
@@ -167,6 +178,11 @@ class FleetHealthView:
                              f"{cur.value} -> {state.value} ({reason})")
         self._state[rid] = state
         self.history.append((rid, cur, state, ts, reason))
+        if self.recorder is not None:
+            self.recorder.note_state(f"ctrl/lease/replica/{rid}",
+                                     f"ctrl/lease/{state.value}", ts,
+                                     attrs={"reason": reason,
+                                            "epoch": self.epoch[rid]})
         logger.info(f"fleet lease: replica {rid} {cur.value} -> {state.value} "
                     f"({reason})")
 

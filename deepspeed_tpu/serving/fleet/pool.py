@@ -85,6 +85,11 @@ class ReplicaPool:
         # replica, like the clock does)
         self.tracer = tracer
         self.metrics = metrics
+        #: fleet flight recorder — back-filled by Router(recorder=...) so
+        #: replacement engines from recover()/restart() inherit it exactly
+        #: like the tracer (the replica-side ctrl/fence instant must not
+        #: depend on a tracer being attached)
+        self.recorder = None
         # fleet prefix directory (docs/SERVING.md "Prefix directory"): the
         # pool is its ONE publish edge — every attached engine's prefix
         # cache streams its chain digests through the listener bus, and
@@ -141,7 +146,8 @@ class ReplicaPool:
         rep.serve = ServingEngine(factory(), clock=rep.clock,
                                   config=self.serving_config, monitor=self.monitor,
                                   tracer=self.tracer, metrics=self.metrics,
-                                  trace_track=f"replica{rid}")
+                                  trace_track=f"replica{rid}",
+                                  recorder=self.recorder)
         rep.generation += 1
         if self.prefix_directory is not None:
             # a fresh engine's cache is empty: stale entries from the

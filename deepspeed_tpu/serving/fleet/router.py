@@ -188,10 +188,29 @@ class Router:
                  tenants: Optional[TenantRegistry] = None,
                  overload=None, prefix_import_cost: float = 0.0,
                  transport=None, lease_config: Optional[LeaseConfig] = None,
-                 warmup_chains: int = 4):
+                 warmup_chains: int = 4, recorder=None, slo=None):
         self.pool = pool
         self.policy = policy
         self.monitor = monitor
+        # fleet flight recorder (telemetry/flight_recorder.py): the
+        # bounded always-on control-plane ring.  Attaching it here fans it
+        # out to every producer — transport message spans, lease-state
+        # intervals, overload-rung occupancy, autoscaler instants, and
+        # (via the pool tracer's retention sink) the request phase spans —
+        # and the router drives the crash-scoped dumps: replica death,
+        # lease expiry, a completed fencing episode.  None = off, zero
+        # overhead, byte-identical pre-r18 behavior.
+        self.recorder = recorder
+        if recorder is not None:
+            # replica frontends record their side of control episodes
+            # (ctrl/fence) directly — tracer or no tracer; pool.recorder
+            # makes recover()/restart() replacements inherit it
+            pool.recorder = recorder
+            for rid in pool.rids:
+                pool.replica(rid).serve.recorder = recorder
+        if recorder is not None and transport is not None \
+                and transport.recorder is None:
+            transport.recorder = recorder
         # control-plane transport (docs/SERVING.md "Control-plane
         # transport"): with one attached, the router stops observing
         # replicas perfectly — health is heartbeat leases with fencing,
@@ -234,7 +253,8 @@ class Router:
             self.lease = FleetHealthView(
                 pool.rids, config=lease_config, clock=pool.clock,
                 emit=lambda name, value: self._emit(
-                    [(name, value, self._next_event_step())]))
+                    [(name, value, self._next_event_step())]),
+                recorder=recorder)
             self._dir_feeds = {rid: _DirFeed() for rid in pool.rids}
         # fleet prefix directory (docs/SERVING.md "Prefix directory"): a
         # directory-routing policy carries the directory it reads; the
@@ -268,6 +288,18 @@ class Router:
         if overload is not None:
             overload.bind(lambda name, value: self._emit(
                 [(name, value, self._next_event_step())]))
+            if recorder is not None and overload.recorder is None:
+                overload.recorder = recorder
+        # SLO burn-rate monitor (telemetry/slo.py): observes every DONE
+        # request's TTFT against its tenant's ttft_slo, ticked once per
+        # fleet round from export_replica_gauges; None = off
+        self.slo = slo
+        if slo is not None:
+            if slo.clock is None:
+                slo.clock = pool.clock
+            slo.bind(emit=lambda name, value: self._emit(
+                [(name, value, self._next_event_step())]),
+                metrics=pool.metrics, recorder=recorder)
         #: DONE-request TTFTs in completion order — the autoscaler's EWMA
         #: input (appended in _finish; never truncated mid-run)
         self.ttft_log: List[float] = []
@@ -308,6 +340,12 @@ class Router:
                 "spans (the pool propagates it to every attached engine, "
                 "including recover()/restart() replacements)")
         self.tracer = pool.tracer if pool.tracer is not None else NULL_TRACER
+        if recorder is not None and self.tracer.enabled \
+                and self.tracer.recorder is None:
+            # retention sink: request/phase spans mirror into the bounded
+            # ring as they finish, so a crash dump shows the recent
+            # requests NEXT TO the control-plane timeline that hurt them
+            self.tracer.recorder = recorder
         self.clock = pool.clock
         self._fids = itertools.count()
         self._pending: List[FleetRequest] = []
@@ -940,6 +978,7 @@ class Router:
         self.kill_records.append(record)
         self._emit([("fleet/failover_requeued", float(len(victims)),
                      self._next_event_step())])
+        self._recorder_dump("lease_expired", now)
 
     def _requeue_attempt(self, fr: FleetRequest, now: float,
                          outcome: str) -> ServingRequest:
@@ -1015,6 +1054,9 @@ class Router:
         # the zombie's cache may still be warm, but the router purged its
         # entries at expiry: pull a fresh full-digest snapshot
         self._request_dir_resync(rid, now)
+        # the fencing episode is complete (zombie cancelled + re-admitted):
+        # dump the black box while the whole story is still in the ring
+        self._recorder_dump("fence", now)
 
     # --------------------------------------------- directory feed + resync
 
@@ -1590,6 +1632,7 @@ class Router:
         self._emit([("fleet/replica_dead", float(rid), self._next_event_step()),
                     ("fleet/failover_requeued", float(len(victims)),
                      self._next_event_step())])
+        self._recorder_dump("replica_dead", now)
         return victims
 
     def _note_victim_resolved(self, fr: FleetRequest, now: float) -> None:
@@ -1626,6 +1669,8 @@ class Router:
                 t["deadline_met"] += 1
             if fr.ttft is not None:
                 self.ttft_log.append(fr.ttft)
+                if self.slo is not None:
+                    self.slo.observe(fr.tenant, fr.ttft, now)
         elif state is FleetState.TIMED_OUT:
             t["timed_out"] += 1
         elif state is FleetState.REJECTED:
@@ -1722,7 +1767,12 @@ class Router:
                    "failovers": fr.failovers, "affinity_hits": fr.affinity_hits,
                    "reject_reason": fr.reject_reason,
                    "ttft": fr.ttft, "tpot": fr.tpot, "e2e": end - fr.arrival_ts,
-                   "deadline_met": fr.met_deadline})
+                   "deadline_met": fr.met_deadline,
+                   # the slowdown-attribution inputs (scripts/why_slow.py):
+                   # which tenant's SLO this counts against, and whether a
+                   # brownout rung truncated the output budget
+                   "tenant": fr.tenant,
+                   "brownout_capped": fr.brownout_capped})
 
     # ----------------------------------------------------------- lifecycle
 
@@ -1757,14 +1807,32 @@ class Router:
         return len(self._pending)
 
     def export_replica_gauges(self) -> None:
-        """Publish each live replica's ``load_stats()`` snapshot as
-        ``fleet/replica_*`` gauges on the pool's MetricsRegistry, plus the
-        fleet-level serving-replica count and (when an overload controller
-        is attached) the current brownout rung.  The fleet driver calls
-        this once per round; with no registry it is a no-op."""
+        """The once-per-fleet-round observability sweep: publish each live
+        replica's ``load_stats()`` snapshot as ``fleet/replica_*`` gauges
+        on the pool's MetricsRegistry, the fleet-level serving-replica
+        count, the brownout rung (when an overload controller is
+        attached), and — under a control transport — the per-link health
+        gauges (``transport/link_loss_ewma/<rid>``, retransmit depth,
+        feed-gap age: ROADMAP's adaptive-lease-sizing input signal).  Also
+        ticks the SLO burn-rate monitor.  The fleet driver calls this once
+        per round; gauges are a no-op without a registry."""
+        now = self.clock.now()
+        if self.slo is not None:
+            self.slo.tick(now)
         metrics = self.pool.metrics
         if metrics is None:
             return
+        if self.transport is not None:
+            for rid in self.pool.rids:
+                metrics.gauge(f"transport/link_loss_ewma/{rid}").set(
+                    round(self.transport.link_loss_ewma("router", rid), 9))
+                feed = self._dir_feeds.get(rid)
+                age = 0.0 if feed is None or feed.gap_since is None \
+                    else max(0.0, now - feed.gap_since)
+                metrics.gauge(f"transport/feed_gap_age/{rid}").set(
+                    round(age, 9))
+            metrics.gauge("transport/retransmit_depth").set(
+                self._retransmit_depth())
         stats = self.pool.load_stats()
         for rid in self.pool.rids:
             # DEAD/parked replicas are absent from load_stats — their
@@ -1785,6 +1853,38 @@ class Router:
         if self.directory is not None:
             metrics.gauge("fleet/prefix_directory_entries").set(
                 self.directory.entries)
+
+    def _retransmit_depth(self) -> int:
+        """How many reliable-stream sends are currently awaiting an ack —
+        unacked fences (FENCING leases), unacked migration chunks, and
+        outstanding directory-resync requests.  A depth that stays high is
+        the 'this link is sick' signal loss counters alone cannot give."""
+        depth = sum(1 for rid in self.pool.rids
+                    if self.lease.state(rid) is LeaseState.FENCING)
+        depth += sum(1 for m in self._migrations.values()
+                     if m.get("chan") is not None
+                     and m["chan"]["sent_idx"] is not None)
+        depth += sum(1 for feed in self._dir_feeds.values()
+                     if feed.resync_since is not None)
+        return depth
+
+    def _recorder_dump(self, reason: str, now: float) -> None:
+        """Crash-scoped flight-recorder dump + its ``recorder/dump`` event.
+        Guarded: a failed black-box write must never escalate a replica
+        death into a driver death."""
+        if self.recorder is None:
+            return
+        try:
+            path = self.recorder.maybe_dump(reason, now)
+        except _fi.InjectedCrash:
+            raise  # simulated death of THIS driver process
+        except Exception as e:
+            logger.warning(f"flight-recorder dump failed ({reason}): {e}")
+            return
+        if path is not None:
+            logger.warning(f"flight recorder: dumped {path} ({reason})")
+            self._emit([("recorder/dump", float(self.recorder.dumps),
+                         self._next_event_step())])
 
     def pending_timestamps(self) -> List[float]:
         """Future timestamps that could unblock progress (pending
@@ -1866,6 +1966,9 @@ class Router:
                     self.stats["partition_dispatch_skips"],
             },
             "overload": None if self.overload is None else self.overload.summary(),
+            "slo": None if self.slo is None else self.slo.summary(),
+            "recorder": None if self.recorder is None
+            else self.recorder.summary(),
             "shed": self.stats["shed"],
             "brownout_capped": self.stats["brownout_capped"],
             "health_transitions": len(self.pool.health.history),

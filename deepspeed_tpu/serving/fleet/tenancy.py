@@ -45,6 +45,11 @@ class TenantSpec:
     ttft_slo: Optional[float] = None
     #: eligible for brownout token caps and overload shedding
     best_effort: bool = False
+    #: SLO error budget: the fraction of requests allowed to miss
+    #: ``ttft_slo`` before the burn rate reads 1.0 — the denominator of
+    #: the multi-window burn-rate monitor (telemetry/slo.py); unused when
+    #: ``ttft_slo`` is None
+    error_budget: float = 0.1
 
     def __post_init__(self):
         if not self.weight > 0:
@@ -52,6 +57,9 @@ class TenantSpec:
                              f"got {self.weight}")
         if not self.name:
             raise ValueError("tenant name must be non-empty")
+        if not 0.0 < self.error_budget <= 1.0:
+            raise ValueError(f"tenant {self.name!r}: error_budget must be in "
+                             f"(0, 1], got {self.error_budget}")
 
 
 #: the implicit tenant of untagged requests — weight 1, unbounded, not

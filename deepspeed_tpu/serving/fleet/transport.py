@@ -91,6 +91,11 @@ class Message:
     send_ts: float
     payload: dict
     version: int = MESSAGE_VERSION
+    #: transport-global monotonic message id — the CAUSAL link between a
+    #: send and its delivery(ies) in the flight recorder: the ``ctrl/*``
+    #: span a delivery materializes carries this id, and a duplicated
+    #: message's two deliveries share it (dup visible per link)
+    mid: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -153,16 +158,24 @@ class ControlTransport:
     def __init__(self, clock, faults: LinkFaults = None, seed: int = 0,
                  partitions: Iterable[PartitionWindow] = (),
                  link_faults: Optional[Dict[frozenset, LinkFaults]] = None,
-                 metrics=None):
+                 metrics=None, recorder=None):
         self.clock = clock
         self.faults = faults or LinkFaults()
         #: per-link overrides keyed by ``frozenset({a, b})``
         self.link_faults = dict(link_faults or {})
         self.partitions: List[PartitionWindow] = list(partitions)
         self.metrics = metrics
+        #: optional flight recorder (telemetry/flight_recorder.py): every
+        #: DELIVERED message becomes a ``ctrl/<kind>`` span [send_ts,
+        #: deliver_ts] on its link's track (the send→deliver causal pair,
+        #: dup deliveries sharing the message's ``mid``), every message
+        #: the fabric ate a ``ctrl/drop`` instant with its cause — the
+        #: per-link drop/dup/retransmit visibility the recorder exists for
+        self.recorder = recorder
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         self._eid = 0                        # total enqueue order (determinism)
+        self._mid = 0                        # causal message ids (recorder)
         #: in-flight: (deliver_ts, eid, Message) — sorted at deliver time
         self._in_flight: List[Tuple[float, int, Message]] = []
         self.stats = {
@@ -170,6 +183,12 @@ class ControlTransport:
             "duplicated": 0, "reordered": 0, "delayed": 0, "send_faults": 0,
             "deliver_faults": 0, "retransmits": 0,
         }
+        #: per-link health accounting for the adaptive-lease-sizing signal
+        #: (ROADMAP): ``loss_ewma`` folds every message's RESOLVED fate
+        #: (1 = eaten — at send by loss/fault/partition, or at deliver by
+        #: a deliver fault / a partition that opened mid-flight; 0 =
+        #: delivered) with alpha 0.2 — keyed by frozenset({a, b})
+        self._link_health: Dict[frozenset, dict] = {}
 
     # ------------------------------------------------------------- topology
 
@@ -199,6 +218,46 @@ class ControlTransport:
         re-request) re-sent a message the receiver never acked."""
         self._count("retransmits")
 
+    def _track(self, src: Endpoint, dst: Endpoint) -> str:
+        return f"ctrl/link/{src}-{dst}"
+
+    def _note_link(self, src: Endpoint, dst: Endpoint, eaten: bool) -> None:
+        """Fold one RESOLVED message fate into the link's health.  Called
+        exactly once per message at the point its fate is known — a
+        send-time drop, a deliver-time drop, or a delivery (a duplicated
+        message's extra copy resolves separately: the link genuinely
+        carried both) — so a link whose sends depart fine but whose
+        deliveries all die still reads as lossy."""
+        h = self._link_health.get(frozenset((src, dst)))
+        if h is None:
+            h = self._link_health[frozenset((src, dst))] = {
+                "resolved": 0, "eaten": 0, "loss_ewma": 0.0}
+        h["resolved"] += 1
+        if eaten:
+            h["eaten"] += 1
+        h["loss_ewma"] = 0.8 * h["loss_ewma"] + 0.2 * (1.0 if eaten else 0.0)
+
+    def link_loss_ewma(self, a: Endpoint, b: Endpoint) -> float:
+        """Observed loss EWMA of the (a, b) link — random loss, injected
+        send/deliver faults and partition severance (at send OR opening
+        mid-flight) folded together (what matters to a lease is whether
+        messages GET THROUGH, not why they don't).  0.0 before any
+        resolved traffic; messages still in flight have no fate yet.  The
+        per-round ``transport/link_loss_ewma/<rid>`` gauge — ROADMAP's
+        adaptive-lease-sizing input signal — reads this."""
+        h = self._link_health.get(frozenset((a, b)))
+        return 0.0 if h is None else h["loss_ewma"]
+
+    def link_health(self) -> Dict[str, dict]:
+        """Deterministically-keyed per-link health table (summary surface)."""
+        out = {}
+        for key in sorted(self._link_health, key=lambda k: sorted(map(str, k))):
+            a, b = sorted(map(str, key))
+            h = self._link_health[key]
+            out[f"{a}-{b}"] = {"resolved": h["resolved"], "eaten": h["eaten"],
+                               "loss_ewma": round(h["loss_ewma"], 9)}
+        return out
+
     def send(self, kind: str, src: Endpoint, dst: Endpoint, payload: dict,
              seq: int = 0) -> Optional[Message]:
         """Schedule one message.  Returns the Message when it was put in
@@ -211,6 +270,8 @@ class ControlTransport:
                              f"{sorted(MESSAGE_KINDS)}")
         now = self.clock.now()
         self._count("sent")
+        self._mid += 1
+        mid = self._mid
         try:
             # chaos site: the send edge of every control message
             _fi.check("transport.send")
@@ -220,11 +281,15 @@ class ControlTransport:
             # injected send fault: the datagram never left the host
             self._count("send_faults")
             self._count("dropped")
+            self._note_link(src, dst, eaten=True)
+            self._record_drop(kind, src, dst, seq, mid, now, "send_fault")
             return None
         msg = Message(kind=kind, src=src, dst=dst, seq=int(seq),
-                      send_ts=now, payload=payload)
+                      send_ts=now, payload=payload, mid=mid)
         if not self.connected(src, dst, now):
             self._count("partition_dropped")
+            self._note_link(src, dst, eaten=True)
+            self._record_drop(kind, src, dst, seq, mid, now, "partition")
             return None
         link = self._link(src, dst)
         # ONE rng, consumed in send order: loss, reorder, dup — always all
@@ -234,6 +299,8 @@ class ControlTransport:
         duped = self._rng.random() < link.dup_p
         if lost:
             self._count("dropped")
+            self._note_link(src, dst, eaten=True)
+            self._record_drop(kind, src, dst, seq, mid, now, "loss")
             return None
         delay = link.delay
         if reordered:
@@ -272,13 +339,39 @@ class ControlTransport:
             except OSError:
                 self._count("deliver_faults")
                 self._count("dropped")
+                self._note_link(msg.src, msg.dst, eaten=True)
+                self._record_drop(msg.kind, msg.src, msg.dst, msg.seq,
+                                  msg.mid, deliver_ts, "deliver_fault")
                 continue
             if not self.connected(msg.src, msg.dst, deliver_ts):
                 self._count("partition_dropped")
+                self._note_link(msg.src, msg.dst, eaten=True)
+                self._record_drop(msg.kind, msg.src, msg.dst, msg.seq,
+                                  msg.mid, deliver_ts, "partition")
                 continue
             self._count("delivered")
+            self._note_link(msg.src, msg.dst, eaten=False)
+            if self.recorder is not None:
+                # the causal send→deliver pair: one span per delivery,
+                # [send_ts, deliver_ts] on the link's track; duplicated
+                # copies share the mid (dups visible), retransmits show as
+                # distinct mids of the same (kind, seq)
+                self.recorder.span(f"ctrl/{msg.kind}",
+                                   self._track(msg.src, msg.dst),
+                                   msg.send_ts, deliver_ts,
+                                   attrs={"src": str(msg.src),
+                                          "dst": str(msg.dst),
+                                          "seq": msg.seq, "mid": msg.mid})
             out.append(msg)
         return out
+
+    def _record_drop(self, kind: str, src: Endpoint, dst: Endpoint, seq: int,
+                     mid: int, ts: float, cause: str) -> None:
+        if self.recorder is not None:
+            self.recorder.instant("ctrl/drop", self._track(src, dst), ts,
+                                  attrs={"kind": kind, "src": str(src),
+                                         "dst": str(dst), "seq": int(seq),
+                                         "mid": mid, "cause": cause})
 
     # ------------------------------------------------------------- schedule
 
@@ -303,4 +396,5 @@ class ControlTransport:
     def summary(self) -> dict:
         return {**self.stats, "in_flight": len(self._in_flight),
                 "partitions": [p.name for p in self.partitions],
+                "links": self.link_health(),
                 "seed": self.seed}

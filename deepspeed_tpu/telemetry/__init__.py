@@ -16,7 +16,10 @@ and fleet dispatch (the client trace_id survives replica failover).
 
 from .export import (load_chrome_trace, spans_to_jsonl, to_chrome_trace,
                      write_chrome_trace, write_jsonl)
-from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .flight_recorder import FlightRecorder
+from .metrics import (Counter, Gauge, Histogram, HistogramWindow,
+                      MetricsRegistry)
+from .slo import BurnRateConfig, SLOBurnMonitor
 from .spans import PHASE_OF_STATE, emit_attempt_spans, phase_intervals
 from .trace import (NULL_SPAN, NULL_TRACER, NullTracer, PerfClock, Span,
                     Tracer)
@@ -24,7 +27,9 @@ from .trace import (NULL_SPAN, NULL_TRACER, NullTracer, PerfClock, Span,
 __all__ = [
     "load_chrome_trace", "spans_to_jsonl", "to_chrome_trace",
     "write_chrome_trace", "write_jsonl",
-    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "FlightRecorder",
+    "Counter", "Gauge", "Histogram", "HistogramWindow", "MetricsRegistry",
+    "BurnRateConfig", "SLOBurnMonitor",
     "PHASE_OF_STATE", "emit_attempt_spans", "phase_intervals",
     "NULL_SPAN", "NULL_TRACER", "NullTracer", "PerfClock", "Span", "Tracer",
 ]

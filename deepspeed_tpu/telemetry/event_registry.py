@@ -19,7 +19,10 @@ standalone (no jax import) and it runs directly by path.
 ``kind`` vocabulary: ``event`` (a monitor ``write_events`` tuple),
 ``counter``/``gauge``/``histogram`` (MetricsRegistry instruments — note
 histograms additionally fan out over the ``telemetry/`` bridge as
-``_p50/_p95/_p99/_count``).
+``_p50/_p95/_p99/_count``), and — since r18 — ``span``/``track``
+(flight-recorder span names and track names: not monitor events, but the
+same one-namespace discipline applies, so the dslint checker validates
+their literals here too).
 """
 
 import re
@@ -195,6 +198,36 @@ EVENTS = {
     "fleet/overload_rung": ("gauge", "serving/fleet/router.py",
                             "current degradation-ladder rung (0 = "
                             "normal service)"),
+    # ---- flight recorder (telemetry/flight_recorder.py, driven by
+    #      serving/fleet/router.py; docs/OBSERVABILITY.md "Flight recorder")
+    "recorder/dump": ("event", "serving/fleet/router.py",
+                      "crash-scoped flight-recorder trace dumped (replica "
+                      "death / lease expiry / fencing / divergence; value = "
+                      "cumulative dump count)"),
+    # ---- control-plane flight-recorder spans/tracks: names the recorder
+    #      rings use (causal message spans ride the DYNAMIC ctrl/ family)
+    "ctrl/drop": ("span", "serving/fleet/transport.py",
+                  "recorder instant: the fabric ate a control message "
+                  "(attrs: kind, seq, mid, cause = loss|partition|"
+                  "send_fault|deliver_fault)"),
+    "ctrl/fence": ("span", "serving/engine.py",
+                   "recorder instant: a FENCE executed on a replica "
+                   "frontend (attrs: cancelled queued/active counts)"),
+    "ctrl/autoscale": ("track", "serving/fleet/autoscale.py",
+                       "flight-recorder track of autoscaler decision "
+                       "instants (ctrl/autoscale/<action>)"),
+    "ctrl/overload": ("track", "serving/fleet/autoscale.py",
+                      "flight-recorder track of brownout-rung occupancy "
+                      "intervals (ctrl/overload/<rung>)"),
+    # ---- control-plane transport health gauges (serving/fleet/router.py,
+    #      exported once per fleet round; the per-rid link gauges are the
+    #      DYNAMIC transport/ gauge family)
+    "transport/retransmit_depth": ("gauge", "serving/fleet/router.py",
+                                   "reliable-stream sends currently "
+                                   "awaiting an ack (unacked fences + "
+                                   "migration chunks + directory "
+                                   "resyncs), sampled once per fleet "
+                                   "round"),
     # ---- monitor surface (monitor/monitor.py)
     "monitor/dropped_events": ("event", "monitor/monitor.py",
                                "cumulative events shed by the max_events cap"),
@@ -250,6 +283,31 @@ DYNAMIC = [
      "expansions": ["..."],
      "doc": "MetricsRegistry.flush_to_monitor bridge of every registered "
             "metric (histograms fan out quantiles + count)"},
+    {"prefix": "ctrl/", "template": "ctrl/<name>",
+     "kind": "span", "source": "serving/fleet/transport.py (+health.py, "
+     "autoscale.py, telemetry/slo.py)",
+     "expansions": ["ctrl/<message-kind> (send->deliver causal span, per "
+                    "ctrl/link/<src>-<dst> track)",
+                    "ctrl/lease/<state> (lease-lifecycle interval per "
+                    "ctrl/lease/replica/<rid> track)",
+                    "ctrl/overload/<rung>", "ctrl/autoscale/<action>",
+                    "ctrl/slo/<tenant> (alert-window interval track)"],
+     "doc": "flight-recorder control-plane span names: causal transport "
+            "message pairs, lease/rung/alert intervals, autoscaler "
+            "instants (docs/OBSERVABILITY.md 'Flight recorder')"},
+    {"prefix": "slo/", "template": "slo/<signal>/<tenant>",
+     "kind": "event+gauge", "source": "telemetry/slo.py",
+     "expansions": ["slo/alert_fired/<tenant>", "slo/alert_cleared/<tenant>",
+                    "slo/burn_fast/<tenant>", "slo/burn_slow/<tenant>"],
+     "doc": "multi-window SLO burn-rate monitoring over per-tenant "
+            "TenantSpec.ttft_slo: hysteresis-gated alert events + the "
+            "fast/slow burn gauges, bit-reproducible under VirtualClock"},
+    {"prefix": "transport/", "template": "transport/<link-gauge>/<rid>",
+     "kind": "gauge", "source": "serving/fleet/router.py",
+     "expansions": ["transport/link_loss_ewma/<rid>",
+                    "transport/feed_gap_age/<rid>"],
+     "doc": "per-link control-plane health, sampled once per fleet round "
+            "— the adaptive-lease-sizing input signal (ROADMAP)"},
 ]
 
 BEGIN_MARK = ("<!-- BEGIN EVENT TABLE (generated from "

@@ -18,9 +18,10 @@ the stack.
 
 import bisect
 import math
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry"]
+__all__ = ["Counter", "Gauge", "Histogram", "HistogramWindow",
+           "MetricsRegistry"]
 
 
 class Counter:
@@ -102,30 +103,8 @@ class Histogram:
         """Value at quantile ``q`` in [0, 1], from bucket boundaries with
         geometric interpolation inside the landing bucket; clamped to the
         observed min/max so tail quantiles never exceed reality."""
-        if self.count == 0:
-            return None
-        if not 0.0 <= q <= 1.0:
-            raise ValueError(f"quantile {q} outside [0, 1]")
-        rank = q * self.count
-        cum = 0
-        for i, c in enumerate(self.counts):
-            cum += c
-            if cum >= rank and c > 0:
-                if i == 0:
-                    lo, hi = 0.0, self.bounds[0]
-                elif i >= len(self.bounds):
-                    lo, hi = self.bounds[-1], self.max
-                else:
-                    lo, hi = self.bounds[i - 1], self.bounds[i]
-                # geometric midpoint-ish: interpolate by the rank's position
-                # inside this bucket's count, in log space when possible
-                frac = (rank - (cum - c)) / c
-                if lo > 0:
-                    est = lo * (hi / lo) ** frac
-                else:
-                    est = lo + (hi - lo) * frac
-                return max(self.min, min(self.max, est))
-        return self.max
+        return _bucket_quantile(self.bounds, self.counts, self.count,
+                                self.min, self.max, q)
 
     def summary(self) -> dict:
         return {
@@ -137,6 +116,98 @@ class Histogram:
             "p95": self.quantile(0.95),
             "p99": self.quantile(0.99),
         }
+
+    # ------------------------------------------------------------- windows
+
+    def window(self) -> "HistogramWindow":
+        """Snapshot this histogram's cumulative state for later windowed
+        reads: ``hist.since(win)`` summarizes only the samples recorded
+        AFTER the snapshot — the burn-rate primitive (telemetry/slo.py,
+        docs/OBSERVABILITY.md "Burn-rate windows") without any sample
+        retention.  The snapshot is O(buckets) at *snapshot* time; the
+        ``record()`` hot path is untouched (the no-allocation disabled
+        path stays pinned by the existing tracemalloc tests)."""
+        return HistogramWindow(counts=tuple(self.counts), count=self.count,
+                               total=self.total)
+
+    def since(self, win: "HistogramWindow") -> dict:
+        """Summary (count/sum/mean/p50/p95/p99) over the samples recorded
+        since ``win`` was taken, by cumulative-count subtraction — the
+        standard Prometheus-style windowed read of a cumulative histogram.
+        Window quantiles interpolate within bucket bounds (the exact
+        window min/max are unknowable without retention); the LIFETIME
+        max bounds the overflow bucket, so a window whose samples exceed
+        the top bound still reads a real tail instead of silently
+        truncating at ``bounds[-1]``."""
+        if len(win.counts) != len(self.counts):
+            raise ValueError("window snapshot geometry mismatch")
+        d_counts = [c - w for c, w in zip(self.counts, win.counts)]
+        if any(d < 0 for d in d_counts):
+            raise ValueError(f"histogram {self.name}: window snapshot is "
+                             "newer than the histogram (counts went down)")
+        d_count = self.count - win.count
+        d_total = self.total - win.total
+        return {
+            "count": d_count,
+            "sum": round(d_total, 9),
+            "mean": round(d_total / d_count, 9) if d_count else None,
+            "p50": _bucket_quantile(self.bounds, d_counts, d_count, None, self.max, 0.50),
+            "p95": _bucket_quantile(self.bounds, d_counts, d_count, None, self.max, 0.95),
+            "p99": _bucket_quantile(self.bounds, d_counts, d_count, None, self.max, 0.99),
+        }
+
+
+def _bucket_quantile(bounds: List[float], counts: List[int], count: int,
+                     lo_clamp: Optional[float], hi_clamp: Optional[float],
+                     q: float) -> Optional[float]:
+    """Shared quantile core over a bucket-count vector (live histograms
+    pass their cumulative counts + observed min/max clamps; windowed reads
+    pass delta counts with only the lifetime max bounding the overflow
+    bucket)."""
+    if count == 0:
+        return None
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile {q} outside [0, 1]")
+    rank = q * count
+    cum = 0
+    est = None
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= rank and c > 0:
+            if i == 0:
+                lo, hi = 0.0, bounds[0]
+            elif i >= len(bounds):
+                lo = bounds[-1]
+                hi = hi_clamp if hi_clamp is not None else bounds[-1]
+            else:
+                lo, hi = bounds[i - 1], bounds[i]
+            # geometric midpoint-ish: interpolate by the rank's position
+            # inside this bucket's count, in log space when possible
+            frac = (rank - (cum - c)) / c
+            if lo > 0 and hi > lo:
+                est = lo * (hi / lo) ** frac
+            else:
+                est = lo + (hi - lo) * frac
+            break
+    if est is None:
+        est = hi_clamp if hi_clamp is not None else bounds[-1]
+    if lo_clamp is not None:
+        est = max(lo_clamp, est)
+    if hi_clamp is not None:
+        est = min(hi_clamp, est)
+    return est
+
+
+class HistogramWindow:
+    """Immutable cumulative-state snapshot of one :class:`Histogram` (see
+    :meth:`Histogram.window`)."""
+
+    __slots__ = ("counts", "count", "total")
+
+    def __init__(self, counts: Tuple[int, ...], count: int, total: float):
+        self.counts = counts
+        self.count = count
+        self.total = total
 
 
 class MetricsRegistry:
@@ -174,6 +245,39 @@ class MetricsRegistry:
         for name in self.names():
             m = self._metrics[name]
             out[name] = m.summary() if isinstance(m, Histogram) else m.value
+        return out
+
+    def window(self) -> dict:
+        """Cumulative-state snapshot of every metric, for
+        :meth:`snapshot_since` — counters snapshot their value, histograms
+        their bucket state (:meth:`Histogram.window`); gauges are
+        last-write-wins and carry no window state."""
+        out = {}
+        for name, m in self._metrics.items():
+            if isinstance(m, Histogram):
+                out[name] = m.window()
+            elif isinstance(m, Counter):
+                out[name] = m.value
+        return out
+
+    def snapshot_since(self, win: dict) -> dict:
+        """Windowed read: counters as deltas since ``win``, histograms as
+        windowed summaries (``Histogram.since``), gauges as their current
+        value.  Metrics created after the snapshot window from zero.
+        Deterministic key order, no sample retention anywhere — the
+        burn-rate monitors' input shape."""
+        out = {}
+        for name in self.names():
+            m = self._metrics[name]
+            if isinstance(m, Histogram):
+                prev = win.get(name)
+                out[name] = m.since(prev) if prev is not None \
+                    else m.since(HistogramWindow(
+                        counts=(0,) * len(m.counts), count=0, total=0.0))
+            elif isinstance(m, Counter):
+                out[name] = m.value - win.get(name, 0.0)
+            else:
+                out[name] = m.value
         return out
 
     def flush_to_monitor(self, monitor, step: int = 0) -> int:

@@ -138,9 +138,15 @@ class Tracer:
 
     enabled = True
 
-    def __init__(self, clock=None, max_spans: int = 100_000):
+    def __init__(self, clock=None, max_spans: int = 100_000, recorder=None):
         self.clock = clock if clock is not None else PerfClock()
         self.max_spans = int(max_spans)
+        #: optional retention sink (telemetry/flight_recorder.py): every
+        #: FINISHED span is mirrored into the recorder's bounded per-track
+        #: ring as it retains here, so crash-scoped dumps still hold the
+        #: recent request phases after this tracer's own retention (or a
+        #: clear()) let them go.  None = no mirroring (zero overhead).
+        self.recorder = recorder
         # bounded deque: retention eviction is O(1) per span even once the
         # cap is reached (a list's del spans[:1] would memmove max_spans
         # entries per append on exactly the long-lived-server path the cap
@@ -216,6 +222,8 @@ class Tracer:
         if self.spans.maxlen is not None and len(self.spans) == self.spans.maxlen:
             self.dropped_spans += 1  # the deque evicts the oldest span
         self.spans.append(span)
+        if self.recorder is not None:
+            self.recorder.observe(span)
 
     # ---------------------------------------------------------- queries
 
@@ -236,6 +244,7 @@ class NullTracer:
     enabled = False
     spans: tuple = ()
     dropped_spans = 0
+    recorder = None
 
     def new_trace_id(self) -> int:
         return 0

@@ -68,6 +68,8 @@ import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+# why_slow (the attribution leg's fold core) lives beside this script
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
@@ -480,6 +482,176 @@ AUTOSCALE_TENANTS = (
 )
 
 
+ATTRIB_TENANTS = (
+    # same shape as AUTOSCALE_TENANTS, tuned for the attribution leg: no
+    # deadlines (every request completes — the attribution must tile the
+    # FULL workload) and a tight premium TTFT SLO the flash crowd + lossy
+    # control plane demonstrably violate inside the degradation window
+    ("premium", 0.3, None, 4.0, 0, 3.0, False),
+    ("standard", 0.3, None, 2.0, 0, None, False),
+    ("best_effort", 0.4, None, 1.0, 8, None, True),
+)
+
+
+def _attribution_point(factory, clock_factory, arrivals, serving_config,
+                       seed, loss_p, partition_spec, lease, slo_cfg,
+                       degradation):
+    """One fully-instrumented attribution run: 4 replicas behind a lossy
+    control transport (one partition window mid-crowd), flight recorder +
+    tracer + metrics + SLO burn-rate monitor + overload ladder all
+    attached.  Returns (summary, outputs, attribution fold, alerts,
+    recorder summary)."""
+    from deepspeed_tpu.serving.fleet import (AutoscaleConfig, Autoscaler,
+                                             ControlTransport, FleetSimulator,
+                                             LeaseConfig, LinkFaults,
+                                             OverloadConfig,
+                                             OverloadController,
+                                             PartitionWindow, ReplicaPool,
+                                             Router, TenantRegistry,
+                                             TenantSpec, make_policy)
+    from deepspeed_tpu.telemetry import (BurnRateConfig, FlightRecorder,
+                                         MetricsRegistry, SLOBurnMonitor,
+                                         Tracer, to_chrome_trace)
+    import why_slow
+
+    clock = clock_factory()
+    recorder = FlightRecorder(clock=clock, max_per_track=512)
+    tracer = Tracer(clock=clock)
+    metrics = MetricsRegistry()
+    partitions = []
+    if partition_spec is not None:
+        partitions = [PartitionWindow(partition_spec["name"],
+                                      partition_spec["t0"], partition_spec["t1"],
+                                      (("router", partition_spec["rid"]),))]
+    transport = ControlTransport(clock, faults=LinkFaults(loss_p=loss_p),
+                                 seed=seed, partitions=partitions,
+                                 metrics=metrics)
+    pool = ReplicaPool(factory, 4, clock=clock, serving_config=serving_config,
+                       transport=transport, tracer=tracer, metrics=metrics)
+    pool.rebase_clock()
+    tenants = TenantRegistry([
+        TenantSpec(name, weight=w, max_outstanding=mo, ttft_slo=slo,
+                   best_effort=be)
+        for name, _, _, w, mo, slo, be in ATTRIB_TENANTS])
+    overload = OverloadController(OverloadConfig(
+        hi=1.0, lo=0.45, cooldown=1.5, token_cap=6, retry_after=10.0))
+    slo = SLOBurnMonitor(tenants, BurnRateConfig(**slo_cfg))
+    router = Router(pool, make_policy("least_outstanding"), tenants=tenants,
+                    overload=overload, transport=transport,
+                    lease_config=LeaseConfig(**lease), recorder=recorder,
+                    slo=slo)
+    # static capacity (min == max == pool): the autoscaler only drives the
+    # brownout ladder here — the attribution story is about WHERE latency
+    # went, not about provisioning
+    autoscaler = Autoscaler(router, AutoscaleConfig(
+        min_replicas=4, ttft_slo=6.0, up_frac=0.5, queue_hi=1.5,
+        queue_lo=0.75, down_streak=3, cooldown_up=1.5, cooldown_down=6.0,
+        decide_interval=0.5))
+    reqs = FleetSimulator(router, autoscaler=autoscaler).run(
+        [dict(a) for a in arrivals])
+    rec = router.summary()
+    rec["offered_rps"] = round(len(arrivals) / max(arrivals[-1]["arrival_ts"], 1e-9), 6)
+    rec["arrival_rate"] = None
+    doc = to_chrome_trace(
+        tracer.spans, dropped_spans=tracer.dropped_spans,
+        meta={"source": "bench_router_attrib",
+              "degradation_t0": degradation[0],
+              "degradation_t1": degradation[1]})
+    attribution = why_slow.fold(doc)
+    return (rec, [list(r.tokens) for r in reqs], attribution,
+            slo.summary()["alerts"], recorder.summary())
+
+
+def run_attribution_leg(factory, clock_factory, seed, vocab, dryrun):
+    """The flight-recorder/attribution receipt (BENCH_ROUTER_ATTRIB.json,
+    docs/OBSERVABILITY.md "Flight recorder"): a flash-crowd run over a
+    LOSSY control plane (5% loss + one partition window severing a healthy
+    replica mid-crowd) with the full observability stack attached.  The
+    acceptance bars: every request's named causes tile its e2e within
+    1e-6, >= 80% of the p99-p50 TTFT gap is attributed to named slowdown
+    causes, the premium tenant's SLO burn-rate alert fires only inside the
+    injected degradation window (clearing after it), and the leg is
+    byte-identical when repeated."""
+    from deepspeed_tpu.serving import ServingConfig
+    from deepspeed_tpu.serving.fleet import flash_crowd_arrivals
+    wl = {"kind": "flash_crowd", "seed": seed,
+          "n_requests": 90 if dryrun else 96,
+          "base_rate": 0.5 if dryrun else 2.0,
+          "crowd_rate": 12.0 if dryrun else 24.0,
+          "crowd_start": 12.0 if dryrun else 2.0,
+          "crowd_duration": 6.0 if dryrun else 3.0}
+    arrivals = flash_crowd_arrivals(
+        seed=wl["seed"], n_requests=wl["n_requests"], base_rate=wl["base_rate"],
+        crowd_rate=wl["crowd_rate"], crowd_start=wl["crowd_start"],
+        crowd_duration=wl["crowd_duration"], vocab=vocab,
+        tenants=[(name, p, slack) for name, p, slack, *_ in ATTRIB_TENANTS])
+    scfg = ServingConfig(step_cost=(lambda toks: 0.25 + 0.01 * toks)
+                         if dryrun else None)
+    lease = {"suspect_after": 2.5, "lease": 6.0, "fence_retry": 2.0}
+    # the partition cuts a healthy replica off INSIDE the crowd: its lease
+    # expires mid-degradation, its in-flight premium work re-homes
+    # (lease_expiry + fenced causes), and the fence fires on heal
+    crowd_end = wl["crowd_start"] + wl["crowd_duration"]
+    partition = {"name": "attrib_cut", "rid": 3,
+                 "t0": wl["crowd_start"] + 1.0, "t1": crowd_end + 2.0}
+    loss_p = 0.05
+    # the INJECTED degradation window: crowd + partition, plus the drain
+    # slack — TTFT violations are OBSERVED at completion time, so a
+    # request that arrived at the crowd's last instant reports its (bad)
+    # TTFT a queue-drain later; alerts must fire inside THIS interval and
+    # clear after it
+    drain_slack = 10.0
+    degradation = (wl["crowd_start"],
+                   max(crowd_end, partition["t1"]) + drain_slack)
+    slo_cfg = {"fast_window": 6.0, "slow_window": 24.0,
+               "fire_threshold": 1.0, "clear_threshold": 0.5,
+               "min_requests": 3, "sub_buckets": 6}
+    rec, out, attribution, alerts, recorder_sum = _attribution_point(
+        factory, clock_factory, arrivals, scfg, seed, loss_p, partition,
+        lease, slo_cfg, degradation)
+    rec2, out2, attribution2, alerts2, recorder_sum2 = _attribution_point(
+        factory, clock_factory, arrivals, scfg, seed, loss_p, partition,
+        lease, slo_cfg, degradation)
+    repeat_identical = (rec == rec2 and out == out2
+                        and attribution == attribution2 and alerts == alerts2
+                        and recorder_sum == recorder_sum2)
+    gap = attribution.get("ttft_gap") or {}
+    record = {
+        "metric": "ttft_gap_attributed_fraction",
+        "value": gap.get("attributed_fraction"),
+        "unit": "fraction",
+        "schema_version": 1,
+        "workload": wl,
+        "step_cost": "0.25 + 0.01 * planned_tokens" if dryrun else "wall",
+        "tenants": {name: {"mix": p, "deadline_slack": slack, "weight": w,
+                           "max_outstanding": mo, "ttft_slo": slo,
+                           "best_effort": be}
+                    for name, p, slack, w, mo, slo, be in ATTRIB_TENANTS},
+        "degradation": {"t0": degradation[0], "t1": degradation[1],
+                        "loss_p": loss_p, "partition": partition,
+                        "crowd": [wl["crowd_start"], crowd_end],
+                        "drain_slack": drain_slack},
+        "lease": lease,
+        "slo": slo_cfg,
+        "fleet": rec,
+        "attribution": attribution,
+        "alerts": alerts,
+        "recorder": recorder_sum,
+        "determinism_repeat_identical": repeat_identical,
+    }
+    ver = attribution["verification"]
+    print(f"# attribution: requests={attribution['n_requests']} "
+          f"mismatches={ver['mismatches']} "
+          f"worst_residual={ver['worst_residual']:g} | ttft gap "
+          f"p50={gap.get('ttft_p50')} p99={gap.get('ttft_p99')} "
+          f"attributed={gap.get('attributed_fraction')} | alerts="
+          f"{[(a['tenant'], a['fired_ts'], a['cleared_ts']) for a in alerts]} "
+          f"| lease_expirations="
+          f"{rec['control_plane']['lease_expirations']} "
+          f"brownout_capped={rec['brownout_capped']}", flush=True)
+    return record
+
+
 def _autoscale_point(factory, clock_factory, arrivals, serving_config,
                      ttft_slo, autoscaled):
     """One flash-crowd run: static-max provisioning (4 always-on replicas)
@@ -609,6 +781,11 @@ def main():
                     help="distinct shared prompt prefixes in the workload")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default="BENCH_ROUTER.json")
+    ap.add_argument("--attrib-out", default="BENCH_ROUTER_ATTRIB.json",
+                    help="attribution/SLO-alert receipt artifact path")
+    ap.add_argument("--attrib-only", action="store_true",
+                    help="run ONLY the attribution leg and write its "
+                         "artifact (fast regeneration loop)")
     ap.add_argument("--trace", nargs="?", const="BENCH_ROUTER_TRACE.json",
                     default=None, metavar="PATH",
                     help="export a Chrome/Perfetto trace of the largest "
@@ -642,6 +819,41 @@ def main():
         kill_at, recover_at = 4.0, 8.0
         clock_factory = WallClock
 
+    def _run_attrib():
+        attrib = run_attribution_leg(factory, clock_factory, args.seed,
+                                     vocab, args.dryrun)
+        if args.dryrun:
+            # the attribution receipts (deterministic on the virtual clock
+            # — fail the run, not just CI; wall mode records only)
+            assert attrib["determinism_repeat_identical"], \
+                "attribution leg is not byte-reproducible"
+            ver = attrib["attribution"]["verification"]
+            assert ver["mismatches"] == 0, \
+                f"{ver['mismatches']} request(s) whose causes do not tile e2e"
+            frac = attrib["attribution"]["ttft_gap"]["attributed_fraction"]
+            assert frac is not None and frac >= 0.8, \
+                f"only {frac} of the p99-p50 TTFT gap attributed to named causes"
+            t0, t1 = attrib["degradation"]["t0"], attrib["degradation"]["t1"]
+            assert attrib["alerts"], "no SLO burn-rate alert fired"
+            for a in attrib["alerts"]:
+                assert t0 <= a["fired_ts"] <= t1, \
+                    f"alert fired at {a['fired_ts']} outside [{t0}, {t1}]"
+                assert a["cleared_ts"] is not None and \
+                    a["cleared_ts"] > a["fired_ts"], f"alert never cleared: {a}"
+            assert attrib["fleet"]["control_plane"]["lease_expirations"] >= 1, \
+                "the partition never expired a lease — no lease_expiry cause " \
+                "to attribute"
+        from deepspeed_tpu.resilience.atomic_io import atomic_write_json
+        atomic_write_json(args.attrib_out, attrib, indent=1)
+        return attrib
+
+    if args.attrib_only:
+        attrib = _run_attrib()
+        print(json.dumps({"metric": attrib["metric"], "value": attrib["value"],
+                          "unit": attrib["unit"],
+                          "alerts": len(attrib["alerts"])}))
+        return
+
     sweep = []
     for n_replicas in REPLICA_COUNTS:
         for policy in POLICY_NAMES:
@@ -669,6 +881,7 @@ def main():
                                           vocab, kv.page_size, args.dryrun)
     partition = run_partition_leg(factory, clock_factory, args.seed, vocab,
                                   args.dryrun)
+    _run_attrib()
     if args.dryrun:
         # the partition-tolerance receipts (deterministic on the virtual
         # clock — fail the run, not just CI; wall mode records only)

@@ -357,6 +357,87 @@ def _spec_pair(v):
     return None
 
 
+def _validate_attribution(v):
+    """The flight-recorder/attribution receipt (bench_router.py
+    run_attribution_leg -> BENCH_ROUTER_ATTRIB.json, scripts/why_slow.py,
+    docs/OBSERVABILITY.md "Flight recorder"): a lossy/brownout run whose
+    per-request slowdown attribution must TILE — every request's named
+    causes sum to its e2e within the declared tolerance (re-verified HERE
+    from the committed per-request table, not trusted from the summary) —
+    with >= 80% of the p99-p50 TTFT gap attributed to named slowdown
+    causes, SLO burn-rate alerts firing only inside the injected
+    degradation window (and clearing after it), and the whole leg
+    byte-identical when repeated."""
+    if not isinstance(v, dict):
+        return f"expected attribution object, got {type(v).__name__}"
+    for k in ("metric", "value", "unit", "schema_version", "workload",
+              "degradation", "slo", "attribution", "alerts",
+              "determinism_repeat_identical", "recorder"):
+        if k not in v:
+            return f"missing attribution key {k!r}"
+    if v["schema_version"] != 1:
+        return f"schema_version {v['schema_version']} != 1"
+    if v["determinism_repeat_identical"] is not True:
+        return "attribution leg not byte-identical across runs"
+    att = v["attribution"]
+    if not isinstance(att, dict) or not isinstance(att.get("requests"), list):
+        return "attribution record carries no per-request table"
+    ver = att.get("verification") or {}
+    # the re-check must not trust a loosened tolerance DECLARED BY the
+    # artifact itself — that would let a regenerated receipt mask a real
+    # attribution gap; the acceptance bar is 1e-6, full stop
+    tol = min(float(ver.get("tol", 1e-6)), 1e-6)
+    if ver.get("partial_trace"):
+        return ("attribution ran on a partial (span-evicted) trace — the "
+                "committed receipt must fold a complete one")
+    if ver.get("mismatches", 1) != 0:
+        return (f"attribution verification recorded {ver.get('mismatches')} "
+                "mismatch(es) — causes do not tile e2e")
+    # re-verify the tiling from the committed table itself: a summary that
+    # CLAIMS zero mismatches over a table that has one is exactly the
+    # drift this checker exists for
+    for i, r in enumerate(att["requests"]):
+        causes = r.get("causes") or {}
+        resid = sum(causes.values()) - r.get("e2e", 0.0)
+        # the committed values are independently rounded to 9 decimals
+        # (each cause + e2e contributes up to 0.5e-9), so pad tol by the
+        # worst-case rounding bound — a legitimately-tiled artifact must
+        # not fail the re-check on rounding noise alone
+        if abs(resid) > tol + 0.5e-9 * (len(causes) + 1):
+            return (f"attribution.requests[{i}] (trace {r.get('trace_id')}): "
+                    f"causes sum {sum(causes.values())} != e2e {r.get('e2e')} "
+                    f"(residual {resid:g} > tol {tol:g})")
+    gap = att.get("ttft_gap") or {}
+    frac = gap.get("attributed_fraction")
+    if not isinstance(frac, (int, float)) or isinstance(frac, bool) \
+            or frac < 0.8:
+        return (f"ttft_gap.attributed_fraction {frac!r} < 0.8 — the p99-p50 "
+                "TTFT gap is not explained by named causes")
+    deg = v["degradation"]
+    t0, t1 = deg.get("t0"), deg.get("t1")
+    if not (isinstance(t0, (int, float)) and isinstance(t1, (int, float))
+            and t1 > t0):
+        return f"degradation window [{t0}, {t1}] is not a real interval"
+    alerts = v["alerts"]
+    if not isinstance(alerts, list) or not alerts:
+        return ("no SLO alert fired — the injected degradation never "
+                "tripped the burn-rate monitor")
+    for i, a in enumerate(alerts):
+        fired, cleared = a.get("fired_ts"), a.get("cleared_ts")
+        if not isinstance(fired, (int, float)) or not t0 <= fired <= t1:
+            return (f"alerts[{i}] fired at {fired!r}, outside the injected "
+                    f"degradation window [{t0}, {t1}]")
+        if not isinstance(cleared, (int, float)) or cleared <= fired:
+            return f"alerts[{i}] never cleared (cleared_ts={cleared!r})"
+    rec = v["recorder"]
+    tracks = rec.get("tracks") if isinstance(rec, dict) else None
+    if not isinstance(tracks, dict) or \
+            not any(t.startswith("ctrl/") for t in tracks):
+        return (f"flight recorder retained no ctrl/* track ({tracks!r}) — "
+                "the control plane left no black-box trail")
+    return None
+
+
 _TERMINAL_STATES = {"done", "timed_out", "rejected"}
 
 
@@ -424,6 +505,8 @@ SCHEMAS = {
     # telemetry trace artifacts (scripts/bench_*.py --trace)
     "BENCH_ROUTER_TRACE.json": _validate_trace,
     "BENCH_SERVING_TRACE.json": _validate_trace,
+    # slowdown-attribution + SLO burn-rate receipt (scripts/why_slow.py)
+    "BENCH_ROUTER_ATTRIB.json": _validate_attribution,
     # single-metric bench artifacts (bench.py-style envelope)
     "BENCH_SCALE.json": {"metric": STR, "value": NUM, "unit": STR,
                          "?vs_baseline": NUM, "extra": DICT},

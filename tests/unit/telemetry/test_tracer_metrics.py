@@ -208,6 +208,59 @@ def test_flush_to_monitor_bridges_telemetry_events():
     assert reg.flush_to_monitor(mon) == 0
 
 
+def test_histogram_window_summarizes_only_new_samples():
+    """r18 windowed snapshots (telemetry/slo.py's input shape): a window
+    is a cumulative-state snapshot, and ``since(win)`` summarizes only
+    the samples recorded after it — no sample retention anywhere."""
+    h = Histogram("lat")
+    for v in (0.1, 0.2, 0.4):
+        h.record(v)
+    win = h.window()
+    assert h.since(win)["count"] == 0 and h.since(win)["p99"] is None
+    rng = np.random.default_rng(1)
+    xs = rng.lognormal(mean=0.0, sigma=0.5, size=2000)
+    for x in xs:
+        h.record(float(x))
+    s = h.since(win)
+    assert s["count"] == 2000
+    assert abs(s["sum"] - float(np.sum(xs))) < 1e-6
+    # windowed quantiles carry the same one-growth-factor bucket error as
+    # the live ones — and must NOT be polluted by the pre-window samples
+    for q, key in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+        exact = float(np.quantile(xs, q))
+        assert abs(s[key] - exact) / exact < 2 ** 0.5 - 1 + 0.05, \
+            (key, s[key], exact)
+    # overflow-bucket samples: the lifetime max bounds the window's tail
+    # instead of silently truncating at bounds[-1] (regression)
+    win2 = h.window()
+    h.record(1e9)
+    assert h.since(win2)["p99"] > h.bounds[-1]
+    # a snapshot from a DIFFERENT histogram's geometry is rejected, as is
+    # a snapshot newer than the histogram it is applied to
+    with pytest.raises(ValueError):
+        Histogram("other", n_buckets=8).since(win)
+    with pytest.raises(ValueError):
+        Histogram("lat").since(h.window())
+
+
+def test_registry_snapshot_since_counters_deltas_and_new_metrics():
+    reg = MetricsRegistry()
+    reg.counter("serving/done").inc(3)
+    reg.histogram("ttft").record(0.5)
+    reg.gauge("rung").set(1.0)
+    win = reg.window()
+    reg.counter("serving/done").inc(2)
+    reg.histogram("ttft").record(1.5)
+    reg.gauge("rung").set(3.0)
+    reg.counter("late/counter").inc(7)   # created after the snapshot
+    snap = reg.snapshot_since(win)
+    assert snap["serving/done"] == 2     # delta, not cumulative
+    assert snap["ttft"]["count"] == 1 and snap["ttft"]["sum"] == 1.5
+    assert snap["rung"] == 3.0           # gauges are last-write-wins
+    assert snap["late/counter"] == 7     # windows from zero
+    assert list(snap) == sorted(snap)
+
+
 # ---------------------------------------------------------------- exporters
 
 
