@@ -6,7 +6,8 @@ directions of drift, all fatal in tier-1.
 
 Mechanics: any string constant matching ``<prefix>/<segment>[...]`` for
 the known prefixes (resilience, serving, fleet, telemetry, monitor,
-profiler, spec, migration, prefix, transport) is an event-name use — except statement-position strings
+profiler, spec, migration, prefix, transport, slo, ctrl, recorder,
+anatomy, kv, engine) is an event-name use — except statement-position strings
 (docstrings) and the registry file itself.  f-string names
 (``f"fleet/health/{state.value}"``) are validated by their literal head
 against the registry's DYNAMIC prefix families.
@@ -22,10 +23,12 @@ from ..core import Checker, FileContext, Runner, collect_files
 
 EVENT_RE = re.compile(
     r"^(resilience|serving|fleet|telemetry|monitor|profiler|spec|migration"
-    r"|prefix|transport|slo|ctrl|recorder)/[a-z0-9_]+(/[a-z0-9_]+)*$")
+    r"|prefix|transport|slo|ctrl|recorder|anatomy|kv|engine)"
+    r"/[a-z0-9_]+(/[a-z0-9_]+)*$")
 _PREFIXES = ("resilience/", "serving/", "fleet/", "telemetry/",
              "monitor/", "profiler/", "spec/", "migration/", "prefix/",
-             "transport/", "slo/", "ctrl/", "recorder/")
+             "transport/", "slo/", "ctrl/", "recorder/", "anatomy/", "kv/",
+             "engine/")
 REGISTRY_REL = "telemetry/event_registry.py"
 
 

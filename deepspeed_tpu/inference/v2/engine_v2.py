@@ -29,6 +29,7 @@ import numpy as np
 
 from ...models.llama import LlamaConfig
 from ...models.llama_cache import LlamaForCausalLMWithCache, PagedKVConfig, init_kv_cache
+from ...telemetry.step_anatomy import NULL_ANATOMY
 from ...utils.logging import logger
 from .ragged import BlockedKVCache, RaggedBatch, StateManager
 from .scheduler import SchedulerConfig, SplitFuseScheduler, StepPlan
@@ -256,9 +257,30 @@ class InferenceEngineV2:
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._max_new: Dict[int, int] = {}
         self._step_fns: Dict[Tuple[int, int], callable] = {}
+        # per-step anatomy (telemetry/step_anatomy.py): NULL by default —
+        # one attribute read + one predicate per hook when disabled
+        self.anatomy = NULL_ANATOMY
+        self._fresh_compile = False
         self._param_sh = self._cache_sh = self._repl_sh = None
         if self.mesh is not None:
             self._setup_tp()
+
+    def set_anatomy(self, anatomy):
+        """Attach a :class:`~...telemetry.step_anatomy.StepAnatomy`
+        recorder (None restores the allocation-free NULL recorder).  The
+        recorder's clock should be the serving clock when a frontend
+        drives this engine, so host-gap windows and device charges live
+        in one time domain."""
+        self.anatomy = anatomy if anatomy is not None else NULL_ANATOMY
+        return self.anatomy
+
+    def _note_compile(self, key: str) -> None:
+        """One JIT cache miss: the NEXT dispatch of this program pays the
+        trace+compile synchronously, so the step's dispatch segment is
+        tagged ``compile_wait`` and the compile tracker records the miss
+        (warm-up vs steady-state — the AOT regression guard)."""
+        self._fresh_compile = True
+        self.anatomy.note_compile(key)
 
     # ------------------------------------------------------------------ TP
 
@@ -403,6 +425,7 @@ class InferenceEngineV2:
             step = _make_step_fn(self.model, self._qparams, self.econfig.greedy,
                                  self.econfig.temperature)
             self._step_fns[key] = jax.jit(step, donate_argnums=(1, ), **self._jit_kwargs())
+            self._note_compile(f"step:b{batch}:c{chunk}")
         return self._step_fns[key]
 
     def _compiled_multi_step(self, batch: int, k: int):
@@ -432,6 +455,7 @@ class InferenceEngineV2:
                 return out, cache
 
             self._step_fns[key] = jax.jit(mstep, donate_argnums=(1, ), **self._jit_kwargs())
+            self._note_compile(f"multi:b{batch}:k{k}")
         return self._step_fns[key]
 
     def _compiled_verify(self, batch: int, width: int):
@@ -461,6 +485,7 @@ class InferenceEngineV2:
                 kwargs = dict(in_shardings=(self._param_sh, self._cache_sh, r, r, r, r),
                               out_shardings=(r, self._cache_sh))
             self._step_fns[key] = jax.jit(vstep, donate_argnums=(1, ), **kwargs)
+            self._note_compile(f"verify:b{batch}:w{width}")
         return self._step_fns[key]
 
     def warm_verify(self, batch_sizes: Sequence[int]) -> None:
@@ -528,6 +553,7 @@ class InferenceEngineV2:
         to non-speculative decode by construction — every emitted token
         IS the model's argmax given the exact accepted history."""
         from ...resilience import fault_injection as _fi
+        anat = self.anatomy
         width = self.econfig.spec.max_draft + 1
         batch = self._bucket_batch(len(seqs))
         base_len = [len(s.tokens) for s in seqs]
@@ -538,12 +564,18 @@ class InferenceEngineV2:
         try:
             rb: RaggedBatch = self.state.pack([(s, 1 + len(d)) for s, d in zip(seqs, drafts)],
                                               width, pad_to=batch)
+            if anat.enabled:
+                anat.mark("verify_plan")
             fn = self._compiled_verify(batch, width)
+            if anat.enabled:
+                anat.note_shape("spec_verify", batch, width)
             _fi.check("engine.verify_step")  # chaos site: device loss mid-verify
             argmax, self.cache = self._invoke(fn, self.params, self.cache,
                                               jnp.asarray(rb.tokens), jnp.asarray(rb.start_pos),
                                               jnp.asarray(rb.block_tables),
                                               jnp.asarray(rb.chunk_lens))
+            if anat.enabled:
+                anat.mark("compile_wait" if self._fresh_compile else "dispatch")
         except BaseException:
             # a failed verify dispatch must never bake unverified drafts
             # into the history: restore every row's token list so a caller
@@ -555,6 +587,8 @@ class InferenceEngineV2:
                 del s.tokens[L:]
             raise
         argmax = np.asarray(argmax)
+        if anat.enabled:
+            anat.device_mark()
 
         out: Dict[int, List[int]] = {}
         eos = self.econfig.eos_token_id
@@ -590,6 +624,8 @@ class InferenceEngineV2:
             self.spec_stats.emitted += len(out[s.uid])
             self.spec_stats.rollback_pages += freed
             self.last_spec_round[s.uid] = (len(d), a, freed)
+        if anat.enabled:
+            anat.mark("sample_accept")
         return out
 
     def _multi_decode(self, seqs, k: int) -> Dict[int, List[int]]:
@@ -607,12 +643,19 @@ class InferenceEngineV2:
             self.kv.ensure_capacity(s, min(k, remaining))
         rb: RaggedBatch = self.state.pack([(s, 1) for s in seqs], 1, pad_to=batch)
 
+        anat = self.anatomy
         self.rng, sub = jax.random.split(self.rng)
         fn = self._compiled_multi_step(batch, k)
+        if anat.enabled:
+            anat.note_shape("multi_decode", batch, k)
         toks, self.cache = self._invoke(fn, self.params, self.cache, jnp.asarray(rb.tokens[:, 0]),
                                         jnp.asarray(rb.start_pos), jnp.asarray(rb.block_tables),
                                         jnp.asarray(rb.chunk_lens), sub)
+        if anat.enabled:
+            anat.mark("compile_wait" if self._fresh_compile else "dispatch")
         toks = np.asarray(toks)
+        if anat.enabled:
+            anat.device_mark()
 
         out: Dict[int, List[int]] = {}
         eos = self.econfig.eos_token_id
@@ -634,6 +677,8 @@ class InferenceEngineV2:
             self.state.truncate(s, len(s.tokens))
             self.state.note_progress(s)
             out[s.uid] = list(s.generated[before:])
+        if anat.enabled:
+            anat.mark("sample_accept")
         return out
 
     def _bucket_batch(self, n: int) -> int:
@@ -646,9 +691,30 @@ class InferenceEngineV2:
         the single-step path, up to ``decode_steps_per_dispatch`` on the
         fused decode path.  ``plan`` lets a caller that already planned
         (the serving frontend's KV-pressure preflight) skip the re-plan;
-        it must have been computed against the CURRENT state."""
-        if plan is None:
-            plan = self.scheduler.plan(self.state)
+        it must have been computed against the CURRENT state.
+
+        With a :class:`~...telemetry.step_anatomy.StepAnatomy` attached
+        (``set_anatomy``), the step is decomposed into host segments +
+        device compute + host gap; a frontend that planned before calling
+        opens the step window itself (``step_begin`` is idempotent) and
+        the ``finally`` here closes it even on a chaos-site failure, so
+        no step window ever leaks open."""
+        anat = self.anatomy
+        self._fresh_compile = False
+        if anat.enabled:
+            anat.step_begin()
+        try:
+            if plan is None:
+                plan = self.scheduler.plan(self.state)
+                if anat.enabled:
+                    anat.mark("schedule")
+            return self._step_inner(plan)
+        finally:
+            if anat.enabled:
+                anat.step_end()
+
+    def _step_inner(self, plan: StepPlan) -> Dict[int, List[int]]:
+        anat = self.anatomy
         # per-step spec accounting: entries describe THIS step's verify
         # round only (the serving frontend reads them right after step())
         self.last_spec_round.clear()
@@ -660,6 +726,8 @@ class InferenceEngineV2:
             # draft to zero) fall through to the fused/single-step rungs —
             # a drained-draft round must still make k=1 progress.
             drafts = self._plan_drafts(plan.decode)
+            if anat.enabled:
+                anat.mark("draft_plan")
             if any(drafts):
                 return self._spec_decode(plan.decode, drafts)
         k_cfg = self.econfig.decode_steps_per_dispatch
@@ -693,10 +761,18 @@ class InferenceEngineV2:
 
         self.rng, sub = jax.random.split(self.rng)
         fn = self._compiled_step(batch, chunk)
+        if anat.enabled:
+            path = ("mixed" if plan.prefill and plan.decode
+                    else "prefill" if plan.prefill else "decode")
+            anat.note_shape(path, batch, chunk)
         next_tok, self.cache = self._invoke(fn, self.params, self.cache, jnp.asarray(rb.tokens),
                                             jnp.asarray(rb.start_pos), jnp.asarray(rb.block_tables),
                                             jnp.asarray(rb.chunk_lens), sub)
+        if anat.enabled:
+            anat.mark("compile_wait" if self._fresh_compile else "dispatch")
         next_tok = np.asarray(next_tok)
+        if anat.enabled:
+            anat.device_mark()
 
         out: Dict[int, List[int]] = {}
         for i, uid in enumerate(rb.uids):
@@ -716,6 +792,8 @@ class InferenceEngineV2:
             if len(seq.generated) >= self._max_new.get(uid, self.econfig.max_new_tokens) or \
                     (eos is not None and tok == eos):
                 seq.done = True
+        if anat.enabled:
+            anat.mark("sample_accept")
         return out
 
     # ----------------------------------------------------------- generate

@@ -428,6 +428,53 @@ class BlockedKVCache:
         arena[:, idx] = block
         return arena
 
+    def arena_stats(self) -> dict:
+        """Point-in-time arena occupancy for the ``kv/*`` telemetry
+        gauges (docs/OBSERVABILITY.md "Step anatomy"):
+
+          usable                 — allocatable pages (the reserved null
+                                   page 0 excluded)
+          in_use / free          — pages held by sequences and/or the
+                                   prefix cache vs on the free list
+          occupancy              — in_use / usable
+          free_run_fragmentation — 1 - (longest contiguous free page-id
+                                   run / free pages).  Pages are fully
+                                   indirected through block tables, so
+                                   this measures allocation churn (how
+                                   interleaved live pages are), the
+                                   input a future multi-page block
+                                   allocator would care about; 0.0 when
+                                   the free ids form one run (or nothing
+                                   is free).
+          prefix_cache_pages     — pages pinned by prefix-cache entries
+          prefix_cache_share     — prefix_cache_pages / in_use (0 when
+                                   the arena is empty)
+        O(free log free) for the sorted run scan — a once-per-fleet-round
+        export, not a hot-path read."""
+        usable = self.num_pages - 1
+        free = self.allocator.free_pages
+        in_use = usable - free
+        frag = 0.0
+        if free > 1:
+            ids = sorted(self.allocator._free)
+            longest = run = 1
+            for prev, cur in zip(ids, ids[1:]):
+                run = run + 1 if cur == prev + 1 else 1
+                if run > longest:
+                    longest = run
+            frag = 1.0 - longest / free
+        pc_pages = self.prefix_cache.cached_pages \
+            if self.prefix_cache is not None else 0
+        return {
+            "usable": usable,
+            "in_use": in_use,
+            "free": free,
+            "occupancy": round(in_use / usable, 6) if usable else 0.0,
+            "free_run_fragmentation": round(frag, 6),
+            "prefix_cache_pages": pc_pages,
+            "prefix_cache_share": round(pc_pages / in_use, 6) if in_use else 0.0,
+        }
+
     def release_tail(self, seq: SequenceDescriptor, keep_pages: int) -> int:
         """Return ``seq``'s pages past the first ``keep_pages`` to the
         allocator (speculative-decode rollback; EOS/limit mid-rung surplus).

@@ -42,6 +42,14 @@ class StepPlan:
     decode: List[SequenceDescriptor]
     prefill: List[Tuple[SequenceDescriptor, int]]   # (seq, n_tokens)
 
+    @property
+    def planned_tokens(self) -> int:
+        """Real tokens this step will feed: one per decode row plus the
+        prefill chunk tokens — the serving ``step_cost`` model's input
+        and the step-anatomy row's token-volume attribution (one
+        definition, two consumers, no drift)."""
+        return len(self.decode) + sum(n for _, n in self.prefill)
+
 
 class SplitFuseScheduler:
 

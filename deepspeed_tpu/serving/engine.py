@@ -28,6 +28,7 @@ import itertools
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..resilience.fault_injection import InjectedCrash
+from ..telemetry.step_anatomy import NULL_ANATOMY
 from ..telemetry.trace import NULL_TRACER
 from ..utils.logging import logger
 from .admission import AdmissionConfig, AdmissionController
@@ -90,6 +91,15 @@ class ServingEngine:
         self._uids = itertools.count(max(engine.state.seqs.keys(), default=-1) + 1)
         self._events_step = 0
         self._t0 = self.clock.now()
+        # step-anatomy fold cursors (telemetry/step_anatomy.py): compiles
+        # already bridged into metrics/events, steps already mirrored into
+        # the flight-recorder ring.  The compile cursor starts at the
+        # recorder's CURRENT log length so pre-frontend warm-up compiles
+        # (harnesses warm before building the frontend) are not re-counted
+        # as serving-time recompiles.
+        self._compiles_seen = len(getattr(engine, "anatomy",
+                                          NULL_ANATOMY).compiles)
+        self._anat_steps_seen = 0
         # EWMA of clock-seconds per tick-with-work (load_stats input for the
         # fleet router's least-loaded policy); None until the first step runs
         self._ewma_step_s: Optional[float] = None
@@ -271,6 +281,7 @@ class ServingEngine:
                     # marker — never the raw clock — decides; the wait
                     # itself cannot change what submit_ok reads)
                     self.clock.wait_until(target)
+                    self._note_idle()
             if ok:
                 reason = None
             elif why != "queue_full":
@@ -317,7 +328,20 @@ class ServingEngine:
     def tick(self) -> Dict[int, List[int]]:
         """One serving iteration: expire deadlines, admit, resolve KV
         pressure, run one engine step, deliver tokens.  Returns the engine
-        step's {uid: [tokens]} (empty when nothing was runnable)."""
+        step's {uid: [tokens]} (empty when nothing was runnable).
+
+        With a step-anatomy recorder on the engine, the tick opens the
+        step window BEFORE the admission/preflight work (``step_begin``
+        is idempotent — the engine's own call then no-ops) and attributes
+        planning up to the engine call as the ``schedule`` segment; on
+        clock-charged steps (VirtualClock / fleet clock views) the
+        charged cost is forwarded as the step's device seconds.  Ticks
+        that run no step leave the window open — their host work folds
+        into the step that eventually runs, which is exactly the loop tax
+        the anatomy exists to expose."""
+        anat = getattr(self.engine, "anatomy", NULL_ANATOMY)
+        if anat.enabled:
+            anat.step_begin()
         now = self.clock.now()
         self._expire(now)
         self._admit(now)
@@ -333,9 +357,11 @@ class ServingEngine:
             # no step to run and no cost to charge — the export chunks are
             # the fleet driver's work, not this replica's step loop's
             return {}
+        if anat.enabled:
+            anat.mark("schedule")
         cost = 1.0
         if self.config.step_cost is not None:
-            cost = self.config.step_cost(len(plan.decode) + sum(n for _, n in plan.prefill))
+            cost = self.config.step_cost(plan.planned_tokens)
         t_step = self.clock.now()
         out = self.engine.step(plan)
         # clock-domain step seconds: clocks that account the cost themselves
@@ -345,11 +371,78 @@ class ServingEngine:
         dt = charged if charged is not None else self.clock.now() - t_step
         self._ewma_step_s = dt if self._ewma_step_s is None \
             else 0.8 * self._ewma_step_s + 0.2 * dt
+        if anat.enabled:
+            if charged is not None:
+                anat.charge_last_step(charged)
+            self._fold_anatomy(anat)
         # fold BEFORE _deliver: finishing a request flushes its engine
         # sequence, which pops its last_spec_round entry
         self._record_spec_rounds()
         self._deliver(out, self.clock.now())
         return out
+
+    def _fold_anatomy(self, anat) -> None:
+        """Bridge the engine's step-anatomy state into the serving
+        telemetry surfaces: new JIT cache misses become ``engine/
+        recompiles`` counter increments (steady-state ones additionally
+        the ``engine/recompile_steady_state`` counter + event — the AOT
+        regression signal, loud by design), and the just-closed step is
+        mirrored as one bounded ``anatomy/step`` span on this frontend's
+        flight-recorder track."""
+        compiles = anat.compiles
+        if len(compiles) > self._compiles_seen:
+            for c in list(compiles)[self._compiles_seen:]:
+                if self.metrics is not None:
+                    self.metrics.counter("engine/recompiles").inc()
+                if c.steady:
+                    if self.metrics is not None:
+                        self.metrics.counter(
+                            "engine/recompile_steady_state").inc()
+                    logger.warning(
+                        f"steady-state recompile: program {c.key} compiled "
+                        f"at step {c.step_index} AFTER the warm-up boundary "
+                        "— the bucketed step set is not closed")
+                    self._emit([("engine/recompile_steady_state", 1.0,
+                                 self._next_event_step())])
+            self._compiles_seen = len(compiles)
+        if anat.total_steps > self._anat_steps_seen:
+            unseen = anat.total_steps - self._anat_steps_seen
+            self._anat_steps_seen = anat.total_steps
+            recorder = self.recorder if self.recorder is not None \
+                else getattr(self.tracer, "recorder", None)
+            if recorder is not None:
+                # mirror EVERY unseen closed step, not just the newest —
+                # a chaos-failed step closes its record but skips that
+                # tick's fold, and its anatomy is exactly what a
+                # crash-scoped dump needs (deque eviction bounds the tail)
+                steps = anat.steps
+                for rec in list(steps)[-min(unseen, len(steps)):]:
+                    recorder.span(
+                        "anatomy/step", f"anatomy/{self.trace_track}",
+                        rec.end_ts - rec.wall_s, rec.end_ts,
+                        attrs={"shape": rec.shape_key,
+                               "host_gap_s": round(rec.host_gap_s, 9),
+                               "host_s": round(rec.host_s(), 9),
+                               "device_s": round(rec.device_s, 9),
+                               "compiles": rec.compiles})
+
+    def export_kv_gauges(self) -> None:
+        """Publish the engine's KV-arena occupancy onto the metrics
+        registry (``kv/*`` gauges — page occupancy, free-run
+        fragmentation, prefix-cache share; docs/OBSERVABILITY.md "Step
+        anatomy").  Standalone frontends call this at whatever cadence
+        they report; the fleet router exports the per-replica variants
+        once per fleet round instead.  No-op without a registry."""
+        if self.metrics is None:
+            return
+        st = self.engine.kv.arena_stats()
+        m = self.metrics
+        m.gauge("kv/pages_in_use").set(st["in_use"])
+        m.gauge("kv/pages_free").set(st["free"])
+        m.gauge("kv/page_occupancy").set(st["occupancy"])
+        m.gauge("kv/free_run_fragmentation").set(st["free_run_fragmentation"])
+        m.gauge("kv/prefix_cache_pages").set(st["prefix_cache_pages"])
+        m.gauge("kv/prefix_cache_share").set(st["prefix_cache_share"])
 
     def _record_spec_rounds(self) -> None:
         """Fold the step's verify-round accounting (``engine.last_spec_round``,
@@ -730,6 +823,7 @@ class ServingEngine:
                 if next_arrival is None:
                     return
                 self.clock.wait_until(next_arrival)
+                self._note_idle()
                 continue
             marker = self._progress_marker()
             self.tick()
@@ -747,7 +841,16 @@ class ServingEngine:
                         f"{len(self._active)} active, no admissible work and no "
                         "future event to wait for")
                 self.clock.wait_until(min(waits) + 1e-9)
+                self._note_idle()
         raise RuntimeError(f"serving loop exceeded max_ticks={max_ticks}")
+
+    def _note_idle(self) -> None:
+        """The loop just idled to a future event: exclude the jump from
+        the step anatomy (idle is absent load, not step-loop tax — the
+        next step is flagged ``after_idle`` instead)."""
+        anat = getattr(self.engine, "anatomy", NULL_ANATOMY)
+        if anat.enabled:
+            anat.note_idle()
 
     def _progress_marker(self):
         return (len(self.stats.finished), self.stats.preemptions,

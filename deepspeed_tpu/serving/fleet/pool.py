@@ -60,7 +60,8 @@ class ReplicaPool:
                  roles: Optional[Sequence[Union[str, ReplicaRole]]] = None,
                  role_factories: Optional[Dict] = None,
                  prefix_directory=None, transport=None,
-                 hb_interval: float = 0.5):
+                 hb_interval: float = 0.5, anatomy: bool = False,
+                 anatomy_max_steps: int = 4096):
         assert n_replicas >= 1, n_replicas
         if roles is not None and len(roles) != n_replicas:
             raise ValueError(f"roles ({len(roles)}) must cover every replica "
@@ -126,6 +127,16 @@ class ReplicaPool:
         #: after the replica rejoined re-acks without cancelling the
         #: legitimately re-dispatched post-rejoin work
         self._fenced_epoch: Dict[int, int] = {r: 0 for r in range(n_replicas)}
+        # per-replica step anatomy (telemetry/step_anatomy.py): each
+        # attached engine gets its OWN recorder on the replica's clock
+        # view (one time domain with the serving charges), recreated
+        # across kill/recover/restart like the engine itself; the
+        # steady-state boundary stays PER-RECORDER: a replacement engine
+        # from recover()/restart() starts un-steady, because a fresh
+        # replica MUST compile its step set — that is recovery, not a
+        # regression (mark_anatomy_steady() re-declares after warm-up)
+        self.anatomy_enabled = bool(anatomy)
+        self.anatomy_max_steps = int(anatomy_max_steps)
         self.clock = clock if clock is not None else VirtualClock()
         self._virtual = isinstance(self.clock, VirtualClock)
         self.replicas: Dict[int, Replica] = {}
@@ -149,6 +160,11 @@ class ReplicaPool:
                                   trace_track=f"replica{rid}",
                                   recorder=self.recorder)
         rep.generation += 1
+        if self.anatomy_enabled:
+            from ...telemetry.step_anatomy import StepAnatomy
+            rep.serve.engine.set_anatomy(
+                StepAnatomy(clock=rep.clock,
+                            max_steps=self.anatomy_max_steps))
         if self.prefix_directory is not None:
             # a fresh engine's cache is empty: stale entries from the
             # replica's previous life (rolling restart) must go first
@@ -278,6 +294,26 @@ class ReplicaPool:
         currently has an engine (DEAD replicas are absent)."""
         return {rid: rep.serve.load_stats()
                 for rid, rep in sorted(self.replicas.items()) if rep.serve is not None}
+
+    def anatomy(self, rid: int):
+        """The step-anatomy recorder of replica ``rid``'s CURRENT engine
+        (None when anatomy is off or the replica is dead) — the router's
+        per-round host-gap gauge input."""
+        rep = self.replicas[rid]
+        if rep.serve is None:
+            return None
+        anat = getattr(rep.serve.engine, "anatomy", None)
+        return anat if getattr(anat, "enabled", False) else None
+
+    def mark_anatomy_steady(self) -> None:
+        """Declare warm-up over on every live replica's recorder: later
+        JIT cache misses count as unexpected steady-state recompiles.
+        Engines attached AFTER this (recover/restart replacements) start
+        un-steady — their compile set is recovery, not regression."""
+        for rid in self.rids:
+            anat = self.anatomy(rid)
+            if anat is not None:
+                anat.mark_steady()
 
     # ----------------------------------------------------------- lifecycle
 

@@ -375,6 +375,17 @@ class Router:
             "partition_dispatch_skips": 0,
         }
         self.recovery_times: List[float] = []
+        # arrival-rate telemetry (ROADMAP's predictive-scale-up input):
+        # submissions counted at submit(), folded into a rate EWMA + its
+        # derivative once per fleet round by export_replica_gauges —
+        # deterministic under VirtualClock like every gauge here
+        self.arrival_ewma_alpha = 0.2
+        self._arrival_count = 0
+        self._arr_last: Optional[Tuple[float, int, Optional[float]]] = None
+        #: tenants that ever carried a kv/tenant_pages gauge — a tenant
+        #: whose pages drop to zero must READ zero, not freeze its last
+        #: non-zero sample forever
+        self._kv_tenants_seen: set = set()
 
     # -------------------------------------------------------------- submit
 
@@ -382,6 +393,7 @@ class Router:
                deadline: Optional[float] = None, arrival_ts: Optional[float] = None,
                priority: float = 0.0, tenant: str = "default") -> FleetRequest:
         now = self.clock.now() if arrival_ts is None else float(arrival_ts)
+        self._arrival_count += 1   # demand signal: sheds/rejects included
         spec = self.tenants.spec(tenant)
         max_new_tokens = int(max_new_tokens)
         capped = False
@@ -1853,6 +1865,111 @@ class Router:
         if self.directory is not None:
             metrics.gauge("fleet/prefix_directory_entries").set(
                 self.directory.entries)
+        self._export_arrival_gauges(now, metrics)
+        self._export_kv_gauges(metrics)
+
+    def _export_arrival_gauges(self, now: float, metrics) -> None:
+        """Arrival-rate EWMA + derivative (``fleet/arrival_rate_ewma`` /
+        ``fleet/arrival_rate_slope``): the demand signal the ROADMAP's
+        predictive scale-up item provisions on — scale BEFORE the queue
+        grows by reading the rate's slope, not the queue's depth.  One
+        fold per fleet round; zero-advance rounds carry no new rate
+        information and are skipped (the gauges keep their last fold)."""
+        if self._arr_last is None:
+            metrics.gauge("fleet/arrival_rate_ewma").set(0.0)
+            metrics.gauge("fleet/arrival_rate_slope").set(0.0)
+            self._arr_last = (now, self._arrival_count, None)
+            return
+        t0, c0, ewma0 = self._arr_last
+        dt = now - t0
+        if dt <= 0:
+            return
+        inst = (self._arrival_count - c0) / dt
+        ewma = inst if ewma0 is None else (
+            self.arrival_ewma_alpha * inst
+            + (1.0 - self.arrival_ewma_alpha) * ewma0)
+        slope = 0.0 if ewma0 is None else (ewma - ewma0) / dt
+        metrics.gauge("fleet/arrival_rate_ewma").set(round(ewma, 9))
+        metrics.gauge("fleet/arrival_rate_slope").set(round(slope, 9))
+        self._arr_last = (now, self._arrival_count, ewma)
+
+    def _export_kv_gauges(self, metrics) -> None:
+        """Per-replica KV-arena occupancy (``kv/<stat>/<rid>``), the
+        per-replica step-anatomy host-gap fraction
+        (``anatomy/host_gap_fraction/<rid>``), and the per-tenant page
+        tallies (``kv/tenant_pages/<tenant>`` — the missing input for the
+        ROADMAP per-tenant KV-quota item).  Tenant tallies attribute every
+        in-use page exactly once, so they SUM to the fleet's pages in use
+        (tested); a tenant that dropped to zero pages reads zero."""
+        for rid in self.pool.rids:
+            rep = self.pool.replica(rid)
+            if rep.serve is None:
+                # DEAD/parked: the arena died with the engine — gauges
+                # must READ zero, not freeze their pre-death samples
+                # (same stance as the fleet/replica_* gauges above)
+                st = {"occupancy": 0.0, "free_run_fragmentation": 0.0,
+                      "prefix_cache_share": 0.0}
+            else:
+                st = rep.serve.engine.kv.arena_stats()
+            metrics.gauge(f"kv/page_occupancy/{rid}").set(st["occupancy"])
+            metrics.gauge(f"kv/free_run_fragmentation/{rid}").set(
+                st["free_run_fragmentation"])
+            metrics.gauge(f"kv/prefix_cache_share/{rid}").set(
+                st["prefix_cache_share"])
+            if getattr(self.pool, "anatomy_enabled", False):
+                # ALWAYS re-set from the current recorder: a replacement
+                # engine's fresh recorder reads None (-> 0.0) until its
+                # first step — the gauge must not keep attributing the
+                # dead engine's loop tax to the new one
+                anat = self.pool.anatomy(rid)
+                frac = anat.host_gap_fraction() if anat is not None else None
+                metrics.gauge(f"anatomy/host_gap_fraction/{rid}").set(
+                    round(frac, 6) if frac is not None else 0.0)
+        pages = self.tenant_kv_pages()
+        for tenant in sorted(self._kv_tenants_seen | set(pages)):
+            metrics.gauge(f"kv/tenant_pages/{tenant}").set(
+                pages.get(tenant, 0))
+        self._kv_tenants_seen |= set(pages)
+
+    def tenant_kv_pages(self) -> Dict[str, int]:
+        """KV pages currently held per tenant, fleet-wide.  Each in-use
+        page is attributed EXACTLY ONCE: to the tenant of the first
+        (uid-ordered) live sequence holding it — a prefix-shared page
+        counts toward whoever admitted first, never twice — with two
+        reserved keys: ``prefix_cache`` for pages only the prefix cache
+        pins, and ``unattributed`` for sequences no fleet request owns
+        (direct engine users).  The tallies therefore sum to the fleet's
+        total pages in use — the conservation law the per-tenant KV-quota
+        item needs to trust before it can enforce anything."""
+        owner: Dict[Tuple[int, int], str] = {}
+        for fr in self._dispatched.values():
+            if fr._current is not None:
+                rid, sr, _gen = fr._current
+                owner[(rid, sr.uid)] = fr.tenant
+        out: Dict[str, int] = {}
+        for rid in self.pool.rids:
+            rep = self.pool.replica(rid)
+            if rep.serve is None:
+                continue
+            eng = rep.serve.engine
+            seen = set()
+            for uid in sorted(eng.state.seqs):
+                seq = eng.state.seqs[uid]
+                tenant = owner.get((rid, uid), "unattributed")
+                n = 0
+                for p in seq.pages:
+                    if p not in seen:
+                        seen.add(p)
+                        n += 1
+                if n:
+                    out[tenant] = out.get(tenant, 0) + n
+            # in_use straight from the allocator (arena_stats would pay
+            # its O(free log free) fragmentation scan just for this field)
+            in_use = (eng.kv.num_pages - 1) - eng.kv.allocator.free_pages
+            cache_only = in_use - len(seen)
+            if cache_only:
+                out["prefix_cache"] = out.get("prefix_cache", 0) + cache_only
+        return out
 
     def _retransmit_depth(self) -> int:
         """How many reliable-stream sends are currently awaiting an ack —

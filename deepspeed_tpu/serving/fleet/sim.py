@@ -388,6 +388,14 @@ class FleetSimulator:
                 t_jump = clock.now()
                 clock.wait_until(min(waits) + 1e-9)
                 self.replica_seconds += (clock.now() - t_jump) * n_provisioned
+                if clock.now() > t_jump:
+                    # idle jump: exclude it from every replica's step
+                    # anatomy (idle is absent load, not step-loop tax —
+                    # same stance as ServingEngine._note_idle)
+                    for rid in pool.rids:
+                        anat = pool.anatomy(rid)
+                        if anat is not None:
+                            anat.note_idle()
         raise RuntimeError(f"fleet simulation exceeded max_rounds={self.max_rounds}")
 
     def _apply(self, ev: FleetEvent, deferred_restarts: List[int]) -> None:

@@ -21,6 +21,8 @@ from .metrics import (Counter, Gauge, Histogram, HistogramWindow,
                       MetricsRegistry)
 from .slo import BurnRateConfig, SLOBurnMonitor
 from .spans import PHASE_OF_STATE, emit_attempt_spans, phase_intervals
+from .step_anatomy import (HOST_SEGMENTS, NULL_ANATOMY, NullStepAnatomy,
+                           StepAnatomy)
 from .trace import (NULL_SPAN, NULL_TRACER, NullTracer, PerfClock, Span,
                     Tracer)
 
@@ -31,5 +33,6 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "HistogramWindow", "MetricsRegistry",
     "BurnRateConfig", "SLOBurnMonitor",
     "PHASE_OF_STATE", "emit_attempt_spans", "phase_intervals",
+    "HOST_SEGMENTS", "NULL_ANATOMY", "NullStepAnatomy", "StepAnatomy",
     "NULL_SPAN", "NULL_TRACER", "NullTracer", "PerfClock", "Span", "Tracer",
 ]

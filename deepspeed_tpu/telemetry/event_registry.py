@@ -228,6 +228,56 @@ EVENTS = {
                                    "migration chunks + directory "
                                    "resyncs), sampled once per fleet "
                                    "round"),
+    # ---- step anatomy (telemetry/step_anatomy.py, folded by
+    #      serving/engine.py; docs/OBSERVABILITY.md "Step anatomy")
+    "engine/recompiles": ("counter", "serving/engine.py",
+                          "JIT cache misses folded from the step-anatomy "
+                          "compile tracker (warm-up included)"),
+    "engine/recompile_steady_state": ("event+counter", "serving/engine.py",
+                                      "a step program compiled AFTER the "
+                                      "warm-up boundary — the AOT "
+                                      "serving-step regression guard"),
+    "anatomy/step": ("span", "serving/engine.py",
+                     "flight-recorder span: one engine step's anatomy "
+                     "(attrs: shape, host/device/gap seconds, compiles) "
+                     "on the anatomy/<frontend> track"),
+    "anatomy/device": ("span", "telemetry/step_anatomy.py",
+                       "device-compute child of an emit_spans "
+                       "anatomy/step (host segments ride as "
+                       "anatomy/<segment> via the DYNAMIC family)"),
+    # ---- engine-step tracer spans (runtime/engine.py set_telemetry)
+    "engine/step": ("span", "runtime/engine.py",
+                    "one train_batch trace root on the engine track"),
+    "engine/fwd_bwd": ("span", "runtime/engine.py",
+                       "forward+backward child of engine/step"),
+    "engine/optim": ("span", "runtime/engine.py",
+                     "optimizer child of engine/step (nvme/host tiers)"),
+    "engine/fused_step": ("span", "runtime/engine.py",
+                          "fused fwd+bwd+optim child of engine/step"),
+    # ---- KV-arena occupancy (serving/engine.py export_kv_gauges; the
+    #      per-rid / per-tenant variants are the DYNAMIC kv/ family)
+    "kv/pages_in_use": ("gauge", "serving/engine.py",
+                        "arena pages held by sequences and/or the prefix "
+                        "cache"),
+    "kv/pages_free": ("gauge", "serving/engine.py",
+                      "arena pages on the free list"),
+    "kv/page_occupancy": ("gauge", "serving/engine.py",
+                          "in-use fraction of the usable arena"),
+    "kv/free_run_fragmentation": ("gauge", "serving/engine.py",
+                                  "1 - longest contiguous free page-id "
+                                  "run / free pages (allocation churn)"),
+    "kv/prefix_cache_pages": ("gauge", "serving/engine.py",
+                              "pages pinned by prefix-cache entries"),
+    "kv/prefix_cache_share": ("gauge", "serving/engine.py",
+                              "prefix-cache share of in-use pages"),
+    # ---- arrival-rate telemetry (serving/fleet/router.py, exported once
+    #      per fleet round — ROADMAP's predictive-scale-up input)
+    "fleet/arrival_rate_ewma": ("gauge", "serving/fleet/router.py",
+                                "EWMA (alpha=0.2) of fleet request "
+                                "arrivals per clock second"),
+    "fleet/arrival_rate_slope": ("gauge", "serving/fleet/router.py",
+                                 "per-round derivative of the arrival "
+                                 "EWMA (scale BEFORE the queue grows)"),
     # ---- monitor surface (monitor/monitor.py)
     "monitor/dropped_events": ("event", "monitor/monitor.py",
                                "cumulative events shed by the max_events cap"),
@@ -308,6 +358,24 @@ DYNAMIC = [
                     "transport/feed_gap_age/<rid>"],
      "doc": "per-link control-plane health, sampled once per fleet round "
             "— the adaptive-lease-sizing input signal (ROADMAP)"},
+    {"prefix": "kv/", "template": "kv/<stat>/<rid-or-tenant>",
+     "kind": "gauge", "source": "serving/fleet/router.py",
+     "expansions": ["kv/page_occupancy/<rid>",
+                    "kv/free_run_fragmentation/<rid>",
+                    "kv/prefix_cache_share/<rid>",
+                    "kv/tenant_pages/<tenant>"],
+     "doc": "per-replica KV-arena occupancy + per-tenant page tallies "
+            "(tenant tallies sum to the fleet's pages in use — the "
+            "per-tenant KV-quota input), exported once per fleet round"},
+    {"prefix": "anatomy/", "template": "anatomy/<name>",
+     "kind": "gauge+span+track", "source": "serving/fleet/router.py "
+     "(+serving/engine.py, telemetry/step_anatomy.py)",
+     "expansions": ["anatomy/host_gap_fraction/<rid> (gauge)",
+                    "anatomy/<frontend> (flight-recorder track of "
+                    "anatomy/step spans, e.g. anatomy/replica0)"],
+     "doc": "step-anatomy surfaces: per-replica host-gap-fraction gauges "
+            "once per fleet round + per-step recorder tracks "
+            "(docs/OBSERVABILITY.md 'Step anatomy')"},
 ]
 
 BEGIN_MARK = ("<!-- BEGIN EVENT TABLE (generated from "

@@ -204,6 +204,94 @@ def run_spec_pair(make_engine, clock_factory, arrivals, rate, max_queue_depth,
     }
 
 
+def run_anatomy_leg(make_engine, clock_factory, arrivals, rate,
+                    max_queue_depth, dryrun, out_path):
+    """Step-anatomy receipt (docs/OBSERVABILITY.md "Step anatomy"): serve
+    one open-loop point with a ``StepAnatomy`` recorder on the engine —
+    warm the step programs, declare the compile set steady, reset, then
+    measure — and commit ``BENCH_STEP_ANATOMY.json``:
+
+    * the per-step table whose components TILE wall time (re-verified by
+      ``scripts/step_anatomy.py`` and the schema checker);
+    * host-gap fraction per (path, batch, chunk) bucket — the Python
+      step-loop tax the ROADMAP AOT serving-step item must shrink.
+      Under ``--dryrun``'s VirtualClock, host segments and gaps are 0 BY
+      CONSTRUCTION (virtual seconds are charged, host work costs none),
+      so the committed dryrun receipt pins the shape census, the tiling
+      contract and the recompile guard; a wall-clock run of the same leg
+      fills in real fractions;
+    * **steady-state recompiles == 0**: after warm-up, no step may pay a
+      JIT compile — the regression guard the AOT item is held to;
+    * byte-identical regeneration (the leg runs twice; the docs must
+      match byte-for-byte).
+    """
+    import importlib.util
+
+    from deepspeed_tpu.serving import AdmissionConfig, ServingConfig, ServingEngine
+    from deepspeed_tpu.telemetry import MetricsRegistry, StepAnatomy
+
+    def one_run():
+        eng = make_engine()
+        clock = clock_factory()
+        anat = eng.set_anatomy(StepAnatomy(clock=clock))
+        _warm(eng, eng.econfig.scheduler.max_seqs)
+        anat.mark_steady()     # the compiled step set is now closed
+        anat.reset_steps()     # warm-up steps must not dilute the fold
+        metrics = MetricsRegistry()
+        serve = ServingEngine(eng, clock=clock,
+                              config=ServingConfig(admission=AdmissionConfig(
+                                  max_queue_depth=max_queue_depth)),
+                              metrics=metrics)
+        serve.run(arrivals)
+        serve.export_kv_gauges()
+        kv = {name: metrics.gauge(name).value
+              for name in metrics.names() if name.startswith("kv/")}
+        return anat.to_doc(), kv, serve.stats.summary(elapsed=serve.clock.now())
+
+    doc, kv, summary = one_run()
+    doc2, kv2, _ = one_run()
+    identical = (json.dumps(doc, sort_keys=True)
+                 == json.dumps(doc2, sort_keys=True)
+                 and json.dumps(kv, sort_keys=True)
+                 == json.dumps(kv2, sort_keys=True))
+
+    # fold + verify with THE report tool (imported by path, stdlib-only),
+    # so the committed "report" section can never drift from what
+    # scripts/step_anatomy.py would print
+    sa_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "step_anatomy.py")
+    spec = importlib.util.spec_from_file_location("_step_anatomy_cli", sa_path)
+    sa = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sa)
+    report = sa.fold(doc)
+    assert report["verification"]["mismatches"] == 0, report["verification"]
+
+    ssr = doc["summary"]["steady_state_recompiles"]
+    rec = {
+        "metric": "host_gap_fraction",
+        "value": report["totals"]["host_gap_fraction"],
+        "unit": "fraction_of_wall",
+        "schema_version": 1,
+        "workload": {"n_requests": len(arrivals), "arrival_rate": rate,
+                     "dryrun": bool(dryrun), "virtual_clock": bool(dryrun)},
+        "steady_state_recompiles": ssr,
+        "determinism_repeat_identical": bool(identical),
+        "serving": {"completed": summary["completed"],
+                    "rejected": summary["rejected"],
+                    "preemptions": summary["preemptions"]},
+        "kv": kv,
+        "report": report,
+        "anatomy": doc,
+    }
+    print(f"# anatomy leg @rate={rate}: steps={report['n_steps']} "
+          f"shapes={report['n_shapes']} "
+          f"host_gap_fraction={report['totals']['host_gap_fraction']} "
+          f"steady_recompiles={ssr} repeat_identical={identical}", flush=True)
+    from deepspeed_tpu.resilience.atomic_io import atomic_write_json
+    atomic_write_json(out_path, rec, indent=1)
+    return rec
+
+
 def run_closed_loop(make_engine, clock_factory, rng, concurrency, n_requests,
                     ttft_budget, tpot_budget, vocab):
     from deepspeed_tpu.serving import ServingConfig, ServingEngine
@@ -245,6 +333,15 @@ def main():
     ap.add_argument("--concurrency", type=int, default=None, help="closed-loop concurrency")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default="BENCH_SERVING.json")
+    ap.add_argument("--anatomy", action="store_true",
+                    help="also run the step-anatomy leg and commit "
+                         "BENCH_STEP_ANATOMY.json (per-step host/device/"
+                         "gap tiling, per-bucket host-gap fraction, "
+                         "steady-state recompile guard)")
+    ap.add_argument("--anatomy-only", action="store_true",
+                    help="run ONLY the step-anatomy leg (fast artifact "
+                         "regeneration)")
+    ap.add_argument("--anatomy-out", default="BENCH_STEP_ANATOMY.json")
     ap.add_argument("--trace", nargs="?", const="BENCH_SERVING_TRACE.json",
                     default=None, metavar="PATH",
                     help="export a Chrome/Perfetto trace of the highest-rate "
@@ -276,6 +373,18 @@ def main():
         ttft_budget, tpot_budget = 2.0, 0.05   # FastGen-style SLA seconds
         max_queue_depth = 256
         clock_factory = WallClock
+
+    if args.anatomy or args.anatomy_only:
+        # the BUSY (not overloaded) point: steps run back-to-back so the
+        # host-gap windows measure loop tax, not idle between arrivals
+        anat_rate = rates[1] if len(rates) > 1 else rates[0]
+        rng = np.random.default_rng(args.seed)
+        anat_arrivals = _workload(rng, n_requests, anat_rate, ttft_budget,
+                                  tpot_budget, vocab)
+        run_anatomy_leg(make_engine, clock_factory, anat_arrivals, anat_rate,
+                        max_queue_depth, args.dryrun, args.anatomy_out)
+        if args.anatomy_only:
+            return
 
     sweep = []
     for rate in rates:

@@ -438,6 +438,83 @@ def _validate_attribution(v):
     return None
 
 
+_ANATOMY_SEGMENTS = ("schedule", "draft_plan", "verify_plan", "compile_wait",
+                     "dispatch", "sample_accept", "bookkeeping")
+
+
+def _validate_step_anatomy(v):
+    """The step-anatomy receipt (bench_serving.py run_anatomy_leg ->
+    BENCH_STEP_ANATOMY.json, scripts/step_anatomy.py, docs/OBSERVABILITY.md
+    "Step anatomy"): per-step host segments + device compute + host gap
+    must TILE each step's wall time within 1e-6 — re-verified HERE from
+    the committed per-step table, not trusted from the summary — with
+    ZERO steady-state recompiles after the declared warm-up boundary (the
+    AOT roadmap item's regression guard), a host-gap fraction reported
+    for every (path, batch, chunk) bucket, and the whole leg
+    byte-identical when repeated."""
+    if not isinstance(v, dict):
+        return f"expected step-anatomy object, got {type(v).__name__}"
+    for k in ("metric", "value", "unit", "schema_version", "workload",
+              "steady_state_recompiles", "determinism_repeat_identical",
+              "report", "anatomy", "kv"):
+        if k not in v:
+            return f"missing step-anatomy key {k!r}"
+    if v["schema_version"] != 1:
+        return f"schema_version {v['schema_version']} != 1"
+    # byte-identical regeneration is a VIRTUAL-clock property: wall-clock
+    # receipts carry real timings that legitimately differ across runs
+    # (the tiling + recompile bars below still bind them)
+    if (v["workload"] or {}).get("virtual_clock") \
+            and v["determinism_repeat_identical"] is not True:
+        return "virtual-clock anatomy leg not byte-identical across runs"
+    if v["steady_state_recompiles"] != 0:
+        return (f"{v['steady_state_recompiles']} steady-state recompile(s) "
+                "after the warm-up boundary — the bucketed step set is not "
+                "closed (the AOT regression guard this receipt exists for)")
+    anatomy = v["anatomy"]
+    steps = anatomy.get("steps") if isinstance(anatomy, dict) else None
+    if not isinstance(steps, list) or not steps:
+        return "anatomy record carries no per-step table"
+    # re-verify the tiling from the committed table itself: a summary that
+    # CLAIMS tiling over a table that breaks it is exactly the drift this
+    # checker exists for.  The acceptance bar is 1e-6, full stop; the
+    # committed components are independently rounded to 9 decimals, so pad
+    # by their worst-case rounding bound.
+    pad = 0.5e-9 * (len(_ANATOMY_SEGMENTS) + 3)
+    for i, row in enumerate(steps):
+        segs = row.get("segments") or {}
+        missing = [s for s in _ANATOMY_SEGMENTS if s not in segs]
+        if missing:
+            return f"anatomy.steps[{i}]: missing segment(s) {missing}"
+        resid = row.get("wall_s", 0.0) - (row.get("host_gap_s", 0.0)
+                                          + sum(segs[s] for s in _ANATOMY_SEGMENTS)
+                                          + row.get("device_s", 0.0))
+        if abs(resid) > 1e-6 + pad:
+            return (f"anatomy.steps[{i}] ({row.get('shape')}): components "
+                    f"do not tile wall_s (residual {resid:g})")
+    # the compile log must agree with the declared counter
+    steady = [c for c in (anatomy.get("compiles") or []) if c.get("steady")]
+    if len(steady) != v["steady_state_recompiles"]:
+        return (f"compile log records {len(steady)} steady entr(ies) but "
+                f"the receipt declares {v['steady_state_recompiles']}")
+    shapes = (v["report"] or {}).get("by_shape")
+    if not isinstance(shapes, dict) or not shapes:
+        return "report carries no per-bucket (by_shape) fold"
+    for key, agg in shapes.items():
+        frac = agg.get("host_gap_fraction")
+        if frac is None and agg.get("wall_s", 0.0) > 0:
+            return f"by_shape[{key!r}]: no host_gap_fraction despite wall time"
+        if frac is not None and not (isinstance(frac, (int, float))
+                                     and not isinstance(frac, bool)
+                                     and 0.0 <= frac <= 1.0):
+            return f"by_shape[{key!r}]: host_gap_fraction {frac!r} not in [0, 1]"
+    rep_ver = (v["report"] or {}).get("verification") or {}
+    if rep_ver.get("mismatches", 1) != 0:
+        return (f"report verification recorded {rep_ver.get('mismatches')} "
+                "mismatch(es) — the committed receipt must tile")
+    return None
+
+
 _TERMINAL_STATES = {"done", "timed_out", "rejected"}
 
 
@@ -507,6 +584,8 @@ SCHEMAS = {
     "BENCH_SERVING_TRACE.json": _validate_trace,
     # slowdown-attribution + SLO burn-rate receipt (scripts/why_slow.py)
     "BENCH_ROUTER_ATTRIB.json": _validate_attribution,
+    # per-step engine anatomy receipt (scripts/step_anatomy.py)
+    "BENCH_STEP_ANATOMY.json": _validate_step_anatomy,
     # single-metric bench artifacts (bench.py-style envelope)
     "BENCH_SCALE.json": {"metric": STR, "value": NUM, "unit": STR,
                          "?vs_baseline": NUM, "extra": DICT},

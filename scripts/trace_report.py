@@ -43,8 +43,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 from deepspeed_tpu.serving.metrics import percentile_summary  # noqa: E402
 
+#: ``host_gap`` / ``compile_wait`` are the step-anatomy phases
+#: (telemetry/step_anatomy.py, ``StepAnatomy.emit_spans``): per-step
+#: host-side loop tax and JIT compile pauses lifted into the trace —
+#: named here so anatomy spans fold instead of breaking the tiling
 PHASES = ("pending", "queued", "prefill", "decode", "migrating", "evicted",
-          "fenced")
+          "fenced", "host_gap", "compile_wait")
 _US = 1e6
 
 

@@ -61,19 +61,25 @@ import sys
 
 _US = 1e6
 
-#: the attribution taxonomy; every phase second maps to exactly one cause
+#: the attribution taxonomy; every phase second maps to exactly one cause.
+#: ``host_gap`` / ``compile_wait`` are the step-anatomy phases
+#: (telemetry/step_anatomy.py): per-step host loop tax and JIT compile
+#: pauses — named slowdowns, not baseline compute
 CAUSES = ("queue_wait", "partition_delay", "prefill", "decode",
-          "migration_pause", "lease_expiry", "fenced", "eviction")
+          "migration_pause", "lease_expiry", "fenced", "eviction",
+          "host_gap", "compile_wait")
 
 #: causes that are NOT baseline compute — the named slowdowns the tail
 #: receipt attributes the p99-p50 gap to
 SLOWDOWN_CAUSES = ("queue_wait", "partition_delay", "migration_pause",
-                   "lease_expiry", "fenced", "eviction")
+                   "lease_expiry", "fenced", "eviction", "host_gap",
+                   "compile_wait")
 
 #: phase -> cause for the phases that map 1:1
 _DIRECT = {"prefill": "prefill", "decode": "decode",
            "migrating": "migration_pause", "fenced": "fenced",
-           "evicted": "eviction"}
+           "evicted": "eviction", "host_gap": "host_gap",
+           "compile_wait": "compile_wait"}
 
 
 def _overlap(t0, t1, w0, w1):

@@ -1,0 +1,200 @@
+"""Fleet-side step-anatomy + KV-occupancy satellites (serving/fleet):
+per-tenant ``kv/tenant_pages/<tenant>`` tallies sum to the fleet's arena
+pages in use, the arrival-rate EWMA/slope gauges are deterministic under
+``VirtualClock``, and ``ReplicaPool(anatomy=True)`` gives every replica
+its own recorder whose host-gap fraction exports once per fleet round."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, build_engine
+from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.models.llama_cache import PagedKVConfig
+from deepspeed_tpu.serving import VirtualClock
+from deepspeed_tpu.serving.fleet import (FleetSimulator, FleetState,
+                                         LeastOutstandingPolicy, ReplicaPool,
+                                         RoundRobinPolicy, Router)
+from deepspeed_tpu.telemetry import MetricsRegistry
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=2, max_position_embeddings=128,
+                  rope_theta=1e4, dtype=jnp.float32, scan_layers=True,
+                  remat=False)
+
+
+@pytest.fixture(scope="module")
+def trained_params():
+    return LlamaForCausalLM(CFG).init(jax.random.PRNGKey(0),
+                                      jnp.zeros((1, 8), jnp.int32))
+
+
+def _factory(trained_params, num_pages=64):
+    def make():
+        kv = PagedKVConfig(num_pages=num_pages, page_size=8, max_pages_per_seq=8)
+        sched = SchedulerConfig(token_budget=64, max_seqs=8, prefill_chunk=8,
+                                decode_bucket=4)
+        return build_engine(CFG, trained_params, RaggedInferenceEngineConfig(
+            kv=kv, scheduler=sched, kv_dtype=jnp.float32,
+            decode_steps_per_dispatch=1))
+    return make
+
+
+def _fleet(trained_params, n=2, metrics=None, anatomy=False):
+    pool = ReplicaPool(_factory(trained_params), n, clock=VirtualClock(),
+                       metrics=metrics, anatomy=anatomy)
+    return Router(pool, LeastOutstandingPolicy()), pool
+
+
+PROMPTS = [[5, 9, 2, 7, 1, 8, 6, 3, 2], [3, 3, 8, 1, 9, 9],
+           [1, 2, 3, 4, 5, 6, 7, 8, 9, 1, 2], [11, 4, 4, 7]]
+
+
+# -------------------------------------------------- tenant KV page tallies
+
+
+def test_tenant_kv_pages_sum_to_arena_pages_in_use(trained_params):
+    """The conservation law the per-tenant KV-quota item needs: every
+    in-use page is attributed to exactly one tenant (or the reserved
+    prefix_cache/unattributed keys), so the tallies SUM to the fleet's
+    pages in use — probed mid-decode, with two tenants live and prefix
+    pages published."""
+    router, pool = _fleet(trained_params, n=2)
+    for i, p in enumerate(PROMPTS):
+        router.submit(p, max_new_tokens=8, arrival_ts=0.0,
+                      tenant="alpha" if i % 2 else "beta")
+    router.dispatch_pending()
+    checked = 0
+    for _ in range(6):
+        for rid in pool.rids:
+            pool.tick(rid)
+        router.poll(pool.clock.now())
+        tally = router.tenant_kv_pages()
+        in_use = sum(pool.replica(rid).serve.engine.kv.arena_stats()["in_use"]
+                     for rid in pool.rids
+                     if pool.replica(rid).serve is not None)
+        assert sum(tally.values()) == in_use, (tally, in_use)
+        if {"alpha", "beta"} <= set(tally):
+            checked += 1
+    assert checked > 0, "both tenants never held pages simultaneously"
+    # drain: completed requests release their pages; the tally follows
+    sim = FleetSimulator(router)
+    sim.run([])
+    tally = router.tenant_kv_pages()
+    in_use = sum(pool.replica(rid).serve.engine.kv.arena_stats()["in_use"]
+                 for rid in pool.rids)
+    assert sum(tally.values()) == in_use
+    assert set(tally) <= {"prefix_cache"}, tally  # only cache pins remain
+
+
+def test_tenant_pages_gauges_exported_and_zeroed(trained_params):
+    metrics = MetricsRegistry()
+    router, pool = _fleet(trained_params, n=1, metrics=metrics)
+    router.submit(PROMPTS[0], max_new_tokens=6, arrival_ts=0.0,
+                  tenant="gamma")
+    router.dispatch_pending()
+    for _ in range(2):
+        pool.tick(0)
+    router.export_replica_gauges()
+    g = metrics.gauge("kv/tenant_pages/gamma").value
+    assert g is not None and g > 0
+    # run to completion: the tenant's gauge must drop to 0, not freeze
+    FleetSimulator(router).run([])
+    router.export_replica_gauges()
+    assert metrics.gauge("kv/tenant_pages/gamma").value == 0
+    # per-replica occupancy gauges rode along
+    assert metrics.gauge("kv/page_occupancy/0").value is not None
+    assert metrics.gauge("kv/free_run_fragmentation/0").value is not None
+
+
+# ------------------------------------------------------ arrival-rate EWMA
+
+
+def test_arrival_rate_ewma_arithmetic(trained_params):
+    """Hand-checked fold: rate EWMA over two rounds with known arrivals
+    and clock advances (alpha = 0.2)."""
+    metrics = MetricsRegistry()
+    router, pool = _fleet(trained_params, n=1, metrics=metrics)
+    clock = pool.clock
+    router.export_replica_gauges()           # t=0: anchor, gauges read 0
+    assert metrics.gauge("fleet/arrival_rate_ewma").value == 0.0
+    for i in range(4):                        # 4 arrivals in 2s -> 2/s
+        router.submit(PROMPTS[i % len(PROMPTS)], max_new_tokens=2,
+                      arrival_ts=0.5 * i)
+    clock.advance(2.0)
+    router.export_replica_gauges()
+    assert metrics.gauge("fleet/arrival_rate_ewma").value == pytest.approx(2.0)
+    assert metrics.gauge("fleet/arrival_rate_slope").value == 0.0
+    clock.advance(2.0)                        # 0 arrivals in 2s -> inst 0
+    router.export_replica_gauges()
+    # ewma = 0.2*0 + 0.8*2 = 1.6; slope = (1.6 - 2.0)/2 = -0.2
+    assert metrics.gauge("fleet/arrival_rate_ewma").value == pytest.approx(1.6)
+    assert metrics.gauge("fleet/arrival_rate_slope").value == pytest.approx(-0.2)
+    # zero-advance rounds carry no new information: values unchanged
+    router.export_replica_gauges()
+    assert metrics.gauge("fleet/arrival_rate_ewma").value == pytest.approx(1.6)
+
+
+def test_arrival_gauges_deterministic_under_virtual_clock(trained_params):
+    import numpy as np
+
+    def run():
+        metrics = MetricsRegistry()
+        pool = ReplicaPool(_factory(trained_params), 2, clock=VirtualClock(),
+                           metrics=metrics)
+        router = Router(pool, RoundRobinPolicy())
+        rng = np.random.default_rng(7)
+        arrivals = [dict(prompt=[int(x) for x in rng.integers(1, 100, 6)],
+                         max_new_tokens=4,
+                         arrival_ts=round(float(rng.exponential(0.7)) * (i + 1), 6))
+                    for i in range(10)]
+        reqs = FleetSimulator(router).run(
+            sorted(arrivals, key=lambda a: a["arrival_ts"]))
+        assert all(r.state is FleetState.DONE for r in reqs)
+        return (metrics.gauge("fleet/arrival_rate_ewma").value,
+                metrics.gauge("fleet/arrival_rate_slope").value)
+
+    a, b = run(), run()
+    assert a == b and a[0] is not None
+
+
+# --------------------------------------------- per-replica anatomy export
+
+
+def test_pool_anatomy_per_replica_and_fleet_gauges(trained_params):
+    metrics = MetricsRegistry()
+    pool = ReplicaPool(_factory(trained_params), 2, clock=VirtualClock(),
+                       metrics=metrics, anatomy=True)
+    router = Router(pool, RoundRobinPolicy())
+    anats = [pool.anatomy(rid) for rid in pool.rids]
+    assert all(a is not None and a.enabled for a in anats)
+    assert anats[0] is not anats[1]           # one recorder per replica
+    reqs = FleetSimulator(router).run(
+        [dict(prompt=p, max_new_tokens=4, arrival_ts=round(0.5 * i, 6))
+         for i, p in enumerate(PROMPTS)])
+    assert all(r.state is FleetState.DONE for r in reqs)
+    for rid in pool.rids:
+        anat = pool.anatomy(rid)
+        assert anat.total_steps > 0
+        # per-step tiling holds for every replica's recorder
+        for row in (r.to_row() for r in anat.steps):
+            assert abs(row["wall_s"] - (row["host_gap_s"]
+                                        + sum(row["segments"].values())
+                                        + row["device_s"])) <= 1e-9
+        assert metrics.gauge(f"anatomy/host_gap_fraction/{rid}").value \
+            is not None
+    # steady boundary: pool-level declaration marks every live recorder
+    pool.mark_anatomy_steady()
+    assert all(pool.anatomy(rid).steady for rid in pool.rids)
+    # a recovered replica starts un-steady (its compiles are recovery)
+    router.kill_replica(0)
+    # a dead replica's kv/anatomy gauges read ZERO, not their pre-death
+    # samples frozen forever (same stance as fleet/replica_*)
+    router.export_replica_gauges()
+    assert metrics.gauge("kv/page_occupancy/0").value == 0.0
+    assert metrics.gauge("anatomy/host_gap_fraction/0").value == 0.0
+    router.recover_replica(0)
+    assert pool.anatomy(0) is not None and not pool.anatomy(0).steady
+    assert pool.anatomy(1).steady

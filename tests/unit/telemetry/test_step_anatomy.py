@@ -1,0 +1,511 @@
+"""Step-anatomy tests (telemetry/step_anatomy.py + the engine/serving
+wiring + scripts/step_anatomy.py): the decomposition tiles wall time by
+construction, host gaps measure inter-step loop tax and exclude idle,
+the compile tracker tags warm-up vs steady-state recompiles (the AOT
+regression guard), the disabled path allocates nothing, the report CLI
+exits 1 on a planted tiling mismatch and prints byte-identical --json,
+and the new ``host_gap``/``compile_wait`` phases fold in
+``trace_report.py``/``why_slow.py`` instead of surfacing as
+``unknown:<p>``."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import tracemalloc
+
+import pytest
+
+from deepspeed_tpu.serving.clock import VirtualClock
+from deepspeed_tpu.telemetry import (NULL_ANATOMY, FlightRecorder,
+                                     MetricsRegistry, StepAnatomy, Tracer)
+from deepspeed_tpu.telemetry.step_anatomy import HOST_SEGMENTS
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                         "..", "..", ".."))
+SA_CLI = os.path.join(REPO_ROOT, "scripts", "step_anatomy.py")
+
+
+def _load_script(name):
+    path = os.path.join(REPO_ROOT, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tiles(row, tol=1e-9):
+    return abs(row["wall_s"] - (row["host_gap_s"]
+                                + sum(row["segments"].values())
+                                + row["device_s"])) <= tol
+
+
+# ------------------------------------------------------------- recorder
+
+
+def test_segments_device_and_gap_tile_wall():
+    clock = VirtualClock()
+    anat = StepAnatomy(clock=clock)
+    anat.step_begin()
+    clock.advance(0.2)
+    anat.mark("schedule")
+    clock.advance(0.1)
+    anat.mark("dispatch")
+    clock.advance(0.5)
+    anat.device_mark()
+    clock.advance(0.05)
+    anat.mark("sample_accept")
+    anat.note_shape("decode", 4, 1)
+    clock.advance(0.03)            # unmarked residual -> bookkeeping
+    rec = anat.step_end()
+    assert rec is not None
+    row = rec.to_row()
+    assert row["segments"]["schedule"] == pytest.approx(0.2)
+    assert row["segments"]["dispatch"] == pytest.approx(0.1)
+    assert row["device_s"] == pytest.approx(0.5)
+    assert row["segments"]["sample_accept"] == pytest.approx(0.05)
+    assert row["segments"]["bookkeeping"] == pytest.approx(0.03)
+    assert row["host_gap_s"] == 0.0            # first step: no predecessor
+    assert _tiles(row) and row["wall_s"] == pytest.approx(0.88)
+    assert row["shape"] == "decode:b4:c1"
+
+    # second step: the inter-step window becomes its host gap
+    clock.advance(0.3)
+    anat.step_begin()
+    clock.advance(0.4)
+    anat.device_mark()
+    anat.note_shape("decode", 4, 1)
+    rec2 = anat.step_end()
+    row2 = rec2.to_row()
+    assert row2["host_gap_s"] == pytest.approx(0.3)
+    assert _tiles(row2) and row2["wall_s"] == pytest.approx(0.7)
+    assert anat.host_gap_fraction() == pytest.approx(0.3 / (0.88 + 0.7))
+
+
+def test_idle_excluded_and_flagged():
+    clock = VirtualClock()
+    anat = StepAnatomy(clock=clock)
+    anat.step_begin()
+    clock.advance(0.1)
+    anat.device_mark()
+    anat.note_shape("decode", 4, 1)
+    anat.step_end()
+    clock.advance(5.0)             # arrival gap: the loop idled
+    anat.note_idle()
+    clock.advance(0.2)             # real pre-step host work after the idle
+    anat.step_begin()
+    clock.advance(0.1)
+    anat.device_mark()
+    anat.note_shape("decode", 4, 1)
+    row = anat.step_end().to_row()
+    # the 5s idle is excluded; note_idle also reset the gap origin, so the
+    # 0.2s of post-idle host work is excluded too (flagged instead)
+    assert row["host_gap_s"] == 0.0 and row["after_idle"] is True
+    assert _tiles(row)
+
+    # mid-step idle (submit backoff): cursor snaps, no segment absorbs it
+    anat.step_begin()
+    clock.advance(1.0)
+    anat.note_idle()
+    clock.advance(0.3)
+    anat.mark("schedule")
+    clock.advance(0.1)
+    anat.device_mark()
+    anat.note_shape("decode", 4, 1)
+    row = anat.step_end().to_row()
+    assert row["segments"]["schedule"] == pytest.approx(0.3)
+    assert _tiles(row)
+
+
+def test_empty_step_discarded_folds_into_next_gap():
+    clock = VirtualClock()
+    anat = StepAnatomy(clock=clock)
+    anat.step_begin()
+    clock.advance(0.1)
+    anat.device_mark()
+    anat.note_shape("decode", 4, 1)
+    anat.step_end()
+    # a planned-but-empty step (no dispatch): discarded, not recorded
+    anat.step_begin()
+    clock.advance(0.25)
+    assert anat.step_end() is None
+    assert anat.total_steps == 1
+    # its window lands in the NEXT real step's host gap
+    anat.step_begin()
+    clock.advance(0.05)
+    anat.device_mark()
+    anat.note_shape("decode", 4, 1)
+    row = anat.step_end().to_row()
+    assert row["host_gap_s"] == pytest.approx(0.25)
+    assert _tiles(row)
+
+
+def test_step_begin_idempotent_shared_between_frontend_and_engine():
+    clock = VirtualClock()
+    anat = StepAnatomy(clock=clock)
+    anat.step_begin()              # the serving frontend opens the window
+    clock.advance(0.2)
+    anat.mark("schedule")
+    anat.step_begin()              # the engine's own call must no-op
+    clock.advance(0.1)
+    anat.device_mark()
+    anat.note_shape("prefill", 8, 32)
+    row = anat.step_end().to_row()
+    assert row["segments"]["schedule"] == pytest.approx(0.2)
+    assert row["device_s"] == pytest.approx(0.1)
+    assert _tiles(row)
+
+
+def test_charge_last_step_virtual_clock_contract():
+    clock = VirtualClock()
+    anat = StepAnatomy(clock=clock)
+    anat.step_begin()
+    anat.note_shape("decode", 4, 1)
+    anat.step_end()                # virtual: zero-width so far
+    clock.advance(1.5)             # clock.on_step charged the cost
+    rec = anat.charge_last_step(1.5)
+    row = rec.to_row()
+    assert row["device_s"] == pytest.approx(1.5)
+    assert _tiles(row) and row["wall_s"] == pytest.approx(1.5)
+    # the gap origin re-anchored at the charged clock: the next step
+    # starts gap-free
+    anat.step_begin()
+    anat.note_shape("decode", 4, 1)
+    anat.step_end()
+    clock.advance(1.0)
+    row2 = anat.charge_last_step(1.0).to_row()
+    assert row2["host_gap_s"] == 0.0 and _tiles(row2)
+    with pytest.raises(ValueError):
+        anat.charge_last_step(-1.0)
+
+
+def test_retention_bound_and_lifetime_totals():
+    clock = VirtualClock()
+    anat = StepAnatomy(clock=clock, max_steps=4)
+    for _ in range(7):
+        anat.step_begin()
+        clock.advance(1.0)
+        anat.device_mark()
+        anat.note_shape("decode", 4, 1)
+        anat.step_end()
+    assert len(anat.steps) == 4 and anat.dropped_steps == 3
+    assert anat.total_steps == 7
+    assert anat.total_wall_s == pytest.approx(7.0)   # totals survive eviction
+    assert anat.summary()["dropped_steps"] == 3
+
+
+def test_compile_tracker_warmup_vs_steady_and_reset():
+    clock = VirtualClock()
+    anat = StepAnatomy(clock=clock)
+    anat.note_compile("step:b4:c1")
+    anat.note_compile("step:b8:c1")
+    assert anat.steady_state_recompiles == 0
+    anat.mark_steady()
+    anat.reset_steps()             # the bench pattern: warm, seal, reset
+    assert len(anat.compiles) == 2  # compile log survives the reset
+    anat.step_begin()
+    anat.note_compile("step:b8:c32")
+    anat.note_shape("mixed", 8, 32)
+    anat.step_end()
+    assert anat.steady_state_recompiles == 1
+    rows = [c.to_row() for c in anat.compiles]
+    assert [c["steady"] for c in rows] == [False, False, True]
+    assert rows[2]["step_index"] == 0  # the measured step that paid it
+
+
+def test_null_anatomy_allocates_nothing():
+    def loop(n):
+        for _ in range(n):
+            NULL_ANATOMY.step_begin()
+            NULL_ANATOMY.mark("schedule")
+            NULL_ANATOMY.note_shape("decode", 4, 1)
+            NULL_ANATOMY.device_mark()
+            NULL_ANATOMY.note_compile("k")
+            NULL_ANATOMY.step_end()
+            NULL_ANATOMY.charge_last_step(1.0)
+
+    loop(10)
+    tracemalloc.start()
+    try:
+        before = tracemalloc.take_snapshot()
+        loop(1000)
+        after = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    pkg = os.path.join("deepspeed_tpu", "telemetry")
+    allocs = [d for d in after.compare_to(before, "lineno")
+              if d.size_diff > 0 and any(pkg in (f.filename or "")
+                                         for f in d.traceback)]
+    assert sum(d.size_diff for d in allocs) < 8192, allocs
+    assert NULL_ANATOMY.to_doc()["steps"] == []
+
+
+# ------------------------------------------------- report CLI + sabotage
+
+
+def _sample_doc():
+    clock = VirtualClock()
+    anat = StepAnatomy(clock=clock)
+    anat.note_compile("step:b4:c1")
+    anat.mark_steady()
+    for i in range(5):
+        anat.step_begin()
+        clock.advance(0.01 * (i + 1))
+        anat.mark("schedule")
+        clock.advance(0.02)
+        anat.mark("dispatch")
+        clock.advance(0.5)
+        anat.device_mark()
+        anat.note_shape("decode" if i % 2 else "prefill", 4, 1 if i % 2 else 32)
+        anat.step_end()
+        clock.advance(0.05)        # inter-step loop tax -> next host gap
+    return anat.to_doc()
+
+
+def test_report_folds_and_verifies():
+    sa = _load_script("step_anatomy")
+    doc = _sample_doc()
+    report = sa.fold(doc)
+    assert report["verification"]["mismatches"] == 0
+    assert report["n_steps"] == 5
+    assert set(report["by_shape"]) == {"decode:b4:c1", "prefill:b4:c32"}
+    for agg in report["by_shape"].values():
+        assert 0.0 <= agg["host_gap_fraction"] <= 1.0
+    assert report["compiles"] == {"total": 1, "warmup": 1, "steady_state": 0,
+                                  "steady_keys": []}
+    # a bench receipt wrapping the doc folds identically
+    assert sa.fold({"anatomy": doc, "metric": "x"}) == report
+
+
+def test_cli_byte_identical_and_sabotage_exit1(tmp_path):
+    doc = _sample_doc()
+    p = tmp_path / "anat.json"
+    p.write_text(json.dumps(doc))
+    outs = []
+    for _ in range(2):
+        r = subprocess.run([sys.executable, SA_CLI, str(p), "--json"],
+                           capture_output=True)
+        assert r.returncode == 0, r.stderr
+        outs.append(r.stdout)
+    assert outs[0] == outs[1]      # byte-identical --json
+
+    # sabotage 1: a planted tiling mismatch must exit 1
+    bad = json.loads(json.dumps(doc))
+    bad["steps"][2]["wall_s"] += 0.5
+    pb = tmp_path / "bad.json"
+    pb.write_text(json.dumps(bad))
+    r = subprocess.run([sys.executable, SA_CLI, str(pb), "--json"],
+                       capture_output=True)
+    assert r.returncode == 1 and b"ANATOMY MISMATCH" in r.stderr
+
+    # sabotage 2: a summary that denies a steady recompile the log records
+    bad2 = json.loads(json.dumps(doc))
+    bad2["compiles"][0]["steady"] = True
+    pb2 = tmp_path / "bad2.json"
+    pb2.write_text(json.dumps(bad2))
+    r = subprocess.run([sys.executable, SA_CLI, str(pb2), "--json"],
+                       capture_output=True)
+    assert r.returncode == 1
+
+
+def test_schema_validator_catches_anatomy_drift(tmp_path):
+    """BENCH_STEP_ANATOMY.json is schema-enforced: the committed artifact
+    passes, a planted tiling break / steady recompile / determinism flag
+    fails."""
+    spec = importlib.util.spec_from_file_location(
+        "check_bench_schema", os.path.join(REPO_ROOT, "scripts",
+                                           "check_bench_schema.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    with open(os.path.join(REPO_ROOT, "BENCH_STEP_ANATOMY.json")) as f:
+        good = json.load(f)
+
+    def errors_for(doc):
+        p = tmp_path / "BENCH_STEP_ANATOMY.json"
+        p.write_text(json.dumps(doc))
+        errs = mod.validate_all(str(tmp_path))
+        p.unlink()
+        return errs
+
+    assert not errors_for(good)
+    bad = json.loads(json.dumps(good))
+    bad["anatomy"]["steps"][0]["device_s"] += 1.0
+    assert any("tile" in e for e in errors_for(bad))
+    bad = json.loads(json.dumps(good))
+    bad["steady_state_recompiles"] = 2
+    assert any("steady-state" in e for e in errors_for(bad))
+    bad = json.loads(json.dumps(good))
+    bad["determinism_repeat_identical"] = False
+    assert any("byte-identical" in e for e in errors_for(bad))
+
+
+# ------------------------------- anatomy phases in the report tooling
+
+
+def _ev(name, ts, dur, args):
+    return {"ph": "X", "pid": 1, "tid": 1, "name": name,
+            "ts": ts * 1e6, "dur": dur * 1e6, "args": args}
+
+
+def _request_trace_with_anatomy_phases():
+    root_args = {"trace_id": 1, "span_id": 1, "state": "done", "ttft": 4.0,
+                 "tpot": 1.0, "n_tokens": 7, "failovers": 0, "tenant": "t"}
+    return {"traceEvents": [
+        _ev("request", 0.0, 10.0, root_args),
+        _ev("phase/pending", 0.0, 1.0,
+            {"trace_id": 1, "span_id": 2, "parent_id": 1}),
+        _ev("phase/prefill", 1.0, 2.0,
+            {"trace_id": 1, "span_id": 3, "parent_id": 1}),
+        _ev("phase/host_gap", 3.0, 0.5,
+            {"trace_id": 1, "span_id": 4, "parent_id": 1}),
+        _ev("phase/compile_wait", 3.5, 0.5,
+            {"trace_id": 1, "span_id": 5, "parent_id": 1}),
+        _ev("phase/decode", 4.0, 6.0,
+            {"trace_id": 1, "span_id": 6, "parent_id": 1}),
+    ], "otherData": {}}
+
+
+def test_why_slow_knows_anatomy_phases():
+    ws = _load_script("why_slow")
+    report = ws.fold(_request_trace_with_anatomy_phases(), tol=1e-6)
+    assert report["verification"]["mismatches"] == 0
+    req = report["requests"][0]
+    assert not any(c.startswith("unknown:") for c in req["causes"])
+    assert req["causes"]["host_gap"] == pytest.approx(0.5)
+    assert req["causes"]["compile_wait"] == pytest.approx(0.5)
+    # both are named SLOWDOWN causes for the tail receipt
+    assert "host_gap" in ws.SLOWDOWN_CAUSES
+    assert "compile_wait" in ws.SLOWDOWN_CAUSES
+
+
+def test_trace_report_knows_anatomy_phases():
+    tr = _load_script("trace_report")
+    report = tr.fold(_request_trace_with_anatomy_phases(), tol=1e-6)
+    assert report["verification"]["mismatches"] == 0
+    cp = report["critical_path"]
+    assert cp["host_gap"]["total_s"] == pytest.approx(0.5)
+    assert cp["compile_wait"]["total_s"] == pytest.approx(0.5)
+
+
+def test_emit_spans_fold_clean_in_reports():
+    """The recorder's own span lift produces phase names both report
+    tools fold without unknowns (anatomy traces carry no request root,
+    so the request folds simply skip them — but the phases must parse)."""
+    clock = VirtualClock()
+    anat = StepAnatomy(clock=clock)
+    anat.step_begin()
+    clock.advance(0.2)
+    anat.mark("compile_wait")
+    clock.advance(0.8)
+    anat.device_mark()
+    anat.note_shape("decode", 4, 1)
+    anat.step_end()
+    tracer = Tracer(clock=clock)
+    n = anat.emit_spans(tracer, track="anatomy")
+    assert n >= 3
+    names = {s.name for s in tracer.spans}
+    assert "anatomy/step" in names and "phase/compile_wait" in names
+    # children tile the parent window exactly
+    parent = next(s for s in tracer.spans if s.name == "anatomy/step")
+    kids = [s for s in tracer.spans if s.parent_id == parent.span_id]
+    assert sum(k.end_ts - k.start_ts for k in kids) == \
+        pytest.approx(parent.end_ts - parent.start_ts)
+
+
+def test_recorder_ring_gets_anatomy_track():
+    """ServingEngine mirrors closed steps onto the flight recorder's
+    ``anatomy/<track>`` ring (here driven directly via the recorder API
+    the frontend uses)."""
+    clock = VirtualClock()
+    rec = FlightRecorder(clock=clock, max_per_track=8)
+    rec.span("anatomy/step", "anatomy/replica0", 0.0, 1.0,
+             attrs={"shape": "decode:b4:c1"})
+    assert [s.name for s in rec.track("anatomy/replica0")] == ["anatomy/step"]
+
+
+# ----------------------------- serving-engine integration (tiny model)
+
+
+@pytest.fixture(scope="module")
+def tiny_serving():
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import (RaggedInferenceEngineConfig,
+                                            build_engine)
+    from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.models.llama_cache import PagedKVConfig
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=128,
+                      rope_theta=1e4, dtype=jnp.float32, scan_layers=True,
+                      remat=False)
+    params = LlamaForCausalLM(cfg).init(jax.random.PRNGKey(0),
+                                        jnp.zeros((1, 8), jnp.int32))
+
+    def make():
+        kv = PagedKVConfig(num_pages=40, page_size=4, max_pages_per_seq=16)
+        sched = SchedulerConfig(token_budget=64, max_seqs=4, prefill_chunk=8,
+                                decode_bucket=2)
+        return build_engine(cfg, params, RaggedInferenceEngineConfig(
+            kv=kv, scheduler=sched, kv_dtype=jnp.float32,
+            decode_steps_per_dispatch=1, max_new_tokens=6))
+    return make
+
+
+def test_serving_anatomy_tiles_and_guards_recompiles(tiny_serving):
+    from deepspeed_tpu.serving import (AdmissionConfig, ServingConfig,
+                                       ServingEngine, VirtualClock)
+
+    eng = tiny_serving()
+    clock = VirtualClock()
+    anat = eng.set_anatomy(StepAnatomy(clock=clock))
+    eng.generate([[1, 2, 3]], max_new_tokens=2)       # warms b2 only
+    warm_compiles = len(anat.compiles)
+    assert warm_compiles >= 2 and anat.steady_state_recompiles == 0
+    anat.mark_steady()
+    anat.reset_steps()
+    metrics = MetricsRegistry()
+    recorder = FlightRecorder(clock=clock, max_per_track=64)
+    serve = ServingEngine(eng, clock=clock,
+                          config=ServingConfig(admission=AdmissionConfig(
+                              max_queue_depth=8)),
+                          metrics=metrics, recorder=recorder)
+    reqs = serve.run([{"arrival_ts": 0.5 * i, "prompt": [1 + i, 2, 3, 4, 5],
+                       "max_new_tokens": 4} for i in range(5)])
+    assert all(r.state.value == "done" for r in reqs)
+
+    doc = anat.to_doc()
+    sa = _load_script("step_anatomy")
+    report = sa.fold(doc)
+    assert report["verification"]["mismatches"] == 0   # tiling holds live
+    assert report["n_steps"] == anat.total_steps > 0
+    # the 4-batch bucket was never warmed: its compile is a steady-state
+    # recompile — counted on the recorder, the metrics, and per-step rows
+    assert anat.steady_state_recompiles >= 1
+    assert metrics.counter("engine/recompile_steady_state").value == \
+        anat.steady_state_recompiles
+    assert metrics.counter("engine/recompiles").value == \
+        len(anat.compiles) - warm_compiles
+    assert sum(r["compiles"] for r in doc["steps"]) >= 1
+    # EVERY closed step mirrored onto the flight-recorder anatomy track
+    # (not just the newest per fold — crash-scoped dumps need them all)
+    assert len(recorder.track("anatomy/serving")) == \
+        min(anat.total_steps, recorder.max_per_track)
+    # kv gauges export
+    serve.export_kv_gauges()
+    assert 0.0 <= metrics.gauge("kv/page_occupancy").value <= 1.0
+    occ = eng.kv.arena_stats()
+    assert occ["in_use"] + occ["free"] == occ["usable"]
+
+
+def test_engine_anatomy_disabled_by_default(tiny_serving):
+    eng = tiny_serving()
+    assert eng.anatomy is NULL_ANATOMY
+    eng.generate([[1, 2, 3]], max_new_tokens=2)
+    assert eng.anatomy.total_steps == 0
+    eng.set_anatomy(None)
+    assert eng.anatomy is NULL_ANATOMY
