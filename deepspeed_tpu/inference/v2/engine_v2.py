@@ -177,6 +177,28 @@ def compile_aot_serving(cfg, mesh, engine_config: RaggedInferenceEngineConfig = 
     return compiled, n_params
 
 
+class InFlightStep:
+    """A dispatched-but-not-folded engine step: the device program has
+    been enqueued (JAX async dispatch) and the host-side fold inputs are
+    snapshotted here, so ``complete_step`` can run an arbitrary amount of
+    host work later — the async double-buffered serving tick schedules
+    step g+1 while this one executes.  ``tokens`` is the un-materialized
+    device array; everything else is plain host state captured at
+    dispatch time (sequence descriptors by OBJECT identity, so a flush
+    that replaced a uid while the step was in flight is detectable)."""
+
+    __slots__ = ("kind", "tokens", "rows", "seqs", "drafts", "base_len", "k")
+
+    def __init__(self, kind: str):
+        self.kind = kind          # "single" | "multi" | "spec"
+        self.tokens = None        # device array: sampled tokens / argmax
+        self.rows = None          # single: [(uid, n, seq, row_index)]
+        self.seqs = None          # multi/spec: descriptor list at dispatch
+        self.drafts = None        # spec: per-row draft token lists
+        self.base_len = None      # spec: pre-splice history lengths
+        self.k = None             # multi: fused rounds in the dispatch
+
+
 class InferenceEngineV2:
     """Continuous-batching engine over a paged-KV Llama model."""
 
@@ -418,13 +440,60 @@ class InferenceEngineV2:
         with self.mesh, trace_mesh(self.mesh):
             return fn(*args)
 
+    def _build_step_jit(self):
+        """The jitted single/mixed step program — ONE builder shared by
+        the lazy per-shape cache and the AOT ``warm_all`` path, so the
+        two can never trace different computations for the same key."""
+        step = _make_step_fn(self.model, self._qparams, self.econfig.greedy,
+                             self.econfig.temperature)
+        return jax.jit(step, donate_argnums=(1, ), **self._jit_kwargs())
+
+    def _build_multi_jit(self, batch: int, k: int):
+        """The fused k-round decode program (shapes close over batch/k)."""
+        def mstep(params, cache, tokens0, start_pos, block_tables, chunk_lens, rng):
+            if self._qparams is not None:
+                params = {"params": self._qparams.dequantize(params["params"])}
+
+            def body(i, carry):
+                cache, toks, out = carry
+                logits, cache = self.model.apply(params, toks[:, None], start_pos + i,
+                                                 block_tables, cache, chunk_lens)
+                row_logits = logits[:, 0]
+                if self.econfig.greedy:
+                    nxt = jnp.argmax(row_logits, axis=-1).astype(jnp.int32)
+                else:
+                    nxt = jax.random.categorical(
+                        jax.random.fold_in(rng, i),
+                        row_logits / self.econfig.temperature, axis=-1).astype(jnp.int32)
+                return (cache, nxt, out.at[:, i].set(nxt))
+
+            out0 = jnp.zeros((batch, k), jnp.int32)
+            cache, _, out = jax.lax.fori_loop(0, k, body, (cache, tokens0, out0))
+            return out, cache
+
+        return jax.jit(mstep, donate_argnums=(1, ), **self._jit_kwargs())
+
+    def _build_verify_jit(self):
+        """The speculative verify program (argmax at EVERY position)."""
+        def vstep(params, cache, tokens, start_pos, block_tables, chunk_lens):
+            if self._qparams is not None:
+                params = {"params": self._qparams.dequantize(params["params"])}
+            logits, cache = self.model.apply(params, tokens, start_pos,
+                                             block_tables, cache, chunk_lens)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        kwargs = {}
+        if self.mesh is not None:
+            r = self._repl_sh
+            kwargs = dict(in_shardings=(self._param_sh, self._cache_sh, r, r, r, r),
+                          out_shardings=(r, self._cache_sh))
+        return jax.jit(vstep, donate_argnums=(1, ), **kwargs)
+
     def _compiled_step(self, batch: int, chunk: int):
         key = (batch, chunk)
         if key not in self._step_fns:
             logger.info(f"InferenceEngineV2: compiling step program batch={batch} chunk={chunk}")
-            step = _make_step_fn(self.model, self._qparams, self.econfig.greedy,
-                                 self.econfig.temperature)
-            self._step_fns[key] = jax.jit(step, donate_argnums=(1, ), **self._jit_kwargs())
+            self._step_fns[key] = self._build_step_jit()
             self._note_compile(f"step:b{batch}:c{chunk}")
         return self._step_fns[key]
 
@@ -432,29 +501,7 @@ class InferenceEngineV2:
         key = ("multi", batch, k)
         if key not in self._step_fns:
             logger.info(f"InferenceEngineV2: compiling multi-decode program batch={batch} k={k}")
-
-            def mstep(params, cache, tokens0, start_pos, block_tables, chunk_lens, rng):
-                if self._qparams is not None:
-                    params = {"params": self._qparams.dequantize(params["params"])}
-
-                def body(i, carry):
-                    cache, toks, out = carry
-                    logits, cache = self.model.apply(params, toks[:, None], start_pos + i,
-                                                     block_tables, cache, chunk_lens)
-                    row_logits = logits[:, 0]
-                    if self.econfig.greedy:
-                        nxt = jnp.argmax(row_logits, axis=-1).astype(jnp.int32)
-                    else:
-                        nxt = jax.random.categorical(
-                            jax.random.fold_in(rng, i),
-                            row_logits / self.econfig.temperature, axis=-1).astype(jnp.int32)
-                    return (cache, nxt, out.at[:, i].set(nxt))
-
-                out0 = jnp.zeros((batch, k), jnp.int32)
-                cache, _, out = jax.lax.fori_loop(0, k, body, (cache, tokens0, out0))
-                return out, cache
-
-            self._step_fns[key] = jax.jit(mstep, donate_argnums=(1, ), **self._jit_kwargs())
+            self._step_fns[key] = self._build_multi_jit(batch, k)
             self._note_compile(f"multi:b{batch}:k{k}")
         return self._step_fns[key]
 
@@ -471,22 +518,131 @@ class InferenceEngineV2:
         if key not in self._step_fns:
             logger.info(f"InferenceEngineV2: compiling verify program batch={batch} "
                         f"width={width}")
-
-            def vstep(params, cache, tokens, start_pos, block_tables, chunk_lens):
-                if self._qparams is not None:
-                    params = {"params": self._qparams.dequantize(params["params"])}
-                logits, cache = self.model.apply(params, tokens, start_pos,
-                                                 block_tables, cache, chunk_lens)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
-
-            kwargs = {}
-            if self.mesh is not None:
-                r = self._repl_sh
-                kwargs = dict(in_shardings=(self._param_sh, self._cache_sh, r, r, r, r),
-                              out_shardings=(r, self._cache_sh))
-            self._step_fns[key] = jax.jit(vstep, donate_argnums=(1, ), **kwargs)
+            self._step_fns[key] = self._build_verify_jit()
             self._note_compile(f"verify:b{batch}:w{width}")
         return self._step_fns[key]
+
+    # ------------------------------------------------------------- AOT set
+
+    @staticmethod
+    def _key_label(key) -> str:
+        if key[0] == "multi":
+            return f"multi:b{key[1]}:k{key[2]}"
+        if key[0] == "verify":
+            return f"verify:b{key[1]}:w{key[2]}"
+        return f"step:b{key[0]}:c{key[1]}"
+
+    def step_shape_set(self) -> List[tuple]:
+        """Enumerate every program key steady-state serving can reach,
+        straight from the scheduler's bucket table: batch buckets are the
+        ``decode_bucket`` multiples up to ``max_seqs``; chunk buckets are
+        {1, prefill_chunk} (the only two ``_dispatch_single`` produces);
+        the fused-decode rung adds its halving ladder (k_cfg, k_cfg/2,
+        ..., 2 — exactly the pressure fallbacks ``_dispatch_inner``
+        walks); a drafter adds one verify width (``max_draft + 1``).
+        This closure is what makes ``warm_all`` a guarantee rather than a
+        heuristic: a steady-state dispatch outside this set would be an
+        engine bug, and the ``engine/recompile_steady_state`` guard would
+        name it."""
+        sched = self.econfig.scheduler
+        q = sched.decode_bucket
+        maxb = self.state.max_batch
+        batches = sorted({min(maxb, m * q) for m in range(1, -(-maxb // q) + 1)})
+        keys: List[tuple] = [(b, c) for b in batches
+                             for c in sorted({1, sched.prefill_chunk})]
+        k_cfg = self.econfig.decode_steps_per_dispatch
+        if k_cfg > 1:
+            ks = set()
+            k = k_cfg
+            while k > 1:
+                ks.add(k)
+                k //= 2
+            keys += [("multi", b, k) for b in batches for k in sorted(ks)]
+        if self.drafter is not None:
+            width = self.econfig.spec.max_draft + 1
+            keys += [("verify", b, width) for b in batches]
+        return keys
+
+    def _aot_compile(self, key):
+        """``lower(...).compile()`` one program key against abstract
+        params/cache (the ``compile_aot_serving`` machinery, aimed at the
+        LIVE engine's shapes): nothing executes, no engine state moves —
+        unlike ``warm_verify``'s all-padding dispatches — and the
+        returned Compiled is call-compatible with the lazily jitted
+        version because both come from the same builder."""
+        sds = jax.ShapeDtypeStruct
+        kvcfg = self.econfig.kv
+        params_abs = jax.tree.map(lambda x: sds(x.shape, x.dtype), self.params)
+        cache_abs = jax.tree.map(lambda x: sds(x.shape, x.dtype), self.cache)
+        rng_abs = sds(self.rng.shape, self.rng.dtype)
+
+        def batch_args(b, w):
+            return (sds((b, w), jnp.int32), sds((b, ), jnp.int32),
+                    sds((b, kvcfg.max_pages_per_seq), jnp.int32),
+                    sds((b, ), jnp.int32))
+
+        if key[0] == "multi":
+            _, b, k = key
+            jitted = self._build_multi_jit(b, k)
+            args = (params_abs, cache_abs, sds((b, ), jnp.int32)) + \
+                batch_args(b, 1)[1:] + (rng_abs, )
+        elif key[0] == "verify":
+            _, b, w = key
+            jitted = self._build_verify_jit()
+            args = (params_abs, cache_abs) + batch_args(b, w)
+        else:
+            b, c = key
+            jitted = self._build_step_jit()
+            args = (params_abs, cache_abs) + batch_args(b, c) + (rng_abs, )
+        if self.mesh is None:
+            return jitted.lower(*args).compile()
+        from ...comm.mesh import trace_mesh
+        with self.mesh, trace_mesh(self.mesh):
+            return jitted.lower(*args).compile()
+
+    def warm_all(self) -> Dict[str, object]:
+        """AOT-compile the full reachable step set (``step_shape_set``)
+        into the program cache, so steady-state serving NEVER pays a
+        trace+compile inside a dispatch — the ROADMAP's AOT serving-step
+        item.  ``ServingEngine`` startup and ``ReplicaPool`` recovery
+        call this before entering dispatch.
+
+        Failure stance: an ``engine.aot_compile`` chaos injection (or a
+        real compiler error) on one key falls back to the lazy JIT path
+        for that key — the first dispatch compiles it synchronously,
+        slower but never wrong, and NEVER a dead replica.  Only
+        ``InjectedCrash`` (simulated process death) propagates.  Each
+        pre-compiled key lands in the compile log as ``aot=True`` —
+        deliberate warm-up, exempt from the steady-state-recompile
+        guard."""
+        from ...resilience import fault_injection as _fi
+        anat = self.anatomy
+        compiled = cached = fallback = 0
+        keys = self.step_shape_set()
+        for key in keys:
+            if key in self._step_fns:
+                cached += 1
+                continue
+            label = self._key_label(key)
+            try:
+                _fi.check("engine.aot_compile")
+                fn = self._aot_compile(key)
+            except _fi.InjectedCrash:
+                raise
+            except Exception as e:
+                fallback += 1
+                logger.warning(f"InferenceEngineV2: AOT compile of {label} failed "
+                               f"({e}); falling back to lazy JIT on first dispatch")
+                continue
+            self._step_fns[key] = fn
+            compiled += 1
+            anat.note_compile(label, aot=True)
+        if anat.enabled and compiled:
+            # inside an open step window the compile time is attributed
+            # explicitly; outside one, mark() is a no-op by design
+            anat.mark("aot_compile")
+        return {"compiled": compiled, "cached": cached, "fallback": fallback,
+                "keys": [self._key_label(k) for k in keys]}
 
     def warm_verify(self, batch_sizes: Sequence[int]) -> None:
         """Pre-compile the speculative verify program for the given raw
@@ -542,23 +698,24 @@ class InferenceEngineV2:
             drafts = [d[:len(d) // 2] for d in drafts]
         return drafts
 
-    def _spec_decode(self, seqs, drafts: List[List[int]]) -> Dict[int, List[int]]:
-        """One draft-verify round for a pure-decode batch: feed
+    def _dispatch_spec(self, seqs, drafts: List[List[int]]) -> InFlightStep:
+        """Enqueue one draft-verify round for a pure-decode batch: feed
         ``[last_sampled, draft_0 .. draft_{d-1}]`` per row through the
-        verify program, accept the longest prefix of drafts matching the
-        model's per-position argmax host-side, emit ``accepted + 1``
-        tokens (the argmax after the last accepted draft rides along as
-        the bonus/correction token), and roll rejected tokens' KV back
-        via ``StateManager.truncate``.  Greedy outputs are byte-identical
-        to non-speculative decode by construction — every emitted token
-        IS the model's argmax given the exact accepted history."""
+        verify program.  The accept fold (``_complete_spec``) accepts the
+        longest prefix of drafts matching the model's per-position argmax
+        host-side, emits ``accepted + 1`` tokens (the argmax after the
+        last accepted draft rides along as the bonus/correction token),
+        and rolls rejected tokens' KV back via ``StateManager.truncate``.
+        Greedy outputs are byte-identical to non-speculative decode by
+        construction — every emitted token IS the model's argmax given
+        the exact accepted history."""
         from ...resilience import fault_injection as _fi
         anat = self.anatomy
         width = self.econfig.spec.max_draft + 1
         batch = self._bucket_batch(len(seqs))
         base_len = [len(s.tokens) for s in seqs]
         # drafts ride in the token history for pack() (sliced back out
-        # below — they are verify INPUTS, not accepted output)
+        # in the fold — they are verify INPUTS, not accepted output)
         for s, d in zip(seqs, drafts):
             s.tokens.extend(d)
         try:
@@ -586,7 +743,28 @@ class InferenceEngineV2:
             for s, L in zip(seqs, base_len):
                 del s.tokens[L:]
             raise
-        argmax = np.asarray(argmax)
+        inf = InFlightStep("spec")
+        inf.tokens = argmax
+        inf.seqs = list(seqs)
+        inf.drafts = drafts
+        inf.base_len = base_len
+        return inf
+
+    def _complete_spec(self, inf: InFlightStep) -> Dict[int, List[int]]:
+        anat = self.anatomy
+        seqs, drafts, base_len = inf.seqs, inf.drafts, inf.base_len
+        try:
+            argmax = np.asarray(inf.tokens)
+        except BaseException:
+            # the deferred readback surfaced the device failure here (the
+            # pipelined tick blocks at complete, not dispatch): the
+            # unverified drafts are still spliced into every still-live
+            # row's history — restore exactly as the dispatch-path
+            # handler does before re-raising
+            for s, L in zip(seqs, base_len):
+                if self.state.seqs.get(s.uid) is s:
+                    del s.tokens[L:]
+            raise
         if anat.enabled:
             anat.device_mark()
 
@@ -594,6 +772,8 @@ class InferenceEngineV2:
         eos = self.econfig.eos_token_id
         self.spec_stats.rounds += 1
         for i, (s, d) in enumerate(zip(seqs, drafts)):
+            if self.state.seqs.get(s.uid) is not s:
+                continue  # flushed while in flight (pipelined tick)
             L = base_len[i]
             s.seen_tokens += 1 + len(d)
             # g[j] = the model's choice for history index L+j given the
@@ -628,8 +808,8 @@ class InferenceEngineV2:
             anat.mark("sample_accept")
         return out
 
-    def _multi_decode(self, seqs, k: int) -> Dict[int, List[int]]:
-        """Run ``k`` fused decode rounds for a pure-decode batch."""
+    def _dispatch_multi(self, seqs, k: int) -> InFlightStep:
+        """Enqueue ``k`` fused decode rounds for a pure-decode batch."""
         batch = self._bucket_batch(len(seqs))
         for s in seqs:
             # capacity for the WHOLE block up front; pack()'s per-token
@@ -653,13 +833,24 @@ class InferenceEngineV2:
                                         jnp.asarray(rb.chunk_lens), sub)
         if anat.enabled:
             anat.mark("compile_wait" if self._fresh_compile else "dispatch")
-        toks = np.asarray(toks)
+        inf = InFlightStep("multi")
+        inf.tokens = toks
+        inf.seqs = list(seqs)
+        inf.k = k
+        return inf
+
+    def _complete_multi(self, inf: InFlightStep) -> Dict[int, List[int]]:
+        anat = self.anatomy
+        toks = np.asarray(inf.tokens)
         if anat.enabled:
             anat.device_mark()
 
         out: Dict[int, List[int]] = {}
         eos = self.econfig.eos_token_id
-        for i, s in enumerate(seqs):
+        k = inf.k
+        for i, s in enumerate(inf.seqs):
+            if self.state.seqs.get(s.uid) is not s:
+                continue  # flushed while in flight (pipelined tick)
             before = len(s.generated)
             s.seen_tokens += k
             limit = self._max_new.get(s.uid, self.econfig.max_new_tokens)
@@ -693,30 +884,68 @@ class InferenceEngineV2:
         (the serving frontend's KV-pressure preflight) skip the re-plan;
         it must have been computed against the CURRENT state.
 
+        Composition of the async-capable halves: ``dispatch_step``
+        enqueues the device program and ``complete_step`` blocks at the
+        readback and folds tokens — called back-to-back here, the serial
+        loop is byte-identical to the pre-split engine (same dispatch
+        order, same rng splits, same fold), and the pipelined serving
+        tick interleaves its own host work between the two."""
+        inf = self.dispatch_step(plan)
+        if inf is None:
+            return {}
+        return self.complete_step(inf)
+
+    def dispatch_step(self, plan: Optional[StepPlan] = None) -> Optional[InFlightStep]:
+        """Plan (unless given one) and ENQUEUE one step on the device,
+        without blocking on its outputs: JAX async dispatch returns as
+        soon as the program is in flight, so the caller owns the device
+        window for overlapped host work.  Returns None when there is
+        nothing to run (empty plan).
+
         With a :class:`~...telemetry.step_anatomy.StepAnatomy` attached
-        (``set_anatomy``), the step is decomposed into host segments +
-        device compute + host gap; a frontend that planned before calling
-        opens the step window itself (``step_begin`` is idempotent) and
-        the ``finally`` here closes it even on a chaos-site failure, so
-        no step window ever leaks open."""
+        (``set_anatomy``), this opens the step window (``step_begin`` is
+        idempotent — a frontend that planned first opens it itself) and
+        the window stays OPEN across the in-flight stretch; an empty or
+        failed dispatch closes it here so no window ever leaks."""
         anat = self.anatomy
         self._fresh_compile = False
         if anat.enabled:
             anat.step_begin()
+        inflight = None
         try:
             if plan is None:
                 plan = self.scheduler.plan(self.state)
                 if anat.enabled:
                     anat.mark("schedule")
-            return self._step_inner(plan)
+            inflight = self._dispatch_inner(plan)
+            return inflight
+        finally:
+            if inflight is None and anat.enabled:
+                anat.step_end()
+
+    def complete_step(self, inf: InFlightStep) -> Dict[int, List[int]]:
+        """Block on the in-flight step's readback and fold its tokens
+        into engine state — the sample/accept half of ``step``.  Rows
+        whose sequence was flushed while the step was in flight (the
+        pipelined tick's expire path) are skipped by object identity;
+        their computed tokens are discarded whole, never half-applied.
+        Closes the anatomy step window even when the readback raises."""
+        anat = self.anatomy
+        try:
+            if inf.kind == "spec":
+                return self._complete_spec(inf)
+            if inf.kind == "multi":
+                return self._complete_multi(inf)
+            return self._complete_single(inf)
         finally:
             if anat.enabled:
                 anat.step_end()
 
-    def _step_inner(self, plan: StepPlan) -> Dict[int, List[int]]:
+    def _dispatch_inner(self, plan: StepPlan) -> Optional[InFlightStep]:
         anat = self.anatomy
         # per-step spec accounting: entries describe THIS step's verify
-        # round only (the serving frontend reads them right after step())
+        # round only (the serving frontend reads them right after the
+        # step's completion)
         self.last_spec_round.clear()
         if self.drafter is not None and plan.decode and not plan.prefill:
             # speculation outranks the fused rung on pure-decode rounds: a
@@ -729,7 +958,7 @@ class InferenceEngineV2:
             if anat.enabled:
                 anat.mark("draft_plan")
             if any(drafts):
-                return self._spec_decode(plan.decode, drafts)
+                return self._dispatch_spec(plan.decode, drafts)
         k_cfg = self.econfig.decode_steps_per_dispatch
         if k_cfg > 1 and plan.decode and not plan.prefill:
             # OVERSHOOT policy (r4): always run the full k rung and discard
@@ -749,10 +978,10 @@ class InferenceEngineV2:
                              > self.kv.allocator.free_pages):
                 k //= 2
             if k > 1:
-                return self._multi_decode(plan.decode, k)
+                return self._dispatch_multi(plan.decode, k)
         work: List = [(s, 1) for s in plan.decode] + list(plan.prefill)
         if not work:
-            return {}
+            return None
         chunk = max(n for _, n in work)
         # chunk buckets: 1 (pure decode) or the prefill quantum
         chunk = 1 if chunk == 1 else self.econfig.scheduler.prefill_chunk
@@ -770,16 +999,22 @@ class InferenceEngineV2:
                                             jnp.asarray(rb.chunk_lens), sub)
         if anat.enabled:
             anat.mark("compile_wait" if self._fresh_compile else "dispatch")
-        next_tok = np.asarray(next_tok)
+        inf = InFlightStep("single")
+        inf.tokens = next_tok
+        inf.rows = [(int(uid), int(rb.chunk_lens[i]), self.state.seqs[uid], i)
+                    for i, uid in enumerate(rb.uids) if uid >= 0]
+        return inf
+
+    def _complete_single(self, inf: InFlightStep) -> Dict[int, List[int]]:
+        anat = self.anatomy
+        next_tok = np.asarray(inf.tokens)
         if anat.enabled:
             anat.device_mark()
 
         out: Dict[int, List[int]] = {}
-        for i, uid in enumerate(rb.uids):
-            if uid < 0:
-                continue
-            seq = self.state.seqs[uid]
-            n = int(rb.chunk_lens[i])
+        for uid, n, seq, i in inf.rows:
+            if self.state.seqs.get(uid) is not seq:
+                continue  # flushed while in flight (pipelined tick)
             seq.seen_tokens += n
             self.state.note_progress(seq)
             if seq.in_prefill:

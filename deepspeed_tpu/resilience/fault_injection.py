@@ -82,6 +82,7 @@ INJECTION_SITES = frozenset({
     "swap.read",            # NVMe/disk swap read issue
     "engine.step",          # training-step dispatch (runtime/engine.py)
     "engine.verify_step",   # speculative verify dispatch (inference/v2/engine_v2.py)
+    "engine.aot_compile",   # AOT serving-step warm-up compile (inference/v2/engine_v2.py warm_all)
     "serving.admit",        # serving request admission (serving/engine.py)
     "admission.tenant",     # tenant-QoS admission bookkeeping (serving/fleet/router.py)
     "router.dispatch",      # fleet router request dispatch (serving/fleet/router.py)

@@ -54,6 +54,16 @@ class ServingConfig:
     # of the planned token count (decodes + prefill chunk tokens).  None →
     # every step costs 1.0 virtual second (pure step-count latency).
     step_cost: Optional[Callable[[int], float]] = None
+    # async double-buffered dispatch: each tick completes the PREVIOUS
+    # step's readback, then enqueues the next step and returns — so step
+    # g+1's host-side work (admission, scheduling, delivery) runs while
+    # step g executes on device, blocking only at the sample/accept
+    # readback.  Greedy token streams are byte-identical to the serial
+    # loop (each request's tokens depend only on its own accepted
+    # history); deadline expiry may fire up to one step earlier than the
+    # serial loop would, since the overlap window checks deadlines before
+    # the in-flight step's tokens fold.
+    async_dispatch: bool = False
 
 
 class ServingEngine:
@@ -103,6 +113,10 @@ class ServingEngine:
         # EWMA of clock-seconds per tick-with-work (load_stats input for the
         # fleet router's least-loaded policy); None until the first step runs
         self._ewma_step_s: Optional[float] = None
+        # async double-buffered dispatch (config.async_dispatch): the
+        # step enqueued last tick, completed at the NEXT tick's readback —
+        # (InFlightStep, charged_cost, dispatch_ts) or None
+        self._inflight = None
         # a fleet ReplicaClockView over a shared VirtualClock quantizes
         # latencies exactly like a bare VirtualClock — unwrap it so the
         # warning below fires for fleet replicas too
@@ -326,9 +340,18 @@ class ServingEngine:
     # ---------------------------------------------------------------- tick
 
     def tick(self) -> Dict[int, List[int]]:
-        """One serving iteration: expire deadlines, admit, resolve KV
-        pressure, run one engine step, deliver tokens.  Returns the engine
-        step's {uid: [tokens]} (empty when nothing was runnable).
+        """One serving iteration.  Serial mode (default): expire
+        deadlines, admit, resolve KV pressure, run one engine step,
+        deliver tokens.  Async mode (``config.async_dispatch``): complete
+        the step dispatched LAST tick, then enqueue the next one — see
+        :meth:`_tick_pipelined`.  Returns the completed step's
+        {uid: [tokens]} (empty when nothing was runnable)."""
+        if self.config.async_dispatch:
+            return self._tick_pipelined()
+        return self._tick_serial()
+
+    def _tick_serial(self) -> Dict[int, List[int]]:
+        """The strictly serial host→device step loop.
 
         With a step-anatomy recorder on the engine, the tick opens the
         step window BEFORE the admission/preflight work (``step_begin``
@@ -379,6 +402,89 @@ class ServingEngine:
         # sequence, which pops its last_spec_round entry
         self._record_spec_rounds()
         self._deliver(out, self.clock.now())
+        return out
+
+    def _tick_pipelined(self) -> Dict[int, List[int]]:
+        """Async double-buffered serving tick: step g+1's host-side work
+        runs while step g executes on device, blocking only at the
+        sample/accept readback.
+
+        Pipeline stages, in tick order:
+
+        1. **overlap window** — deadline expiry and admission run while
+           last tick's dispatch is still in flight; with a recorder
+           attached the stretch lands in the open step's ``overlap``
+           segment (loop tax hidden under device time).  A sequence
+           flushed here while in flight is skipped whole at the fold
+           (object-identity guards in ``complete_step``) — its computed
+           tokens are discarded, never half-applied.
+        2. **complete** — the one blocking point: read back step g's
+           tokens and fold them into engine state.
+        3. **dispatch** — KV-pressure preflight, plan, and enqueue step
+           g+1.  The clock cost is charged AT DISPATCH (not completion),
+           so every ``clock.now()`` reading a request observes matches
+           the serial loop's.
+        4. **deliver** — step g's tokens reach their requests while step
+           g+1 is already on device; the timestamp is captured BEFORE
+           g+1's charge, so delivery/finish times equal the serial
+           loop's (sum of costs through step g).  Runs in a ``finally``:
+           a g+1 dispatch failure must never lose g's delivered tokens.
+        """
+        anat = getattr(self.engine, "anatomy", NULL_ANATOMY)
+        now = self.clock.now()
+        self._expire(now)
+        self._admit(now)
+        if anat.enabled:
+            anat.mark("overlap")   # no-op when no step window is open
+        out: Dict[int, List[int]] = {}
+        if self._inflight is not None:
+            inf, charged, t_dispatch = self._inflight
+            self._inflight = None
+            out = self.engine.complete_step(inf)
+            dt = charged if charged is not None \
+                else self.clock.now() - t_dispatch
+            self._ewma_step_s = dt if self._ewma_step_s is None \
+                else 0.8 * self._ewma_step_s + 0.2 * dt
+            if anat.enabled:
+                self._fold_anatomy(anat)
+            # fold BEFORE the next dispatch (it clears last_spec_round)
+            # and BEFORE _deliver (finishing a request flushes its engine
+            # sequence, which pops its entry)
+            self._record_spec_rounds()
+        # serial-parity delivery timestamp: the clock already carries
+        # every step cost through g (charged at its own dispatch), and
+        # g+1's charge has not landed yet
+        t_deliver = self.clock.now()
+        if not self._active:
+            self._deliver(out, t_deliver)
+            return out
+        if anat.enabled:
+            anat.step_begin()      # open step g+1's window for its planning
+        try:
+            evicted, plan = self.kvp.resolve()
+            for seq in evicted:
+                self._on_preempted(seq, now)
+            if self._active and (plan.decode or plan.prefill):
+                if anat.enabled:
+                    anat.mark("schedule")
+                cost = 1.0
+                if self.config.step_cost is not None:
+                    cost = self.config.step_cost(plan.planned_tokens)
+                t_dispatch = self.clock.now()
+                inf = self.engine.dispatch_step(plan)
+                if inf is not None:
+                    # charge-at-dispatch: clock-accounted costs land when
+                    # the step enqueues, keeping arrivals/admission and
+                    # delivery timestamps aligned with the serial loop
+                    charged = self.clock.on_step(cost)
+                    if charged is not None and anat.enabled:
+                        # the virtual charge is this step's device time —
+                        # claim it now so the next overlap window cannot
+                        # absorb it as host work
+                        anat.device_mark()
+                    self._inflight = (inf, charged, t_dispatch)
+        finally:
+            self._deliver(out, t_deliver)
         return out
 
     def _fold_anatomy(self, anat) -> None:
@@ -819,7 +925,7 @@ class ServingEngine:
     def _loop(self, pending_arrival, max_ticks: int) -> None:
         for _ in range(max_ticks):
             next_arrival = pending_arrival()
-            if not self._queue and not self._active:
+            if not self._queue and not self._active and self._inflight is None:
                 if next_arrival is None:
                     return
                 self.clock.wait_until(next_arrival)
@@ -853,10 +959,13 @@ class ServingEngine:
             anat.note_idle()
 
     def _progress_marker(self):
+        # the in-flight flag counts as progress: a pipelined tick that
+        # only dispatches (or only drains) changes nothing else yet
         return (len(self.stats.finished), self.stats.preemptions,
                 len(self._queue), len(self._active),
                 sum(s.seen_tokens for s in self.engine.state.seqs.values()),
-                sum(len(r.tokens) for r in self._active.values()))
+                sum(len(r.tokens) for r in self._active.values()),
+                self._inflight is not None)
 
     def fence(self) -> Dict[str, int]:
         """Cancel EVERY in-flight request on this frontend — the fleet
@@ -871,6 +980,19 @@ class ServingEngine:
         them — the fleet-level record was already re-homed, and a second
         terminal here would be the double-serve fencing exists to prevent.
         Returns the cancel counts for the fence ack."""
+        if self._inflight is not None:
+            # async mode with a step in flight: block on its readback and
+            # discard the fold output — fenced work is dropped WHOLE (the
+            # flushes below release its sequences), never half-applied
+            inf, _, _ = self._inflight
+            self._inflight = None
+            try:
+                self.engine.complete_step(inf)
+            except InjectedCrash:
+                raise
+            except Exception as e:
+                logger.warning(f"serving: in-flight step failed during "
+                               f"fence ({e}); dropping it")
         counts = {"queued": len(self._queue), "active": len(self._active)}
         for req in list(self._queue):
             self._requests.pop(req.uid, None)

@@ -308,8 +308,9 @@ class ReplicaPool:
     def mark_anatomy_steady(self) -> None:
         """Declare warm-up over on every live replica's recorder: later
         JIT cache misses count as unexpected steady-state recompiles.
-        Engines attached AFTER this (recover/restart replacements) start
-        un-steady — their compile set is recovery, not regression."""
+        Recover/restart replacements re-enter dispatch already steady —
+        ``_warm_replacement`` AOT-compiles their step set and marks the
+        fresh recorder before the replica serves its first request."""
         for rid in self.rids:
             anat = self.anatomy(rid)
             if anat is not None:
@@ -351,11 +352,29 @@ class ReplicaPool:
             self.prefix_directory.purge(rid)
         return victims
 
+    def _warm_replacement(self, rid: int) -> None:
+        """A replacement engine must not pay its compile set inside the
+        first served request's TTFT: AOT-compile the full reachable step
+        set (``warm_all`` — an ``engine.aot_compile`` chaos fault falls
+        back to lazy JIT per key, never a dead replica) and declare the
+        fresh recorder steady — recovery compiles are deliberate warm-up
+        by construction, and any LATER JIT miss on this replica is a real
+        steady-state regression, not recovery noise."""
+        rep = self.replicas[rid]
+        warm = getattr(rep.serve.engine, "warm_all", None)
+        if warm is not None:
+            warm()
+        anat = self.anatomy(rid)
+        if anat is not None:
+            anat.mark_steady()
+
     def recover(self, rid: int) -> None:
-        """Attach a fresh engine to a DEAD replica (replacement host)."""
+        """Attach a fresh engine to a DEAD replica (replacement host),
+        pre-compiled and anatomy-steady before it re-enters dispatch."""
         assert self.health.state(rid) is ReplicaState.DEAD, \
             f"recover() on replica {rid} in state {self.health.state(rid).value}"
         self._attach_engine(rid)
+        self._warm_replacement(rid)
         self.health.recovering(rid)
 
     def drain(self, rid: int) -> None:
@@ -363,7 +382,8 @@ class ReplicaPool:
 
     def restart(self, rid: int) -> None:
         """Rolling restart of a DRAINED replica: must be idle (the point of
-        draining is that nothing is lost), swaps in a fresh engine."""
+        draining is that nothing is lost), swaps in a fresh engine —
+        pre-compiled and anatomy-steady, like a recovery replacement."""
         assert self.health.state(rid) is ReplicaState.DRAINING, \
             f"restart() on replica {rid} in state {self.health.state(rid).value}"
         assert self.is_idle(rid), f"restart() on replica {rid} before drained"
@@ -371,6 +391,7 @@ class ReplicaPool:
         if rep.serve is not None:
             rep.serve.close()
         self._attach_engine(rid)
+        self._warm_replacement(rid)
         self.health.recovering(rid, "rolling restart")
 
     # ---------------------------------------------------------------- tick

@@ -11,6 +11,9 @@ to kill.  This module decomposes EVERY engine step into:
                        the serving frontend's KV-pressure preflight)
     ``draft_plan``     speculative draft planning (``_plan_drafts``)
     ``verify_plan``    verify-batch staging (history splice + ``pack``)
+    ``aot_compile``    ahead-of-time ``lower().compile()`` work done
+                       inside a step window (``warm_all`` invoked while
+                       a step is open — deliberate warm-up, not a miss)
     ``compile_wait``   a dispatch that triggered a JIT cache miss — the
                        trace+compile ride the first call synchronously
     ``dispatch``       host-side dispatch of an already-compiled program
@@ -18,6 +21,10 @@ to kill.  This module decomposes EVERY engine step into:
                        enqueue)
     ``sample_accept``  host-side token fold (argmax accept loop, EOS/
                        limit checks, rollback truncation)
+    ``overlap``        host work for step g+1 executed while step g was
+                       still in flight on device (the async double-
+                       buffered tick's scheduling/admission/delivery
+                       window — loop tax HIDDEN under device time)
     ``bookkeeping``    everything else inside the step window (prefix-
                        cache publish, descriptor updates, the residual
                        between the last mark and step end)
@@ -64,8 +71,9 @@ __all__ = ["HOST_SEGMENTS", "StepAnatomy", "NullStepAnatomy", "NULL_ANATOMY",
 
 #: the closed host-segment vocabulary; every step exports all of them
 #: (zero-filled) so the per-step table has one fixed shape
-HOST_SEGMENTS = ("schedule", "draft_plan", "verify_plan", "compile_wait",
-                 "dispatch", "sample_accept", "bookkeeping")
+HOST_SEGMENTS = ("schedule", "draft_plan", "verify_plan", "aot_compile",
+                 "compile_wait", "dispatch", "sample_accept", "overlap",
+                 "bookkeeping")
 
 
 class StepRecord:
@@ -112,20 +120,25 @@ class StepRecord:
 
 
 class CompileRecord:
-    """One JIT cache miss: which program key, at which step, and whether
-    it fired after the warm-up boundary (``steady`` = the regression)."""
+    """One compile event: which program key, at which step, whether it
+    fired after the warm-up boundary (``steady`` = the regression), and
+    whether it was a deliberate AOT ``lower().compile()`` (``aot``)
+    rather than a JIT cache miss a dispatch paid for synchronously."""
 
-    __slots__ = ("key", "step_index", "steady", "ts")
+    __slots__ = ("key", "step_index", "steady", "ts", "aot")
 
-    def __init__(self, key: str, step_index: int, steady: bool, ts: float):
+    def __init__(self, key: str, step_index: int, steady: bool, ts: float,
+                 aot: bool = False):
         self.key = key
         self.step_index = step_index
         self.steady = steady
         self.ts = ts
+        self.aot = aot
 
     def to_row(self) -> dict:
         return {"key": self.key, "step_index": self.step_index,
-                "steady": self.steady, "ts": round(self.ts, 9)}
+                "steady": self.steady, "aot": self.aot,
+                "ts": round(self.ts, 9)}
 
 
 class StepAnatomy:
@@ -219,14 +232,19 @@ class StepAnatomy:
             self._cur.batch = int(batch)
             self._cur.chunk = int(chunk)
 
-    def note_compile(self, key: str) -> None:
-        """One JIT cache miss (the engine's ``_step_fns`` grew an entry).
-        Tagged warm-up until :meth:`mark_steady`; after it, counted as an
-        unexpected steady-state recompile — the AOT regression signal."""
+    def note_compile(self, key: str, aot: bool = False) -> None:
+        """One compile event (the engine's ``_step_fns`` grew an entry).
+        A JIT cache miss (``aot=False``) is tagged warm-up until
+        :meth:`mark_steady`; after it, counted as an unexpected
+        steady-state recompile — the AOT regression signal.  A deliberate
+        ``warm_all`` AOT compile (``aot=True``) is NEVER steady-state
+        noise: it is the warm-up mechanism itself, and does not bump the
+        per-step JIT-miss counter either."""
         idx = self._cur.index if self._cur is not None else self.total_steps
-        rec = CompileRecord(key, idx, self._steady, self.clock.now())
+        rec = CompileRecord(key, idx, self._steady and not aot,
+                            self.clock.now(), aot=aot)
         self.compiles.append(rec)
-        if self._cur is not None:
+        if self._cur is not None and not aot:
             self._cur.compiles += 1
         if rec.steady:
             self.steady_state_recompiles += 1
@@ -400,9 +418,11 @@ class StepAnatomy:
         """The full deterministic export (what ``bench_serving.py
         --anatomy`` commits and ``scripts/step_anatomy.py`` re-verifies):
         per-step table, compile log, per-shape fold, summary.  Pure data,
-        9-dp rounding, sorted keys downstream."""
+        9-dp rounding, sorted keys downstream.  Schema 2 = the r20
+        segment vocabulary (``aot_compile``/``overlap``) plus the
+        compile log's ``aot`` flag."""
         return {
-            "schema": 1,
+            "schema": 2,
             "summary": self.summary(),
             "by_shape": self.by_shape(),
             "steps": [rec.to_row() for rec in self.steps],
@@ -476,7 +496,7 @@ class NullStepAnatomy:
     def note_shape(self, path, batch, chunk) -> None:
         pass
 
-    def note_compile(self, key) -> None:
+    def note_compile(self, key, aot=False) -> None:
         pass
 
     def note_idle(self) -> None:
@@ -508,7 +528,7 @@ class NullStepAnatomy:
         return {}
 
     def to_doc(self) -> dict:
-        return {"schema": 1, "summary": {}, "by_shape": {}, "steps": [],
+        return {"schema": 2, "summary": {}, "by_shape": {}, "steps": [],
                 "compiles": []}
 
     def emit_spans(self, tracer, trace_id=None, track="anatomy") -> int:

@@ -102,16 +102,14 @@ def _workload(rng, n_requests, rate, ttft_budget, tpot_budget, vocab,
 
 
 def _warm(eng, max_seqs):
-    """Compile the hot step programs on the engine ACTUALLY used (the
+    """AOT-compile the serving step set on the engine ACTUALLY used (the
     per-instance _step_fns cache means warming a throwaway engine warms
-    nothing): min and max batch buckets x {prefill-chunk, decode} shapes.
-    Intermediate bucket rungs can still compile lazily mid-serve — rare,
-    and irrelevant under the virtual clock."""
-    eng.generate([[1, 2, 3]], max_new_tokens=2)
-    eng.generate([[1, 2, 3]] * max_seqs, max_new_tokens=2)
-    # spec engines: the verify program too (drafting is history-dependent,
-    # so the tiny warm generations above never reach a verify round)
-    eng.warm_verify([1, max_seqs])
+    nothing): ``warm_all`` enumerates every reachable (path, batch-bucket,
+    chunk/k/width) shape from the scheduler's bucket table — including the
+    intermediate bucket rungs and the spec verify program — and
+    ``lower().compile()``\\ s each up front, so no step of the measured run
+    pays a lazy JIT compile."""
+    eng.warm_all()
 
 
 def run_open_loop(make_engine, clock_factory, arrivals, rate, max_queue_depth=256,
@@ -206,87 +204,176 @@ def run_spec_pair(make_engine, clock_factory, arrivals, rate, max_queue_depth,
 
 def run_anatomy_leg(make_engine, clock_factory, arrivals, rate,
                     max_queue_depth, dryrun, out_path):
-    """Step-anatomy receipt (docs/OBSERVABILITY.md "Step anatomy"): serve
-    one open-loop point with a ``StepAnatomy`` recorder on the engine —
-    warm the step programs, declare the compile set steady, reset, then
-    measure — and commit ``BENCH_STEP_ANATOMY.json``:
+    """Step-anatomy receipt (docs/OBSERVABILITY.md "Step anatomy"),
+    schema v2: the SAME workload served twice — the strictly serial tick
+    loop and the async double-buffered one (``async_dispatch=True``) —
+    each leg AOT-warmed (``warm_all``: compile set closed up front),
+    declared steady, reset, then measured.  Commits
+    ``BENCH_STEP_ANATOMY.json``:
 
-    * the per-step table whose components TILE wall time (re-verified by
-      ``scripts/step_anatomy.py`` and the schema checker);
-    * host-gap fraction per (path, batch, chunk) bucket — the Python
-      step-loop tax the ROADMAP AOT serving-step item must shrink.
-      Under ``--dryrun``'s VirtualClock, host segments and gaps are 0 BY
-      CONSTRUCTION (virtual seconds are charged, host work costs none),
-      so the committed dryrun receipt pins the shape census, the tiling
-      contract and the recompile guard; a wall-clock run of the same leg
-      fills in real fractions;
-    * **steady-state recompiles == 0**: after warm-up, no step may pay a
-      JIT compile — the regression guard the AOT item is held to;
-    * byte-identical regeneration (the leg runs twice; the docs must
-      match byte-for-byte).
+    * per-leg per-step tables whose components TILE wall time
+      (re-verified by ``scripts/step_anatomy.py`` and the schema checker);
+    * **greedy parity, asserted per request**: the pipelined loop's token
+      streams must be byte-identical to the serial loop's (deadlines are
+      stripped from this leg's workload — the documented one-step expiry
+      skew of the overlap window is a timing policy, not a decoding
+      difference, and must not contaminate a decoding-parity claim);
+    * **steady-state recompiles == 0 in BOTH legs**: after ``warm_all``
+      no step may pay a JIT compile — the AOT regression guard;
+    * a **wall-clock comparison**: the same two modes on a ``WallClock``
+      burst (all-at-once arrivals, so steps run back-to-back), where the
+      pipelined host-gap fraction must land STRICTLY below serial at
+      equal completions — the Python loop tax measurably hidden under
+      device time.  Real timings vary run to run; the ordering is the
+      receipt.  Under ``--dryrun``'s VirtualClock the primary legs' host
+      segments and gaps are 0 BY CONSTRUCTION, so they pin the shape
+      census, parity, tiling and the recompile guard instead;
+    * byte-identical regeneration of the virtual legs (each runs twice).
     """
     import importlib.util
 
-    from deepspeed_tpu.serving import AdmissionConfig, ServingConfig, ServingEngine
+    from deepspeed_tpu.serving import (AdmissionConfig, ServingConfig,
+                                       ServingEngine, WallClock)
     from deepspeed_tpu.telemetry import MetricsRegistry, StepAnatomy
 
-    def one_run():
+    # decoding-parity workload: same arrivals, no deadlines (see docstring)
+    leg_arrivals = [dict(a, deadline=None) for a in arrivals]
+
+    def one_run(async_dispatch, make_clock=clock_factory, runs=leg_arrivals,
+                queue_depth=max_queue_depth):
         eng = make_engine()
-        clock = clock_factory()
+        clock = make_clock()
         anat = eng.set_anatomy(StepAnatomy(clock=clock))
-        _warm(eng, eng.econfig.scheduler.max_seqs)
+        aot = eng.warm_all()   # the AOT step set, compiled up front
         anat.mark_steady()     # the compiled step set is now closed
         anat.reset_steps()     # warm-up steps must not dilute the fold
         metrics = MetricsRegistry()
         serve = ServingEngine(eng, clock=clock,
-                              config=ServingConfig(admission=AdmissionConfig(
-                                  max_queue_depth=max_queue_depth)),
+                              config=ServingConfig(
+                                  admission=AdmissionConfig(
+                                      max_queue_depth=queue_depth),
+                                  async_dispatch=async_dispatch),
                               metrics=metrics)
-        serve.run(arrivals)
+        t0 = clock.now()
+        reqs = serve.run(runs)
         serve.export_kv_gauges()
         kv = {name: metrics.gauge(name).value
               for name in metrics.names() if name.startswith("kv/")}
-        return anat.to_doc(), kv, serve.stats.summary(elapsed=serve.clock.now())
-
-    doc, kv, summary = one_run()
-    doc2, kv2, _ = one_run()
-    identical = (json.dumps(doc, sort_keys=True)
-                 == json.dumps(doc2, sort_keys=True)
-                 and json.dumps(kv, sort_keys=True)
-                 == json.dumps(kv2, sort_keys=True))
+        outputs = [(r.state.value, list(r.tokens)) for r in reqs]
+        return (anat.to_doc(), kv,
+                serve.stats.summary(elapsed=clock.now() - t0), outputs, aot)
 
     # fold + verify with THE report tool (imported by path, stdlib-only),
-    # so the committed "report" section can never drift from what
+    # so the committed "report" sections can never drift from what
     # scripts/step_anatomy.py would print
     sa_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "step_anatomy.py")
     spec = importlib.util.spec_from_file_location("_step_anatomy_cli", sa_path)
     sa = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(sa)
-    report = sa.fold(doc)
-    assert report["verification"]["mismatches"] == 0, report["verification"]
 
-    ssr = doc["summary"]["steady_state_recompiles"]
+    legs, outputs, identical = {}, {}, True
+    for name, async_dispatch in (("serial", False), ("pipelined", True)):
+        doc, kv, summary, outs, aot = one_run(async_dispatch)
+        if dryrun:  # byte-identical regeneration: a virtual-clock property
+            doc2, kv2, _, outs2, _ = one_run(async_dispatch)
+            identical = identical and (
+                json.dumps(doc, sort_keys=True) == json.dumps(doc2, sort_keys=True)
+                and json.dumps(kv, sort_keys=True) == json.dumps(kv2, sort_keys=True)
+                and outs == outs2)
+        report = sa.fold(doc)
+        assert report["verification"]["mismatches"] == 0, report["verification"]
+        outputs[name] = outs
+        legs[name] = {
+            "steady_state_recompiles": doc["summary"]["steady_state_recompiles"],
+            "aot": aot,
+            "serving": {"completed": summary["completed"],
+                        "rejected": summary["rejected"],
+                        "preemptions": summary["preemptions"]},
+            "kv": kv,
+            "report": report,
+            "anatomy": doc,
+        }
+
+    # greedy parity, request by request.  Dryrun (deterministic virtual
+    # clock): the strict contract — identical state AND tokens for every
+    # request.  Wall clock: admission/preemption outcomes are timing-
+    # dependent, so compare token streams of requests DONE in both legs.
+    if dryrun:
+        assert outputs["serial"] == outputs["pipelined"], (
+            "async double-buffered dispatch diverged from the serial loop: "
+            + str([i for i, (a, b) in enumerate(zip(outputs["serial"],
+                                                    outputs["pipelined"]))
+                   if a != b][:5]))
+        parity = True
+    else:
+        done_both = [i for i, (a, b) in enumerate(zip(outputs["serial"],
+                                                      outputs["pipelined"]))
+                     if a[0] == "done" and b[0] == "done"]
+        parity = bool(done_both) and all(
+            outputs["serial"][i][1] == outputs["pipelined"][i][1]
+            for i in done_both)
+
+    # wall-clock after-leg: the same two modes on a WallClock burst.  All
+    # arrivals land at t=0 so the loop never idles — every inter-step gap
+    # is loop tax, which is exactly what the pipelined mode must hide.
+    # Retried up to 3x before the strict assert: one noisy scheduler
+    # stall on a shared box must not fail artifact regeneration.
+    burst = [dict(a, arrival_ts=0.0, deadline=None)
+             for a in arrivals[:16]]
+    wall = None
+    for _ in range(3):
+        _, _, w_ser_sum, w_ser_out, _ = (w_ser := one_run(
+            False, make_clock=WallClock, runs=burst, queue_depth=256))
+        _, _, w_pipe_sum, w_pipe_out, _ = (w_pipe := one_run(
+            True, make_clock=WallClock, runs=burst, queue_depth=256))
+        g_ser = sa.fold(w_ser[0])["totals"]["host_gap_fraction"] or 0.0
+        g_pipe = sa.fold(w_pipe[0])["totals"]["host_gap_fraction"] or 0.0
+        wall = {
+            "serial_host_gap_fraction": round(g_ser, 6),
+            "pipelined_host_gap_fraction": round(g_pipe, 6),
+            "serial_completed": w_ser_sum["completed"],
+            "pipelined_completed": w_pipe_sum["completed"],
+            "serial_goodput_rps": w_ser_sum["goodput_rps"],
+            "pipelined_goodput_rps": w_pipe_sum["goodput_rps"],
+            "n_requests": len(burst),
+            "note": "wall-clock timings vary across runs; the receipt is "
+                    "the ordering (pipelined strictly below serial) at "
+                    "equal completions",
+        }
+        if g_pipe < g_ser and \
+                w_ser_sum["completed"] == w_pipe_sum["completed"] and \
+                w_ser_out == w_pipe_out:
+            break
+    assert wall["pipelined_host_gap_fraction"] \
+        < wall["serial_host_gap_fraction"], (
+        "pipelined wall-clock host_gap_fraction not strictly below serial: "
+        + str(wall))
+    assert w_ser_out == w_pipe_out, \
+        "wall-clock legs diverged on token streams"
+
+    pipe_report = legs["pipelined"]["report"]
     rec = {
         "metric": "host_gap_fraction",
-        "value": report["totals"]["host_gap_fraction"],
+        "value": pipe_report["totals"]["host_gap_fraction"],
         "unit": "fraction_of_wall",
-        "schema_version": 1,
+        "schema_version": 2,
         "workload": {"n_requests": len(arrivals), "arrival_rate": rate,
-                     "dryrun": bool(dryrun), "virtual_clock": bool(dryrun)},
-        "steady_state_recompiles": ssr,
-        "determinism_repeat_identical": bool(identical),
-        "serving": {"completed": summary["completed"],
-                    "rejected": summary["rejected"],
-                    "preemptions": summary["preemptions"]},
-        "kv": kv,
-        "report": report,
-        "anatomy": doc,
+                     "dryrun": bool(dryrun), "virtual_clock": bool(dryrun),
+                     "deadlines": False},
+        "greedy_parity": bool(parity),
+        "determinism_repeat_identical": bool(dryrun and identical),
+        "legs": legs,
+        "wall": wall,
     }
-    print(f"# anatomy leg @rate={rate}: steps={report['n_steps']} "
-          f"shapes={report['n_shapes']} "
-          f"host_gap_fraction={report['totals']['host_gap_fraction']} "
-          f"steady_recompiles={ssr} repeat_identical={identical}", flush=True)
+    print(f"# anatomy legs @rate={rate}: "
+          f"steps serial={legs['serial']['report']['n_steps']} "
+          f"pipelined={pipe_report['n_steps']} parity={parity} "
+          f"steady_recompiles="
+          f"{[legs[n]['steady_state_recompiles'] for n in ('serial', 'pipelined')]} "
+          f"wall_gap serial={wall['serial_host_gap_fraction']} "
+          f"pipelined={wall['pipelined_host_gap_fraction']} "
+          f"repeat_identical={identical}", flush=True)
     from deepspeed_tpu.resilience.atomic_io import atomic_write_json
     atomic_write_json(out_path, rec, indent=1)
     return rec

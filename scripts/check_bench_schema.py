@@ -438,43 +438,30 @@ def _validate_attribution(v):
     return None
 
 
-_ANATOMY_SEGMENTS = ("schedule", "draft_plan", "verify_plan", "compile_wait",
-                     "dispatch", "sample_accept", "bookkeeping")
+_ANATOMY_SEGMENTS = ("schedule", "draft_plan", "verify_plan", "aot_compile",
+                     "compile_wait", "dispatch", "sample_accept", "overlap",
+                     "bookkeeping")
 
 
-def _validate_step_anatomy(v):
-    """The step-anatomy receipt (bench_serving.py run_anatomy_leg ->
-    BENCH_STEP_ANATOMY.json, scripts/step_anatomy.py, docs/OBSERVABILITY.md
-    "Step anatomy"): per-step host segments + device compute + host gap
-    must TILE each step's wall time within 1e-6 — re-verified HERE from
-    the committed per-step table, not trusted from the summary — with
-    ZERO steady-state recompiles after the declared warm-up boundary (the
-    AOT roadmap item's regression guard), a host-gap fraction reported
-    for every (path, batch, chunk) bucket, and the whole leg
-    byte-identical when repeated."""
-    if not isinstance(v, dict):
-        return f"expected step-anatomy object, got {type(v).__name__}"
-    for k in ("metric", "value", "unit", "schema_version", "workload",
-              "steady_state_recompiles", "determinism_repeat_identical",
-              "report", "anatomy", "kv"):
-        if k not in v:
-            return f"missing step-anatomy key {k!r}"
-    if v["schema_version"] != 1:
-        return f"schema_version {v['schema_version']} != 1"
-    # byte-identical regeneration is a VIRTUAL-clock property: wall-clock
-    # receipts carry real timings that legitimately differ across runs
-    # (the tiling + recompile bars below still bind them)
-    if (v["workload"] or {}).get("virtual_clock") \
-            and v["determinism_repeat_identical"] is not True:
-        return "virtual-clock anatomy leg not byte-identical across runs"
-    if v["steady_state_recompiles"] != 0:
-        return (f"{v['steady_state_recompiles']} steady-state recompile(s) "
-                "after the warm-up boundary — the bucketed step set is not "
-                "closed (the AOT regression guard this receipt exists for)")
-    anatomy = v["anatomy"]
+def _validate_anatomy_leg(leg, name):
+    """One serial/pipelined leg of the step-anatomy receipt: tiling
+    re-verified from the committed per-step table (not trusted from the
+    summary), ZERO steady-state recompiles, the compile log agreeing with
+    the declared counter, and a host-gap fraction for every bucket."""
+    if not isinstance(leg, dict):
+        return f"legs.{name}: expected object, got {type(leg).__name__}"
+    for k in ("steady_state_recompiles", "serving", "kv", "report",
+              "anatomy"):
+        if k not in leg:
+            return f"legs.{name}: missing key {k!r}"
+    if leg["steady_state_recompiles"] != 0:
+        return (f"legs.{name}: {leg['steady_state_recompiles']} steady-state "
+                "recompile(s) after the warm-up boundary — the AOT step set "
+                "is not closed (the regression guard this receipt exists for)")
+    anatomy = leg["anatomy"]
     steps = anatomy.get("steps") if isinstance(anatomy, dict) else None
     if not isinstance(steps, list) or not steps:
-        return "anatomy record carries no per-step table"
+        return f"legs.{name}: anatomy record carries no per-step table"
     # re-verify the tiling from the committed table itself: a summary that
     # CLAIMS tiling over a table that breaks it is exactly the drift this
     # checker exists for.  The acceptance bar is 1e-6, full stop; the
@@ -485,33 +472,118 @@ def _validate_step_anatomy(v):
         segs = row.get("segments") or {}
         missing = [s for s in _ANATOMY_SEGMENTS if s not in segs]
         if missing:
-            return f"anatomy.steps[{i}]: missing segment(s) {missing}"
+            return f"legs.{name}.anatomy.steps[{i}]: missing segment(s) {missing}"
         resid = row.get("wall_s", 0.0) - (row.get("host_gap_s", 0.0)
                                           + sum(segs[s] for s in _ANATOMY_SEGMENTS)
                                           + row.get("device_s", 0.0))
         if abs(resid) > 1e-6 + pad:
-            return (f"anatomy.steps[{i}] ({row.get('shape')}): components "
-                    f"do not tile wall_s (residual {resid:g})")
-    # the compile log must agree with the declared counter
+            return (f"legs.{name}.anatomy.steps[{i}] ({row.get('shape')}): "
+                    f"components do not tile wall_s (residual {resid:g})")
+    # the compile log must agree with the declared counter; deliberate AOT
+    # warm-up compiles (aot=true) are never steady-state entries
     steady = [c for c in (anatomy.get("compiles") or []) if c.get("steady")]
-    if len(steady) != v["steady_state_recompiles"]:
-        return (f"compile log records {len(steady)} steady entr(ies) but "
-                f"the receipt declares {v['steady_state_recompiles']}")
-    shapes = (v["report"] or {}).get("by_shape")
+    if len(steady) != leg["steady_state_recompiles"]:
+        return (f"legs.{name}: compile log records {len(steady)} steady "
+                f"entr(ies) but declares {leg['steady_state_recompiles']}")
+    if any(c.get("steady") and c.get("aot")
+           for c in (anatomy.get("compiles") or [])):
+        return (f"legs.{name}: compile log tags an AOT warm-up compile as a "
+                "steady-state recompile — the recorder contract broke")
+    shapes = (leg["report"] or {}).get("by_shape")
     if not isinstance(shapes, dict) or not shapes:
-        return "report carries no per-bucket (by_shape) fold"
+        return f"legs.{name}: report carries no per-bucket (by_shape) fold"
     for key, agg in shapes.items():
         frac = agg.get("host_gap_fraction")
         if frac is None and agg.get("wall_s", 0.0) > 0:
-            return f"by_shape[{key!r}]: no host_gap_fraction despite wall time"
+            return (f"legs.{name}.by_shape[{key!r}]: no host_gap_fraction "
+                    "despite wall time")
         if frac is not None and not (isinstance(frac, (int, float))
                                      and not isinstance(frac, bool)
                                      and 0.0 <= frac <= 1.0):
-            return f"by_shape[{key!r}]: host_gap_fraction {frac!r} not in [0, 1]"
-    rep_ver = (v["report"] or {}).get("verification") or {}
+            return (f"legs.{name}.by_shape[{key!r}]: host_gap_fraction "
+                    f"{frac!r} not in [0, 1]")
+    rep_ver = (leg["report"] or {}).get("verification") or {}
     if rep_ver.get("mismatches", 1) != 0:
-        return (f"report verification recorded {rep_ver.get('mismatches')} "
-                "mismatch(es) — the committed receipt must tile")
+        return (f"legs.{name}: report verification recorded "
+                f"{rep_ver.get('mismatches')} mismatch(es) — the committed "
+                "receipt must tile")
+    return None
+
+
+def _gap_fraction(leg):
+    frac = ((leg.get("report") or {}).get("totals") or {}) \
+        .get("host_gap_fraction")
+    return frac if isinstance(frac, (int, float)) \
+        and not isinstance(frac, bool) else None
+
+
+def _validate_step_anatomy(v):
+    """The step-anatomy receipt (bench_serving.py run_anatomy_leg ->
+    BENCH_STEP_ANATOMY.json, scripts/step_anatomy.py, docs/OBSERVABILITY.md
+    "Step anatomy"), schema v2: the SAME workload served twice — the
+    strictly serial tick loop and the async double-buffered one — each leg
+    re-verified for tiling and ZERO steady-state recompiles (the AOT step
+    set must be closed in BOTH modes), greedy token streams byte-identical
+    between the legs (per request, asserted by the producer and declared
+    here), pipelined host-gap fraction no worse than serial, and — when a
+    wall-clock comparison section is present — pipelined host-gap fraction
+    STRICTLY below serial at equal goodput (the loop tax the async
+    dispatch exists to hide under device time)."""
+    if not isinstance(v, dict):
+        return f"expected step-anatomy object, got {type(v).__name__}"
+    for k in ("metric", "value", "unit", "schema_version", "workload",
+              "greedy_parity", "determinism_repeat_identical", "legs",
+              "wall"):
+        if k not in v:
+            return f"missing step-anatomy key {k!r}"
+    if v["schema_version"] != 2:
+        return f"schema_version {v['schema_version']} != 2"
+    if v["greedy_parity"] is not True:
+        return ("greedy_parity is not true — the pipelined loop's token "
+                "streams diverged from the serial loop's")
+    # byte-identical regeneration is a VIRTUAL-clock property: wall-clock
+    # receipts carry real timings that legitimately differ across runs
+    # (the tiling + recompile bars below still bind them)
+    if (v["workload"] or {}).get("virtual_clock") \
+            and v["determinism_repeat_identical"] is not True:
+        return "virtual-clock anatomy legs not byte-identical across runs"
+    legs = v["legs"]
+    if not isinstance(legs, dict):
+        return f"legs: expected object, got {type(legs).__name__}"
+    for name in ("serial", "pipelined"):
+        if name not in legs:
+            return f"legs: missing leg {name!r}"
+        err = _validate_anatomy_leg(legs[name], name)
+        if err:
+            return err
+    g_serial, g_pipe = _gap_fraction(legs["serial"]), \
+        _gap_fraction(legs["pipelined"])
+    if g_serial is not None and g_pipe is not None and g_pipe > g_serial:
+        return (f"pipelined host_gap_fraction {g_pipe} > serial {g_serial} "
+                "— async dispatch made the loop tax WORSE")
+    wall = v["wall"]
+    if wall is not None:
+        # the wall-clock after-leg: real timings, so numbers vary across
+        # runs — but the ordering is the receipt.  Strictly below, at
+        # equal goodput (same completion counts): hiding host work under
+        # device time by shedding load would not be a win.
+        if not isinstance(wall, dict):
+            return f"wall: expected object or null, got {type(wall).__name__}"
+        for k in ("serial_host_gap_fraction", "pipelined_host_gap_fraction",
+                  "serial_completed", "pipelined_completed"):
+            if not isinstance(wall.get(k), (int, float)) \
+                    or isinstance(wall.get(k), bool):
+                return f"wall.{k} is not a number ({wall.get(k)!r})"
+        if not wall["pipelined_host_gap_fraction"] \
+                < wall["serial_host_gap_fraction"]:
+            return (f"wall-clock pipelined host_gap_fraction "
+                    f"{wall['pipelined_host_gap_fraction']} not strictly "
+                    f"below serial {wall['serial_host_gap_fraction']}")
+        if wall["pipelined_completed"] != wall["serial_completed"]:
+            return (f"wall-clock legs completed different request counts "
+                    f"(serial {wall['serial_completed']} vs pipelined "
+                    f"{wall['pipelined_completed']}) — not an equal-goodput "
+                    "comparison")
     return None
 
 

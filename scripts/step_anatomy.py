@@ -39,14 +39,24 @@ import sys
 
 #: must mirror telemetry/step_anatomy.py HOST_SEGMENTS — the fixed
 #: per-step segment vocabulary (a committed row missing one is drift)
-HOST_SEGMENTS = ("schedule", "draft_plan", "verify_plan", "compile_wait",
-                 "dispatch", "sample_accept", "bookkeeping")
+HOST_SEGMENTS = ("schedule", "draft_plan", "verify_plan", "aot_compile",
+                 "compile_wait", "dispatch", "sample_accept", "overlap",
+                 "bookkeeping")
 
 
 def _anatomy_of(doc):
-    """Accept a raw recorder doc or a bench receipt wrapping one."""
-    if isinstance(doc, dict) and isinstance(doc.get("anatomy"), dict):
-        return doc["anatomy"]
+    """Accept a raw recorder doc or a bench receipt wrapping one.  A
+    schema-v2 receipt carries TWO legs (serial / pipelined); the fold
+    reads the pipelined one — the headline the receipt's ``value`` quotes
+    (fold a specific leg by passing its ``anatomy`` sub-document)."""
+    if isinstance(doc, dict):
+        legs = doc.get("legs")
+        if isinstance(legs, dict):
+            leg = legs.get("pipelined") or legs.get("serial") or {}
+            if isinstance(leg.get("anatomy"), dict):
+                return leg["anatomy"]
+        if isinstance(doc.get("anatomy"), dict):
+            return doc["anatomy"]
     return doc
 
 

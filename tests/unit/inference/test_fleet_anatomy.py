@@ -188,7 +188,7 @@ def test_pool_anatomy_per_replica_and_fleet_gauges(trained_params):
     # steady boundary: pool-level declaration marks every live recorder
     pool.mark_anatomy_steady()
     assert all(pool.anatomy(rid).steady for rid in pool.rids)
-    # a recovered replica starts un-steady (its compiles are recovery)
+    # recovery: the replacement is AOT-warmed and steady before dispatch
     router.kill_replica(0)
     # a dead replica's kv/anatomy gauges read ZERO, not their pre-death
     # samples frozen forever (same stance as fleet/replica_*)
@@ -196,5 +196,11 @@ def test_pool_anatomy_per_replica_and_fleet_gauges(trained_params):
     assert metrics.gauge("kv/page_occupancy/0").value == 0.0
     assert metrics.gauge("anatomy/host_gap_fraction/0").value == 0.0
     router.recover_replica(0)
-    assert pool.anatomy(0) is not None and not pool.anatomy(0).steady
+    # the replacement re-enters dispatch pre-compiled (warm_all) and
+    # already steady: its compile log holds only deliberate AOT entries,
+    # and none of them count as steady-state recompiles
+    anat0 = pool.anatomy(0)
+    assert anat0 is not None and anat0.steady
+    assert anat0.compiles and all(c.aot for c in anat0.compiles)
+    assert anat0.steady_state_recompiles == 0
     assert pool.anatomy(1).steady

@@ -310,8 +310,10 @@ def test_cli_byte_identical_and_sabotage_exit1(tmp_path):
 
 
 def test_schema_validator_catches_anatomy_drift(tmp_path):
-    """BENCH_STEP_ANATOMY.json is schema-enforced: the committed artifact
-    passes, a planted tiling break / steady recompile / determinism flag
+    """BENCH_STEP_ANATOMY.json (schema v2, serial + pipelined legs) is
+    schema-enforced: the committed artifact passes; a planted tiling
+    break, steady recompile, parity break, determinism flag, or a wall
+    comparison where pipelining did not strictly shrink the host gap
     fails."""
     spec = importlib.util.spec_from_file_location(
         "check_bench_schema", os.path.join(REPO_ROOT, "scripts",
@@ -330,14 +332,28 @@ def test_schema_validator_catches_anatomy_drift(tmp_path):
 
     assert not errors_for(good)
     bad = json.loads(json.dumps(good))
-    bad["anatomy"]["steps"][0]["device_s"] += 1.0
+    bad["legs"]["serial"]["anatomy"]["steps"][0]["device_s"] += 1.0
     assert any("tile" in e for e in errors_for(bad))
     bad = json.loads(json.dumps(good))
-    bad["steady_state_recompiles"] = 2
+    bad["legs"]["pipelined"]["steady_state_recompiles"] = 2
     assert any("steady-state" in e for e in errors_for(bad))
     bad = json.loads(json.dumps(good))
     bad["determinism_repeat_identical"] = False
     assert any("byte-identical" in e for e in errors_for(bad))
+    bad = json.loads(json.dumps(good))
+    bad["greedy_parity"] = False
+    assert any("greedy" in e for e in errors_for(bad))
+    bad = json.loads(json.dumps(good))
+    bad["wall"]["pipelined_host_gap_fraction"] = \
+        bad["wall"]["serial_host_gap_fraction"]
+    assert any("strictly" in e for e in errors_for(bad))
+    # an AOT warm-up compile mislabeled as a steady-state recompile
+    bad = json.loads(json.dumps(good))
+    aot_rows = [c for c in bad["legs"]["serial"]["anatomy"]["compiles"]
+                if c["aot"]]
+    assert aot_rows, "committed artifact carries no AOT compile entries"
+    aot_rows[0]["steady"] = True
+    assert any(e for e in errors_for(bad))
 
 
 # ------------------------------- anatomy phases in the report tooling
