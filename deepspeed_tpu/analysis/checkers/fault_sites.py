@@ -19,7 +19,7 @@ from typing import Dict, List, Tuple
 
 from ..core import Checker, FileContext, Runner
 
-_SITE_RE = re.compile(r"^[a-z0-9_]+\.[a-z0-9_]+$")
+_SITE_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
 _PROBE_FUNCS = ("check", "writer_fault")
 
 

@@ -93,6 +93,8 @@ INJECTION_SITES = frozenset({
     "prefix.import",        # hot-prefix KV h2d adoption (serving/kvtransfer/snapshot.py)
     "transport.send",       # control-plane message send edge (serving/fleet/transport.py)
     "transport.deliver",    # control-plane message delivery edge (serving/fleet/transport.py)
+    "lifecycle.cmd.send",   # router lifecycle-command send edge (serving/fleet/router.py)
+    "lifecycle.cmd.apply",  # replica-side lifecycle-command apply edge (serving/fleet/router.py)
 })
 
 _RAISING_KINDS = ("os_error", "crash", "device_loss", "latency")

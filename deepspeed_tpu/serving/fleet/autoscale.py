@@ -8,17 +8,31 @@ already exposes (per-replica ``load_stats()``, fleet queue depth, a TTFT
 EWMA folded from completions) and acts through the EXISTING replica
 lifecycle, so no new failure modes are invented:
 
-* **scale up** — ``pool.recover(rid)`` on a parked (DEAD) replica: the
-  fresh engine warms through the RECOVERING probe path before it takes
-  dispatches, exactly like a replacement host joining;
-* **scale down** — ``pool.drain(rid)`` then, only once the replica is
-  IDLE, ``pool.kill`` parks it.  In-flight work is NEVER killed by a
-  scale decision; a device loss *during* the drain fails the victims
-  over through the ordinary recompute-on-resume path with byte-identical
-  outputs (chaos-tested).
+* **scale up** — a ``recover`` lifecycle command on a parked (DEAD)
+  replica: the fresh engine warms through the RECOVERING probe path
+  before it takes dispatches, exactly like a replacement host joining;
+* **scale down** — a ``drain`` command then, only once the replica is
+  IDLE, a ``park``.  In-flight work is NEVER killed by a scale decision;
+  a device loss *during* the drain fails the victims over through the
+  ordinary recompute-on-resume path with byte-identical outputs
+  (chaos-tested).
 * **hysteresis + cooldown** — separate up/down thresholds, a consecutive
   low-streak requirement, and per-direction cooldowns, so the fleet does
   not flap between sizes on a noisy boundary.
+* **predictive scale-up** (``predictive=True``) — provision from the
+  demand FORECAST: the router's arrival-rate EWMA projected along its
+  slope to the replica warm-up horizon, plus premium-tenant SLO
+  fast-burn; the reactive thresholds above stay armed as the safety net.
+* **role-aware rebalancing** (``role_aware=True``) — when one serving
+  phase's pressure dwarfs the other's, drain one replica of the
+  over-provisioned phase and re-role it toward the starved one
+  (MIXED <-> PREFILL/DECODE), through the same drain-gated path.
+
+Every mutation flows through ``Router.lifecycle_command``: the direct
+pool calls without a control transport (byte-identical to the pre-r21
+autoscaler), typed + seq-numbered + epoch-fenced + retried-until-acked
+``lifecycle_cmd`` messages under one — a partitioned or fenced replica
+can never act on (or double-apply) a stale scale decision.
 
 Alongside it the :class:`OverloadController` runs the graceful-
 degradation ladder: when shedding capacity is not enough, the fleet
@@ -46,6 +60,7 @@ from typing import Dict, List, Optional, Tuple
 from ...resilience import fault_injection as _fi
 from ...utils.logging import logger
 from .health import ReplicaState
+from .pool import ReplicaRole
 from .tenancy import TenantSpec
 
 # ---------------------------------------------------------------- overload
@@ -228,6 +243,28 @@ class AutoscaleConfig:
     decide_interval: float = 1.0
     #: TTFT EWMA smoothing (weight of each new completion)
     ewma_alpha: float = 0.3
+    #: provision from the demand FORECAST — the router's arrival-rate
+    #: EWMA projected along its slope to ``warmup_horizon``, plus
+    #: premium-tenant SLO fast-burn — instead of waiting for queue/TTFT
+    #: pressure to confirm the crowd already arrived (reactive thresholds
+    #: stay armed underneath as the safety net)
+    predictive: bool = False
+    #: seconds a recovered replica needs before it takes dispatches — the
+    #: horizon the demand forecast is projected to: capacity ordered NOW
+    #: is only useful against the demand arriving THEN
+    warmup_horizon: float = 4.0
+    #: requests/second one dispatchable replica absorbs inside SLO — the
+    #: capacity yardstick the forecast is compared against
+    per_replica_rate: float = 1.0
+    #: reassign replica roles (MIXED <-> PREFILL/DECODE) from phase
+    #: imbalance, drain-gated so no in-flight work is lost
+    role_aware: bool = False
+    #: the starved phase's per-capable-replica pressure must exceed the
+    #: other phase's by this factor before a role moves (hysteresis)
+    role_imbalance: float = 1.5
+    #: min time between role reassignments (a role change costs a drain
+    #: plus a restart — it must not flap)
+    role_cooldown: float = 8.0
 
     def __post_init__(self):
         if self.min_replicas < 1:
@@ -235,6 +272,15 @@ class AutoscaleConfig:
         if not self.queue_lo < self.queue_hi:
             raise ValueError(f"autoscale hysteresis needs queue_lo < queue_hi "
                              f"(got {self.queue_lo}, {self.queue_hi})")
+        if self.warmup_horizon < 0:
+            raise ValueError(f"warmup_horizon must be >= 0, "
+                             f"got {self.warmup_horizon}")
+        if not self.per_replica_rate > 0:
+            raise ValueError(f"per_replica_rate must be > 0, "
+                             f"got {self.per_replica_rate}")
+        if not self.role_imbalance > 1.0:
+            raise ValueError(f"role_imbalance must be > 1.0 (a factor), "
+                             f"got {self.role_imbalance}")
 
 
 class Autoscaler:
@@ -275,6 +321,7 @@ class Autoscaler:
         self._last_eval: Optional[float] = None
         self._last_up: Optional[float] = None
         self._last_down: Optional[float] = None
+        self._last_role: Optional[float] = None
         self._low_streak = 0
 
     # ----------------------------------------------------------- telemetry
@@ -383,11 +430,18 @@ class Autoscaler:
         self._evaluate(now, sig)
 
     def _advance_drain(self, now: float) -> None:
-        """Progress an in-flight scale-down: park (or restart) the drained
-        replica once — and only once — it is idle.  Runs every step, not
-        just on decide ticks, so a drain never outlives its work."""
+        """Progress an in-flight scale-down (or role change): park /
+        restart / re-role the drained replica once — and only once — it
+        is idle.  Runs every step, not just on decide ticks, so a drain
+        never outlives its work.  Every mutation goes through
+        ``Router.lifecycle_command`` — the direct pool calls without a
+        transport, typed+retried+epoch-fenced commands under one."""
         rid = self._draining
         if rid is None:
+            return
+        if self.router.lifecycle_pending(rid, "drain"):
+            # the drain COMMAND is still in flight on the fabric: the pool
+            # state has not moved yet and must not read as an abort
             return
         state = self.pool.health.state(rid)
         if state is not ReplicaState.DRAINING:
@@ -396,22 +450,28 @@ class Autoscaler:
             self._decide(now, "drain_aborted", rid, f"state {state.value}")
             self._draining, self._drain_mode = None, None
             return
-        if not self.pool.is_idle(rid):
+        if not self.router.replica_idle(rid):
             return
         mode = self._drain_mode
         self._draining, self._drain_mode = None, None
         if mode == "restart":
             # scale-up arrived mid-drain: give the replica straight back
             # through the rolling-restart path instead of parking it
-            self.pool.restart(rid)
-            self.router.warmup_replica(rid)
+            self.router.lifecycle_command(rid, "restart", now=now)
             self._decide(now, "drain_cancelled", rid, "scale-up during drain")
             self._emit_event("fleet/scale_up", float(rid))
             self._last_up = now
             return
-        victims = self.pool.kill(rid, reason="autoscale: scale-down (drained)")
-        assert not victims, \
-            f"scale-down parked replica {rid} with in-flight work: {victims}"
+        if mode is not None and mode.startswith("role:"):
+            role = mode.split(":", 1)[1]
+            self.router.lifecycle_command(rid, "role_change",
+                                          {"role": role}, now=now)
+            self._decide(now, "role_change", rid,
+                         f"drained idle; role -> {role}")
+            return
+        self.router.lifecycle_command(
+            rid, "park", {"reason": "autoscale: scale-down (drained)"},
+            now=now)
         self._decide(now, "down", rid, "drained idle; parked")
         self._emit_event("fleet/scale_down", float(rid))
 
@@ -422,24 +482,35 @@ class Autoscaler:
         n_disp = len(sig["dispatchable"])
         ceiling = cfg.max_replicas if cfg.max_replicas is not None \
             else len(pool.replicas)
+        # a DEAD replica with a lifecycle command still in flight is
+        # already being acted on — issuing a second mutation would race it
         dead = [r for r in pool.rids
-                if pool.health.state(r) is ReplicaState.DEAD]
+                if pool.health.state(r) is ReplicaState.DEAD
+                and not self.router.lifecycle_pending(r)]
         # availability floor first: below min_replicas we provision
         # unconditionally (no cooldown — this is repair, not reaction)
         if n_prov < cfg.min_replicas and dead:
             rid = dead[0]
             # via the router: a prefix directory pre-imports its hottest
             # chains while the replica is still RECOVERING (warm join)
-            self.router.recover_replica(rid)
+            self.router.lifecycle_command(rid, "recover", now=now)
             self._decide(now, "up", rid, f"below min_replicas ({n_prov} < "
                          f"{cfg.min_replicas})")
             self._emit_event("fleet/scale_up", float(rid))
             self._last_up = now
             self._low_streak = 0
             return
+        if cfg.role_aware and self._draining is None \
+                and self._maybe_rebalance_roles(now, sig):
+            return
         work = sig["queued"] + sig["outstanding"]
         kv_starved = sig["free_kv_pages"] == 0 and sig["queued"] > 0
-        want_up = work > 0 and (sig["pressure"] >= 1.0 or kv_starved)
+        reactive_up = work > 0 and (sig["pressure"] >= 1.0 or kv_starved)
+        predict_up, predict_reason, projected = False, "", 0.0
+        if cfg.predictive:
+            predict_up, predict_reason, projected = \
+                self._predict_demand(now, sig)
+        want_up = reactive_up or predict_up
         if want_up:
             self._low_streak = 0
             if self._last_up is not None and now - self._last_up < cfg.cooldown_up:
@@ -454,16 +525,23 @@ class Autoscaler:
                 return
             if dead and n_prov < ceiling:
                 rid = dead[0]
-                self.router.recover_replica(rid)
-                self._decide(now, "up", rid,
-                             f"pressure {sig['pressure']:.3f}"
-                             + (" (kv starved)" if kv_starved else ""))
+                self.router.lifecycle_command(rid, "recover", now=now)
+                reason = predict_reason if (predict_up and not reactive_up) \
+                    else (f"pressure {sig['pressure']:.3f}"
+                          + (" (kv starved)" if kv_starved else ""))
+                self._decide(now, "up", rid, reason)
                 self._emit_event("fleet/scale_up", float(rid))
                 self._last_up = now
             return
         low = sig["outstanding"] <= cfg.queue_lo * max(1, n_disp) \
             and sig["queued"] == 0
         if not low:
+            self._low_streak = 0
+            return
+        if cfg.predictive and \
+                projected > max(0, n_disp - 1) * cfg.per_replica_rate:
+            # the queue is momentarily empty but the FORECAST still needs
+            # today's capacity: do not start shrinking into a ramp
             self._low_streak = 0
             return
         self._low_streak += 1
@@ -473,13 +551,97 @@ class Autoscaler:
         if self._last_down is not None and now - self._last_down < cfg.cooldown_down:
             return
         rid = sig["dispatchable"][-1]
-        pool.drain(rid)
+        self.router.lifecycle_command(rid, "drain", now=now)
         self._draining, self._drain_mode = rid, "park"
         self._decide(now, "drain", rid,
                      f"low occupancy x{self._low_streak}")
         self._emit_event("fleet/scale_drain", float(rid))
         self._last_down = now
         self._low_streak = 0
+
+    def _predict_demand(self, now: float,
+                        sig: dict) -> Tuple[bool, str, float]:
+        """The predictive loop's forecast: project the arrival-rate EWMA
+        along its slope to the warm-up horizon (capacity ordered NOW only
+        serves demand arriving THEN) and compare against dispatchable
+        capacity; independently, a premium tenant burning its SLO error
+        budget at >= 1x on the fast window is demand the rate fold has
+        not caught up to yet.  Returns ``(scale_up, reason, projected)``;
+        the projected rate also guards scale-DOWN during a ramp."""
+        cfg = self.config
+        rate, slope = self.router.arrival_rate()
+        projected = max(0.0, rate + slope * cfg.warmup_horizon)
+        capacity = len(sig["dispatchable"]) * cfg.per_replica_rate
+        if projected > capacity:
+            return True, (f"projected {projected:.3f} req/s > capacity "
+                          f"{capacity:.3f} at +{cfg.warmup_horizon:g}s"), \
+                projected
+        slo = getattr(self.router, "slo", None)
+        if slo is not None:
+            for name in self.router.tenants.names():
+                spec = self.router.tenants.spec(name)
+                if spec.ttft_slo is None or spec.best_effort:
+                    continue
+                fast, _slow = slo.burn_rates(name, now)
+                if fast >= 1.0:
+                    return True, (f"tenant {name!r} fast burn rate "
+                                  f"{fast:.3f} >= 1.0"), projected
+        return False, "", projected
+
+    def _maybe_rebalance_roles(self, now: float, sig: dict) -> bool:
+        """Phase-aware role reassignment (docs/SERVING.md "Disaggregated
+        serving"): when one phase's per-capable-replica pressure dwarfs
+        the other's by ``role_imbalance``, drain one replica of the
+        over-provisioned phase and re-role it toward the starved one.
+        The change rides the ordinary drain -> restart path, so no
+        in-flight work is ever lost to a role decision.  Returns True
+        when a role drain was started (the evaluation stops there: a
+        role move IS this tick's decision)."""
+        cfg = self.config
+        if self._last_role is not None and \
+                now - self._last_role < cfg.role_cooldown:
+            return False
+        disp = sig["dispatchable"]
+        if len(disp) < 2:
+            return False
+        stats = self.router.fleet_load_stats()
+        roles = {r: self.pool.replica(r).role for r in disp}
+        prefill_caps = [r for r in disp if roles[r] is not ReplicaRole.DECODE]
+        decode_caps = [r for r in disp if roles[r] is not ReplicaRole.PREFILL]
+        prefill_demand = self.router.queue_depth + sum(
+            stats[r]["queue_depth"] for r in prefill_caps if r in stats)
+        decode_demand = sum(
+            stats[r]["active"] for r in decode_caps if r in stats)
+        p_press = prefill_demand / max(1, len(prefill_caps))
+        d_press = decode_demand / max(1, len(decode_caps))
+        rid, role = None, ""
+        if p_press >= cfg.role_imbalance * max(d_press, 1e-9) and p_press > 0:
+            # prefill starved: a pure-DECODE replica broadens to MIXED
+            # (never below one decode-capable replica — migrated KV must
+            # always have somewhere to land)
+            pure_decode = [r for r in disp if roles[r] is ReplicaRole.DECODE]
+            if pure_decode and len(decode_caps) > 1:
+                rid, role = pure_decode[-1], "mixed"
+        elif d_press >= cfg.role_imbalance * max(p_press, 1e-9) and d_press > 0:
+            # decode starved: narrow a pure-PREFILL to MIXED first; with
+            # no pure prefill left, specialize a MIXED toward DECODE —
+            # only while another prefill-capable replica remains to admit
+            pure_prefill = [r for r in disp if roles[r] is ReplicaRole.PREFILL]
+            if pure_prefill and len(prefill_caps) > 1:
+                rid, role = pure_prefill[-1], "mixed"
+            else:
+                mixed = [r for r in disp if roles[r] is ReplicaRole.MIXED]
+                if mixed and len(prefill_caps) > 1:
+                    rid, role = mixed[-1], "decode"
+        if rid is None:
+            return False
+        self.router.lifecycle_command(rid, "drain", now=now)
+        self._draining, self._drain_mode = rid, f"role:{role}"
+        self._decide(now, "role_drain", rid,
+                     f"phase imbalance prefill {p_press:.3f} vs decode "
+                     f"{d_press:.3f}; role -> {role}")
+        self._last_role = now
+        return True
 
     # ------------------------------------------------------------- surface
 

@@ -79,6 +79,35 @@ class LeaseConfig:
     lease: float = 6.0
     #: minimum clock time between FENCE (re)sends to an unacked zombie
     fence_retry: float = 2.0
+    #: ADAPTIVE LEASE SIZING (r21, docs/SERVING.md "Closed-loop control").
+    #: Off by default: the fixed constants above hold and behavior is
+    #: byte-identical to r20.  When on, each replica's effective
+    #: suspect_after/lease is the base value times a per-replica scale in
+    #: [1, max_scale], derived from the heartbeat interarrival EWMA, the
+    #: per-link ``transport/link_loss_ewma``, and the standing
+    #: ``transport/feed_gap_age`` — a fleet whose steps legitimately slow
+    #: widens its leases instead of fencing healthy replicas, and
+    #: tightens back when the links recover.
+    adaptive: bool = False
+    #: ceiling of the per-replica scale band: the effective lease never
+    #: exceeds ``lease * max_scale`` — a real death is still detected
+    #: within a bounded window (the BENCH receipt pins this)
+    max_scale: float = 4.0
+    #: silence tolerance in heartbeat interarrivals: the effective
+    #: suspect_after targets ``miss_budget`` consecutive missed beats
+    #: (loss-inflated: /(1 - link_loss_ewma)) before suspecting
+    miss_budget: float = 3.0
+    #: EWMA alpha for the heartbeat interarrival estimate
+    interarrival_alpha: float = 0.3
+    #: weight of the standing directory-feed gap age in the target
+    #: (a stalling feed is fabric delay evidence, not death evidence)
+    feed_gap_weight: float = 0.5
+    #: hysteresis deadband: widen only when the target scale exceeds the
+    #: current by this fraction ...
+    widen_frac: float = 0.1
+    #: ... and tighten only when it falls below by this (tighten > widen:
+    #: widening is the false-fence guard, so it reacts faster)
+    tighten_frac: float = 0.25
 
     def __post_init__(self):
         if not 0 < self.suspect_after < self.lease:
@@ -86,6 +115,19 @@ class LeaseConfig:
                              f"(got {self.suspect_after}, {self.lease})")
         if self.fence_retry <= 0:
             raise ValueError(f"fence_retry must be > 0, got {self.fence_retry}")
+        if self.max_scale < 1.0:
+            raise ValueError(f"max_scale must be >= 1, got {self.max_scale}")
+        if self.miss_budget <= 0:
+            raise ValueError(f"miss_budget must be > 0, got {self.miss_budget}")
+        if not 0.0 < self.interarrival_alpha <= 1.0:
+            raise ValueError(f"interarrival_alpha must be in (0, 1], got "
+                             f"{self.interarrival_alpha}")
+        if self.feed_gap_weight < 0:
+            raise ValueError(f"feed_gap_weight must be >= 0, got "
+                             f"{self.feed_gap_weight}")
+        if self.widen_frac < 0 or not 0.0 <= self.tighten_frac < 1.0:
+            raise ValueError(f"hysteresis fracs out of range (widen "
+                             f"{self.widen_frac}, tighten {self.tighten_frac})")
 
 
 class FleetHealthView:
@@ -136,6 +178,20 @@ class FleetHealthView:
         self.epoch: Dict[int, int] = {r: 0 for r in rids}
         #: (rid, from, to, ts, reason) — the auditable lease timeline
         self.history: List[Tuple[int, LeaseState, LeaseState, float, str]] = []
+        # --- adaptive lease sizing state (inert while config.adaptive is
+        # off: every scale stays 1.0 and the fixed constants hold) ---
+        #: per-replica lease scale in [1, max_scale]; effective
+        #: suspect_after/lease are the base values times this
+        self._scale: Dict[int, float] = {r: 1.0 for r in rids}
+        #: heartbeat interarrival EWMA (send-timestamp gaps; None until
+        #: the first gap is observed)
+        self._hb_gap_ewma: Dict[int, Optional[float]] = {r: None for r in rids}
+        #: freshest router-fed link-quality signals (note_link_quality)
+        self._link_loss: Dict[int, float] = {r: 0.0 for r in rids}
+        self._feed_gap_age: Dict[int, float] = {r: 0.0 for r in rids}
+        #: (rid, ts, old_scale, new_scale, direction) — the auditable
+        #: resize timeline behind every ``fleet/lease_resize`` event
+        self.resizes: List[Tuple[int, float, float, float, str]] = []
 
     # ------------------------------------------------------------- queries
 
@@ -166,6 +222,71 @@ class FleetHealthView:
         """Newest self-reported engine generation (None before any
         heartbeat)."""
         return self._generation[rid]
+
+    def effective_lease(self, rid: int) -> Tuple[float, float]:
+        """``(suspect_after, lease)`` currently in force for ``rid`` —
+        the configured base times the replica's adaptive scale (exactly
+        the base values while the scale sits at 1.0, so the static
+        configuration stays byte-identical)."""
+        s = self._scale[rid]
+        if s == 1.0:
+            return self.config.suspect_after, self.config.lease
+        return (round(self.config.suspect_after * s, 9),
+                round(self.config.lease * s, 9))
+
+    # ------------------------------------------------- adaptive lease sizing
+
+    def note_link_quality(self, rid: int, loss_ewma: float,
+                          feed_gap_age: float, now: float) -> None:
+        """Fold the router's per-link fabric evidence — the r18
+        ``transport/link_loss_ewma`` and ``transport/feed_gap_age``
+        signals — and re-derive the replica's lease scale.  No-op unless
+        ``config.adaptive``; called once per control round from
+        ``Router.transport_poll`` in sorted-rid order, so the resize
+        timeline is deterministic."""
+        if not self.config.adaptive:
+            return
+        self._link_loss[rid] = loss_ewma
+        self._feed_gap_age[rid] = feed_gap_age
+        self._resize(rid, now)
+
+    def _resize(self, rid: int, now: float) -> None:
+        """Recompute ``rid``'s lease scale from the closed-loop inputs,
+        with hysteresis (widen fast — it is the false-fence guard —
+        tighten slow) and the [1, max_scale] clamp that keeps real-death
+        detection bounded.  Every applied adjustment is an auditable
+        ``fleet/lease_resize`` event."""
+        cfg = self.config
+        gap = self._hb_gap_ewma[rid]
+        if gap is None:
+            return  # no interarrival evidence yet: the configured base holds
+        # target silence tolerance: miss_budget interarrivals, inflated by
+        # the link's observed loss (p lost => 1/(1-p) expected sends per
+        # arrival), plus the standing feed gap (fabric delay, not death)
+        loss = min(self._link_loss[rid], 0.75)
+        target_suspect = cfg.miss_budget * gap / (1.0 - loss) \
+            + cfg.feed_gap_weight * self._feed_gap_age[rid]
+        target = min(max(target_suspect / cfg.suspect_after, 1.0),
+                     cfg.max_scale)
+        target = round(target, 9)
+        cur = self._scale[rid]
+        if target > cur * (1.0 + cfg.widen_frac):
+            direction = "widen"
+        elif target < cur * (1.0 - cfg.tighten_frac):
+            direction = "tighten"
+        else:
+            return  # inside the hysteresis deadband: hold
+        self._scale[rid] = target
+        ts = round(now, 9)
+        self.resizes.append((rid, ts, cur, target, direction))
+        self._emit("fleet/lease_resize", float(rid))
+        if self.recorder is not None:
+            self.recorder.instant(
+                "ctrl/lease_resize", f"ctrl/lease/replica/{rid}", now,
+                attrs={"direction": direction, "scale": target,
+                       "gap_ewma": round(gap, 9), "loss": round(loss, 9)})
+        logger.info(f"fleet lease: replica {rid} {direction} scale "
+                    f"{cur:.3f} -> {target:.3f}")
 
     # --------------------------------------------------------- transitions
 
@@ -226,6 +347,12 @@ class FleetHealthView:
             return "zombie"
         # the lease is measured from the heartbeat's SEND time: a delayed
         # heartbeat proves the replica was alive when it SENT, nothing more
+        if sent_ts > self._last_hb[rid]:
+            gap = sent_ts - self._last_hb[rid]
+            a = self.config.interarrival_alpha
+            prev = self._hb_gap_ewma[rid]
+            self._hb_gap_ewma[rid] = round(gap if prev is None
+                                           else (1.0 - a) * prev + a * gap, 9)
         self._last_hb[rid] = max(self._last_hb[rid], sent_ts)
         self._reported[rid] = state
         self._stats[rid] = stats
@@ -246,15 +373,16 @@ class FleetHealthView:
             cur = self._state[rid]
             if cur not in (LeaseState.ALIVE, LeaseState.SUSPECT):
                 continue
+            suspect_after, lease = self.effective_lease(rid)
             silence = now - self._last_hb[rid]
-            if silence >= self.config.lease:
+            if silence >= lease:
                 self._to(rid, LeaseState.DEAD, now,
                          f"lease expired ({silence:.3f}s of silence)")
                 self.epoch[rid] += 1
                 self._fence_sent_ts[rid] = None
                 self._emit("fleet/lease_expired", float(rid))
                 expired.append(rid)
-            elif cur is LeaseState.ALIVE and silence >= self.config.suspect_after:
+            elif cur is LeaseState.ALIVE and silence >= suspect_after:
                 self._to(rid, LeaseState.SUSPECT, now,
                          f"lease expiring ({silence:.3f}s of silence)")
                 self._emit("fleet/lease_suspect", float(rid))
@@ -316,11 +444,12 @@ class FleetHealthView:
         lease)."""
         out = []
         for rid, cur in self._state.items():
+            suspect_after, lease = self.effective_lease(rid)
             if cur is LeaseState.ALIVE:
-                out.append(self._last_hb[rid] + self.config.suspect_after)
-                out.append(self._last_hb[rid] + self.config.lease)
+                out.append(self._last_hb[rid] + suspect_after)
+                out.append(self._last_hb[rid] + lease)
             elif cur is LeaseState.SUSPECT:
-                out.append(self._last_hb[rid] + self.config.lease)
+                out.append(self._last_hb[rid] + lease)
             elif cur is LeaseState.FENCING:
                 sent = self._fence_sent_ts[rid]
                 out.append(now if sent is None
@@ -335,6 +464,9 @@ class FleetHealthView:
             "states": {r: s.value for r, s in sorted(self._state.items())},
             "epochs": dict(sorted(self.epoch.items())),
             "transitions": len(self.history),
+            "lease_resizes": len(self.resizes),
+            "scales": {r: s for r, s in sorted(self._scale.items())
+                       if s != 1.0},
         }
 
 

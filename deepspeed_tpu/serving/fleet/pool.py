@@ -127,6 +127,13 @@ class ReplicaPool:
         #: after the replica rejoined re-acks without cancelling the
         #: legitimately re-dispatched post-rejoin work
         self._fenced_epoch: Dict[int, int] = {r: 0 for r in range(n_replicas)}
+        #: lifecycle-command dedup ledger: cmd seq -> ack status for every
+        #: command this replica already applied — POOL-level so it survives
+        #: engine swaps (the point: a retried/duplicated ``lifecycle_cmd``
+        #: delivered after a recover/restart must RE-ACK its recorded
+        #: outcome, never re-apply the mutation)
+        self._lifecycle_seen: Dict[int, Dict[int, str]] = \
+            {r: {} for r in range(n_replicas)}
         # per-replica step anatomy (telemetry/step_anatomy.py): each
         # attached engine gets its OWN recorder on the replica's clock
         # view (one time domain with the serving charges), recreated
@@ -266,6 +273,19 @@ class ReplicaPool:
             return {"queued": 0, "active": 0}
         return rep.serve.fence()
 
+    def fenced_epoch(self, rid: int) -> int:
+        """Highest fencing epoch this replica has EXECUTED — the
+        replica-local half of the lifecycle-command epoch fence: a
+        ``lifecycle_cmd`` stamped with an older epoch was issued before
+        this replica was declared dead and must be rejected, not applied
+        (``Router._apply_lifecycle``)."""
+        return self._fenced_epoch[rid]
+
+    def lifecycle_seen(self, rid: int) -> Dict[int, str]:
+        """The replica's lifecycle-command dedup ledger (cmd seq -> ack
+        status); survives engine swaps like the fencing epoch."""
+        return self._lifecycle_seen[rid]
+
     def _emit(self, name: str, value: float) -> None:
         if self.monitor is None or not getattr(self.monitor, "enabled", True):
             return
@@ -379,6 +399,14 @@ class ReplicaPool:
 
     def drain(self, rid: int) -> None:
         self.health.drain(rid)
+
+    def set_role(self, rid: int, role) -> None:
+        """Reassign the replica's serving role (MIXED⇄PREFILL/DECODE).
+        Takes effect at the NEXT engine attach — ``restart``/``recover``
+        pick the factory by ``Replica.role`` — so the caller drains
+        first and no in-flight work is lost (autoscaler role loop,
+        docs/SERVING.md "Closed-loop control")."""
+        self.replicas[rid].role = ReplicaRole(role)
 
     def restart(self, rid: int) -> None:
         """Rolling restart of a DRAINED replica: must be idle (the point of

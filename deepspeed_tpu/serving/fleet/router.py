@@ -31,6 +31,7 @@ duplicated, or served twice through any kill/recover/drain schedule.
 import dataclasses
 import enum
 import itertools
+import math
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ...resilience import fault_injection as _fi
@@ -159,6 +160,11 @@ class FleetRequest:
 #: retry soon (an active brownout substitutes the ladder's own hint)
 TENANT_FAULT_RETRY_S = 1.0
 
+#: retry-after stamped on a KV-quota rejection: pages free as the
+#: tenant's own requests complete, so the hint is a serving-timescale
+#: backoff, not the overload ladder's episode-scale one
+KV_QUOTA_RETRY_S = 2.0
+
 
 @dataclasses.dataclass
 class _DirFeed:
@@ -176,6 +182,56 @@ class _DirFeed:
     buffer: Dict[int, Tuple[str, int]] = dataclasses.field(default_factory=dict)
     gap_since: Optional[float] = None
     resync_since: Optional[float] = None   # outstanding resync request ts
+
+
+class LifecycleCmdState(enum.Enum):
+    """Router-side delivery state of ONE transported lifecycle command
+    (docs/SERVING.md "Closed-loop control")."""
+    PENDING = "pending"   # recorded; the first send has not left yet
+    SENT = "sent"         # on the wire, awaiting the replica's ack
+    ACKED = "acked"       # the replica reported an outcome (applied/rejected)
+    ABORTED = "aborted"   # overtaken: the target's lease epoch advanced
+    #                       mid-flight — retrying would carry a pre-fencing
+    #                       decision into the post-fence world
+
+
+#: validated lifecycle-command transitions (dslint state-machine table;
+#: rendered into docs/STATE_MACHINES.md).  PENDING -> ABORTED covers a
+#: command whose target's epoch advanced before its first send ever left
+#: (an injected send fault kept it queued across the expiry).
+_LIFECYCLE_ALLOWED = {
+    LifecycleCmdState.PENDING: {LifecycleCmdState.SENT,
+                                LifecycleCmdState.ABORTED},
+    LifecycleCmdState.SENT: {LifecycleCmdState.ACKED,
+                             LifecycleCmdState.ABORTED},
+    LifecycleCmdState.ACKED: set(),
+    LifecycleCmdState.ABORTED: set(),
+}
+
+
+@dataclasses.dataclass
+class _LifecycleCmd:
+    """One transported lifecycle command: typed op + monotone seq (the
+    replica-side dedup key) + the target's lease epoch at dispatch (the
+    fencing token — a zombie replica, or a command that outlived a lease
+    expiry, can never act on or double-apply stale intent).  Delivery is
+    stop-and-wait with ack/retry, the same discipline as fences and
+    migration chunks."""
+    seq: int
+    rid: int
+    op: str
+    payload: dict
+    epoch: int                      # lease epoch of rid when issued
+    issued_ts: float
+    state: LifecycleCmdState = LifecycleCmdState.PENDING
+    sent_ts: Optional[float] = None
+    status: Optional[str] = None    # the replica's ack outcome
+
+    def to(self, state: LifecycleCmdState) -> None:
+        if state not in _LIFECYCLE_ALLOWED[self.state]:
+            raise ValueError(f"lifecycle cmd {self.seq}: illegal transition "
+                             f"{self.state.value} -> {state.value}")
+        self.state = state
 
 
 class Router:
@@ -247,6 +303,18 @@ class Router:
         self.dir_resync_retry = 4.0
         #: min clock time between retransmits of an unacked migration chunk
         self.mig_retry = 1.0
+        #: min clock time between retransmits of an unacked lifecycle command
+        self.lifecycle_retry = 1.0
+        # transported lifecycle commands (docs/SERVING.md "Closed-loop
+        # control"): with a transport attached, every control-plane
+        # mutation of a replica — autoscaler recover/drain/park/restart,
+        # role changes, migration completion — crosses the same lossy
+        # fabric as everything else as a typed, seq-numbered, epoch-fenced
+        # ``lifecycle_cmd`` with stop-and-wait ack/retry; without one,
+        # ``lifecycle_command`` degenerates to the pre-r21 direct calls
+        self._lifecycle_seq = itertools.count(1)
+        #: cmd seq -> _LifecycleCmd (the full auditable command log)
+        self._lifecycle: Dict[int, _LifecycleCmd] = {}
         #: hottest directory chains pre-imported onto a recovering replica
         self.warmup_chains = int(warmup_chains)
         if transport is not None:
@@ -373,15 +441,28 @@ class Router:
             "publish_gaps": 0, "dir_resyncs": 0,
             "warmup_imports": 0, "warmup_fallbacks": 0,
             "partition_dispatch_skips": 0,
+            "kv_quota_rejects": 0,
+            "lifecycle_cmds": 0, "lifecycle_applied": 0,
+            "lifecycle_acked": 0, "lifecycle_stale_acks": 0,
+            "lifecycle_aborted": 0, "lifecycle_send_faults": 0,
         }
         self.recovery_times: List[float] = []
         # arrival-rate telemetry (ROADMAP's predictive-scale-up input):
         # submissions counted at submit(), folded into a rate EWMA + its
         # derivative once per fleet round by export_replica_gauges —
-        # deterministic under VirtualClock like every gauge here
-        self.arrival_ewma_alpha = 0.2
+        # deterministic under VirtualClock like every gauge here.  The
+        # fold is TIME-constant based (alpha = 1 - exp(-dt/tau)), not
+        # per-sample: round lengths vary, and in sparse traffic a single
+        # arrival inside a short round reads as a huge instantaneous rate
+        # — a fixed per-sample alpha would let that noise (times the
+        # forecast horizon, via the slope) conjure phantom demand
+        self.arrival_rate_tau = 2.5
         self._arrival_count = 0
-        self._arr_last: Optional[Tuple[float, int, Optional[float]]] = None
+        self._arr_last: Optional[Tuple[float, int, Optional[float],
+                                       float]] = None
+        #: (rate EWMA, slope) as of the last fold — kept unrounded; the
+        #: gauges round at export, the predictive autoscaler reads it raw
+        self._arr_rate: Tuple[float, float] = (0.0, 0.0)
         #: tenants that ever carried a kv/tenant_pages gauge — a tenant
         #: whose pages drop to zero must READ zero, not freeze its last
         #: non-zero sample forever
@@ -448,6 +529,24 @@ class Router:
                          self._next_event_step())])
             self._finish(fr, FleetState.REJECTED, now)
             return fr
+        if spec.kv_page_quota > 0:
+            # per-tenant KV quota (docs/SERVING.md "Closed-loop control"):
+            # admission charges the request's PROJECTED page need against
+            # the tenant's exactly-once fleet-wide tally — one tenant's
+            # long-context burst cannot occupy every arena's pages.  A
+            # rejection is transient (pages free as the tenant's own work
+            # completes), so it carries a retry-after hint.
+            need = self._kv_page_need(len(fr.prompt), max_new_tokens)
+            if need is not None and \
+                    self.tenant_kv_pages().get(tenant, 0) + need \
+                    > spec.kv_page_quota:
+                self.stats["kv_quota_rejects"] += 1
+                fr.reject_reason = "kv_quota"
+                fr.retry_after = KV_QUOTA_RETRY_S
+                self._emit([("fleet/kv_quota_reject", float(need),
+                             self._next_event_step())])
+                self._finish(fr, FleetState.REJECTED, now)
+                return fr
         if capped:
             # flagged/counted only for requests that will actually be
             # SERVED with the truncated budget — a shed/fault-rejected
@@ -471,6 +570,19 @@ class Router:
         fr._wfq = self.tenants.next_pass(tenant, floor=floor)
         self._pending.append(fr)
         return fr
+
+    def _kv_page_need(self, prompt_len: int, max_new_tokens: int) -> Optional[int]:
+        """Projected KV page demand of one request at full generation —
+        the admission-time charge against a tenant's ``kv_page_quota``.
+        Reads the arena geometry off the first live engine (every replica
+        shares it); None when no engine is attached to read from, in
+        which case the quota cannot be metered and admission proceeds."""
+        for rid in self.pool.rids:
+            rep = self.pool.replica(rid)
+            if rep.serve is not None:
+                ps = rep.serve.engine.kv.page_size
+                return -(-(prompt_len + max_new_tokens) // ps)
+        return None
 
     def _taccount(self, tenant: str) -> dict:
         t = self.tenant_stats.get(tenant)
@@ -724,6 +836,22 @@ class Router:
         target = self.pool.replica(rid)
         if donor.serve is None or target.serve is None:
             return self._prefix_import_fallback(fr, "replica gone before staging")
+        tspec = self.tenants.spec(fr.tenant)
+        if tspec.kv_page_quota > 0 and \
+                self.tenant_kv_pages().get(fr.tenant, 0) \
+                + plan["donor_depth"] > tspec.kv_page_quota:
+            # the import charges the IMPORTING tenant's quota: adopting
+            # remote pages it has no budget for would launder arena
+            # occupancy through the prefix cache.  Checked BEFORE the d2h
+            # export against the directory's promised depth, so a
+            # quota-bound tenant costs no staging bandwidth — the dispatch
+            # proceeds cold instead (slower, never wrong).
+            self.stats["kv_quota_rejects"] += 1
+            self._emit([("fleet/kv_quota_reject", float(plan["donor_depth"]),
+                         self._next_event_step())])
+            return self._prefix_import_fallback(
+                fr, f"tenant {fr.tenant!r} kv quota "
+                f"({tspec.kv_page_quota} pages)")
         tokens = list(fr.prompt) + list(fr.tokens)
         try:
             snapshot = export_prefix(donor.serve.engine, tokens,
@@ -890,10 +1018,22 @@ class Router:
                 self._requeue_attempt(fr, now, "replica_restarted")
                 self._emit([("fleet/failover_requeued", 1.0,
                              self._next_event_step())])
+        if self.lease.config.adaptive:
+            # feed the adaptive-lease loop its link-quality inputs before
+            # the expiry sweep, so a lossy fabric widens the band BEFORE
+            # it can false-fence (docs/SERVING.md "Closed-loop control")
+            for rid in self.pool.rids:
+                feed = self._dir_feeds.get(rid)
+                age = 0.0 if feed is None or feed.gap_since is None \
+                    else max(0.0, now - feed.gap_since)
+                self.lease.note_link_quality(
+                    rid, self.transport.link_loss_ewma("router", rid),
+                    age, now)
         for rid in self.lease.tick(now):
             self.on_lease_expired(rid, now)
         for rid in self.lease.fence_pending(now):
             self._send_fence(rid, now)
+        self._sweep_lifecycle(now)
         for rid, feed in self._dir_feeds.items():
             self._check_dir_feed(rid, feed, now)
 
@@ -918,6 +1058,8 @@ class Router:
                 self._on_fence_ack(msg.src, p, now)
             elif kind == "mig_chunk":
                 self._on_mig_chunk(msg.src, p, now)
+            elif kind == "lifecycle_ack":
+                self._on_lifecycle_ack(msg.src, p, now)
             return
         rid = msg.dst
         if kind == "fence":
@@ -944,6 +1086,8 @@ class Router:
                 if p["next"] > ch["base"]:
                     ch["base"] = p["next"]
                     ch["sent_idx"], ch["sent_ts"] = None, None
+        elif kind == "lifecycle_cmd":
+            self._apply_lifecycle(rid, p, now)
 
     # -------------------------------------------------- lease expiry + fence
 
@@ -1069,6 +1213,261 @@ class Router:
         # the fencing episode is complete (zombie cancelled + re-admitted):
         # dump the black box while the whole story is still in the ring
         self._recorder_dump("fence", now)
+
+    # ---------------------------------------------- lifecycle command plane
+
+    def lifecycle_command(self, rid: int, op: str,
+                          payload: Optional[dict] = None,
+                          now: Optional[float] = None) -> Optional[int]:
+        """Issue one lifecycle mutation against replica ``rid`` — the
+        single entry point the autoscaler (and the migration pump) drives
+        replica state through (docs/SERVING.md "Closed-loop control").
+
+        Without a transport this IS the pre-r21 direct call, synchronous
+        and unlosable.  With one, the mutation becomes a typed
+        ``lifecycle_cmd`` message: seq-numbered (the replica's dedup key),
+        stamped with the target's CURRENT lease epoch (the fencing token),
+        re-sent stop-and-wait until acked.  An identical (rid, op) command
+        already in flight is not duplicated — the retry timer owns it.
+        Returns the command seq under a transport, else None."""
+        now = self.clock.now() if now is None else now
+        payload = dict(payload or {})
+        if self.transport is None:
+            self._lifecycle_direct(rid, op, payload)
+            return None
+        for cmd in self._lifecycle.values():
+            if cmd.rid == rid and cmd.op == op and \
+                    cmd.state in (LifecycleCmdState.PENDING,
+                                  LifecycleCmdState.SENT):
+                return cmd.seq   # already in flight: idempotent issue
+        seq = next(self._lifecycle_seq)
+        cmd = _LifecycleCmd(seq=seq, rid=rid, op=op, payload=payload,
+                            epoch=self.lease.epoch[rid], issued_ts=now)
+        self._lifecycle[seq] = cmd
+        self.stats["lifecycle_cmds"] += 1
+        self._emit([("fleet/lifecycle_cmd", float(rid),
+                     self._next_event_step())])
+        if self.recorder is not None:
+            self.recorder.instant("ctrl/lifecycle", "ctrl/autoscale", now,
+                                  attrs={"rid": rid, "op": op, "seq": seq,
+                                         "epoch": cmd.epoch})
+        self._send_lifecycle(cmd, now)
+        return seq
+
+    def _lifecycle_direct(self, rid: int, op: str, payload: dict) -> None:
+        """The transportless path: exactly the synchronous calls the
+        autoscaler made before lifecycle traffic was transported —
+        byte-identical behavior with ``transport=None``."""
+        if op == "recover":
+            self.pool.recover(rid)
+            self.warmup_replica(rid)
+        elif op == "drain":
+            self.pool.drain(rid)
+        elif op == "park":
+            victims = self.pool.kill(
+                rid, reason=payload.get("reason", "parked (lifecycle)"))
+            assert not victims, \
+                f"lifecycle park of replica {rid} displaced in-flight " \
+                f"work: {victims}"
+        elif op == "restart":
+            self.pool.restart(rid)
+            self.warmup_replica(rid)
+        elif op == "role_change":
+            self.pool.set_role(rid, payload["role"])
+            self.pool.restart(rid)
+            self.warmup_replica(rid)
+            self._emit([("fleet/role_change", float(rid),
+                         self._next_event_step())])
+        else:
+            raise ValueError(f"unknown lifecycle op {op!r}")
+
+    def _send_lifecycle(self, cmd: _LifecycleCmd, now: float) -> None:
+        """(Re)send one command over the fabric.  A transient send-path
+        fault leaves the record PENDING for the retry sweep; the fabric
+        eating the message (loss/partition) is indistinguishable from a
+        lost ack and the same timer recovers both."""
+        try:
+            # chaos site: the router's lifecycle send edge
+            _fi.check("lifecycle.cmd.send")
+        except _fi.InjectedCrash:
+            raise  # simulated death of THIS driver process
+        except OSError as e:
+            self.stats["lifecycle_send_faults"] += 1
+            logger.warning(f"lifecycle.cmd.send transient fault for "
+                           f"seq={cmd.seq} ({cmd.op} -> replica "
+                           f"{cmd.rid}): {e}")
+            return
+        if cmd.state is LifecycleCmdState.PENDING:
+            cmd.to(LifecycleCmdState.SENT)
+        else:
+            self.transport.note_retransmit()
+        cmd.sent_ts = now
+        self.transport.send("lifecycle_cmd", "router", cmd.rid,
+                            {"seq": cmd.seq, "op": cmd.op,
+                             "epoch": cmd.epoch, "payload": cmd.payload},
+                            seq=cmd.seq)
+
+    def _sweep_lifecycle(self, now: float) -> None:
+        """One retry round: abort commands whose target's epoch advanced
+        mid-flight (stale intent must not be retried into the post-fence
+        world), then (re)send everything unacked whose timer is due."""
+        for seq in sorted(self._lifecycle):
+            cmd = self._lifecycle[seq]
+            if cmd.state not in (LifecycleCmdState.PENDING,
+                                 LifecycleCmdState.SENT):
+                continue
+            if self.lease.epoch[cmd.rid] > cmd.epoch:
+                cmd.to(LifecycleCmdState.ABORTED)
+                self.stats["lifecycle_aborted"] += 1
+                logger.warning(f"lifecycle cmd {seq} ({cmd.op} -> replica "
+                               f"{cmd.rid}) aborted: epoch advanced "
+                               f"{cmd.epoch} -> {self.lease.epoch[cmd.rid]}")
+                continue
+            if cmd.state is LifecycleCmdState.SENT and \
+                    cmd.sent_ts is not None and \
+                    now - cmd.sent_ts < self.lifecycle_retry:
+                continue   # in flight, not yet timed out
+            self._send_lifecycle(cmd, now)
+
+    def _apply_lifecycle(self, rid: int, p: dict, now: float) -> None:
+        """Replica-side command application: exactly-once effects under
+        at-least-once delivery.  The pool-level seq ledger (it survives
+        engine swaps, like the fencing epoch) re-acks the recorded outcome
+        for duplicated/retried copies without re-applying; a command
+        stamped with a pre-fencing epoch is rejected (``stale_epoch``) —
+        a partitioned router's zombie command can never mutate a replica
+        that was fenced after the command was issued."""
+        seq, op = p["seq"], p["op"]
+        seen = self.pool.lifecycle_seen(rid)
+        status = seen.get(seq)
+        if status is None:
+            try:
+                # chaos site: the replica's lifecycle apply edge
+                _fi.check("lifecycle.cmd.apply")
+            except _fi.InjectedCrash:
+                raise  # simulated death of THIS driver process
+            except OSError as e:
+                # transient apply fault: nothing changed, nothing acked —
+                # the router's retry timer re-delivers
+                logger.warning(f"lifecycle.cmd.apply transient fault on "
+                               f"replica {rid} (seq={seq} {op}): {e}")
+                return
+            if p["epoch"] < self.pool.fenced_epoch(rid):
+                status = "stale_epoch"
+                logger.warning(f"replica {rid}: rejected lifecycle cmd "
+                               f"{seq} ({op}) from epoch {p['epoch']} "
+                               f"(fenced at {self.pool.fenced_epoch(rid)})")
+            else:
+                status = self._lifecycle_apply_op(rid, op,
+                                                  p.get("payload") or {})
+            seen[seq] = status
+            if status == "applied":
+                self.stats["lifecycle_applied"] += 1
+        self.transport.send("lifecycle_ack", rid, "router",
+                            {"seq": seq, "op": op, "epoch": p["epoch"],
+                             "status": status}, seq=seq)
+
+    def _lifecycle_apply_op(self, rid: int, op: str, payload: dict) -> str:
+        """Execute one op against the replica-LOCAL truth, state-guarded:
+        a late or duplicated command the replica's state no longer fits is
+        REJECTED with an auditable status instead of tripping the pool's
+        transition asserts (e.g. a retried recover landing after the
+        replica already recovered and died again)."""
+        health = self.pool.health.state(rid)
+        if op == "recover":
+            if health is not ReplicaState.DEAD:
+                return f"rejected:{health.value}"
+            self.pool.recover(rid)
+            return "applied"
+        if op == "drain":
+            if health not in (ReplicaState.HEALTHY, ReplicaState.DEGRADED):
+                return f"rejected:{health.value}"
+            self.pool.drain(rid)
+            return "applied"
+        if op in ("park", "restart", "role_change"):
+            if health is not ReplicaState.DRAINING or not self.pool.is_idle(rid):
+                return f"rejected:{health.value}"
+            if op == "park":
+                victims = self.pool.kill(
+                    rid, reason=payload.get("reason", "parked (lifecycle)"))
+                assert not victims, \
+                    f"lifecycle park of replica {rid} displaced in-flight " \
+                    f"work: {victims}"
+            else:
+                if op == "role_change":
+                    self.pool.set_role(rid, payload["role"])
+                self.pool.restart(rid)
+            return "applied"
+        if op == "mig_complete":
+            rep = self.pool.replica(rid)
+            uid = payload["uid"]
+            if rep.serve is None:
+                return "rejected:no_engine"
+            sr = rep.serve._active.get(uid)
+            if sr is None or sr.state is not RequestState.MIGRATING:
+                # the source's copy already left MIGRATING (preempted,
+                # restarted, or a duplicate raced the first apply): there
+                # is nothing to close — the router-side handoff owns the
+                # request either way
+                return "rejected:not_migrating"
+            rep.serve.complete_migration(uid)
+            return "applied"
+        return f"rejected:unknown_op:{op}"
+
+    def _on_lifecycle_ack(self, rid: int, p: dict, now: float) -> None:
+        """Fold one ack.  A fenced zombie's late ack — the target's epoch
+        advanced after the command was stamped — is DISCARDED: whatever
+        the zombie claims to have applied predates the fence and must not
+        drive follow-up actions on this side."""
+        cmd = self._lifecycle.get(p["seq"])
+        if cmd is None or cmd.state is not LifecycleCmdState.SENT:
+            return   # unknown seq, duplicate ack, or an aborted record
+        if self.lease.epoch[cmd.rid] > cmd.epoch:
+            self.stats["lifecycle_stale_acks"] += 1
+            cmd.to(LifecycleCmdState.ABORTED)
+            self.stats["lifecycle_aborted"] += 1
+            logger.warning(f"fleet: discarded stale lifecycle ack seq="
+                           f"{cmd.seq} ({cmd.op}) from fenced replica {rid}")
+            return
+        cmd.to(LifecycleCmdState.ACKED)
+        cmd.status = p["status"]
+        self.stats["lifecycle_acked"] += 1
+        if p["status"] != "applied":
+            return
+        # router-side follow-ups the direct path ran synchronously
+        if cmd.op in ("recover", "restart", "role_change"):
+            self.warmup_replica(cmd.rid)
+        if cmd.op == "role_change":
+            self._emit([("fleet/role_change", float(cmd.rid),
+                         self._next_event_step())])
+        elif cmd.op == "park":
+            # a deliberate park must never read as a failure: fold it into
+            # the lease view NOW (epoch bump included) so the coming
+            # heartbeat silence cannot expire a lease over it
+            self.lease.declare_dead(cmd.rid, now, reason="parked (lifecycle)")
+
+    def lifecycle_pending(self, rid: int, op: Optional[str] = None) -> bool:
+        """Any lifecycle command still in flight for ``rid`` (optionally a
+        specific op)?  The autoscaler gates follow-up decisions on this so
+        it never stacks a second mutation on an unacked first.  Always
+        False without a transport (direct calls complete synchronously)."""
+        return any(c.rid == rid and (op is None or c.op == op)
+                   and c.state in (LifecycleCmdState.PENDING,
+                                   LifecycleCmdState.SENT)
+                   for c in self._lifecycle.values())
+
+    def replica_idle(self, rid: int) -> bool:
+        """Is ``rid`` idle by the router's best evidence?  A direct pool
+        probe without a transport; the last-known-good heartbeat payload
+        under one — safe for drain gating, because a DRAINING replica
+        takes no new dispatches, so its idleness only ever becomes MORE
+        true after the observation."""
+        if self.transport is None:
+            return self.pool.is_idle(rid)
+        stats, _age = self.lease.stats(rid)
+        if stats is None:
+            return self.pool.is_idle(rid)
+        return stats["queue_depth"] == 0 and stats["active"] == 0
 
     # --------------------------------------------- directory feed + resync
 
@@ -1312,6 +1711,11 @@ class Router:
             ch = m.get("chan")
             if ch is not None and ch["sent_ts"] is not None:
                 out.append(ch["sent_ts"] + self.mig_retry)
+        for cmd in self._lifecycle.values():
+            if cmd.state is LifecycleCmdState.SENT and cmd.sent_ts is not None:
+                out.append(cmd.sent_ts + self.lifecycle_retry)
+            elif cmd.state is LifecycleCmdState.PENDING:
+                out.append(now)   # a send-faulted command retries next poll
         # already-due wake-ups clamp to ``now`` (a zero-width jump: the
         # next round's transport_poll resolves them) rather than being
         # dropped — dropping one would let the idle-jump leap PAST a due
@@ -1330,6 +1734,8 @@ class Router:
         return (self.stats["lease_expirations"], self.stats["fenced_replicas"],
                 self.stats["fenced_completions"], self.stats["fenced_requests"],
                 self.stats["publish_gaps"], self.stats["dir_resyncs"],
+                self.stats["lifecycle_applied"], self.stats["lifecycle_acked"],
+                self.stats["lifecycle_aborted"],
                 tuple(s.value for _, s in sorted(self.lease.states().items())))
 
     # ----------------------------------------------------------- migration
@@ -1492,7 +1898,15 @@ class Router:
                     rep.serve.abort_migration(sr.uid)
                 self._migration_fallback(fid, "no decode replica for handoff")
                 continue
-            rep.serve.complete_migration(sr.uid)
+            if self.transport is None:
+                rep.serve.complete_migration(sr.uid)
+            else:
+                # the source-side close becomes a transported lifecycle
+                # command (retried, epoch-fenced, idempotent per seq); the
+                # handoff itself proceeds NOW on the router-side assembled
+                # snapshot — the command only releases the source's copy
+                self.lifecycle_command(rid, "mig_complete",
+                                       {"uid": sr.uid}, now)
             self._migrations.pop(fid)
             self._mig_rx.pop(fid, None)
             del self._dispatched[fid]
@@ -1831,6 +2245,9 @@ class Router:
         now = self.clock.now()
         if self.slo is not None:
             self.slo.tick(now)
+        # the rate fold runs even without a registry: the predictive
+        # autoscaler reads the raw (ewma, slope) pair via arrival_rate()
+        self._fold_arrival_rate(now)
         metrics = self.pool.metrics
         if metrics is None:
             return
@@ -1868,30 +2285,46 @@ class Router:
         self._export_arrival_gauges(now, metrics)
         self._export_kv_gauges(metrics)
 
-    def _export_arrival_gauges(self, now: float, metrics) -> None:
-        """Arrival-rate EWMA + derivative (``fleet/arrival_rate_ewma`` /
-        ``fleet/arrival_rate_slope``): the demand signal the ROADMAP's
-        predictive scale-up item provisions on — scale BEFORE the queue
-        grows by reading the rate's slope, not the queue's depth.  One
-        fold per fleet round; zero-advance rounds carry no new rate
-        information and are skipped (the gauges keep their last fold)."""
+    def _fold_arrival_rate(self, now: float) -> None:
+        """Fold the arrival-rate EWMA + derivative: the demand signal
+        predictive scale-up provisions on — scale BEFORE the queue grows
+        by reading the rate's slope, not the queue's depth.  One fold per
+        fleet round; zero-advance rounds carry no new rate information
+        and are skipped (the fold keeps its last value)."""
         if self._arr_last is None:
-            metrics.gauge("fleet/arrival_rate_ewma").set(0.0)
-            metrics.gauge("fleet/arrival_rate_slope").set(0.0)
-            self._arr_last = (now, self._arrival_count, None)
+            self._arr_last = (now, self._arrival_count, None, 0.0)
+            self._arr_rate = (0.0, 0.0)
             return
-        t0, c0, ewma0 = self._arr_last
+        t0, c0, ewma0, slope0 = self._arr_last
         dt = now - t0
         if dt <= 0:
             return
         inst = (self._arrival_count - c0) / dt
-        ewma = inst if ewma0 is None else (
-            self.arrival_ewma_alpha * inst
-            + (1.0 - self.arrival_ewma_alpha) * ewma0)
-        slope = 0.0 if ewma0 is None else (ewma - ewma0) / dt
+        alpha = 1.0 - math.exp(-dt / self.arrival_rate_tau)
+        if ewma0 is None:
+            ewma, slope = inst, 0.0
+        else:
+            ewma = ewma0 + alpha * (inst - ewma0)
+            # the slope is smoothed with the SAME time constant: the raw
+            # per-fold derivative of an EWMA is exactly the noise the EWMA
+            # removed, scaled back up by 1/dt
+            slope = slope0 + alpha * ((ewma - ewma0) / dt - slope0)
+        self._arr_rate = (ewma, slope)
+        self._arr_last = (now, self._arrival_count, ewma, slope)
+
+    def arrival_rate(self) -> Tuple[float, float]:
+        """The last-folded (rate EWMA, slope) pair, UNROUNDED — the
+        predictive autoscaler's demand input (``fleet/arrival_rate_*``
+        gauges publish the rounded rendering of the same fold)."""
+        return self._arr_rate
+
+    def _export_arrival_gauges(self, now: float, metrics) -> None:
+        """Publish the current arrival-rate fold as the
+        ``fleet/arrival_rate_ewma`` / ``fleet/arrival_rate_slope`` gauges
+        (rounded at the export boundary like every gauge here)."""
+        ewma, slope = self._arr_rate
         metrics.gauge("fleet/arrival_rate_ewma").set(round(ewma, 9))
         metrics.gauge("fleet/arrival_rate_slope").set(round(slope, 9))
-        self._arr_last = (now, self._arrival_count, ewma)
 
     def _export_kv_gauges(self, metrics) -> None:
         """Per-replica KV-arena occupancy (``kv/<stat>/<rid>``), the
@@ -1983,6 +2416,8 @@ class Router:
                      and m["chan"]["sent_idx"] is not None)
         depth += sum(1 for feed in self._dir_feeds.values()
                      if feed.resync_since is not None)
+        depth += sum(1 for c in self._lifecycle.values()
+                     if c.state is LifecycleCmdState.SENT)
         return depth
 
     def _recorder_dump(self, reason: str, now: float) -> None:
@@ -2081,6 +2516,14 @@ class Router:
                 "warmup_fallbacks": self.stats["warmup_fallbacks"],
                 "partition_dispatch_skips":
                     self.stats["partition_dispatch_skips"],
+                "lifecycle": {
+                    "cmds": self.stats["lifecycle_cmds"],
+                    "applied": self.stats["lifecycle_applied"],
+                    "acked": self.stats["lifecycle_acked"],
+                    "stale_acks": self.stats["lifecycle_stale_acks"],
+                    "aborted": self.stats["lifecycle_aborted"],
+                    "send_faults": self.stats["lifecycle_send_faults"],
+                },
             },
             "overload": None if self.overload is None else self.overload.summary(),
             "slo": None if self.slo is None else self.slo.summary(),
@@ -2088,6 +2531,7 @@ class Router:
             else self.recorder.summary(),
             "shed": self.stats["shed"],
             "brownout_capped": self.stats["brownout_capped"],
+            "kv_quota_rejects": self.stats["kv_quota_rejects"],
             "health_transitions": len(self.pool.health.history),
         }
 

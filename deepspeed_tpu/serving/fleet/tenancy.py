@@ -50,6 +50,13 @@ class TenantSpec:
     #: the multi-window burn-rate monitor (telemetry/slo.py); unused when
     #: ``ttft_slo`` is None
     error_budget: float = 0.1
+    #: fleet-wide KV arena budget in PAGES, metered by the exactly-once
+    #: ``kv/tenant_pages/<tenant>`` attribution (Router.tenant_kv_pages):
+    #: admission rejects a request whose projected page need would push
+    #: the tenant past this bound (``fleet/kv_quota_reject`` + retry_after
+    #: hint), and prefix-directory imports charge the importing tenant's
+    #: budget before adopting remote pages; <= 0 = unbounded
+    kv_page_quota: int = 0
 
     def __post_init__(self):
         if not self.weight > 0:
@@ -60,6 +67,9 @@ class TenantSpec:
         if not 0.0 < self.error_budget <= 1.0:
             raise ValueError(f"tenant {self.name!r}: error_budget must be in "
                              f"(0, 1], got {self.error_budget}")
+        if self.kv_page_quota < 0:
+            raise ValueError(f"tenant {self.name!r}: kv_page_quota must be "
+                             f">= 0 (0 = unbounded), got {self.kv_page_quota}")
 
 
 #: the implicit tenant of untagged requests — weight 1, unbounded, not

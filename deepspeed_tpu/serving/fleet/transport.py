@@ -26,7 +26,14 @@ flows onto it:
   a targeted full-digest resync instead of silent absorption;
 * **ack/retry chunk delivery** — migration chunks flow stop-and-wait with
   cumulative acks and idempotent (index-checked) import, so loss costs
-  retransmits, never torn snapshots.
+  retransmits, never torn snapshots;
+* **lifecycle commands** (r21) — autoscaler recover/drain/park/
+  role-change and migration completion ride typed, seq-numbered,
+  epoch-fenced ``lifecycle_cmd`` messages with the same stop-and-wait
+  ack/retry discipline as migration chunks; the replica side dedups by
+  command seq and rejects commands stamped with a pre-fencing epoch, so
+  a partitioned or zombie replica can never act on — or double-apply —
+  a stale command (``Router._apply_lifecycle``).
 
 Message taxonomy (``kind``):
 
@@ -41,6 +48,8 @@ kind               direction                  payload
 ``fence_ack``      replica -> router          epoch echo + cancel counts
 ``mig_chunk``      source replica -> router   KV chunk (idx, crc, last flag)
 ``mig_ack``        router -> source replica   cumulative chunk ack
+``lifecycle_cmd``  router -> replica          op + cmd seq + dispatch epoch
+``lifecycle_ack``  replica -> router          cmd seq + epoch echo + status
 =================  =========================  ==============================
 
 Faults are drawn per message in SEND order from one seeded
@@ -73,6 +82,7 @@ MESSAGE_VERSION = 1
 MESSAGE_KINDS = frozenset({
     "heartbeat", "dir_publish", "dir_resync_req", "dir_resync",
     "fence", "fence_ack", "mig_chunk", "mig_ack",
+    "lifecycle_cmd", "lifecycle_ack",
 })
 
 #: the control-plane endpoint name of the router; replicas are their rids

@@ -173,6 +173,19 @@ EVENTS = {
                             "directory-driven warm-up pre-imported hot "
                             "chains onto a recovering replica "
                             "(value = rid)"),
+    "fleet/lease_resize": ("event", "serving/fleet/health.py",
+                           "adaptive lease sizing widened/tightened a "
+                           "replica's lease band from observed link "
+                           "quality (value = rid)"),
+    "fleet/lifecycle_cmd": ("event", "serving/fleet/router.py",
+                            "a typed lifecycle command (recover/drain/"
+                            "park/restart/role_change/mig_complete) was "
+                            "issued over the control transport "
+                            "(value = target rid)"),
+    "fleet/role_change": ("event", "serving/fleet/router.py",
+                          "a drained replica's serving role was "
+                          "reassigned (prefill/decode/mixed) "
+                          "(value = rid)"),
     # ---- overload control plane (serving/fleet/autoscale.py + router.py)
     "fleet/scale_up": ("event", "serving/fleet/autoscale.py",
                        "autoscaler provisioned a replica through "
@@ -192,6 +205,10 @@ EVENTS = {
     "fleet/overload_shed": ("event", "serving/fleet/router.py",
                             "best-effort admission shed with a "
                             "retry-after hint (value = rung)"),
+    "fleet/kv_quota_reject": ("event", "serving/fleet/router.py",
+                              "admission or prefix-import rejected "
+                              "against a tenant's KV page quota "
+                              "(value = projected pages)"),
     "fleet/serving_replicas": ("gauge", "serving/fleet/router.py",
                                "replicas in a serving state, sampled "
                                "once per fleet round"),
@@ -213,6 +230,13 @@ EVENTS = {
     "ctrl/fence": ("span", "serving/engine.py",
                    "recorder instant: a FENCE executed on a replica "
                    "frontend (attrs: cancelled queued/active counts)"),
+    "ctrl/lease_resize": ("span", "serving/fleet/health.py",
+                          "recorder instant: an adaptive lease resize on "
+                          "the replica's lease track (attrs: direction, "
+                          "scale, gap_ewma, loss)"),
+    "ctrl/lifecycle": ("span", "serving/fleet/router.py",
+                       "recorder instant: a lifecycle command was issued "
+                       "(attrs: rid, op, seq, epoch)"),
     "ctrl/autoscale": ("track", "serving/fleet/autoscale.py",
                        "flight-recorder track of autoscaler decision "
                        "instants (ctrl/autoscale/<action>)"),

@@ -56,7 +56,18 @@ directory hit rate >= 0.95 with the probe baseline recorded beside it,
 p99 TTFT strictly better at equal goodput, >= 1 cold-replica prefix
 import, zero output divergence, and byte-identical repeats.
 
-Writes BENCH_ROUTER.json (schema v5 — scripts/check_bench_schema.py
+Plus the CONTROL-LOOPS leg (schema v6, docs/SERVING.md "Closed-loop
+control"), three sub-legs: (1) adaptive lease sizing — a heavy-step
+workload (constant 3.5-unit rounds) over 5% control-plane loss, where
+the static lease false-fences on the first lost heartbeat and the
+adaptive (gap-EWMA) lease records zero expirations yet still detects a
+real injected kill inside its widened band; (2) predictive scale-up —
+the flash crowd served reactive vs predictive (arrival-rate forecast),
+where forecasting must beat reactive premium p99 TTFT at near-equal
+replica-step spend; (3) per-tenant KV page quotas — admission-time
+rejects with both tenants' accounting closed.
+
+Writes BENCH_ROUTER.json (schema v6 — scripts/check_bench_schema.py
 validates it, incl. affinity hit rate > 0 on the prefix_affinity points
 and finite recovery on every kill) and prints one JSON line.
 """
@@ -771,6 +782,308 @@ def run_autoscale_leg(factory, clock_factory, seed, vocab, dryrun):
     return rec
 
 
+QUOTA_TENANTS = (
+    # (name, mix probability, kv_page_quota, weight) — the quota sub-leg's
+    # split: "bulk" holds a hard fleet-wide KV page budget, "premium" is
+    # unbounded; both must close their accounting under rejection
+    ("bulk", 0.5, 8, 1.0),
+    ("premium", 0.5, 0, 4.0),
+)
+
+
+def _control_lease_point(factory, clock_factory, arrivals, serving_config,
+                         seed, loss_p, lease, adaptive, schedule=None):
+    """One heavy-step run over a lossy control transport: the constant
+    per-round step cost (3.5 virtual units) exceeds the static suspect
+    window (2.0), so a single lost heartbeat leaves a silence the static
+    lease misreads as death.  ``adaptive`` turns on gap-EWMA lease sizing
+    over the SAME base numbers.  Returns (summary, per-request outputs,
+    DEAD transitions as [rid, ts, reason])."""
+    from deepspeed_tpu.serving.fleet import (ControlTransport, FleetSimulator,
+                                             LeaseConfig, LinkFaults,
+                                             ReplicaPool, Router, make_policy)
+    clock = clock_factory()
+    transport = ControlTransport(clock, faults=LinkFaults(loss_p=loss_p),
+                                 seed=seed)
+    pool = ReplicaPool(factory, 4, clock=clock, serving_config=serving_config,
+                       transport=transport)
+    pool.rebase_clock()
+    router = Router(pool, make_policy("least_outstanding"), transport=transport,
+                    lease_config=LeaseConfig(adaptive=adaptive, **lease))
+    reqs = FleetSimulator(router).run([dict(a) for a in arrivals],
+                                      schedule=schedule)
+    rec = router.summary()
+    deaths = [[rid, round(ts, 6), reason] for rid, _, to, ts, reason
+              in router.lease.history if to.value == "dead"]
+    return rec, [list(r.tokens) for r in reqs], deaths
+
+
+def _predictive_point(factory, clock_factory, arrivals, serving_config,
+                      ttft_slo, predictive):
+    """One flash-crowd run from a 1-warm / 3-parked fleet: the reactive
+    SLA autoscaler vs the same config with the arrival-rate forecast on
+    top (scale BEFORE the queue shows the crowd, not after).  Returns
+    (summary + spend receipts, per-request outputs)."""
+    from deepspeed_tpu.serving.fleet import (AutoscaleConfig, Autoscaler,
+                                             FleetSimulator, OverloadConfig,
+                                             OverloadController, ReplicaPool,
+                                             Router, TenantRegistry,
+                                             TenantSpec, make_policy)
+    clock = clock_factory()
+    pool = ReplicaPool(factory, 4, clock=clock, serving_config=serving_config)
+    pool.rebase_clock()
+    tenants = TenantRegistry([
+        TenantSpec(name, weight=w, max_outstanding=mo, ttft_slo=slo,
+                   best_effort=be)
+        for name, _, _, w, mo, slo, be in AUTOSCALE_TENANTS])
+    overload = OverloadController(OverloadConfig(
+        hi=1.0, lo=0.45, cooldown=1.5, token_cap=6, retry_after=10.0))
+    router = Router(pool, make_policy("least_outstanding"), tenants=tenants,
+                    overload=overload)
+    for rid in (1, 2, 3):
+        pool.kill(rid, reason="autoscale: parked")
+    autoscaler = Autoscaler(router, AutoscaleConfig(
+        min_replicas=1, ttft_slo=ttft_slo, up_frac=0.5, queue_hi=1.5,
+        queue_lo=0.75, down_streak=3, cooldown_up=1.5, cooldown_down=6.0,
+        decide_interval=0.5, predictive=predictive, warmup_horizon=4.0,
+        per_replica_rate=2.0))
+    sim = FleetSimulator(router, autoscaler=autoscaler)
+    reqs = sim.run([dict(a) for a in arrivals])
+    rec = router.summary()
+    rec["replica_steps"] = sim.replica_steps
+    rec["replica_seconds"] = round(sim.replica_seconds, 6)
+    rec["rounds"] = sim.rounds
+    rec["autoscaler"] = autoscaler.summary()
+    return rec, [list(r.tokens) for r in reqs]
+
+
+def _quota_point(factory, clock_factory, arrivals, serving_config):
+    """Two tenants sharing 2 replicas, one holding a hard KV-page quota:
+    admission charges each request's projected page need against the
+    tenant's fleet-wide tally and rejects over-quota work BEFORE it holds
+    a page.  Returns (summary, per-request outputs)."""
+    from deepspeed_tpu.serving.fleet import (FleetSimulator, ReplicaPool,
+                                             Router, TenantRegistry,
+                                             TenantSpec, make_policy)
+    clock = clock_factory()
+    pool = ReplicaPool(factory, 2, clock=clock, serving_config=serving_config)
+    pool.rebase_clock()
+    tenants = TenantRegistry([
+        TenantSpec(name, weight=w, kv_page_quota=q)
+        for name, _, q, w in QUOTA_TENANTS])
+    router = Router(pool, make_policy("least_outstanding"), tenants=tenants)
+    reqs = FleetSimulator(router).run([dict(a) for a in arrivals])
+    rec = router.summary()
+    return rec, [list(r.tokens) for r in reqs]
+
+
+def run_control_loops_leg(factory, clock_factory, seed, vocab, dryrun):
+    """The closed-loop-control receipt (schema-v6 ``control_loops``
+    record, docs/SERVING.md "Closed-loop control"), three sub-legs:
+
+    * ``adaptive_lease`` — a HEAVY-step workload (constant 3.5-unit
+      rounds, heartbeat cadence == round cadence) over 5% control-plane
+      loss.  The static lease (suspect 2.0 / lease 6.0) false-fences on
+      the first lost heartbeat; the adaptive lease (same base numbers,
+      gap-EWMA sizing) records ZERO expirations — and with a real kill
+      injected it still detects the death inside the widened-lease band.
+    * ``predictive`` — the same flash-crowd shape as the autoscale leg
+      served reactive vs predictive (arrival-rate forecast): the
+      predictive run must beat the reactive run's premium p99 TTFT at
+      near-equal replica-step spend, with zero output divergence.
+    * ``kv_quota`` — a two-tenant crowd where "bulk" holds a hard KV
+      page quota: admission-time rejects fire (``kv_quota_rejects``),
+      the unbounded tenant completes everything it submitted, and both
+      tenants' accounting closes.
+
+    Every sub-leg is deterministic on the virtual clock; the adaptive
+    and predictive runs are repeated and must be byte-identical."""
+    from deepspeed_tpu.serving import ServingConfig
+    from deepspeed_tpu.serving.fleet import (diurnal_arrivals,
+                                             flash_crowd_arrivals)
+
+    # --- sub-leg 1: adaptive lease sizing under heavy steps -------------
+    wl_lease = {"kind": "diurnal", "seed": seed,
+                "n_requests": 36 if dryrun else 48,
+                "base_rate": 1.2 if dryrun else 4.0,
+                "amplitude": 0.4, "period": 20.0 if dryrun else 8.0,
+                "deadline_slack": None}
+    lease_arrivals = diurnal_arrivals(
+        seed=wl_lease["seed"], n_requests=wl_lease["n_requests"],
+        base_rate=wl_lease["base_rate"], amplitude=wl_lease["amplitude"],
+        period=wl_lease["period"], vocab=vocab)
+    # constant step cost LONGER than the static suspect window: the round
+    # (== heartbeat) cadence is 3.5 while suspect_after is 2.0 — the shape
+    # adaptive lease sizing exists for
+    heavy_scfg = ServingConfig(step_cost=(lambda toks: 3.5)
+                               if dryrun else None)
+    lease = {"suspect_after": 2.0, "lease": 6.0, "fence_retry": 2.0}
+    loss_p = 0.05
+    max_scale = 4.0
+    static_rec, static_out, static_deaths = _control_lease_point(
+        factory, clock_factory, lease_arrivals, heavy_scfg, seed,
+        loss_p, lease, adaptive=False)
+    adapt_rec, adapt_out, adapt_deaths = _control_lease_point(
+        factory, clock_factory, lease_arrivals, heavy_scfg, seed,
+        loss_p, lease, adaptive=True)
+    adapt_rec2, adapt_out2, adapt_deaths2 = _control_lease_point(
+        factory, clock_factory, lease_arrivals, heavy_scfg, seed,
+        loss_p, lease, adaptive=True)
+    kill_t, kill_rid = 18.0, 3
+    kill_rec, _, kill_deaths = _control_lease_point(
+        factory, clock_factory, lease_arrivals, heavy_scfg, seed,
+        loss_p, lease, adaptive=True,
+        schedule=[(kill_t, "kill", kill_rid)])
+    lease_offered = round(len(lease_arrivals)
+                          / max(lease_arrivals[-1]["arrival_ts"], 1e-9), 6)
+    for r in (static_rec, adapt_rec, adapt_rec2, kill_rec):
+        r["offered_rps"] = lease_offered
+        r["arrival_rate"] = wl_lease["base_rate"]
+    # detection latency: first fleet-declared death of the killed replica
+    # after the kill instant.  The bound is the fully-widened lease plus
+    # heartbeat/sweep quantization (three heavy rounds).
+    detect_bound = lease["lease"] * max_scale + 3 * 3.5
+    detected = [d for d in kill_deaths if d[0] == kill_rid and d[1] >= kill_t]
+    detected_ts = detected[0][1] if detected else None
+    lease_divergent = sum(1 for a, b in zip(static_out, adapt_out) if a != b)
+    adaptive_lease = {
+        "workload": wl_lease,
+        "step_cost": "3.5 (constant, > static suspect window)"
+        if dryrun else "wall",
+        "loss_p": loss_p,
+        "lease": lease,
+        "max_scale": max_scale,
+        "static": static_rec,
+        "adaptive": adapt_rec,
+        # no kills in either run: every expiration is a FALSE one
+        "static_false_expiries":
+            static_rec["control_plane"]["lease_expirations"],
+        "adaptive_false_expiries":
+            adapt_rec["control_plane"]["lease_expirations"],
+        "static_deaths": static_deaths,
+        "adaptive_deaths": adapt_deaths,
+        "lease_resizes": adapt_rec["control_plane"]["lease"]["lease_resizes"],
+        "kill": {"t": kill_t, "rid": kill_rid, "detected_ts": detected_ts,
+                 "latency": None if detected_ts is None
+                 else round(detected_ts - kill_t, 6),
+                 "bound": detect_bound, "deaths": kill_deaths,
+                 "fleet": kill_rec},
+        "divergent_requests": lease_divergent,
+        "zero_divergence": lease_divergent == 0,
+        "determinism_repeat_identical": (adapt_rec == adapt_rec2
+                                         and adapt_out == adapt_out2
+                                         and adapt_deaths == adapt_deaths2),
+    }
+    print(f"# control_loops/adaptive_lease: static expiries="
+          f"{adaptive_lease['static_false_expiries']} adaptive expiries="
+          f"{adaptive_lease['adaptive_false_expiries']} resizes="
+          f"{adaptive_lease['lease_resizes']} | kill detected="
+          f"{detected_ts} (bound {kill_t + detect_bound}) "
+          f"divergent={lease_divergent}", flush=True)
+
+    # --- sub-leg 2: predictive scale-up ---------------------------------
+    ttft_slo = 25.0 if dryrun else 2.0
+    wl_pred = {"kind": "flash_crowd", "seed": seed,
+               "n_requests": 110 if dryrun else 96,
+               "base_rate": 0.5 if dryrun else 2.0,
+               "crowd_rate": 12.0 if dryrun else 24.0,
+               "crowd_start": 10.0 if dryrun else 2.0,
+               "crowd_duration": 6.0 if dryrun else 3.0}
+    pred_arrivals = flash_crowd_arrivals(
+        seed=wl_pred["seed"], n_requests=wl_pred["n_requests"],
+        base_rate=wl_pred["base_rate"], crowd_rate=wl_pred["crowd_rate"],
+        crowd_start=wl_pred["crowd_start"],
+        crowd_duration=wl_pred["crowd_duration"], vocab=vocab,
+        tenants=[(name, p, slack) for name, p, slack, *_ in AUTOSCALE_TENANTS])
+    scfg = ServingConfig(step_cost=(lambda toks: 0.25 + 0.01 * toks)
+                         if dryrun else None)
+    react_rec, react_out = _predictive_point(
+        factory, clock_factory, pred_arrivals, scfg, ttft_slo,
+        predictive=False)
+    pred_rec, pred_out = _predictive_point(
+        factory, clock_factory, pred_arrivals, scfg, ttft_slo,
+        predictive=True)
+    pred_rec2, pred_out2 = _predictive_point(
+        factory, clock_factory, pred_arrivals, scfg, ttft_slo,
+        predictive=True)
+    pred_offered = round(len(pred_arrivals)
+                         / max(pred_arrivals[-1]["arrival_ts"], 1e-9), 6)
+    for r in (react_rec, pred_rec, pred_rec2):
+        r["offered_rps"] = pred_offered
+        r["arrival_rate"] = wl_pred["base_rate"]
+    # brownout caps only truncate best-effort outputs (greedy prefixes),
+    # so prefix-consistency IS zero divergence — same stance as autoscale
+    pred_divergent = 0
+    for a, b in zip(react_out, pred_out):
+        n = min(len(a), len(b))
+        if a[:n] != b[:n]:
+            pred_divergent += 1
+    spend_ratio = pred_rec["replica_steps"] / max(1, react_rec["replica_steps"])
+    predictive = {
+        "workload": wl_pred,
+        "step_cost": "0.25 + 0.01 * planned_tokens" if dryrun else "wall",
+        "ttft_slo": ttft_slo,
+        "warmup_horizon": 4.0,
+        "per_replica_rate": 2.0,
+        "reactive": react_rec,
+        "predictive": pred_rec,
+        "premium_p99_ttft": {
+            "reactive": react_rec["tenants"]["premium"]["ttft"]["p99"],
+            "predictive": pred_rec["tenants"]["premium"]["ttft"]["p99"],
+        },
+        "spend_ratio": round(spend_ratio, 4),
+        #: predictive capacity must cost at most 15% more replica-steps
+        #: than reactive — "beats p99 TTFT at near-equal spend"
+        "spend_bound": 1.15,
+        "divergent_requests": pred_divergent,
+        "zero_divergence": pred_divergent == 0,
+        "determinism_repeat_identical": (pred_rec == pred_rec2
+                                         and pred_out == pred_out2),
+    }
+    print(f"# control_loops/predictive: premium p99 ttft reactive="
+          f"{predictive['premium_p99_ttft']['reactive']} predictive="
+          f"{predictive['premium_p99_ttft']['predictive']} | steps reactive="
+          f"{react_rec['replica_steps']} predictive="
+          f"{pred_rec['replica_steps']} ratio={spend_ratio:.3f} "
+          f"divergent={pred_divergent}", flush=True)
+
+    # --- sub-leg 3: per-tenant KV page quotas ---------------------------
+    wl_quota = {"kind": "flash_crowd", "seed": seed,
+                "n_requests": 48 if dryrun else 64,
+                "base_rate": 1.0 if dryrun else 4.0,
+                "crowd_rate": 8.0 if dryrun else 16.0,
+                "crowd_start": 6.0 if dryrun else 2.0,
+                "crowd_duration": 5.0 if dryrun else 3.0}
+    quota_arrivals = flash_crowd_arrivals(
+        seed=wl_quota["seed"], n_requests=wl_quota["n_requests"],
+        base_rate=wl_quota["base_rate"], crowd_rate=wl_quota["crowd_rate"],
+        crowd_start=wl_quota["crowd_start"],
+        crowd_duration=wl_quota["crowd_duration"], vocab=vocab,
+        tenants=[(name, p, None) for name, p, _, _ in QUOTA_TENANTS])
+    quota_rec, _ = _quota_point(factory, clock_factory, quota_arrivals, scfg)
+    quota_rec["offered_rps"] = round(
+        len(quota_arrivals) / max(quota_arrivals[-1]["arrival_ts"], 1e-9), 6)
+    quota_rec["arrival_rate"] = wl_quota["base_rate"]
+    prem = quota_rec["tenants"].get("premium", {})
+    kv_quota = {
+        "workload": wl_quota,
+        "step_cost": "0.25 + 0.01 * planned_tokens" if dryrun else "wall",
+        "tenants": {name: {"mix": p, "kv_page_quota": q, "weight": w}
+                    for name, p, q, w in QUOTA_TENANTS},
+        "fleet": quota_rec,
+        "rejects": quota_rec["kv_quota_rejects"],
+        "accounting_closed": all(t.get("closed")
+                                 for t in quota_rec["tenants"].values()),
+        "unbounded_tenant_unharmed":
+            bool(prem) and prem["completed"] == prem["submitted"],
+    }
+    print(f"# control_loops/kv_quota: rejects={kv_quota['rejects']} "
+          f"bulk={quota_rec['tenants'].get('bulk')}", flush=True)
+
+    return {"adaptive_lease": adaptive_lease, "predictive": predictive,
+            "kv_quota": kv_quota}
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--dryrun", action="store_true",
@@ -881,7 +1194,52 @@ def main():
                                           vocab, kv.page_size, args.dryrun)
     partition = run_partition_leg(factory, clock_factory, args.seed, vocab,
                                   args.dryrun)
+    control_loops = run_control_loops_leg(factory, clock_factory, args.seed,
+                                          vocab, args.dryrun)
     _run_attrib()
+    if args.dryrun:
+        # the closed-loop-control receipts (deterministic on the virtual
+        # clock — fail the run, not just CI; wall mode records only)
+        al = control_loops["adaptive_lease"]
+        assert al["determinism_repeat_identical"], \
+            "adaptive-lease leg is not byte-reproducible"
+        assert al["static_false_expiries"] >= 1, \
+            "the static lease never false-fenced under heavy steps — the " \
+            "adaptive comparison is vacuous"
+        assert al["adaptive_false_expiries"] == 0, \
+            f"the adaptive lease false-fenced " \
+            f"{al['adaptive_false_expiries']} time(s): {al['adaptive_deaths']}"
+        assert al["lease_resizes"] >= 1, \
+            "the adaptive lease never resized — the gap EWMA fed nothing"
+        kill = al["kill"]
+        assert kill["latency"] is not None and \
+            kill["latency"] <= kill["bound"], \
+            f"real kill not detected inside the widened-lease band: {kill}"
+        assert al["zero_divergence"], \
+            f"{al['divergent_requests']} request(s) diverged between " \
+            "static and adaptive lease sizing"
+        pr = control_loops["predictive"]
+        assert pr["determinism_repeat_identical"], \
+            "predictive autoscale leg is not byte-reproducible"
+        assert pr["zero_divergence"], \
+            f"{pr['divergent_requests']} request(s) diverged between " \
+            "reactive and predictive autoscaling"
+        ttfts = pr["premium_p99_ttft"]
+        assert ttfts["predictive"] < ttfts["reactive"], \
+            f"predictive premium p99 TTFT {ttfts['predictive']} does not " \
+            f"beat reactive {ttfts['reactive']}"
+        assert pr["spend_ratio"] <= pr["spend_bound"], \
+            f"predictive spend ratio {pr['spend_ratio']} over the bound " \
+            f"{pr['spend_bound']} — forecast capacity is not near-equal spend"
+        kq = control_loops["kv_quota"]
+        assert kq["rejects"] >= 1, \
+            "the KV page quota never rejected — the quota loop is untested"
+        assert kq["accounting_closed"], \
+            f"tenant accounting did not close under quota rejection: " \
+            f"{kq['fleet']['tenants']}"
+        assert kq["unbounded_tenant_unharmed"], \
+            f"the unbounded tenant lost work to its neighbor's quota: " \
+            f"{kq['fleet']['tenants'].get('premium')}"
     if args.dryrun:
         # the partition-tolerance receipts (deterministic on the virtual
         # clock — fail the run, not just CI; wall mode records only)
@@ -973,7 +1331,7 @@ def main():
         "metric": "fleet_goodput_rps",
         "value": best["goodput_rps"],
         "unit": "requests/s" if not args.dryrun else "requests/step",
-        "schema_version": 5,
+        "schema_version": 6,
         "sla": {"ttft_budget": ttft_budget, "tpot_budget": tpot_budget},
         "workload": {"n_requests": n_requests, "seed": args.seed,
                      "arrival_rate": rate,
@@ -997,6 +1355,7 @@ def main():
         "autoscale": autoscale,
         "prefix_directory": prefix_dir,
         "partition": partition,
+        "control_loops": control_loops,
     }
     print(json.dumps({k: result[k] for k in ("metric", "value", "unit")} |
                      {"best": {"policy": best["policy"],

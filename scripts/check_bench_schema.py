@@ -291,6 +291,119 @@ def _partition_record(v):
     return None
 
 
+def _control_loops_record(v):
+    """The closed-loop-control receipt (bench_router.py
+    run_control_loops_leg, docs/SERVING.md "Closed-loop control"), three
+    sub-records.  ``adaptive_lease``: under heavy steps + control-plane
+    loss the static lease must record >= 1 FALSE expiry while the
+    adaptive lease (same base numbers) records ZERO — yet still detects
+    a real injected kill inside its widened-lease band, with zero output
+    divergence and byte-identical repeats.  ``predictive``: the
+    arrival-rate forecast must beat reactive autoscaling on premium p99
+    TTFT at near-equal replica-step spend (<= the declared spend bound),
+    zero divergence, byte-identical repeats.  ``kv_quota``: the page
+    quota must actually reject (>= 1), every tenant's accounting must
+    close under rejection, and the unbounded tenant must complete all of
+    its submitted work."""
+    if not isinstance(v, dict):
+        return f"expected control_loops object, got {type(v).__name__}"
+    for k in ("adaptive_lease", "predictive", "kv_quota"):
+        if not isinstance(v.get(k), dict):
+            return f"missing/invalid control_loops sub-record {k!r}"
+    al = v["adaptive_lease"]
+    for k in ("workload", "loss_p", "lease", "max_scale", "static",
+              "adaptive", "static_false_expiries", "adaptive_false_expiries",
+              "lease_resizes", "kill", "zero_divergence",
+              "divergent_requests", "determinism_repeat_identical"):
+        if k not in al:
+            return f"missing adaptive_lease key {k!r}"
+    if al["determinism_repeat_identical"] is not True:
+        return "adaptive-lease leg not byte-identical across runs"
+    if not (isinstance(al["static_false_expiries"], int)
+            and al["static_false_expiries"] >= 1):
+        return ("static lease recorded no false expiry under heavy steps "
+                f"({al['static_false_expiries']!r}) — the adaptive "
+                "comparison is vacuous")
+    if al["adaptive_false_expiries"] != 0:
+        return (f"adaptive lease false-fenced "
+                f"{al['adaptive_false_expiries']!r} time(s) — sizing must "
+                "absorb benign heartbeat loss")
+    if not (isinstance(al["lease_resizes"], int) and al["lease_resizes"] >= 1):
+        return "adaptive lease never resized — the gap EWMA fed nothing"
+    kill = al["kill"]
+    if not isinstance(kill, dict):
+        return f"adaptive_lease.kill is not an object: {kill!r}"
+    lat, bound = kill.get("latency"), kill.get("bound")
+    for name, x in (("latency", lat), ("bound", bound)):
+        if not isinstance(x, (int, float)) or isinstance(x, bool):
+            return f"adaptive_lease.kill.{name} is not a number ({x!r})"
+    if lat > bound:
+        return (f"real kill detected {lat} after injection, outside the "
+                f"widened-lease band {bound} — adaptive sizing traded "
+                "real-death detection away")
+    if al["zero_divergence"] is not True or al["divergent_requests"] != 0:
+        return (f"output divergence recorded ({al['divergent_requests']} "
+                "request(s)) between static and adaptive lease sizing")
+    pr = v["predictive"]
+    for k in ("workload", "reactive", "predictive", "premium_p99_ttft",
+              "spend_ratio", "spend_bound", "zero_divergence",
+              "divergent_requests", "determinism_repeat_identical"):
+        if k not in pr:
+            return f"missing predictive key {k!r}"
+    if pr["determinism_repeat_identical"] is not True:
+        return "predictive autoscale leg not byte-identical across runs"
+    if pr["zero_divergence"] is not True or pr["divergent_requests"] != 0:
+        return (f"output divergence recorded ({pr['divergent_requests']} "
+                "request(s)) between reactive and predictive autoscaling")
+    ttfts = pr["premium_p99_ttft"]
+    if not isinstance(ttfts, dict):
+        return f"premium_p99_ttft is not an object: {ttfts!r}"
+    re_p99, pr_p99 = ttfts.get("reactive"), ttfts.get("predictive")
+    for name, x in (("reactive", re_p99), ("predictive", pr_p99)):
+        if not isinstance(x, (int, float)) or isinstance(x, bool):
+            return f"premium_p99_ttft.{name} is not a number ({x!r})"
+    if not pr_p99 < re_p99:
+        return (f"predictive premium p99 TTFT {pr_p99} does not beat "
+                f"reactive {re_p99} — the forecast bought nothing")
+    sb = pr["spend_bound"]
+    if not isinstance(sb, (int, float)) or isinstance(sb, bool) or sb < 1.0:
+        return f"spend_bound {sb!r} is not a declared ratio >= 1"
+    sr = pr["spend_ratio"]
+    if not isinstance(sr, (int, float)) or isinstance(sr, bool) or sr > sb:
+        return (f"predictive replica-step spend ratio {sr!r} over the "
+                f"declared bound {sb} — not a near-equal-spend win")
+    kq = v["kv_quota"]
+    for k in ("workload", "tenants", "fleet", "rejects",
+              "accounting_closed", "unbounded_tenant_unharmed"):
+        if k not in kq:
+            return f"missing kv_quota key {k!r}"
+    if not (isinstance(kq["rejects"], int) and kq["rejects"] >= 1):
+        return ("the KV page quota never rejected — the quota loop went "
+                "unexercised in the committed receipt")
+    if kq["accounting_closed"] is not True:
+        return ("tenant accounting did not close under quota rejection "
+                "(submitted != completed+timed_out+rejected)")
+    if kq["unbounded_tenant_unharmed"] is not True:
+        return ("the unbounded tenant lost work to its neighbor's quota — "
+                "quotas must isolate, not leak")
+    fleet = kq["fleet"]
+    if not isinstance(fleet, dict) or \
+            fleet.get("kv_quota_rejects") != kq["rejects"]:
+        return (f"fleet-side quota accounting "
+                f"{fleet.get('kv_quota_rejects')!r} disagrees with the "
+                f"record's rejects {kq['rejects']!r}")
+    errors = []
+    for side, rec in (("adaptive_lease.static", al["static"]),
+                      ("adaptive_lease.adaptive", al["adaptive"]),
+                      ("predictive.reactive", pr["reactive"]),
+                      ("predictive.predictive", pr["predictive"]),
+                      ("kv_quota.fleet", fleet)):
+        _check(rec, _ROUTER_POINT, f"control_loops.{side}", errors)
+    if errors:
+        return "; ".join(errors)
+    return None
+
+
 def _router_sweep_invariants(v):
     """The fleet bench's acceptance receipts: >= 3 points, the
     prefix_affinity policy actually hit its cache somewhere, and every
@@ -679,10 +792,10 @@ SCHEMAS = {
                         "concurrency": INT},
         "engine_throughput": ("nullable", _LEGACY_THROUGHPUT),
     },
-    # the fleet router harness (scripts/bench_router.py, schema v5)
+    # the fleet router harness (scripts/bench_router.py, schema v6)
     "BENCH_ROUTER.json": {
         "metric": STR, "value": NUM, "unit": STR,
-        "schema_version": lambda v: None if v == 5 else f"schema_version {v} != 5",
+        "schema_version": lambda v: None if v == 6 else f"schema_version {v} != 6",
         "sla": {"ttft_budget": NUM, "tpot_budget": NUM},
         "workload": {"n_requests": INT, "seed": INT, "arrival_rate": NUM,
                      "prefix_groups": INT, "prefix_pages": INT, "dryrun": BOOL,
@@ -695,6 +808,7 @@ SCHEMAS = {
         "autoscale": _autoscale_record,
         "prefix_directory": _prefix_directory_record,
         "partition": _partition_record,
+        "control_loops": _control_loops_record,
     },
 }
 

@@ -1,0 +1,367 @@
+"""Closed-loop control tests (docs/SERVING.md "Closed-loop control"):
+adaptive lease sizing (health.py), predictive + role-aware autoscaling
+(autoscale.py), and the per-tenant KV page quota (router admission +
+prefix import).
+
+The standing contract: every loop is deterministic (same inputs, same
+decisions, byte-identical outputs), OFF by default (static configs stay
+byte-identical to r20), and fails toward SLOWER, never WRONG — an
+adaptive lease widens before it false-fences, a forecast miss leaves the
+reactive thresholds armed, a quota rejection is an explicit REJECTED
+with a retry-after hint, never silent arena starvation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, build_engine
+from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.models.llama_cache import PagedKVConfig
+from deepspeed_tpu.serving import VirtualClock
+from deepspeed_tpu.serving.engine import ServingConfig
+from deepspeed_tpu.serving.fleet import (AutoscaleConfig, Autoscaler,
+                                         ControlTransport, FleetSimulator,
+                                         FleetState, LeaseConfig, LinkFaults,
+                                         ReplicaPool, ReplicaState, Router,
+                                         TenantRegistry, TenantSpec,
+                                         make_policy)
+from deepspeed_tpu.serving.fleet.health import FleetHealthView, LeaseState
+from deepspeed_tpu.serving.fleet.pool import ReplicaRole
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128,
+                  rope_theta=1e4, dtype=jnp.float32, scan_layers=True, remat=False)
+
+
+@pytest.fixture(scope="module")
+def trained_params():
+    model = LlamaForCausalLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def _factory(trained_params):
+    def make():
+        kv = PagedKVConfig(num_pages=64, page_size=8, max_pages_per_seq=8)
+        sched = SchedulerConfig(token_budget=64, max_seqs=8, prefill_chunk=8,
+                                decode_bucket=4)
+        return build_engine(CFG, trained_params, RaggedInferenceEngineConfig(
+            kv=kv, scheduler=sched, kv_dtype=jnp.float32, decode_steps_per_dispatch=1))
+    return make
+
+
+@pytest.fixture(scope="module")
+def goldens(trained_params):
+    cache = {}
+    eng = _factory(trained_params)()
+
+    def get(prompt, max_new=8):
+        key = tuple(prompt)
+        if key not in cache or len(cache[key]) < max_new:
+            cache[key] = eng.generate([list(prompt)], max_new_tokens=max_new)[0]
+        return cache[key]
+    return get
+
+
+PROMPTS = [[5, 9, 2, 7, 1], [3, 3, 8, 1], [2, 4, 6, 8, 10, 12], [13, 1, 1, 2],
+           [21, 7], [9, 9, 9, 4, 2], [17, 3, 5], [11, 2, 2, 6, 8]]
+
+
+def _arrivals(prompts, max_new=6, spacing=1.0):
+    return [dict(prompt=p, max_new_tokens=max_new, arrival_ts=round(i * spacing, 6))
+            for i, p in enumerate(prompts)]
+
+
+# ------------------------------------------------------------ config gates
+
+
+def test_control_loop_config_validation():
+    with pytest.raises(ValueError, match="max_scale"):
+        LeaseConfig(adaptive=True, max_scale=0.5)
+    with pytest.raises(ValueError, match="miss_budget"):
+        LeaseConfig(adaptive=True, miss_budget=0.0)
+    with pytest.raises(ValueError, match="interarrival_alpha"):
+        LeaseConfig(interarrival_alpha=0.0)
+    with pytest.raises(ValueError, match="feed_gap_weight"):
+        LeaseConfig(feed_gap_weight=-0.1)
+    with pytest.raises(ValueError, match="warmup_horizon"):
+        AutoscaleConfig(warmup_horizon=-1.0)
+    with pytest.raises(ValueError, match="per_replica_rate"):
+        AutoscaleConfig(per_replica_rate=0.0)
+    with pytest.raises(ValueError, match="role_imbalance"):
+        AutoscaleConfig(role_aware=True, role_imbalance=1.0)
+    with pytest.raises(ValueError, match="kv_page_quota"):
+        TenantSpec("t", kv_page_quota=-1)
+
+
+# ------------------------------------------------- adaptive lease (unit)
+
+
+def test_adaptive_lease_widens_clamps_and_tightens():
+    """The resize loop against synthetic heartbeats: slow beats over a
+    lossy link WIDEN the band (fast — it is the false-fence guard), the
+    scale never leaves [1, max_scale], and recovered links TIGHTEN back
+    to the configured base.  Every applied move is an auditable
+    ``fleet/lease_resize`` with a history entry."""
+    events = []
+    view = FleetHealthView([0], LeaseConfig(
+        suspect_after=2.0, lease=6.0, adaptive=True, max_scale=3.0),
+        emit=lambda n, v: events.append((n, v)))
+    assert view.effective_lease(0) == (2.0, 6.0)   # scale 1.0: the base holds
+    # slow heartbeats (gap 2.0) on a 50%-lossy link: target_suspect =
+    # 3 * 2.0 / 0.5 = 12s -> scale 6, clamped at max_scale 3
+    t = 0.0
+    for seq in range(1, 5):
+        t = 2.0 * seq
+        view.observe_heartbeat(0, seq, "healthy", {}, t, t)
+    view.note_link_quality(0, loss_ewma=0.5, feed_gap_age=0.0, now=t)
+    assert view.effective_lease(0) == (6.0, 18.0)  # 3x, the clamp
+    assert view.resizes and view.resizes[-1][4] == "widen"
+    assert ("fleet/lease_resize", 0.0) in events
+    # the link recovers and the beats speed up: tighten back down — the
+    # hysteresis deadband (tighten_frac 0.25) legitimately parks the
+    # scale within 1/(1-0.25) of the floor rather than exactly at 1.0
+    seq = 5
+    for i in range(40):
+        t = round(t + 0.4, 9)
+        view.observe_heartbeat(0, seq + i, "healthy", {}, t, t)
+        view.note_link_quality(0, loss_ewma=0.0, feed_gap_age=0.0, now=t)
+    assert view.effective_lease(0)[0] <= 2.0 * (1.0 / 0.75)
+    dirs = {r[4] for r in view.resizes}
+    assert dirs == {"widen", "tighten"}
+    # the clamp held throughout: no resize ever left [1, max_scale]
+    assert all(1.0 <= r[3] <= 3.0 for r in view.resizes)
+    assert view.summary()["lease_resizes"] == len(view.resizes)
+
+
+def test_adaptive_off_is_inert():
+    """adaptive=False: note_link_quality is a no-op and the static
+    constants hold — byte-identical r20 behavior."""
+    view = FleetHealthView([0], LeaseConfig(suspect_after=2.0, lease=6.0))
+    for seq in range(1, 5):
+        view.observe_heartbeat(0, seq, "healthy", {}, 3.0 * seq, 3.0 * seq)
+    view.note_link_quality(0, loss_ewma=0.6, feed_gap_age=5.0, now=12.0)
+    assert view.effective_lease(0) == (2.0, 6.0)
+    assert not view.resizes
+
+
+# ------------------------------------- adaptive lease (fleet regression)
+
+
+def _lease_fleet(trained_params, adaptive, loss_p=0.15, seed=2):
+    clock = VirtualClock()
+    transport = ControlTransport(clock, faults=LinkFaults(loss_p=loss_p),
+                                 seed=seed)
+    pool = ReplicaPool(_factory(trained_params), 2, clock=clock,
+                       transport=transport,
+                       serving_config=ServingConfig(step_cost=lambda t: 3.5))
+    router = Router(pool, make_policy("least_outstanding"), transport=transport,
+                    lease_config=LeaseConfig(suspect_after=2.0, lease=6.0,
+                                             fence_retry=2.0,
+                                             adaptive=adaptive, max_scale=4.0))
+    return router, pool
+
+
+def test_adaptive_lease_prevents_heavy_step_false_fencing(trained_params, goldens):
+    """THE false-fencing regression: steps cost 3.5s, so the heartbeat
+    cadence (3.5s) already exceeds suspect_after (2s) and one lost beat
+    exceeds the whole 6s static lease — the static fleet fences healthy
+    replicas on fabric noise.  The adaptive fleet reads the same slow
+    interarrivals, widens its band, and expires NOTHING — while a real
+    kill stays detectable within the clamped bound (next test)."""
+    arrivals = _arrivals(PROMPTS, max_new=6, spacing=1.0)
+
+    def run(adaptive):
+        router, pool = _lease_fleet(trained_params, adaptive)
+        reqs = FleetSimulator(router).run([dict(a) for a in arrivals])
+        return router, reqs
+
+    r_static, reqs_s = run(False)
+    r_adapt, reqs_a = run(True)
+    # nothing was killed: every static expiry is a FALSE fence
+    assert r_static.summary()["control_plane"]["lease_expirations"] >= 1
+    assert r_adapt.summary()["control_plane"]["lease_expirations"] == 0
+    assert r_adapt.summary()["control_plane"]["lease"]["lease_resizes"] >= 1
+    # failover keeps the static fleet CORRECT (slower, never wrong): both
+    # runs still complete everything with golden-identical outputs
+    for reqs in (reqs_s, reqs_a):
+        assert [r.state for r in reqs] == [FleetState.DONE] * len(PROMPTS)
+        for r in reqs:
+            assert r.tokens == goldens(r.prompt, r.max_new_tokens)
+    # determinism: the adaptive resize timeline replays byte-for-byte
+    r_adapt2, reqs_a2 = run(True)
+    assert [r.tokens for r in reqs_a2] == [r.tokens for r in reqs_a]
+    assert r_adapt2.lease.resizes == r_adapt.lease.resizes
+
+
+def test_adaptive_lease_detects_real_kill_within_band(trained_params):
+    """The widened band must stay a DETECTOR: a silent host loss under
+    the adaptive lease is declared fleet-dead within the clamped bound
+    lease * max_scale plus a few heartbeat rounds."""
+    kill_t = 10.0
+    arrivals = _arrivals(PROMPTS * 2, max_new=6, spacing=3.0)
+    router, pool = _lease_fleet(trained_params, adaptive=True,
+                                loss_p=0.05, seed=0)
+    reqs = FleetSimulator(router).run(
+        [dict(a) for a in arrivals], schedule=[(kill_t, "kill", 1)])
+    deaths = [(rid, ts) for rid, _f, to, ts, _r in router.lease.history
+              if to is LeaseState.DEAD]
+    assert deaths and deaths[0][0] == 1
+    detect_latency = deaths[0][1] - kill_t
+    bound = 6.0 * 4.0 + 3 * 3.5   # lease * max_scale + 3 heartbeat rounds
+    assert 0.0 < detect_latency <= bound, (detect_latency, bound)
+    # the killed replica's work re-homed; everything still completed
+    assert [r.state for r in reqs] == [FleetState.DONE] * len(arrivals)
+
+
+# ------------------------------------------------- predictive autoscaler
+
+
+def _asc_fleet(trained_params, n_replicas, cfg, tenants=None, roles=None):
+    pool = ReplicaPool(_factory(trained_params), n_replicas,
+                       clock=VirtualClock(), roles=roles,
+                       serving_config=ServingConfig(step_cost=lambda t: 0.5))
+    router = Router(pool, make_policy("least_outstanding"), tenants=tenants)
+    return pool, router, Autoscaler(router, cfg)
+
+
+def test_predictive_scale_up_from_forecast(trained_params):
+    """The forecast trigger: arrival rate projected along its slope to
+    the warm-up horizon exceeds dispatchable capacity -> recover a parked
+    replica NOW, before any queue/TTFT pressure exists."""
+    cfg = AutoscaleConfig(min_replicas=1, predictive=True, warmup_horizon=4.0,
+                          per_replica_rate=1.0, cooldown_up=0.0,
+                          decide_interval=0.0)
+    pool, router, asc = _asc_fleet(trained_params, 2, cfg)
+    pool.kill(1, reason="autoscale: parked")
+    router.arrival_rate = lambda: (2.5, 0.5)   # projected 4.5 > capacity 1.0
+    asc.step(0.0)
+    assert [d[1] for d in asc.decisions] == ["up"]
+    assert "projected 4.500" in asc.decisions[0][3]
+    assert pool.health.state(1) is ReplicaState.RECOVERING
+
+
+def test_predictive_scale_up_from_slo_fast_burn(trained_params):
+    """The burn-rate trigger: a premium tenant burning its TTFT error
+    budget at >= 1x on the fast window is demand the rate fold has not
+    caught up to — scale up even with a flat forecast."""
+    tenants = TenantRegistry([TenantSpec("premium", ttft_slo=10.0),
+                              TenantSpec("bulk", best_effort=True)])
+    cfg = AutoscaleConfig(min_replicas=1, predictive=True, cooldown_up=0.0,
+                          decide_interval=0.0, per_replica_rate=1.0)
+    pool, router, asc = _asc_fleet(trained_params, 2, cfg, tenants=tenants)
+    pool.kill(1, reason="autoscale: parked")
+    router.arrival_rate = lambda: (0.0, 0.0)
+
+    class _Slo:
+        def burn_rates(self, name, now):
+            return (1.5, 0.1) if name == "premium" else (0.0, 0.0)
+    router.slo = _Slo()
+    asc.step(0.0)
+    assert [d[1] for d in asc.decisions] == ["up"]
+    assert "fast burn rate" in asc.decisions[0][3]
+    assert pool.health.state(1) is ReplicaState.RECOVERING
+
+
+def test_predictive_forecast_guards_scale_down(trained_params):
+    """A momentarily empty queue during a ramp must not shrink the fleet:
+    while the projected rate still needs today's capacity the low-streak
+    stays pinned at zero; once the forecast clears, scale-down resumes."""
+    cfg = AutoscaleConfig(min_replicas=1, predictive=True, warmup_horizon=4.0,
+                          per_replica_rate=1.0, down_streak=2,
+                          cooldown_down=0.0, decide_interval=0.0)
+    pool, router, asc = _asc_fleet(trained_params, 2, cfg)
+    # idle fleet, but the forecast (1.5 req/s) exceeds what ONE replica
+    # absorbs: shrinking would dig a hole right before the ramp lands
+    router.arrival_rate = lambda: (1.5, 0.0)
+    for t in range(6):
+        asc.step(float(t))
+    assert asc.decisions == [] and asc._low_streak == 0
+    # demand actually fades: the ordinary low-streak drain proceeds
+    router.arrival_rate = lambda: (0.2, 0.0)
+    for t in range(6, 10):
+        asc.step(float(t))
+    assert [d[1] for d in asc.decisions][:1] == ["drain"]
+
+
+def test_role_rebalance_prefill_starved(trained_params):
+    """Role-aware rebalancing: a backlog only prefill-capable replicas
+    can admit, against an idle decode tier -> the last pure-DECODE
+    replica drains and re-roles to MIXED (drain-gated: the role change
+    applies only once the replica is idle), leaving at least one
+    decode-capable replica untouched."""
+    cfg = AutoscaleConfig(min_replicas=1, role_aware=True, role_imbalance=1.5,
+                          role_cooldown=8.0, decide_interval=0.0)
+    pool, router, asc = _asc_fleet(trained_params, 3, cfg,
+                                   roles=["prefill", "decode", "decode"])
+    for i in range(4):   # queued work only replica 0 may admit
+        router.submit([1 + i, 2, 3], max_new_tokens=4, arrival_ts=0.0)
+    asc.step(0.0)
+    assert [d[1] for d in asc.decisions] == ["role_drain"]
+    assert asc.decisions[0][2] == 2          # the LAST pure-decode replica
+    assert pool.health.state(2) is ReplicaState.DRAINING
+    # idle already -> the next step applies the role change via restart
+    asc.step(0.1)
+    assert [d[1] for d in asc.decisions] == ["role_drain", "role_change"]
+    assert pool.replica(2).role is ReplicaRole.MIXED
+    assert pool.replica(1).role is ReplicaRole.DECODE   # the floor survivor
+    # cooldown: no second role move inside the window
+    asc.step(0.2)
+    assert len(asc.decisions) == 2
+
+
+# ------------------------------------------------------- kv page quota
+
+
+def test_kv_quota_rejects_at_admission_and_releases(trained_params):
+    """Admission charges the request's projected page need against the
+    tenant's live fleet-wide tally: a second request that would overflow
+    the quota is REJECTED with a retry-after hint while the tenant's own
+    work holds the pages — and admits again once they free.  An
+    unbounded tenant (quota 0) is never metered."""
+    tenants = TenantRegistry([TenantSpec("bulk", kv_page_quota=2),
+                              TenantSpec("premium")])
+    pool = ReplicaPool(_factory(trained_params), 1, clock=VirtualClock())
+    router = Router(pool, make_policy("least_outstanding"), tenants=tenants)
+    r1 = router.submit(PROMPTS[0], max_new_tokens=8, arrival_ts=0.0,
+                       tenant="bulk")           # needs ceil(13/8) = 2 pages
+    router.dispatch_pending()
+    pool.tick(0)                                # r1 now holds live pages
+    r2 = router.submit(PROMPTS[1], max_new_tokens=8, arrival_ts=0.0,
+                       tenant="bulk")
+    assert r2.state is FleetState.REJECTED
+    assert r2.reject_reason == "kv_quota" and r2.retry_after > 0
+    assert router.stats["kv_quota_rejects"] == 1
+    # the unbounded tenant rides through untouched
+    r3 = router.submit(PROMPTS[2], max_new_tokens=8, arrival_ts=0.0,
+                       tenant="premium")
+    assert r3.state is not FleetState.REJECTED
+    FleetSimulator(router).run([])
+    assert r1.state is FleetState.DONE and r3.state is FleetState.DONE
+    # pages released with the work: the same tenant admits again
+    r4 = router.submit(PROMPTS[3], max_new_tokens=8,
+                       arrival_ts=router.clock.now(), tenant="bulk")
+    assert r4.state is not FleetState.REJECTED
+    FleetSimulator(router).run([])
+    assert r4.state is FleetState.DONE
+    s = router.summary()
+    assert s["kv_quota_rejects"] == 1
+    assert s["tenants"]["bulk"]["closed"] and s["tenants"]["premium"]["closed"]
+
+
+def test_kv_quota_blocks_prefix_import_before_staging(trained_params):
+    """The import path charges the IMPORTING tenant's quota BEFORE the
+    d2h export: a quota-bound tenant falls back to a cold dispatch
+    (slower, never wrong) and costs zero staging bandwidth."""
+    tenants = TenantRegistry([TenantSpec("bulk", kv_page_quota=1)])
+    pool = ReplicaPool(_factory(trained_params), 2, clock=VirtualClock())
+    router = Router(pool, make_policy("least_outstanding"), tenants=tenants)
+    fr = router.submit([4, 2], max_new_tokens=4, arrival_ts=0.0,
+                       tenant="bulk")           # needs 1 page: admitted
+    assert fr.state is not FleetState.REJECTED
+    res = router._prefix_import(
+        fr, 1, {"prefix_import": {"donor": 0, "donor_depth": 5}}, 0.0)
+    assert res == "fallback"
+    assert router.stats["kv_quota_rejects"] == 1
+    assert router.stats["prefix_imports"] == 0   # no export was staged

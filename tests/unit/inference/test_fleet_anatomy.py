@@ -114,27 +114,36 @@ def test_tenant_pages_gauges_exported_and_zeroed(trained_params):
 
 def test_arrival_rate_ewma_arithmetic(trained_params):
     """Hand-checked fold: rate EWMA over two rounds with known arrivals
-    and clock advances (alpha = 0.2)."""
+    and clock advances.  The fold is a TIME-CONSTANT EWMA (r21:
+    alpha = 1 - exp(-dt / tau), tau = 2.5s) so the smoothing depth is a
+    property of wall time, not of round cadence — a fleet stepping 3.5s
+    rounds adapts exactly as fast as one stepping 0.5s rounds."""
+    import math
     metrics = MetricsRegistry()
     router, pool = _fleet(trained_params, n=1, metrics=metrics)
     clock = pool.clock
+    tau = router.arrival_rate_tau
     router.export_replica_gauges()           # t=0: anchor, gauges read 0
     assert metrics.gauge("fleet/arrival_rate_ewma").value == 0.0
     for i in range(4):                        # 4 arrivals in 2s -> 2/s
         router.submit(PROMPTS[i % len(PROMPTS)], max_new_tokens=2,
                       arrival_ts=0.5 * i)
     clock.advance(2.0)
-    router.export_replica_gauges()
+    router.export_replica_gauges()           # first sample seeds the EWMA
     assert metrics.gauge("fleet/arrival_rate_ewma").value == pytest.approx(2.0)
     assert metrics.gauge("fleet/arrival_rate_slope").value == 0.0
     clock.advance(2.0)                        # 0 arrivals in 2s -> inst 0
     router.export_replica_gauges()
-    # ewma = 0.2*0 + 0.8*2 = 1.6; slope = (1.6 - 2.0)/2 = -0.2
-    assert metrics.gauge("fleet/arrival_rate_ewma").value == pytest.approx(1.6)
-    assert metrics.gauge("fleet/arrival_rate_slope").value == pytest.approx(-0.2)
+    # alpha = 1 - exp(-2/2.5); ewma = 2 + alpha*(0 - 2) = 2*exp(-0.8)
+    # slope = alpha * ((ewma - 2)/2) (smoothed with the same constant)
+    alpha = 1.0 - math.exp(-2.0 / tau)
+    ewma = 2.0 * math.exp(-2.0 / tau)
+    assert metrics.gauge("fleet/arrival_rate_ewma").value == pytest.approx(ewma)
+    assert metrics.gauge("fleet/arrival_rate_slope").value == pytest.approx(
+        alpha * (ewma - 2.0) / 2.0)
     # zero-advance rounds carry no new information: values unchanged
     router.export_replica_gauges()
-    assert metrics.gauge("fleet/arrival_rate_ewma").value == pytest.approx(1.6)
+    assert metrics.gauge("fleet/arrival_rate_ewma").value == pytest.approx(ewma)
 
 
 def test_arrival_gauges_deterministic_under_virtual_clock(trained_params):

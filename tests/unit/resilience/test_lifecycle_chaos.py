@@ -1,0 +1,279 @@
+"""Lifecycle command-plane chaos suite (docs/RESILIENCE.md
+"Lifecycle command plane", docs/SERVING.md "Closed-loop control").
+
+r21 moved every autoscaler/migration replica mutation onto the control
+transport as typed, seq-numbered, epoch-fenced ``lifecycle_cmd``
+messages.  The contract under chaos: commands are applied EXACTLY ONCE
+no matter how the fabric loses, duplicates or delays them (the replica's
+seq ledger re-acks without re-applying); a command or ack that crosses a
+fencing epoch is discarded/aborted, never applied into the post-fence
+world; transient faults at the ``lifecycle.cmd.send`` /
+``lifecycle.cmd.apply`` injection sites are absorbed as message loss and
+recovered by the stop-and-wait retry timer; ``InjectedCrash`` (simulated
+driver death) propagates.  And the whole closed-loop control plane —
+adaptive leases + predictive/role-aware autoscaling + transported
+lifecycle — survives the 3-seed property audit with byte-identical
+outputs and closed accounting."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, build_engine
+from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.models.llama_cache import PagedKVConfig
+from deepspeed_tpu.resilience.fault_injection import (INJECTION_SITES, FaultSpec,
+                                                      InjectedCrash,
+                                                      configure_fault_injection)
+from deepspeed_tpu.serving import VirtualClock
+from deepspeed_tpu.serving.fleet import (AutoscaleConfig, Autoscaler,
+                                         ControlTransport, FleetSimulator,
+                                         FleetState, LeaseConfig,
+                                         LeastOutstandingPolicy, LinkFaults,
+                                         ReplicaPool, ReplicaState, Router,
+                                         TenantRegistry, TenantSpec)
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128,
+                  rope_theta=1e4, dtype=jnp.float32, scan_layers=True, remat=False)
+
+
+@pytest.fixture(scope="module")
+def trained_params():
+    model = LlamaForCausalLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    configure_fault_injection(None)
+
+
+def _factory(trained_params):
+    def make():
+        kv = PagedKVConfig(num_pages=64, page_size=8, max_pages_per_seq=8)
+        sched = SchedulerConfig(token_budget=64, max_seqs=8, prefill_chunk=8,
+                                decode_bucket=4)
+        return build_engine(CFG, trained_params, RaggedInferenceEngineConfig(
+            kv=kv, scheduler=sched, kv_dtype=jnp.float32, decode_steps_per_dispatch=1))
+    return make
+
+
+def _fleet(trained_params, n_replicas, faults=None, seed=0, lease=None,
+           tenants=None):
+    clock = VirtualClock()
+    transport = ControlTransport(clock, faults=faults, seed=seed)
+    pool = ReplicaPool(_factory(trained_params), n_replicas, clock=clock,
+                       transport=transport)
+    router = Router(pool, LeastOutstandingPolicy(), transport=transport,
+                    tenants=tenants,
+                    # a huge lease for the command-plane unit legs: the
+                    # manual polling timelines below never tick the pool,
+                    # and heartbeat silence must not expire anything
+                    lease_config=lease or LeaseConfig(suspect_after=25.0,
+                                                      lease=50.0))
+    return router, pool, transport
+
+
+# ------------------------------------------------------------------- sites
+
+
+def test_lifecycle_sites_registered():
+    assert "lifecycle.cmd.send" in INJECTION_SITES
+    assert "lifecycle.cmd.apply" in INJECTION_SITES
+    FaultSpec(site="lifecycle.cmd.send", kind="os_error")     # validates
+    FaultSpec(site="lifecycle.cmd.apply", kind="crash")
+    with pytest.raises(ValueError, match="unknown injection site"):
+        FaultSpec(site="lifecycle.cmd.ack", kind="os_error")
+
+
+def test_send_fault_is_retried_until_applied(trained_params):
+    """Transient ``os_error`` at the send edge: the datagram never left
+    the host, the command stays unacked, and the stop-and-wait retry
+    timer (lifecycle_retry) re-sends until it lands and acks."""
+    configure_fault_injection({"sites": [
+        {"site": "lifecycle.cmd.send", "kind": "os_error", "at": 1, "times": 2}]})
+    router, pool, tr = _fleet(trained_params, 2)
+    router.lifecycle_command(0, "drain", now=0.0)
+    assert router.stats["lifecycle_send_faults"] >= 1
+    for t in (1.1, 2.2, 3.3, 4.4):
+        router.clock.advance(1.1)
+        router.transport_poll(t)
+    assert router.stats["lifecycle_send_faults"] == 2
+    assert pool.health.state(0) is ReplicaState.DRAINING
+    assert router.stats["lifecycle_applied"] == 1
+    assert router.stats["lifecycle_acked"] == 1
+    assert not router.lifecycle_pending(0)
+
+
+def test_duplicate_delivery_applies_exactly_once(trained_params):
+    """dup_p = 1: every message (command AND ack) is delivered twice.
+    The replica's seq ledger re-acks the recorded outcome for the second
+    copy without re-applying; the duplicate ack is ignored."""
+    router, pool, tr = _fleet(trained_params, 2,
+                              faults=LinkFaults(dup_p=1.0))
+    router.lifecycle_command(0, "drain", now=0.0)
+    for _ in range(6):
+        router.clock.advance(0.45)   # under the 1.0 retry: no retransmits
+        router.transport_poll(router.clock.now())
+    assert tr.stats["duplicated"] >= 2
+    assert pool.health.state(0) is ReplicaState.DRAINING
+    assert router.stats["lifecycle_cmds"] == 1
+    assert router.stats["lifecycle_applied"] == 1    # exactly once
+    assert router.stats["lifecycle_acked"] == 1
+    assert list(pool.lifecycle_seen(0).values()) == ["applied"]
+
+
+def test_apply_fault_recovered_by_retry(trained_params):
+    """Transient ``os_error`` at the replica's apply edge: nothing is
+    applied, nothing is acked — indistinguishable from a lost message,
+    and the same retry timer re-delivers and applies."""
+    configure_fault_injection({"sites": [
+        {"site": "lifecycle.cmd.apply", "kind": "os_error", "at": 1}]})
+    router, pool, tr = _fleet(trained_params, 2)
+    router.lifecycle_command(0, "drain", now=0.0)
+    router.clock.advance(0.1)
+    router.transport_poll(0.1)       # delivered, apply faults, no ack
+    assert pool.health.state(0) is not ReplicaState.DRAINING
+    assert router.lifecycle_pending(0, "drain")
+    for t in (1.2, 1.4, 1.6):
+        router.clock.advance(0.5)
+        router.transport_poll(t)     # retry resend -> apply -> ack
+    assert pool.health.state(0) is ReplicaState.DRAINING
+    assert router.stats["lifecycle_applied"] == 1
+    assert router.stats["lifecycle_acked"] == 1
+
+
+def test_stale_epoch_ack_discarded(trained_params):
+    """The fencing interlock: the replica applies a command and acks it,
+    but the router fences the replica BEFORE the ack arrives (delayed
+    fabric).  The ack is from a pre-fence world: it must be discarded
+    (``lifecycle_stale_acks``) and the command aborted — whatever the
+    zombie applied must not drive router-side follow-ups."""
+    router, pool, tr = _fleet(trained_params, 2,
+                              faults=LinkFaults(delay=0.5))
+    router.lifecycle_command(0, "drain", now=0.0)
+    router.clock.advance(0.6)
+    router.transport_poll(0.6)       # cmd delivered + applied; ack due 1.1
+    assert pool.health.state(0) is ReplicaState.DRAINING
+    # direct death evidence lands before the ack: epoch bumps
+    router.lease.declare_dead(0, 0.8, reason="device loss (test)")
+    router.clock.advance(0.6)
+    router.transport_poll(1.2)       # the late ack crosses the fence
+    assert router.stats["lifecycle_stale_acks"] == 1
+    assert router.stats["lifecycle_aborted"] == 1
+    assert router.stats["lifecycle_acked"] == 0
+
+
+def test_stale_command_rejected_by_state_guard(trained_params):
+    """A command whose target's local state no longer fits (recover of a
+    HEALTHY replica — e.g. a duplicate that lost a race) is REJECTED with
+    an auditable status, never tripping the pool's transition asserts."""
+    router, pool, tr = _fleet(trained_params, 2)
+    seq = router.lifecycle_command(0, "recover", now=0.0)
+    for t in (0.1, 0.2):
+        router.clock.advance(0.1)
+        router.transport_poll(t)
+    cmd = router._lifecycle[seq]
+    assert cmd.status == "rejected:healthy"
+    assert router.stats["lifecycle_applied"] == 0
+    assert router.stats["lifecycle_acked"] == 1
+    assert pool.health.state(0) is ReplicaState.HEALTHY
+
+
+@pytest.mark.parametrize("site", ["lifecycle.cmd.send", "lifecycle.cmd.apply"])
+def test_crash_transparency(trained_params, site):
+    """``InjectedCrash`` is simulated DRIVER death: neither the send loop
+    nor the replica-side apply handler may absorb it."""
+    configure_fault_injection({"sites": [{"site": site, "kind": "crash", "at": 1}]})
+    router, pool, tr = _fleet(trained_params, 2)
+    with pytest.raises(InjectedCrash):
+        router.lifecycle_command(0, "drain", now=0.0)
+        router.clock.advance(0.1)
+        router.transport_poll(0.1)
+
+
+# ------------------------------------------------------------ property audit
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_property_closed_loop_chaos(trained_params, seed):
+    """3-seed property audit of the WHOLE closed-loop plane at once:
+    adaptive leases + predictive, role-aware autoscaler + transported
+    lifecycle commands, under random loss/dup/reorder/delay composed
+    with a kill/recover schedule and a 2-tenant flash workload.
+    Invariants: every request DONE exactly once with a golden-prefix
+    output, per-tenant accounting closes, and the full run — outputs,
+    dispatches, scale decisions, lifecycle ledgers — replays
+    byte-identically."""
+    rng = np.random.default_rng(300 + seed)
+    n_requests = 10
+    arrivals, t = [], 0.0
+    for i in range(n_requests):
+        t += float(rng.exponential(1.2))
+        arrivals.append({
+            "arrival_ts": round(t, 6),
+            "prompt": [int(x) for x in rng.integers(1, CFG.vocab_size,
+                                                    int(rng.integers(3, 10)))],
+            "max_new_tokens": int(rng.integers(4, 10)),
+            "tenant": "premium" if rng.random() < 0.4 else "batch",
+        })
+    golden = _factory(trained_params)().generate(
+        [a["prompt"] for a in arrivals],
+        max_new_tokens=max(a["max_new_tokens"] for a in arrivals))
+    faults = LinkFaults(loss_p=round(float(rng.uniform(0.02, 0.1)), 6),
+                        dup_p=0.1, reorder_p=0.1,
+                        delay=round(float(rng.uniform(0.0, 0.2)), 6),
+                        reorder_delay=1.0)
+    victim = int(rng.integers(0, 3))
+    kill_at = round(float(rng.uniform(2.0, 8.0)), 6)
+    schedule = [(kill_at, "kill", victim),
+                (round(kill_at + float(rng.uniform(8.0, 14.0)), 6),
+                 "recover", victim)]
+
+    def run_once():
+        tenants = TenantRegistry([
+            TenantSpec("premium", weight=3.0, ttft_slo=30.0),
+            TenantSpec("batch", weight=1.0, kv_page_quota=48)])
+        router, pool, tr = _fleet(
+            trained_params, 3, faults=faults, seed=seed, tenants=tenants,
+            lease=LeaseConfig(suspect_after=2.5, lease=8.0, adaptive=True,
+                              max_scale=2.0))
+        asc = Autoscaler(router, AutoscaleConfig(
+            min_replicas=1, predictive=True, role_aware=True,
+            warmup_horizon=3.0, per_replica_rate=2.0, queue_hi=2.0,
+            queue_lo=0.5, down_streak=3, cooldown_up=1.0, cooldown_down=6.0,
+            decide_interval=0.5))
+        reqs = FleetSimulator(router, autoscaler=asc).run(
+            [dict(a) for a in arrivals], schedule=schedule)
+        return router, pool, asc, reqs
+
+    router, pool, asc, reqs = run_once()
+    assert [r.state for r in reqs] == [FleetState.DONE] * n_requests, \
+        (seed, [r.state.value for r in reqs])
+    for r, g in zip(reqs, golden):
+        assert r.tokens == g[:r.max_new_tokens], (seed, r.fid)
+        assert sum(1 for st, _ in r.history if st.terminal) == 1
+    s = router.summary()
+    for name, trec in s["tenants"].items():
+        assert trec["closed"], (seed, name, trec)
+    assert sum(trec["completed"] for trec in s["tenants"].values()) == n_requests
+    # nothing double-applied: the per-replica seq ledgers record at most
+    # one verdict per command (a command the sim ended mid-flight may
+    # legitimately still be unacked — that is truncation, not a leak)
+    lc = s["control_plane"]["lifecycle"]
+    assert lc["applied"] <= lc["cmds"]
+    seen = [st for r in pool.rids for st in pool.lifecycle_seen(r).values()]
+    assert len(seen) == sum(len(pool.lifecycle_seen(r)) for r in pool.rids)
+    # byte-identical replay: data plane AND the whole control plane
+    router2, pool2, asc2, reqs2 = run_once()
+    assert [r.tokens for r in reqs2] == [r.tokens for r in reqs]
+    assert [r.dispatches for r in reqs2] == [r.dispatches for r in reqs]
+    assert asc2.decisions == asc.decisions
+    assert router2.lease.resizes == router.lease.resizes
+    assert {r: pool2.lifecycle_seen(r) for r in pool2.rids} == \
+        {r: pool.lifecycle_seen(r) for r in pool.rids}
+    assert router2.summary()["control_plane"]["lifecycle"] == lc
