@@ -151,6 +151,13 @@ class PrefixCacheManager:
         #: PrefixDirectory so routing warmth is pushed, not probed; None
         #: (the default) costs one ``is None`` test per transition.
         self.listener = None
+        #: optional eviction demoter: ``demoter(chain_hash, page_id,
+        #: tokens, parent_hash)`` called by :meth:`evict` BEFORE the page
+        #: is freed (while its KV bytes are still valid to gather) — the
+        #: serving kvtier stages the page host-side so the chain stays
+        #: warm-on-host instead of going cold.  Must not allocate or free
+        #: device pages; None (the default) keeps eviction unchanged.
+        self.demoter = None
         # chain hash → (page id, page's token tuple, parent chain hash).
         # The tokens are kept for verification on match: a 64-bit hash
         # collision would otherwise silently attach another prompt's KV
@@ -268,9 +275,12 @@ class PrefixCacheManager:
             while h is not None and freed < n and h in self._pages:
                 if self._children.get(h):
                     break  # has live descendants: they would be stranded
-                page, _, parent = self._pages[h]
+                page, toks, parent = self._pages[h]
                 if self.allocator.refcount(page) != 1:
                     break  # a live sequence still shares this page
+                if self.demoter is not None:
+                    # stage the page host-side BEFORE freeing (kvtier)
+                    self.demoter(h, page, toks, parent)
                 self.allocator.free([page])
                 del self._pages[h]
                 del self._lru[h]

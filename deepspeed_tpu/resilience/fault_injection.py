@@ -89,6 +89,8 @@ INJECTION_SITES = frozenset({
     "autoscaler.decide",    # overload-control-plane decision probe (serving/fleet/autoscale.py)
     "kv.export",            # KV page d2h staging chunk (serving/kvtransfer/snapshot.py)
     "kv.import",            # KV snapshot h2d import (serving/kvtransfer/snapshot.py)
+    "kv.demote",            # KV page demotion to the host tier (serving/kvtier/tier.py)
+    "kv.promote",           # host-tier KV promotion back to device (serving/kvtier/tier.py)
     "prefix.publish",       # replica->directory digest publish/retract (serving/fleet/prefix_directory.py)
     "prefix.import",        # hot-prefix KV h2d adoption (serving/kvtransfer/snapshot.py)
     "transport.send",       # control-plane message send edge (serving/fleet/transport.py)

@@ -95,8 +95,13 @@ class ServingEngine:
         self.admission = AdmissionController(self.config.admission, engine)
         self.kvp = KVPressureManager(engine, youth_key=self._youth_key)
         self.stats = ServingStats()
+        # host KV tier (serving/kvtier): set via attach_tier().  When
+        # present, park()/resume() stage idle sessions host-side and
+        # KV-pressure preemption demotes instead of plain-evicting.
+        self.tier = None
         self._queue: List[ServingRequest] = []
         self._active: Dict[int, ServingRequest] = {}
+        self._parked: Dict[int, ServingRequest] = {}
         self._requests: Dict[int, ServingRequest] = {}
         self._uids = itertools.count(max(engine.state.seqs.keys(), default=-1) + 1)
         self._events_step = 0
@@ -549,6 +554,11 @@ class ServingEngine:
         m.gauge("kv/free_run_fragmentation").set(st["free_run_fragmentation"])
         m.gauge("kv/prefix_cache_pages").set(st["prefix_cache_pages"])
         m.gauge("kv/prefix_cache_share").set(st["prefix_cache_share"])
+        if self.tier is not None:
+            m.gauge("kv/host_pages").set(self.tier.host.pages_used)
+            frac = self.tier.hidden_frac
+            m.gauge("kv/tier_prefetch_hidden_frac").set(
+                frac if frac is not None else 0.0)
 
     def _record_spec_rounds(self) -> None:
         """Fold the step's verify-round accounting (``engine.last_spec_round``,
@@ -581,6 +591,12 @@ class ServingEngine:
             req = self._active.pop(uid)
             self.engine.flush(uid)  # reclaim KV pages + engine state
             self._finish(req, RequestState.TIMED_OUT, now)
+        for uid in [u for u, r in self._parked.items()
+                    if r.deadline is not None and now > r.deadline]:
+            req = self._parked.pop(uid)
+            if self.tier is not None:
+                self.tier.discard(uid)  # reclaim host pages + prefetch slot
+            self._finish(req, RequestState.TIMED_OUT, now)
 
     def _admit(self, now: float) -> None:
         """FCFS-with-aging head-of-line admission: the queue is served in
@@ -600,15 +616,24 @@ class ServingEngine:
                 "collision) — cannot admit")
             imported = req.kv_snapshot is not None and self._try_import(req)
             if not imported:
+                if self.tier is not None:
+                    # warm-on-host prefix promotion: pull any host-staged
+                    # chain tail for this prompt device-side first, so the
+                    # prefill below skips it via the ordinary match()
+                    self._promote_prefix_for(req)
                 self.engine.put([req.uid], [req.engine_tokens()],
                                 max_new_tokens=req.remaining_new_tokens)
             if req.spec is not None:
                 # re-applied on every (re)admission: preemption/flush
                 # cleared the engine's per-uid opt-out
                 self.engine.set_spec(req.uid, req.spec)
+            # a tier promotion may have stalled admission (the non-hidden
+            # transfer remainder advanced the clock): stamp with the
+            # settled time, never a pre-stall reading
+            adm_now = max(now, self.clock.now())
             if req.admitted_ts is None:
-                req.admitted_ts = now
-            req.to(RequestState.PREFILL, now)
+                req.admitted_ts = adm_now
+            req.to(RequestState.PREFILL, adm_now)
             self._active[req.uid] = req
             reserved += self.admission._start_pages(req)
 
@@ -623,8 +648,21 @@ class ServingEngine:
         the request pushed back onto the queue so the kill path collects
         it for failover."""
         from ..resilience.fault_injection import DeviceLossError
+        from .kvtier import HostKVHandle
         from .kvtransfer import import_snapshot
         snap, req.kv_snapshot = req.kv_snapshot, None   # consumed either way
+        if isinstance(snap, HostKVHandle):
+            # parked/demoted locally: resolve the handle through the tier
+            # (kv.promote chaos site, prefetch-window settlement).  A None
+            # snapshot is any degradable miss — recompute owns the resume.
+            snap, stall, window = self.tier.claim(
+                req.uid, req.engine_tokens(), self.clock.now())
+            if snap is None:
+                self.stats.kv_import_fallbacks += 1
+                if self.metrics is not None:
+                    self.metrics.counter("migration/import_fallback").inc()
+                return False
+            self._charge_promote_stall(req, stall, window)
         try:
             import_snapshot(self.engine, req.uid, req.engine_tokens(), snap,
                             max_new_tokens=req.remaining_new_tokens)
@@ -651,6 +689,31 @@ class ServingEngine:
             self.metrics.counter("migration/kv_imports").inc()
         return True
 
+    def _charge_promote_stall(self, req: ServingRequest, stall: float,
+                              window) -> None:
+        """Account one settled promotion transfer: wait out the non-hidden
+        remainder (the prefetched part already hid under earlier device
+        windows) and record the transfer interval on the request so
+        telemetry carves it out of the queued phase as ``phase/promote``."""
+        if stall > 0:
+            self.clock.wait_until(self.clock.now() + stall)
+            anat = getattr(self.engine, "anatomy", NULL_ANATOMY)
+            if anat.enabled:
+                anat.mark("promote_wait")
+        if window is not None:
+            req.promote_windows.append(window)
+
+    def _promote_prefix_for(self, req: ServingRequest) -> None:
+        """Pre-admission warm-on-host promotion: if the host tier holds a
+        chain tail for this request's tokens beyond what the device prefix
+        cache has, scatter it back and adopt it so the prefill's
+        ``match()`` attaches those pages instead of recomputing their KV.
+        Failures degrade silently to the ordinary cold prefill."""
+        n, stall, window = self.tier.promote_prefix(
+            req.engine_tokens(), self.clock.now())
+        if n:
+            self._charge_promote_stall(req, stall, window)
+
     def import_prefix(self, snapshot) -> int:
         """Adopt a host-staged hot-prefix snapshot into this replica's
         prefix cache (``kvtransfer.import_prefix``) so the NEXT admission
@@ -669,6 +732,87 @@ class ServingEngine:
             if self.metrics is not None:
                 self.metrics.counter("prefix/import").inc()
         return n
+
+    # ------------------------------------------------- tiered KV (kvtier)
+
+    def attach_tier(self, tier) -> None:
+        """Wire a ``kvtier.TieredKVManager`` into this frontend: park()/
+        resume() become available, KV-pressure preemption demotes victims
+        to the host tier before releasing their pages (demotion-first),
+        and admission resolves ``HostKVHandle`` snapshots through the
+        tier's prefetch-hidden promotion path (docs/SERVING.md "Tiered
+        KV")."""
+        self.tier = tier
+        self.kvp.tier = tier
+        if tier.metrics is None:
+            tier.metrics = self.metrics
+
+    def park(self, uid: int) -> bool:
+        """Park an idle decoding session: demote its KV pages to the host
+        tier, release its engine sequence, and hold the request in PARKED
+        until :meth:`resume`.  The session costs ZERO device pages while
+        parked; its resume promotes the staged pages back (prefetched, so
+        the h2d transfer hides under intervening steps) instead of
+        recomputing the prompt.  Returns False when the request is not an
+        active unfinished DECODE (parking mid-prefill or mid-step work is
+        not a supported window) or has no tier to park into.  A failed
+        demotion still parks — that resume just recomputes (the
+        kv_snapshot stays None), the ladder's never-wrong fallback."""
+        req = self._active.get(uid)
+        if self.tier is None or req is None \
+                or req.state is not RequestState.DECODE:
+            return False
+        seq = self.engine.state.seqs.get(uid)
+        if seq is None or seq.done or seq.paused:
+            return False
+        now = self.clock.now()
+        # demote BEFORE preempt: the gather needs the pages still live
+        handle = self.tier.demote_sequence(uid)
+        self.engine.preempt(uid)
+        del self._active[uid]
+        req.to(RequestState.PARKED, now)
+        req.kv_snapshot = handle
+        self._parked[uid] = req
+        self.stats.parks += 1
+        if self.metrics is not None:
+            self.metrics.counter("kv/park").inc()
+        self._emit([("kv/park", 1.0, self._next_event_step())])
+        return True
+
+    def prefetch_resume(self, uid: int) -> bool:
+        """Hint that a PARKED request will resume soon: issue its h2d
+        promotion transfer NOW, so it runs under the device windows of the
+        steps between this call and the actual :meth:`resume` — the
+        prefetch-hidden promotion contract.  A session controller that
+        knows the next user turn is coming (typing indicator, scheduled
+        agent step) calls this ahead of resume; an unhinted resume still
+        prefetches, it just has less time to hide.  Idempotent; False for
+        an unknown/non-parked/snapshot-less uid."""
+        req = self._parked.get(uid)
+        if req is None or req.kv_snapshot is None or self.tier is None:
+            return False
+        self.tier.prefetch(uid, req.kv_snapshot.n_pages, self.clock.now())
+        return True
+
+    def resume(self, uid: int) -> bool:
+        """Re-enqueue a PARKED request and issue its promotion prefetch
+        (if :meth:`prefetch_resume` didn't already), so by the time
+        admission reaches it the h2d transfer has (partly or wholly)
+        hidden under the steps in between.  Returns False for an
+        unknown/non-parked uid."""
+        req = self._parked.pop(uid, None)
+        if req is None:
+            return False
+        now = self.clock.now()
+        req.to(RequestState.QUEUED, now)
+        if req.kv_snapshot is not None and self.tier is not None:
+            self.tier.prefetch(uid, req.kv_snapshot.n_pages, now)
+        self._queue.append(req)
+        self.stats.resumes += 1
+        if self.metrics is not None:
+            self.metrics.counter("kv/resume").inc()
+        self._emit([("kv/resume", 1.0, self._next_event_step())])
+        return True
 
     # ----------------------------------------------------------- migration
 
@@ -782,6 +926,16 @@ class ServingEngine:
             self.metrics.counter("serving/preemptions").inc()
         self._emit([("serving/preempted", 1.0, self._next_event_step())])
         req.to(RequestState.QUEUED, now)
+        if self.tier is not None and req.kv_snapshot is None:
+            # demotion-first preemption (kv_pressure): the tier staged the
+            # victim's pages before preempt freed them — ride the handle on
+            # the request and start the promote prefetch NOW, so by
+            # re-admission the h2d transfer has hidden under the steps that
+            # ran in between
+            handle = self.tier.handle_for(req.uid)
+            if handle is not None:
+                req.kv_snapshot = handle
+                self.tier.prefetch(req.uid, handle.n_pages, now)
         self._queue.append(req)
 
     def _deliver(self, out: Dict[int, List[int]], now: float) -> None:
@@ -993,7 +1147,8 @@ class ServingEngine:
             except Exception as e:
                 logger.warning(f"serving: in-flight step failed during "
                                f"fence ({e}); dropping it")
-        counts = {"queued": len(self._queue), "active": len(self._active)}
+        counts = {"queued": len(self._queue), "active": len(self._active),
+                  "parked": len(self._parked)}
         for req in list(self._queue):
             self._requests.pop(req.uid, None)
             self._trace_ctx.pop(req.uid, None)
@@ -1004,6 +1159,14 @@ class ServingEngine:
             self._requests.pop(uid, None)
             self._trace_ctx.pop(uid, None)
         self._active.clear()
+        for uid in sorted(self._parked):
+            # parked zombies hold HOST pages, not device pages — reclaim
+            # them through the tier, same no-terminal abandonment
+            if self.tier is not None:
+                self.tier.discard(uid)
+            self._requests.pop(uid, None)
+            self._trace_ctx.pop(uid, None)
+        self._parked.clear()
         recorder = self.recorder if self.recorder is not None \
             else getattr(self.tracer, "recorder", None)
         if recorder is not None:
@@ -1055,6 +1218,7 @@ class ServingEngine:
         return {
             "queue_depth": len(self._queue),
             "active": len(self._active),
+            "parked": len(self._parked),
             "outstanding_tokens": sum(r.remaining_new_tokens for r in self._active.values()),
             "free_kv_pages": self.engine.kv.allocator.free_pages,
             "ewma_step_s": self._ewma_step_s,

@@ -205,28 +205,47 @@ class PrefixDirectoryPolicy(RoutingPolicy):
         # resume is exactly the traffic whose warm pages matter — same
         # stance as the probe policy
         tokens = list(request.prompt) + list(request.tokens)
-        depth = self.directory.depths(tokens, [rid for rid, _, _ in candidates])
-        best = max(candidates, key=lambda c: (depth[c[0]], -c[2]["queue_depth"], -c[0]))
+        # two-tier warmth (serving/kvtier): device-resident pages attach
+        # for free; host-staged pages cost a bounded h2d promote — better
+        # than cold, worse than device-warm.  With no host tier attached
+        # warm == device and this orders exactly like the old single-tier
+        # key (the probe-policy oracle still holds).
+        tiered = self.directory.tiered_depths(
+            tokens, [rid for rid, _, _ in candidates])
+        best = max(candidates, key=lambda c: (
+            tiered[c[0]][0], tiered[c[0]][1], -c[2]["queue_depth"], -c[0]))
         rid, _, stats = best
-        if depth[rid] > 0 and stats["queue_depth"] < self.saturation_queue_depth:
-            return rid, {"affinity_hit": True, "warm_pages": depth[rid]}
+        dev, warm = tiered[rid]
+        if warm > 0 and stats["queue_depth"] < self.saturation_queue_depth:
+            info = {"affinity_hit": True, "warm_pages": dev}
+            if warm > dev:
+                info["host_warm"] = True
+                info["host_pages"] = warm - dev
+            return rid, info
         # cold everywhere, or the warm target is saturated: least-loaded,
         # excluding the saturated warm target when an alternative exists
         # (identical fallback shape to PrefixAffinityPolicy)
-        saturated = depth[rid] > 0
+        saturated = warm > 0
         fb_candidates = [c for c in candidates if c[0] != rid] if saturated else candidates
         if not fb_candidates:
             fb_candidates = candidates
         fb_rid, _ = self._fallback.select(request, fb_candidates)
-        info = {"affinity_hit": depth.get(fb_rid, 0) > 0,
-                "warm_pages": depth.get(fb_rid, 0),
+        fb_dev, fb_warm = tiered.get(fb_rid, (0, 0))
+        info = {"affinity_hit": fb_warm > 0,
+                "warm_pages": fb_dev,
                 "affinity_saturated": saturated}
+        if fb_warm > fb_dev:
+            info["host_warm"] = True
+            info["host_pages"] = fb_warm - fb_dev
         if saturated and fb_rid is not None \
-                and depth[rid] - depth.get(fb_rid, 0) >= self.import_min_pages:
+                and warm - fb_warm >= self.import_min_pages:
             # the fleet is warm, the landing replica is not: ask the router
             # to import the hot prefix there before dispatch (the router
-            # flips affinity_hit to True if the import lands)
-            info["prefix_import"] = {"donor": rid, "donor_depth": depth[rid]}
+            # flips affinity_hit to True if the import lands).  The donor
+            # depth counts BOTH tiers — export_prefix sources the host-
+            # staged tail from the donor's kvtier without touching its
+            # device arena.
+            info["prefix_import"] = {"donor": rid, "donor_depth": warm}
         return fb_rid, info
 
 

@@ -61,7 +61,7 @@ class ReplicaPool:
                  role_factories: Optional[Dict] = None,
                  prefix_directory=None, transport=None,
                  hb_interval: float = 0.5, anatomy: bool = False,
-                 anatomy_max_steps: int = 4096):
+                 anatomy_max_steps: int = 4096, kv_tier=None):
         assert n_replicas >= 1, n_replicas
         if roles is not None and len(roles) != n_replicas:
             raise ValueError(f"roles ({len(roles)}) must cover every replica "
@@ -101,6 +101,13 @@ class ReplicaPool:
         if prefix_directory is not None and metrics is not None \
                 and prefix_directory.metrics is None:
             prefix_directory.metrics = metrics
+        # host KV tier (serving/kvtier): a TierConfig (or True for the
+        # defaults) gives every attached engine its own TieredKVManager —
+        # park/resume, demotion-first preemption, warm-on-host prefix
+        # pages.  Per-replica like the engine itself: a kill drops the
+        # host tier with the arena (same failure domain), and the
+        # directory purge on death/attach forgets its host publishes too.
+        self.kv_tier = kv_tier
         # control-plane transport (docs/SERVING.md "Control-plane
         # transport"): when attached, the replica-side control flows stop
         # being perfect in-process calls — each tick sends a
@@ -175,10 +182,24 @@ class ReplicaPool:
         if self.prefix_directory is not None:
             # a fresh engine's cache is empty: stale entries from the
             # replica's previous life (rolling restart) must go first
+            # (purge drops BOTH tiers — the old host tier died with the
+            # old engine)
             self.prefix_directory.purge(rid)
             pc = rep.serve.engine.kv.prefix_cache
             if pc is not None:
                 pc.listener = self._directory_listener(rid)
+        if self.kv_tier is not None:
+            from ..kvtier import TierConfig, TieredKVManager
+            cfg = self.kv_tier if isinstance(self.kv_tier, TierConfig) else None
+            tier = TieredKVManager(rep.serve.engine, config=cfg,
+                                   metrics=self.metrics)
+            rep.serve.attach_tier(tier)
+            if self.prefix_directory is not None:
+                # host-tier publishes ride the SAME seq-numbered stream as
+                # the device publishes — one ordered feed per replica, so
+                # a demote(evict device, publish host) pair can never be
+                # applied out of order router-side
+                tier.listener = self._host_directory_listener(rid)
 
     def _directory_listener(self, rid: int):
         """Publish edge replica -> directory.  A transient fault at the
@@ -211,6 +232,33 @@ class ReplicaPool:
             except OSError as e:
                 logger.warning(f"fleet: prefix directory {event} dropped for "
                                f"replica {rid}: {e}")
+        return on_event
+
+    def _host_directory_listener(self, rid: int):
+        """Publish edge kvtier -> directory host table: ``host_publish``
+        when a demoted prefix page lands host-side, ``host_evict`` when it
+        leaves (promoted back or evicted under host pressure).  Same
+        fault stance and (with a transport) the same ordered per-replica
+        ``dir_publish`` stream as the device-tier listener."""
+        directory = self.prefix_directory
+
+        def on_event(event: str, digest: int) -> None:
+            if self.transport is not None:
+                self._dir_seq[rid] += 1
+                self.transport.send("dir_publish", rid, "router",
+                                    {"op": event, "digest": digest},
+                                    seq=self._dir_seq[rid])
+                return
+            try:
+                if event == "host_publish":
+                    directory.publish_host(rid, digest)
+                else:
+                    directory.retract_host(rid, digest)
+            except InjectedCrash:
+                raise
+            except OSError as e:
+                logger.warning(f"fleet: prefix directory {event} dropped "
+                               f"for replica {rid}: {e}")
         return on_event
 
     # ------------------------------------------------------- control plane
@@ -250,7 +298,11 @@ class ReplicaPool:
             return None
         pc = rep.serve.engine.kv.prefix_cache
         digests = pc.held_digests() if pc is not None else []
-        return {"digests": digests, "barrier": self._dir_seq[rid]}
+        snap = {"digests": digests, "barrier": self._dir_seq[rid]}
+        tier = rep.serve.tier
+        if tier is not None:
+            snap["host_digests"] = tier.host.held_prefix_digests()
+        return snap
 
     def fence_replica(self, rid: int, epoch: int = 0) -> Dict[str, int]:
         """Execute a FENCE on this replica: cancel every in-flight request

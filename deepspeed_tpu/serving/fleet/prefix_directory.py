@@ -70,8 +70,16 @@ class PrefixDirectory:
         #: (rid, digest) -> None, oldest first — the LRU the capacity
         #: bound evicts from; refreshed on re-publish and on lookup match
         self._lru: "OrderedDict[Tuple[int, int], None]" = OrderedDict()
+        #: host-tier mirror (serving/kvtier): digest -> rids whose HOST
+        #: tier holds the page.  Host-warm is a second-class warmth — the
+        #: target can promote the page h2d instead of recomputing — so it
+        #: is tracked in its own table (same capacity bound, own LRU) and
+        #: reported separately by :meth:`tiered_depths`.
+        self._host_holders: Dict[int, set] = {}
+        self._host_lru: "OrderedDict[Tuple[int, int], None]" = OrderedDict()
         self.stats = {"published": 0, "retracted": 0, "purged": 0,
-                      "lru_evicted": 0, "lookups": 0}
+                      "lru_evicted": 0, "lookups": 0,
+                      "host_published": 0, "host_retracted": 0}
 
     # ------------------------------------------------------------- publish
 
@@ -105,16 +113,54 @@ class PrefixDirectory:
         if self.metrics is not None:
             self.metrics.counter("prefix/evict").inc()
 
+    def publish_host(self, rid: int, digest: int) -> None:
+        """``rid``'s HOST tier (serving/kvtier) staged the page keyed by
+        ``digest``: a demotion parked it CPU-side, still promotable.
+        Same chaos stream as device publishes — a dropped host publish is
+        the stale-cold rung (the fleet forgets warmth it has)."""
+        _fi.check("prefix.publish")
+        key = (rid, digest)
+        if key in self._host_lru:
+            self._host_lru.move_to_end(key)
+            return
+        self._host_holders.setdefault(digest, set()).add(rid)
+        self._host_lru[key] = None
+        self.stats["host_published"] += 1
+        if self.metrics is not None:
+            self.metrics.counter("prefix/publish").inc()
+        while len(self._host_lru) > self.capacity:
+            (orid, odig), _ = self._host_lru.popitem(last=False)
+            self._drop_host(orid, odig)
+            self.stats["lru_evicted"] += 1
+
+    def retract_host(self, rid: int, digest: int) -> None:
+        """``rid``'s host tier dropped the page (promoted back to the
+        device — now a device publish — or evicted under host pressure)."""
+        _fi.check("prefix.publish")
+        key = (rid, digest)
+        if key not in self._host_lru:
+            return
+        del self._host_lru[key]
+        self._drop_host(rid, digest)
+        self.stats["host_retracted"] += 1
+        if self.metrics is not None:
+            self.metrics.counter("prefix/evict").inc()
+
     def purge(self, rid: int) -> int:
         """Forget every entry ``rid`` published — replica death (the
-        engine and its cache are gone) or a fresh engine attach (restart:
-        the new cache starts empty).  Returns entries dropped."""
+        engine, its cache AND its host tier are gone) or a fresh engine
+        attach (restart: the new cache starts empty).  Returns entries
+        dropped (both tiers)."""
         victims = [key for key in self._lru if key[0] == rid]
         for key in victims:
             del self._lru[key]
             self._drop(*key)
-        self.stats["purged"] += len(victims)
-        return len(victims)
+        host_victims = [key for key in self._host_lru if key[0] == rid]
+        for key in host_victims:
+            del self._host_lru[key]
+            self._drop_host(*key)
+        self.stats["purged"] += len(victims) + len(host_victims)
+        return len(victims) + len(host_victims)
 
     def _drop(self, rid: int, digest: int) -> None:
         holders = self._holders.get(digest)
@@ -122,6 +168,13 @@ class PrefixDirectory:
             holders.discard(rid)
             if not holders:
                 del self._holders[digest]
+
+    def _drop_host(self, rid: int, digest: int) -> None:
+        holders = self._host_holders.get(digest)
+        if holders is not None:
+            holders.discard(rid)
+            if not holders:
+                del self._host_holders[digest]
 
     # -------------------------------------------------------------- lookup
 
@@ -155,6 +208,42 @@ class PrefixDirectory:
                 self._lru.move_to_end((rid, digest))
         return depth
 
+    def tiered_depths(self, tokens: Iterable[int],
+                      rids: Iterable[int]) -> Dict[int, Tuple[int, int]]:
+        """Per-replica ``(device_depth, warm_depth)`` for ``tokens``.
+
+        ``device_depth`` is exactly what :meth:`depths` reports: leading
+        full pages resident in the replica's DEVICE cache (attach is
+        free).  ``warm_depth >= device_depth`` extends the chain through
+        pages the replica holds in EITHER tier — a host-tier page costs a
+        bounded h2d promote instead of a prefill recompute, so a
+        host-warm replica beats a cold one but loses to a device-warm
+        one at equal depth.  One chain walk total, same last-token cap."""
+        tokens = list(tokens)
+        rids = list(rids)
+        out = {rid: (0, 0) for rid in rids}
+        self.stats["lookups"] += 1
+        usable_pages = max(0, (len(tokens) - 1) // self.page_size)
+        live_dev = set(rids)
+        live_warm = set(rids)
+        for k, digest in enumerate(iter_prefix_chain_hashes(
+                tokens[:usable_pages * self.page_size], self.page_size)):
+            dev = self._holders.get(digest, ())
+            host = self._host_holders.get(digest, ())
+            live_dev &= set(dev)
+            live_warm &= set(dev) | set(host)
+            if not live_warm:
+                break
+            for rid in sorted(live_warm):
+                d, _ = out[rid]
+                if rid in live_dev:
+                    d = k + 1
+                    self._lru.move_to_end((rid, digest))
+                elif (rid, digest) in self._host_lru:
+                    self._host_lru.move_to_end((rid, digest))
+                out[rid] = (d, k + 1)
+        return out
+
     def hottest(self, k: int) -> List[Tuple[int, List[int]]]:
         """The ``k`` most-recently-used digests (newest LRU end first),
         each with the sorted rids holding it — the directory-driven
@@ -180,6 +269,11 @@ class PrefixDirectory:
     def entries(self) -> int:
         return len(self._lru)
 
+    @property
+    def host_entries(self) -> int:
+        return len(self._host_lru)
+
     def summary(self) -> dict:
         return {**self.stats, "entries": self.entries,
+                "host_entries": self.host_entries,
                 "digests": len(self._holders), "capacity": self.capacity}

@@ -1475,6 +1475,11 @@ class Router:
         try:
             if op == "publish":
                 self.directory.publish(rid, digest)
+            elif op == "host_publish":
+                # replica's kvtier staged the page host-side (serving/kvtier)
+                self.directory.publish_host(rid, digest)
+            elif op == "host_evict":
+                self.directory.retract_host(rid, digest)
             else:
                 self.directory.retract(rid, digest)
         except _fi.InjectedCrash:
@@ -1566,6 +1571,8 @@ class Router:
         self.directory.purge(rid)
         for digest in p["digests"]:
             self._dir_apply(rid, "publish", digest)
+        for digest in p.get("host_digests", ()):
+            self._dir_apply(rid, "host_publish", digest)
         feed.expect = p["barrier"] + 1
         feed.buffer = {s: v for s, v in feed.buffer.items() if s >= feed.expect}
         feed.gap_since = now if feed.buffer else None

@@ -15,6 +15,13 @@ lost.  The manager preflights the scheduler's plan against
    Decodes are preempted before prefills only via youth order falling out
    of FCFS admission; a mid-prefill victim loses only its partial pages.
 
+When a host KV tier is attached (``serving/kvtier`` — set via
+``ServingEngine``), step 2 becomes DEMOTION-FIRST: the victim's pages are
+staged to the host tier before ``preempt`` frees them, so its resume
+promotes the staged copy back instead of recomputing the prompt.  A
+failed demotion (transient fault, host tier full) degrades to the plain
+evict+recompute above — slower, never wrong.
+
 The worst-case demand is evaluated at the single-token rung (k=1): the
 fused multi-decode path already self-shrinks ``k`` under page pressure
 (``engine_v2.step``), so k=1 feasibility guarantees the step runs.
@@ -34,6 +41,9 @@ class KVPressureManager:
         monotonically by the frontend, so this is arrival order)."""
         self.engine = engine
         self.youth_key = youth_key or (lambda uid: uid)
+        #: optional TieredKVManager (serving/kvtier): when set, victims are
+        #: demoted to the host tier before preemption (demotion-first)
+        self.tier = None
 
     def resolve(self):
         """Evict cache pages / preempt sequences until the planned step fits.
@@ -66,6 +76,13 @@ class KVPressureManager:
                     f"KV pressure unresolvable: step needs {need} pages, "
                     f"{kv.allocator.free_pages} free, nothing preemptible")
             victim = max(victims, key=lambda s: self.youth_key(s.uid))
+            if self.tier is not None:
+                # demotion-first: stage the victim's KV host-side while its
+                # pages are still valid; the frontend attaches the handle
+                # in _on_preempted so the resume promotes, not recomputes.
+                # None (failed/refused demotion) falls through to plain
+                # evict+recompute.
+                self.tier.demote_sequence(victim.uid)
             logger.debug(f"KV pressure: preempting uid={victim.uid} "
                          f"({len(victim.pages)} pages, shortfall {shortfall})")
             evicted.append(engine.preempt(victim.uid))

@@ -301,23 +301,40 @@ def export_prefix(engine, tokens: Sequence[int],
     the donor has since evicted, and the caller's recompute fallback owns
     the request.  Read-only on the donor: no refcounts taken, no LRU
     touched (the donor never sees this request).  The ``kv.export`` chaos
-    site fires once per staging, like a migration chunk."""
+    site fires once per staging, like a migration chunk.
+
+    When the donor has a host KV tier attached (``serving/kvtier``), the
+    staged run is EXTENDED with warm-on-host pages continuing the chain
+    past the device-held depth: those blocks are already host-side
+    (crc-verified on read), so a saturated-warm donor can serve the import
+    without touching its device arena at all."""
     kv = engine.kv
     pc = kv.prefix_cache
     arena = engine.cache
     if pc is None or not hasattr(arena, "shape") or len(arena.shape) != 6:
         return None
     pages = [page for _, page in pc._walk(tokens)]
-    if not pages:
+    tier = getattr(engine, "_kv_tier", None)
+    host_blocks = []
+    if tier is not None:
+        # the same usable cap _walk applies: never stage a page covering
+        # the final token (the importer must still compute >= 1 token)
+        max_depth = max(0, (len(tokens) - 1) // kv.page_size)
+        host_blocks = tier.host_prefix_blocks(tokens, start_depth=len(pages),
+                                              max_depth=max_depth)
+    if not pages and not host_blocks:
         return None
     _fi.check("kv.export")   # chaos site: torn/failed d2h staging
-    depth = len(pages)
+    depth = len(pages) + len(host_blocks)
     snapshot = KVSnapshot(
         tokens=[int(t) for t in tokens[:depth * kv.page_size]],
         seen_tokens=depth * kv.page_size, page_size=kv.page_size,
         block_shape=(arena.shape[0], ) + tuple(arena.shape[2:]),
         dtype=str(arena.dtype), source=source)
-    snapshot.add_chunk(kv.export_pages(arena, pages))
+    if pages:
+        snapshot.add_chunk(kv.export_pages(arena, pages))
+    for block in host_blocks:
+        snapshot.add_chunk(block)
     snapshot.complete = True
     return snapshot
 

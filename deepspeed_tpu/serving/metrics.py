@@ -45,6 +45,8 @@ class ServingStats:
     migrated: int = 0          # requests handed off with their KV (kvtransfer)
     kv_imports: int = 0        # KV-import fast-path resumes on THIS replica
     kv_import_fallbacks: int = 0   # snapshot rejected -> recompute-on-resume
+    parks: int = 0             # sessions parked to the host KV tier (kvtier)
+    resumes: int = 0           # parked sessions re-enqueued for promotion
     prefix_imports: int = 0        # hot-prefix page imports adopted here
     prefix_import_pages: int = 0   # pages those imports scattered in
     reject_reasons: Dict[str, int] = dataclasses.field(default_factory=dict)
@@ -81,6 +83,8 @@ class ServingStats:
             "migrated": self.migrated,
             "kv_imports": self.kv_imports,
             "kv_import_fallbacks": self.kv_import_fallbacks,
+            "parks": self.parks,
+            "resumes": self.resumes,
             "prefix_imports": self.prefix_imports,
             "prefix_import_pages": self.prefix_import_pages,
             "deadline_met": len(met),

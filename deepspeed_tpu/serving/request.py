@@ -12,6 +12,9 @@ state machine the frontend drives:
        │        └→ EVICTED ┘→ QUEUED   (KV-pressure preemption; resume
        │                  ▲             recomputes the generated tokens'
        │                  │             KV from the extended prompt)
+       │            DECODE → PARKED → QUEUED  (kvtier: KV demoted to the
+       │                        │              host tier; resume promotes
+       │                        │              it back — no recompute)
        │  {PREFILL|DECODE} → MIGRATING → MIGRATED  (KV handed off to
        │                        │         another replica — kvtransfer;
        │                        │         late-prefill pause = the
@@ -29,6 +32,10 @@ engine sequence is paused (pages byte-stable for chunked export) and the
 fleet router either hands it off (MIGRATED — the request continues on a
 decode replica), aborts back to DECODE, or loses it to preemption
 (EVICTED — recompute-on-resume, the migration's fallback ladder).
+PARKED is the tiered-KV idle state (docs/SERVING.md "Tiered KV"): the
+request left the engine with its KV demoted to the host tier; resume
+re-enqueues it and admission promotes the pages back device-side, falling
+back to recompute on any host-tier miss or fault.
 """
 
 import dataclasses
@@ -41,6 +48,7 @@ class RequestState(enum.Enum):
     PREFILL = "prefill"
     DECODE = "decode"
     MIGRATING = "migrating"   # paused for KV export (serving/kvtransfer)
+    PARKED = "parked"         # idle; KV demoted to the host tier (serving/kvtier)
     DONE = "done"
     EVICTED = "evicted"
     TIMED_OUT = "timed_out"
@@ -58,7 +66,12 @@ _ALLOWED = {
     RequestState.PREFILL: {RequestState.DECODE, RequestState.EVICTED, RequestState.TIMED_OUT,
                            RequestState.MIGRATING},
     RequestState.DECODE: {RequestState.DONE, RequestState.EVICTED, RequestState.TIMED_OUT,
-                          RequestState.MIGRATING},
+                          RequestState.MIGRATING, RequestState.PARKED},
+    # an idle session parked mid-decode: its KV was demoted to the host
+    # tier and its engine sequence released; resume() re-enqueues it and
+    # admission promotes the host pages back (or recomputes on any
+    # host-tier fallback — slower, never wrong)
+    RequestState.PARKED: {RequestState.QUEUED, RequestState.TIMED_OUT},
     # a migration can begin LATE IN PREFILL (the DistServe boundary: the
     # final chunk + first-token sampling run on the decode replica, so the
     # staging pause lands in TTFT, never TPOT) or mid-DECODE (short
@@ -115,9 +128,15 @@ class ServingRequest:
     spec_accepted: int = 0            # drafts the model's argmax confirmed
     spec_rollback_pages: int = 0      # KV pages rolled back for rejected drafts
     # host-staged KV state to import at admission instead of recomputing
-    # the prompt (serving/kvtransfer KVSnapshot; consumed — and cleared —
-    # on first admission whether the import succeeds or falls back)
+    # the prompt (serving/kvtransfer KVSnapshot, or a kvtier HostKVHandle
+    # naming an entry parked in the engine-local host tier; consumed — and
+    # cleared — on first admission whether the import succeeds or falls back)
     kv_snapshot: Optional[object] = None
+    #: promotion transfer windows ``(t_start, t_ready)`` the host tier
+    #: charged this request (kvtier prefetch): telemetry carves them out of
+    #: the surrounding QUEUED interval as ``phase/promote`` spans, so a
+    #: resume's TTFT splits into queue wait vs h2d promotion
+    promote_windows: List[Tuple[float, float]] = dataclasses.field(default_factory=list)
 
     def __post_init__(self):
         self.prompt = list(self.prompt)

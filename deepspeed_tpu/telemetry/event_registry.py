@@ -294,6 +294,25 @@ EVENTS = {
                               "pages pinned by prefix-cache entries"),
     "kv/prefix_cache_share": ("gauge", "serving/engine.py",
                               "prefix-cache share of in-use pages"),
+    # ---- tiered KV (serving/kvtier — docs/SERVING.md "Tiered KV")
+    "kv/demote": ("counter", "serving/kvtier/tier.py",
+                  "sequence or prefix page staged d2h into the host tier"),
+    "kv/promote": ("counter", "serving/kvtier/tier.py",
+                   "host-tier pages promoted h2d (resume claim or "
+                   "prefix-chain promote)"),
+    "kv/park": ("event+counter", "serving/engine.py",
+                "idle session demoted + parked (DECODE -> PARKED, zero "
+                "device pages held)"),
+    "kv/resume": ("event+counter", "serving/engine.py",
+                  "parked session re-enqueued (PARKED -> QUEUED, promote "
+                  "prefetch issued)"),
+    "kv/host_pages": ("gauge", "serving/engine.py",
+                      "host-tier pages held (demoted sequences + "
+                      "warm-on-host prefix pages)"),
+    "kv/tier_prefetch_hidden_frac": ("gauge", "serving/engine.py",
+                                     "fraction of promote transfer "
+                                     "seconds hidden under prior device "
+                                     "windows by issued-ahead prefetch"),
     # ---- arrival-rate telemetry (serving/fleet/router.py, exported once
     #      per fleet round — ROADMAP's predictive-scale-up input)
     "fleet/arrival_rate_ewma": ("gauge", "serving/fleet/router.py",

@@ -44,7 +44,48 @@ PHASE_OF_STATE = {
     # request is paused on the source replica while its pages export — the
     # per-request migration cost the disaggregation bench accounts for
     RequestState.MIGRATING: "migrating",
+    # idle session with its KV demoted to the host tier (serving/kvtier):
+    # zero device pages held; ends at resume() re-enqueue
+    RequestState.PARKED: "parked",
 }
+
+
+def _carve_promote(intervals: List[Tuple[str, float, float]],
+                   windows: List[Tuple[float, float]]
+                   ) -> List[Tuple[str, float, float]]:
+    """Carve h2d promotion transfer windows (``ServingRequest.
+    promote_windows``) out of the ``parked``/``queued`` intervals they
+    overlap, as ``promote`` pieces.  The pieces PARTITION each original
+    interval (tiling preserved exactly): a resume's TTFT then splits into
+    genuine queue wait vs promotion transfer instead of lumping both into
+    ``queued``.  Windows never overlap other phases — the engine stalls
+    admission until ``t_ready`` before stamping PREFILL."""
+    if not windows:
+        return intervals
+    # merge overlapping/adjacent windows (seq + prefix promotes can abut)
+    merged: List[List[float]] = []
+    for w0, w1 in sorted(windows):
+        if merged and w0 <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], w1)
+        else:
+            merged.append([w0, w1])
+    out: List[Tuple[str, float, float]] = []
+    for phase, t0, t1 in intervals:
+        if phase not in ("parked", "queued"):
+            out.append((phase, t0, t1))
+            continue
+        cur = t0
+        for w0, w1 in merged:
+            lo, hi = max(cur, w0), min(t1, w1)
+            if hi <= lo:
+                continue
+            if lo > cur:
+                out.append((phase, cur, lo))
+            out.append(("promote", lo, hi))
+            cur = hi
+        if t1 > cur:
+            out.append((phase, cur, t1))
+    return out
 
 
 def phase_intervals(history: List[Tuple[RequestState, float]],
@@ -99,9 +140,12 @@ def emit_attempt_spans(tracer: Tracer, req: ServingRequest, trace_id: int,
     attempt a replica death (or lease expiry — ``tail_phase="fenced"``)
     displaced."""
     spans = []
-    for phase, t0, t1 in phase_intervals(req.history, end_ts=end_ts,
-                                         clamp_start=clamp_start,
-                                         tail_phase=tail_phase):
+    intervals = phase_intervals(req.history, end_ts=end_ts,
+                                clamp_start=clamp_start,
+                                tail_phase=tail_phase)
+    intervals = _carve_promote(intervals,
+                               getattr(req, "promote_windows", None) or [])
+    for phase, t0, t1 in intervals:
         spans.append(tracer.add_span(f"phase/{phase}", trace_id, t0, t1,
                                      parent_id=parent_id, track=track))
     return spans

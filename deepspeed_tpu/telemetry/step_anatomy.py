@@ -73,7 +73,7 @@ __all__ = ["HOST_SEGMENTS", "StepAnatomy", "NullStepAnatomy", "NULL_ANATOMY",
 #: (zero-filled) so the per-step table has one fixed shape
 HOST_SEGMENTS = ("schedule", "draft_plan", "verify_plan", "aot_compile",
                  "compile_wait", "dispatch", "sample_accept", "overlap",
-                 "bookkeeping")
+                 "bookkeeping", "promote_wait")
 
 
 class StepRecord:

@@ -71,14 +71,16 @@ def _build_engine(dryrun: bool):
     model = LlamaForCausalLM(cfg)
     params = jax.jit(model.init)(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
 
-    def make(spec=None):
+    def make(spec=None, kv_cfg=None, sched_cfg=None):
         # decode_steps_per_dispatch=1: the SLA bench measures PER-TOKEN
         # latency; the fused k-step dispatch would quantize token delivery
         # to k-sized bursts and blur TPOT.  ``spec`` (a SpecConfig) turns
         # on draft-verify speculative decoding for the spec-on/spec-off
-        # comparison pair.
+        # comparison pair.  ``kv_cfg``/``sched_cfg`` let a leg reshape the
+        # arena/scheduler around the SAME params (the kv_tier leg needs a
+        # seq-slot ceiling that makes the page arena the binding resource).
         return build_engine(cfg, params, RaggedInferenceEngineConfig(
-            kv=kv, scheduler=sched, kv_dtype=cfg.dtype,
+            kv=kv_cfg or kv, scheduler=sched_cfg or sched, kv_dtype=cfg.dtype,
             decode_steps_per_dispatch=1, spec=spec))
     return make, cfg, kv, sched
 
@@ -379,6 +381,240 @@ def run_anatomy_leg(make_engine, clock_factory, arrivals, rate,
     return rec
 
 
+def run_kv_tier_leg(make_engine, clock_factory, dryrun, out_path, seed):
+    """Tiered-KV receipt (docs/SERVING.md "Tiered KV"), schema v1: the
+    resident-session capacity the host tier buys, at EQUAL active-set
+    per-token latency.  Commits ``BENCH_KV_TIER.json``:
+
+    * **off leg** — multi-turn chat sessions WITHOUT the tier.  The only
+      way to keep a session's KV resident is to keep the sequence active,
+      so resident capacity = the page-arena bound (sessions x pages each
+      <= usable pages, also capped by seq slots).  The leg runs exactly
+      that many sessions start-to-finish and measures per-token delivery
+      gaps (TPOT) from the stream callback.
+    * **on leg** — 3x the sessions WITH the tier attached.  A turn
+      controller parks each session at its turn boundaries (KV demoted to
+      crc-tagged host pages, device pages freed), issues
+      ``prefetch_resume`` a lead interval BEFORE the scheduled resume so
+      the h2d promotion hides under other sessions' device windows, then
+      resumes.  Active-set TPOT counts only gaps WITHIN a turn segment
+      (the stream baseline resets at each park — think time is the
+      user's, not the system's).
+    * the receipt asserts: every session completes in both legs, every
+      on-leg resume takes the snapshot-import fast path (zero recompute
+      fallbacks), prefetch hides >50% of promoted bytes, and on-leg p99
+      active TPOT stays within the equal-latency bar of the off leg;
+    * byte-identical regeneration under ``--dryrun`` (both legs run
+      twice; VirtualClock makes the comparison exact).
+    """
+    from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+    from deepspeed_tpu.models.llama_cache import PagedKVConfig
+    from deepspeed_tpu.serving import (RequestState, ServingConfig,
+                                       ServingEngine)
+    from deepspeed_tpu.serving.kvtier import TierConfig, TieredKVManager
+
+    if dryrun:
+        # max_seqs raised past the page bound so the ARENA is the binding
+        # resident-capacity resource (55 usable pages / 4-page sessions
+        # -> 13 resident); mps=8 bounds any one session at 8 pages
+        kv_cfg = PagedKVConfig(num_pages=56, page_size=8, max_pages_per_seq=8)
+        sched_cfg = SchedulerConfig(token_budget=128, max_seqs=13,
+                                    prefill_chunk=32, decode_bucket=4)
+        prompt_len, new_tokens, bounds = 12, 20, (7, 14)
+        think, lead, h2d_page_s = 6.0, 3.0, 0.05
+    else:
+        kv_cfg = PagedKVConfig(num_pages=129, page_size=16, max_pages_per_seq=8)
+        sched_cfg = SchedulerConfig(token_budget=2048, max_seqs=32,
+                                    prefill_chunk=128, decode_bucket=8)
+        prompt_len, new_tokens, bounds = 24, 40, (14, 28)
+        think, lead, h2d_page_s = 0.6, 0.3, 0.001
+
+    usable = kv_cfg.num_pages - 1
+    pps = -(-(prompt_len + new_tokens) // kv_cfg.page_size)  # pages/session
+    n_off = min(usable // pps, sched_cfg.max_seqs)
+    n_on = 3 * n_off
+    terminal = (RequestState.DONE, RequestState.TIMED_OUT,
+                RequestState.REJECTED)
+
+    rng = np.random.default_rng(seed + 19)
+    prompts = [[int(x) for x in rng.integers(1, 250, prompt_len)]
+               for _ in range(n_on)]
+
+    def _pct(vals):
+        if not vals:
+            return {"p50": None, "p95": None, "p99": None}
+        s = sorted(vals)
+
+        def q(pct):   # nearest-rank on integer percent: deterministic,
+            rank = -(-pct * len(s) // 100)   # interpolation- and fuzz-free
+            return round(s[min(len(s) - 1, max(0, rank - 1))], 6)
+        return {"p50": q(50), "p95": q(95), "p99": q(99)}
+
+    def off_leg():
+        eng = make_engine(kv_cfg=kv_cfg, sched_cfg=sched_cfg)
+        _warm(eng, sched_cfg.max_seqs)
+        serve = ServingEngine(eng, clock=clock_factory(), config=ServingConfig())
+        last_ts, gaps = {}, []
+
+        def stream(req, toks, now):
+            lt = last_ts.get(req.uid)
+            if lt is not None and toks:
+                gaps.append((now - lt) / len(toks))
+            last_ts[req.uid] = now
+
+        reqs = [serve.submit(prompts[i], max_new_tokens=new_tokens, stream=stream)
+                for i in range(n_off)]
+        serve.drain()
+        summ = serve.stats.summary(elapsed=serve.clock.now())
+        outs = [(r.state.value, list(r.tokens)) for r in reqs]
+        return {
+            "sessions": n_off,
+            "completed": summ["completed"],
+            "preemptions": summ["preemptions"],
+            "tpot_active": _pct(gaps),
+            "n_gaps": len(gaps),
+            "elapsed": round(serve.clock.now(), 6),
+        }, outs
+
+    def on_leg():
+        eng = make_engine(kv_cfg=kv_cfg, sched_cfg=sched_cfg)
+        _warm(eng, sched_cfg.max_seqs)
+        serve = ServingEngine(eng, clock=clock_factory(), config=ServingConfig())
+        # demote_prefix=False: this leg measures SESSION park/resume; the
+        # dead sessions' donated prefix pages must not churn the host LRU
+        # under the parked snapshots (warm-on-host has its own tests)
+        tier = TieredKVManager(eng, config=TierConfig(
+            host_capacity_pages=pps * n_on + 8, h2d_page_s=h2d_page_s,
+            demote_prefix=False))
+        serve.attach_tier(tier)
+        last_ts, gaps = {}, []
+
+        def stream(req, toks, now):
+            lt = last_ts.get(req.uid)
+            if lt is not None and toks:
+                gaps.append((now - lt) / len(toks))
+            last_ts[req.uid] = now
+
+        sessions = [{"req": serve.submit(prompts[i], max_new_tokens=new_tokens,
+                                         stream=stream),
+                     "seg": 0, "parked": False, "resume_at": 0.0,
+                     "prefetched": False} for i in range(n_on)]
+        host_peak, guard = 0, 0
+        while any(s["req"].state not in terminal for s in sessions):
+            guard += 1
+            assert guard < 500_000, "kv_tier on-leg wedged"
+            live_parked = [s for s in sessions
+                           if s["parked"] and s["req"].state not in terminal]
+            if not serve._active and not serve._queue and live_parked:
+                # everyone is thinking: jump the clock to the next due
+                # controller action (prefetch lead first, then resume)
+                serve.clock.wait_until(min(
+                    (s["resume_at"] if s["prefetched"]
+                     else s["resume_at"] - lead) for s in live_parked))
+            serve.tick()
+            now = serve.clock.now()
+            host_peak = max(host_peak, tier.host.pages_used)
+            for s in sessions:
+                r = s["req"]
+                if r.state in terminal:
+                    continue
+                if s["parked"]:
+                    if not s["prefetched"] and now >= s["resume_at"] - lead:
+                        serve.prefetch_resume(r.uid)
+                        s["prefetched"] = True
+                    if now >= s["resume_at"]:
+                        serve.resume(r.uid)
+                        s["parked"] = False
+                elif s["seg"] < len(bounds) and \
+                        len(r.tokens) >= bounds[s["seg"]] and \
+                        r.state is RequestState.DECODE and serve.park(r.uid):
+                    last_ts.pop(r.uid, None)   # think time is not TPOT
+                    s["seg"] += 1
+                    s["parked"], s["prefetched"] = True, False
+                    s["resume_at"] = now + think
+        summ = serve.stats.summary(elapsed=serve.clock.now())
+        outs = [(s["req"].state.value, list(s["req"].tokens)) for s in sessions]
+        return {
+            "sessions": n_on,
+            "completed": summ["completed"],
+            "preemptions": summ["preemptions"],
+            "parks": serve.stats.parks,
+            "resumes": serve.stats.resumes,
+            "demotions": tier.stats["demotions"],
+            "promotions": tier.stats["promotions"],
+            "kv_imports": serve.stats.kv_imports,
+            "kv_import_fallbacks": serve.stats.kv_import_fallbacks,
+            "prefetch_hidden_frac": (None if tier.hidden_frac is None
+                                     else round(tier.hidden_frac, 6)),
+            "host_pages_peak": host_peak,
+            "tpot_active": _pct(gaps),
+            "n_gaps": len(gaps),
+            "elapsed": round(serve.clock.now(), 6),
+        }, outs
+
+    off, off_outs = off_leg()
+    on, on_outs = on_leg()
+    identical = True
+    if dryrun:   # byte-identical regeneration: a virtual-clock property
+        off2, off_outs2 = off_leg()
+        on2, on_outs2 = on_leg()
+        identical = (json.dumps((off, on), sort_keys=True)
+                     == json.dumps((off2, on2), sort_keys=True)
+                     and off_outs == off_outs2 and on_outs == on_outs2)
+
+    assert off["completed"] == n_off and on["completed"] == n_on, \
+        f"sessions did not all complete: off={off['completed']}/{n_off} " \
+        f"on={on['completed']}/{n_on}"
+    assert on["kv_import_fallbacks"] == 0 and on["kv_imports"] >= on["resumes"], \
+        f"on-leg resumes did not all take the import fast path: {on}"
+    assert on["prefetch_hidden_frac"] is not None \
+        and on["prefetch_hidden_frac"] > 0.5, \
+        f"prefetch hid <=50% of promoted bytes: {on['prefetch_hidden_frac']}"
+    ratio = round(n_on / n_off, 6)
+    assert ratio >= 3.0, f"capacity ratio {ratio} < 3x"
+    tpot_bar = 1.25
+    p99_off, p99_on = off["tpot_active"]["p99"], on["tpot_active"]["p99"]
+    tpot_ratio = round(p99_on / p99_off, 6)
+    assert tpot_ratio <= tpot_bar, \
+        f"on-leg active-set p99 TPOT {p99_on} vs off {p99_off} " \
+        f"(ratio {tpot_ratio}) blew the equal-latency bar {tpot_bar}"
+
+    rec = {
+        "metric": "resident_session_capacity_ratio",
+        "value": ratio,
+        "unit": "x",
+        "schema_version": 1,
+        "workload": {"prompt_len": prompt_len, "new_tokens": new_tokens,
+                     "turns": len(bounds) + 1, "think": think,
+                     "prefetch_lead": lead, "h2d_page_s": h2d_page_s,
+                     "seed": seed, "dryrun": bool(dryrun),
+                     "virtual_clock": bool(dryrun),
+                     "kv": {"num_pages": kv_cfg.num_pages,
+                            "page_size": kv_cfg.page_size,
+                            "max_pages_per_seq": kv_cfg.max_pages_per_seq},
+                     "scheduler": {"token_budget": sched_cfg.token_budget,
+                                   "max_seqs": sched_cfg.max_seqs,
+                                   "prefill_chunk": sched_cfg.prefill_chunk,
+                                   "decode_bucket": sched_cfg.decode_bucket}},
+        "arena": {"usable_pages": usable, "pages_per_session": pps,
+                  "page_bound_sessions": usable // pps,
+                  "max_seqs": sched_cfg.max_seqs},
+        "off": off,
+        "on": on,
+        "equal_tpot": {"off_p99": p99_off, "on_p99": p99_on,
+                       "ratio": tpot_ratio, "bar": tpot_bar},
+        "determinism_repeat_identical": bool(dryrun and identical),
+    }
+    print(f"# kv_tier leg: sessions off={n_off} on={n_on} (ratio {ratio}x) "
+          f"tpot p99 off={p99_off} on={p99_on} "
+          f"hidden_frac={on['prefetch_hidden_frac']} "
+          f"imports={on['kv_imports']} fallbacks={on['kv_import_fallbacks']} "
+          f"repeat_identical={identical}", flush=True)
+    from deepspeed_tpu.resilience.atomic_io import atomic_write_json
+    atomic_write_json(out_path, rec, indent=1)
+    return rec
+
+
 def run_closed_loop(make_engine, clock_factory, rng, concurrency, n_requests,
                     ttft_budget, tpot_budget, vocab):
     from deepspeed_tpu.serving import ServingConfig, ServingEngine
@@ -429,6 +665,15 @@ def main():
                     help="run ONLY the step-anatomy leg (fast artifact "
                          "regeneration)")
     ap.add_argument("--anatomy-out", default="BENCH_STEP_ANATOMY.json")
+    ap.add_argument("--kv-tier", action="store_true",
+                    help="also run the tiered-KV resident-session capacity "
+                         "leg and commit BENCH_KV_TIER.json (park/resume "
+                         "sessions vs resident baseline at equal active-set "
+                         "p99 TPOT, prefetch-hidden promotion fraction)")
+    ap.add_argument("--kv-tier-only", action="store_true",
+                    help="run ONLY the kv_tier leg (fast artifact "
+                         "regeneration)")
+    ap.add_argument("--kv-tier-out", default="BENCH_KV_TIER.json")
     ap.add_argument("--trace", nargs="?", const="BENCH_SERVING_TRACE.json",
                     default=None, metavar="PATH",
                     help="export a Chrome/Perfetto trace of the highest-rate "
@@ -471,6 +716,12 @@ def main():
         run_anatomy_leg(make_engine, clock_factory, anat_arrivals, anat_rate,
                         max_queue_depth, args.dryrun, args.anatomy_out)
         if args.anatomy_only:
+            return
+
+    if args.kv_tier or args.kv_tier_only:
+        run_kv_tier_leg(make_engine, clock_factory, args.dryrun,
+                        args.kv_tier_out, args.seed)
+        if args.kv_tier_only:
             return
 
     sweep = []

@@ -553,7 +553,7 @@ def _validate_attribution(v):
 
 _ANATOMY_SEGMENTS = ("schedule", "draft_plan", "verify_plan", "aot_compile",
                      "compile_wait", "dispatch", "sample_accept", "overlap",
-                     "bookkeeping")
+                     "bookkeeping", "promote_wait")
 
 
 def _validate_anatomy_leg(leg, name):
@@ -761,6 +761,84 @@ def _validate_trace(doc):
     return None
 
 
+def _tier_tpot(p):
+    """Active-set TPOT summary: non-null ordered percentiles (a leg with
+    zero measured gaps has no latency claim and fails loudly)."""
+    if not isinstance(p, dict):
+        return f"expected percentile dict, got {type(p).__name__}"
+    for k in ("p50", "p95", "p99"):
+        if p.get(k) is None:
+            return f"missing/null percentile {k!r}"
+    if not (p["p50"] <= p["p95"] <= p["p99"]):
+        return f"percentiles out of order: {p}"
+    return None
+
+
+_TIER_LEG = {
+    "sessions": INT, "completed": INT, "preemptions": INT,
+    "tpot_active": _tier_tpot, "n_gaps": INT, "elapsed": NUM,
+}
+
+
+def _kv_tier_record(v):
+    """The tiered-KV receipt (bench_serving.py run_kv_tier_leg): the host
+    tier must buy >= 3x resident-session capacity — every session
+    completing in BOTH legs — with every on-leg resume taking the
+    snapshot-import fast path (zero recompute fallbacks), the prefetch
+    hiding > 50% of promoted bytes under other sessions' device windows,
+    and on-leg active-set p99 TPOT inside the committed equal-latency
+    bar.  A committed artifact where parking cost latency or resumes
+    silently recomputed is a regression, not a benchmark."""
+    if not isinstance(v, dict):
+        return f"expected kv_tier object, got {type(v).__name__}"
+    errors = []
+    _check(v, {
+        "metric": STR, "value": NUM, "unit": STR,
+        "schema_version": lambda x: None if x == 1 else f"schema_version {x} != 1",
+        "workload": {"prompt_len": INT, "new_tokens": INT, "turns": INT,
+                     "think": NUM, "prefetch_lead": NUM, "h2d_page_s": NUM,
+                     "seed": INT, "dryrun": BOOL, "virtual_clock": BOOL,
+                     "kv": DICT, "scheduler": DICT},
+        "arena": {"usable_pages": INT, "pages_per_session": INT,
+                  "page_bound_sessions": INT, "max_seqs": INT},
+        "off": _TIER_LEG,
+        "on": {**_TIER_LEG, "parks": INT, "resumes": INT, "demotions": INT,
+               "promotions": INT, "kv_imports": INT,
+               "kv_import_fallbacks": INT, "prefetch_hidden_frac": NUM,
+               "host_pages_peak": INT},
+        "equal_tpot": {"off_p99": NUM, "on_p99": NUM, "ratio": NUM, "bar": NUM},
+        "determinism_repeat_identical": BOOL,
+    }, "kv_tier", errors)
+    if errors:
+        return "; ".join(errors)
+    if v["metric"] != "resident_session_capacity_ratio" or v["unit"] != "x":
+        return f"wrong metric envelope: {v['metric']!r} [{v['unit']!r}]"
+    off, on = v["off"], v["on"]
+    if v["value"] < 3.0 or on["sessions"] < 3 * off["sessions"]:
+        return (f"capacity ratio {v['value']} (on {on['sessions']} vs off "
+                f"{off['sessions']}) below the 3x bar")
+    for side, leg in (("off", off), ("on", on)):
+        if leg["completed"] != leg["sessions"]:
+            return (f"{side} leg lost sessions: {leg['completed']}/"
+                    f"{leg['sessions']} completed")
+    if on["kv_import_fallbacks"] != 0 or on["kv_imports"] < on["resumes"]:
+        return (f"resumes did not all take the KV-import fast path: "
+                f"imports={on['kv_imports']} resumes={on['resumes']} "
+                f"fallbacks={on['kv_import_fallbacks']}")
+    if on["parks"] != on["resumes"] or on["parks"] == 0:
+        return f"unbalanced park/resume ledger: {on['parks']}/{on['resumes']}"
+    if not on["prefetch_hidden_frac"] > 0.5:
+        return (f"prefetch hid only {on['prefetch_hidden_frac']} of promoted "
+                "bytes (> 0.5 required)")
+    eq = v["equal_tpot"]
+    if eq["ratio"] > eq["bar"]:
+        return (f"on-leg p99 active TPOT {eq['on_p99']} vs off {eq['off_p99']} "
+                f"(ratio {eq['ratio']}) outside the equal-latency bar {eq['bar']}")
+    if v["workload"]["dryrun"] and v["determinism_repeat_identical"] is not True:
+        return "dryrun artifact not byte-identical across regenerations"
+    return None
+
+
 SCHEMAS = {
     # per-round driver transcripts
     "BENCH_r*.json": {"n": INT, "cmd": STR, "rc": INT, "tail": STR, "?parsed": DICT},
@@ -771,6 +849,8 @@ SCHEMAS = {
     "BENCH_ROUTER_ATTRIB.json": _validate_attribution,
     # per-step engine anatomy receipt (scripts/step_anatomy.py)
     "BENCH_STEP_ANATOMY.json": _validate_step_anatomy,
+    # tiered-KV resident-session capacity receipt (bench_serving.py --kv-tier)
+    "BENCH_KV_TIER.json": _kv_tier_record,
     # single-metric bench artifacts (bench.py-style envelope)
     "BENCH_SCALE.json": {"metric": STR, "value": NUM, "unit": STR,
                          "?vs_baseline": NUM, "extra": DICT},

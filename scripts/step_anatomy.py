@@ -41,7 +41,7 @@ import sys
 #: per-step segment vocabulary (a committed row missing one is drift)
 HOST_SEGMENTS = ("schedule", "draft_plan", "verify_plan", "aot_compile",
                  "compile_wait", "dispatch", "sample_accept", "overlap",
-                 "bookkeeping")
+                 "bookkeeping", "promote_wait")
 
 
 def _anatomy_of(doc):

@@ -47,8 +47,12 @@ from deepspeed_tpu.serving.metrics import percentile_summary  # noqa: E402
 #: (telemetry/step_anatomy.py, ``StepAnatomy.emit_spans``): per-step
 #: host-side loop tax and JIT compile pauses lifted into the trace —
 #: named here so anatomy spans fold instead of breaking the tiling
+#: ``parked``/``promote`` are the kv-tier phases (serving/kvtier):
+#: host-demoted idle windows and the unhidden slice of the h2d promote
+#: transfer a resume pays (telemetry/spans.py carves them out of
+#: parked/queued so the tiling still holds exactly)
 PHASES = ("pending", "queued", "prefill", "decode", "migrating", "evicted",
-          "fenced", "host_gap", "compile_wait")
+          "fenced", "host_gap", "compile_wait", "parked", "promote")
 _US = 1e6
 
 

@@ -326,8 +326,9 @@ def test_health_fatal_classification():
 def test_load_stats_and_ewma(trained_params):
     serve = ServingEngine(_factory(trained_params)(), clock=VirtualClock())
     s0 = serve.load_stats()
-    assert s0 == {"queue_depth": 0, "active": 0, "outstanding_tokens": 0,
-                  "free_kv_pages": 63, "ewma_step_s": None}
+    assert s0 == {"queue_depth": 0, "active": 0, "parked": 0,
+                  "outstanding_tokens": 0, "free_kv_pages": 63,
+                  "ewma_step_s": None}
     serve.submit([1, 2, 3, 4, 5], max_new_tokens=6)
     assert serve.load_stats()["queue_depth"] == 1
     serve.tick()

@@ -1,0 +1,200 @@
+"""Chaos tests for the tiered KV host-offload path (``kv.demote`` /
+``kv.promote`` fault sites + direct host-page corruption): every
+degradable failure falls back to recompute-on-resume with byte-identical
+outputs and zero page drift; a torn/corrupt host page is rejected by crc
+BEFORE any scatter; driver crashes propagate."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, build_engine
+from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.models.llama_cache import PagedKVConfig
+from deepspeed_tpu.resilience.fault_injection import (INJECTION_SITES,
+                                                      FaultSpec,
+                                                      InjectedCrash,
+                                                      configure_fault_injection)
+from deepspeed_tpu.serving import (RequestState, ServingConfig, ServingEngine,
+                                   VirtualClock)
+from deepspeed_tpu.serving.kvtier import TieredKVManager
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=2, max_position_embeddings=128,
+                  rope_theta=1e4, dtype=jnp.float32, scan_layers=True,
+                  remat=False)
+
+
+@pytest.fixture(scope="module")
+def trained_params():
+    model = LlamaForCausalLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    configure_fault_injection(None)
+
+
+def _engine(trained_params):
+    kv = PagedKVConfig(num_pages=64, page_size=8, max_pages_per_seq=8)
+    sched = SchedulerConfig(token_budget=64, max_seqs=8, prefill_chunk=8,
+                            decode_bucket=4)
+    return build_engine(CFG, trained_params, RaggedInferenceEngineConfig(
+        kv=kv, scheduler=sched, kv_dtype=jnp.float32,
+        decode_steps_per_dispatch=1))
+
+
+def _serve(trained_params):
+    serve = ServingEngine(_engine(trained_params), clock=VirtualClock(),
+                          config=ServingConfig())
+    tier = TieredKVManager(serve.engine)
+    serve.attach_tier(tier)
+    return serve, tier
+
+
+PROMPT = [5, 9, 2, 7, 1, 44, 17, 3, 61]
+
+
+@pytest.fixture(scope="module")
+def golden(trained_params):
+    return _engine(trained_params).generate([PROMPT], max_new_tokens=10)
+
+
+def _park_mid_decode(serve, req, max_ticks=200):
+    for _ in range(max_ticks):
+        if req.state is RequestState.DECODE and len(req.tokens) >= 2:
+            assert serve.park(req.uid)
+            return
+        serve.tick()
+    raise AssertionError("never reached a parkable DECODE window")
+
+
+def _assert_clean(serve, tier):
+    eng = serve.engine
+    assert not eng.state.seqs
+    if eng.kv.prefix_cache is not None:
+        eng.kv.prefix_cache.evict(eng.kv.num_pages)
+    assert eng.kv.allocator.free_pages == eng.kv.num_pages - 1
+    assert tier.host.pages_used == sum(tier.host._lru.values())
+
+
+def test_tier_sites_registered():
+    assert "kv.demote" in INJECTION_SITES
+    assert "kv.promote" in INJECTION_SITES
+    FaultSpec(site="kv.demote", kind="os_error")     # validates
+    FaultSpec(site="kv.promote", kind="crash")
+    with pytest.raises(ValueError, match="unknown injection site"):
+        FaultSpec(site="kv.demot", kind="crash")
+
+
+def test_demote_os_error_parks_without_snapshot_resume_recomputes(
+        trained_params, golden):
+    """A transient fault during the d2h gather: the park still succeeds
+    (the session sleeps), but its resume recomputes — slower, identical."""
+    configure_fault_injection(
+        {"sites": [{"site": "kv.demote", "kind": "os_error", "at": 1}]})
+    serve, tier = _serve(trained_params)
+    req = serve.submit(PROMPT, max_new_tokens=10)
+    _park_mid_decode(serve, req)
+    assert tier.stats["demote_faults"] == 1
+    assert req.kv_snapshot is None           # parked, nothing staged
+    assert serve.resume(req.uid)
+    serve.drain()
+    assert req.state is RequestState.DONE
+    assert [list(req.tokens)] == golden
+    assert serve.stats.kv_imports == 0       # recompute owned the resume
+    _assert_clean(serve, tier)
+
+
+def test_promote_os_error_falls_back_to_recompute(trained_params, golden):
+    """A transient fault at the h2d promotion: the claim is consumed, the
+    import falls back, and the recompute serves identical tokens."""
+    configure_fault_injection(
+        {"sites": [{"site": "kv.promote", "kind": "os_error", "at": 1}]})
+    serve, tier = _serve(trained_params)
+    req = serve.submit(PROMPT, max_new_tokens=10)
+    _park_mid_decode(serve, req)
+    assert serve.resume(req.uid)
+    serve.drain()
+    assert req.state is RequestState.DONE
+    assert [list(req.tokens)] == golden
+    assert tier.stats["promote_faults"] == 1
+    assert serve.stats.kv_import_fallbacks == 1
+    assert serve.stats.kv_imports == 0
+    _assert_clean(serve, tier)
+
+
+def test_corrupt_host_page_rejected_by_crc_before_scatter(
+        trained_params, golden):
+    """Bit rot in a staged host page: the crc verify rejects the snapshot
+    BEFORE any scatter touches the arena, and the recompute fallback
+    serves identical tokens with zero page drift."""
+    serve, tier = _serve(trained_params)
+    req = serve.submit(PROMPT, max_new_tokens=10)
+    _park_mid_decode(serve, req)
+    snap = tier.host.peek_seq(req.uid)
+    assert snap is not None and snap.chunks
+    # flip bits in the staged payload without refreshing its crc tag
+    snap.chunks[0] = snap.chunks[0] + np.float32(1.0)
+    free_before = serve.engine.kv.allocator.free_pages
+    assert serve.resume(req.uid)
+    serve.tick()
+    assert serve.stats.kv_import_fallbacks == 1
+    serve.drain()
+    assert req.state is RequestState.DONE
+    assert [list(req.tokens)] == golden
+    assert serve.stats.kv_imports == 0
+    _assert_clean(serve, tier)
+    # the rejected import allocated-then-freed (or never allocated):
+    # nothing leaked relative to the pre-resume arena
+    assert serve.engine.kv.allocator.free_pages >= free_before
+
+
+def test_corrupt_host_prefix_page_dropped_before_adoption(trained_params):
+    """A corrupt warm-on-host prefix page is dropped at the crc check —
+    the chain promotion stops there and the prefill recomputes the tail."""
+    prefix = list(range(1, 17))
+    prompts = [prefix + [40], prefix + [41]]
+    golden = _engine(trained_params).generate(
+        [list(p) for p in prompts], max_new_tokens=4)
+    serve, tier = _serve(trained_params)
+    r1 = serve.submit(prompts[0], max_new_tokens=4)
+    serve.drain()
+    pc = serve.engine.kv.prefix_cache
+    pc.evict(serve.engine.kv.num_pages)      # demote both pages host-side
+    assert tier.stats["prefix_demotions"] >= 2
+    ent = next(iter(tier.host._prefix.values()))
+    ent.block = ent.block + np.float32(1.0)  # crc tag now stale
+    r2 = serve.submit(prompts[1], max_new_tokens=4)
+    serve.drain()
+    assert [list(r1.tokens), list(r2.tokens)] == golden
+    _assert_clean(serve, tier)
+
+
+def test_demote_crash_propagates(trained_params):
+    configure_fault_injection(
+        {"sites": [{"site": "kv.demote", "kind": "crash", "at": 1}]})
+    serve, _ = _serve(trained_params)
+    req = serve.submit(PROMPT, max_new_tokens=10)
+    for _ in range(200):
+        if req.state is RequestState.DECODE and len(req.tokens) >= 2:
+            break
+        serve.tick()
+    with pytest.raises(InjectedCrash):
+        serve.park(req.uid)
+
+
+def test_promote_crash_propagates(trained_params):
+    configure_fault_injection(
+        {"sites": [{"site": "kv.promote", "kind": "crash", "at": 1}]})
+    serve, _ = _serve(trained_params)
+    req = serve.submit(PROMPT, max_new_tokens=10)
+    _park_mid_decode(serve, req)
+    assert serve.resume(req.uid)
+    with pytest.raises(InjectedCrash):
+        serve.drain()
