@@ -97,6 +97,8 @@ INJECTION_SITES = frozenset({
     "transport.deliver",    # control-plane message delivery edge (serving/fleet/transport.py)
     "lifecycle.cmd.send",   # router lifecycle-command send edge (serving/fleet/router.py)
     "lifecycle.cmd.apply",  # replica-side lifecycle-command apply edge (serving/fleet/router.py)
+    "session.route",        # session-coordinator turn submit edge (serving/sessions/manager.py)
+    "session.tool_result",  # tool-result delivery edge ending a stall (serving/sessions/manager.py)
 })
 
 _RAISING_KINDS = ("os_error", "crash", "device_loss", "latency")

@@ -351,6 +351,12 @@ class ServingEngine:
         the step dispatched LAST tick, then enqueue the next one — see
         :meth:`_tick_pipelined`.  Returns the completed step's
         {uid: [tokens]} (empty when nothing was runnable)."""
+        if self.tier is not None:
+            # capacity-pressure demotion (docs/SERVING.md "Tiered KV"):
+            # coldest-first device→host demotion / host drops once the
+            # configured occupancy watermarks are crossed — a no-op with
+            # the default (None) watermarks
+            self.tier.enforce_watermarks()
         if self.config.async_dispatch:
             return self._tick_pipelined()
         return self._tick_serial()
@@ -747,7 +753,7 @@ class ServingEngine:
         if tier.metrics is None:
             tier.metrics = self.metrics
 
-    def park(self, uid: int) -> bool:
+    def park(self, uid: int, phase: str = "parked") -> bool:
         """Park an idle decoding session: demote its KV pages to the host
         tier, release its engine sequence, and hold the request in PARKED
         until :meth:`resume`.  The session costs ZERO device pages while
@@ -757,7 +763,12 @@ class ServingEngine:
         active unfinished DECODE (parking mid-prefill or mid-step work is
         not a supported window) or has no tier to park into.  A failed
         demotion still parks — that resume just recomputes (the
-        kv_snapshot stays None), the ladder's never-wrong fallback."""
+        kv_snapshot stays None), the ladder's never-wrong fallback.
+
+        ``phase`` labels the PARKED interval for telemetry ("parked" for
+        idle-session parks, "tool_stall" for a session's mid-generation
+        tool-call stall — serving/sessions); the park/resume machinery is
+        identical either way."""
         req = self._active.get(uid)
         if self.tier is None or req is None \
                 or req.state is not RequestState.DECODE:
@@ -770,6 +781,7 @@ class ServingEngine:
         handle = self.tier.demote_sequence(uid)
         self.engine.preempt(uid)
         del self._active[uid]
+        req.park_phase = phase
         req.to(RequestState.PARKED, now)
         req.kv_snapshot = handle
         self._parked[uid] = req

@@ -22,13 +22,14 @@ from .health import (FleetHealthView, HealthConfig, HealthTracker, LeaseConfig,
                      LeaseState, ReplicaState, classify_fatal)
 from .policies import (POLICIES, DisaggregatedPolicy, LeastOutstandingPolicy,
                        PrefixAffinityPolicy, PrefixDirectoryPolicy,
-                       RoundRobinPolicy, RoutingPolicy, make_policy)
+                       RoundRobinPolicy, RoutingPolicy, SessionAffinityPolicy,
+                       make_policy)
 from .pool import Replica, ReplicaPool, ReplicaRole
 from .prefix_directory import PrefixDirectory
 from .router import FleetRequest, FleetState, Router
 from .sim import (FleetEvent, FleetSimulator, diurnal_arrivals,
                   flash_crowd_arrivals, heavy_tail_arrivals,
-                  poisson_mixed_arrivals)
+                  poisson_mixed_arrivals, session_arrivals)
 from .tenancy import DEFAULT_TENANT, TenantRegistry, TenantSpec
 from .transport import (MESSAGE_KINDS, MESSAGE_VERSION, ControlTransport,
                         LinkFaults, Message, PartitionWindow)
@@ -42,9 +43,11 @@ __all__ = [
     "HealthConfig", "HealthTracker", "ReplicaState", "classify_fatal",
     "POLICIES", "DisaggregatedPolicy", "LeastOutstandingPolicy",
     "PrefixAffinityPolicy", "PrefixDirectoryPolicy", "PrefixDirectory",
-    "RoundRobinPolicy", "RoutingPolicy", "make_policy",
+    "RoundRobinPolicy", "RoutingPolicy", "SessionAffinityPolicy",
+    "make_policy",
     "Replica", "ReplicaPool", "ReplicaRole", "FleetRequest", "FleetState",
     "Router", "FleetEvent", "FleetSimulator", "diurnal_arrivals",
     "flash_crowd_arrivals", "heavy_tail_arrivals", "poisson_mixed_arrivals",
+    "session_arrivals",
     "DEFAULT_TENANT", "TenantRegistry", "TenantSpec",
 ]

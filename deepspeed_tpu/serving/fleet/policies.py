@@ -33,6 +33,14 @@ read-only prefix-cache warmth probe.
   the router to IMPORT the hot prefix's KV pages onto that cold replica
   first (``prefix_import`` in the select info), turning warm-replica
   affinity into cluster-wide warmth (docs/SERVING.md "Prefix directory").
+* :class:`SessionAffinityPolicy` — sticky-with-failover placement for
+  agentic sessions (serving/sessions): every turn of a session lands on
+  the replica that served the previous one — its prefix cache already
+  holds the FULL transcript's pages, so turn N+1 prefills only the new
+  user tokens.  When the sticky replica is dead or saturated the turn
+  re-homes through the wrapped fallback (directory-warmth when a
+  directory is attached, least-loaded otherwise) and the session
+  re-sticks there (docs/SERVING.md "Agentic sessions").
 """
 
 from typing import List, Optional, Tuple
@@ -249,9 +257,69 @@ class PrefixDirectoryPolicy(RoutingPolicy):
         return fb_rid, info
 
 
+class SessionAffinityPolicy(RoutingPolicy):
+    """Sticky-with-failover session placement (docs/SERVING.md "Agentic
+    sessions").
+
+    The map is ``session_id -> rid``, learned from wherever each session's
+    LAST dispatch landed.  The sticky replica wins whenever it is a live
+    candidate below ``saturation_queue_depth`` — its prefix cache holds
+    the session's whole transcript (generated tokens included: the engine
+    publishes full pages as decode progresses), so the sticky turn
+    prefills only the fresh user suffix.  Otherwise the turn re-homes:
+
+    * sticky replica DEAD (not in candidates) or SATURATED → fall back to
+      the wrapped policy — :class:`PrefixDirectoryPolicy` when a
+      directory is attached (a failover turn carries the transcript
+      prefix, and the directory may know a second-warm replica or plan a
+      ``prefix_import`` onto the landing one), least-loaded otherwise —
+      and RE-STICK to wherever the turn lands.
+    * session-less requests (``session_id`` is None) go straight to the
+      fallback: mixing stateless traffic through the sticky map would
+      pin it to arbitrary replicas.
+
+    Info keys: ``session_sticky`` (the sticky fast path won),
+    ``session_failover`` (a previously-stuck session re-homed), plus
+    whatever the fallback contributes (``affinity_hit``,
+    ``prefix_import`` ...)."""
+
+    name = "session_affinity"
+
+    def __init__(self, directory=None, saturation_queue_depth: int = 4,
+                 import_min_pages: int = 1):
+        assert saturation_queue_depth >= 1, saturation_queue_depth
+        self.saturation_queue_depth = saturation_queue_depth
+        self._sticky = {}          # session_id -> rid of the last dispatch
+        self._fallback = PrefixDirectoryPolicy(
+            directory, saturation_queue_depth=saturation_queue_depth,
+            import_min_pages=import_min_pages) if directory is not None \
+            else LeastOutstandingPolicy()
+
+    def select(self, request, candidates):
+        if not candidates:
+            return None, {}
+        sid = getattr(request, "session_id", None)
+        if sid is None:
+            return self._fallback.select(request, candidates)
+        rid = self._sticky.get(sid)
+        if rid is not None:
+            for c_rid, _, stats in candidates:
+                if c_rid == rid and \
+                        stats["queue_depth"] < self.saturation_queue_depth:
+                    return rid, {"session_sticky": True}
+        fb_rid, info = self._fallback.select(request, candidates)
+        if fb_rid is None:
+            return None, {}
+        info = {**info, "session_sticky": False}
+        if rid is not None and fb_rid != rid:
+            info["session_failover"] = True
+        self._sticky[sid] = fb_rid
+        return fb_rid, info
+
+
 POLICIES = {p.name: p for p in (RoundRobinPolicy, LeastOutstandingPolicy,
                                 PrefixAffinityPolicy, DisaggregatedPolicy,
-                                PrefixDirectoryPolicy)}
+                                PrefixDirectoryPolicy, SessionAffinityPolicy)}
 
 
 def make_policy(name: str, **kwargs) -> RoutingPolicy:

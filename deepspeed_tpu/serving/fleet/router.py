@@ -40,6 +40,7 @@ from ...telemetry.trace import NULL_TRACER
 from ...utils.logging import logger
 from ..metrics import percentile_summary
 from ..request import RequestState, ServingRequest
+from ..kvtier import HostKVHandle
 from ..kvtransfer import SnapshotAborted
 from .health import FleetHealthView, LeaseConfig, LeaseState, ReplicaState
 from .policies import RoutingPolicy
@@ -103,6 +104,11 @@ class FleetRequest:
     #: QoS: the submitting tenant and its weighted-fair stride pass
     tenant: str = "default"
     _wfq: float = 0.0
+    #: agentic-session identity (serving/sessions): set when this request
+    #: is one TURN of a multi-turn session — the ``session_affinity``
+    #: routing policy keys its sticky replica map on it, so turn N+1 lands
+    #: where turn N left its warm transcript pages
+    session_id: Optional[object] = None
     #: True when a brownout rung capped this request's max_new_tokens
     brownout_capped: bool = False
     #: host-staged KV carried between attempts: set when a migration's
@@ -445,6 +451,8 @@ class Router:
             "lifecycle_cmds": 0, "lifecycle_applied": 0,
             "lifecycle_acked": 0, "lifecycle_stale_acks": 0,
             "lifecycle_aborted": 0, "lifecycle_send_faults": 0,
+            "session_sticky_hits": 0, "session_failovers": 0,
+            "session_parks": 0, "session_resumes": 0,
         }
         self.recovery_times: List[float] = []
         # arrival-rate telemetry (ROADMAP's predictive-scale-up input):
@@ -472,7 +480,8 @@ class Router:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                deadline: Optional[float] = None, arrival_ts: Optional[float] = None,
-               priority: float = 0.0, tenant: str = "default") -> FleetRequest:
+               priority: float = 0.0, tenant: str = "default",
+               session: Optional[object] = None) -> FleetRequest:
         now = self.clock.now() if arrival_ts is None else float(arrival_ts)
         self._arrival_count += 1   # demand signal: sheds/rejects included
         spec = self.tenants.spec(tenant)
@@ -488,7 +497,8 @@ class Router:
                 max_new_tokens, capped = cap, True
         fr = FleetRequest(fid=next(self._fids), prompt=list(prompt),
                           max_new_tokens=max_new_tokens, arrival_ts=now,
-                          deadline=deadline, priority=priority, tenant=tenant)
+                          deadline=deadline, priority=priority, tenant=tenant,
+                          session_id=session)
         if self.tracer.enabled:
             # reserve the root span id now: attempt/phase children parent
             # to it long before the root's extent (terminal ts) is known
@@ -805,6 +815,12 @@ class Router:
             self.stats[key] += 1
             if info["affinity_hit"]:
                 fr.affinity_hits += 1
+        if info.get("session_sticky"):
+            self.stats["session_sticky_hits"] += 1
+        if info.get("session_failover"):
+            # the session's sticky replica was gone/saturated and the turn
+            # re-homed — distinct from fr.failovers (mid-attempt displacement)
+            self.stats["session_failovers"] += 1
         self._emit([("fleet/dispatch", float(rid), self._next_event_step())])
         return True
 
@@ -932,6 +948,77 @@ class Router:
                 fr.first_token_ts = ts
             fr.tokens.extend(toks)
         return on_tokens
+
+    # ----------------------------------------------------- session parking
+
+    def _current_attempt(self, fr: FleetRequest):
+        """``(replica, sr)`` when ``fr``'s current attempt is live on a
+        healthy replica of the generation it was dispatched to; None when
+        the request has no attempt (pending/terminal) or the replica died
+        or restarted since — callers degrade gracefully (a park that can't
+        happen just means the stall holds its device pages; a resume that
+        can't happen means failover already re-queued the request)."""
+        cur = fr._current
+        if cur is None:
+            return None
+        rid, sr, gen = cur
+        rep = self.pool.replica(rid)
+        if rep.serve is None or rep.generation != gen:
+            return None
+        return rep, sr
+
+    def request_decoding(self, fr: FleetRequest) -> bool:
+        """True when ``fr``'s current attempt is actively DECODING on a
+        live replica — the only window :meth:`park_request` can use.  A
+        session coordinator polls this after a failover re-dispatch to
+        re-park a request whose tool stall the death interrupted."""
+        live = self._current_attempt(fr)
+        return live is not None and live[1].state is RequestState.DECODE
+
+    def park_request(self, fr: FleetRequest, phase: str = "tool_stall") -> bool:
+        """Park ``fr``'s in-flight attempt through its replica's host KV
+        tier (serving/sessions tool stall): partial generation demoted
+        host-side, device pages freed, the fleet request stays DISPATCHED
+        (the attempt is PARKED, not displaced).  False when the attempt
+        isn't in a parkable window — the stall then simply rides out
+        on-device, slower for neighbors but never wrong."""
+        live = self._current_attempt(fr)
+        if live is None:
+            return False
+        rep, sr = live
+        if not rep.serve.park(sr.uid, phase=phase):
+            return False
+        self.stats["session_parks"] += 1
+        self._emit([("fleet/session_park", 1.0, self._next_event_step())])
+        return True
+
+    def prefetch_resume_request(self, fr: FleetRequest) -> bool:
+        """Prefetch hint for a parked attempt's h2d promotion (the session
+        coordinator calls this ``prefetch_lead_s`` ahead of the tool
+        result's ETA, so the transfer hides under intervening steps)."""
+        live = self._current_attempt(fr)
+        if live is None:
+            return False
+        rep, sr = live
+        return rep.serve.prefetch_resume(sr.uid)
+
+    def resume_request(self, fr: FleetRequest) -> bool:
+        """Resume ``fr``'s parked attempt in place (tool result arrived):
+        re-enqueued on the SAME replica, admission promotes the staged KV
+        back (or recomputes on any host-tier miss).  False when the
+        attempt is gone — replica death displaced it and the normal
+        failover path owns it now."""
+        live = self._current_attempt(fr)
+        if live is None:
+            return False
+        rep, sr = live
+        if sr.state is not RequestState.PARKED:
+            return False
+        if not rep.serve.resume(sr.uid):
+            return False
+        self.stats["session_resumes"] += 1
+        self._emit([("fleet/session_resume", 1.0, self._next_event_step())])
+        return True
 
     # ---------------------------------------------------------------- poll
 
@@ -2033,9 +2120,21 @@ class Router:
                     fr._kv_snapshot = snap
                     self.stats["migration_failover_reuse"] += 1
                 elif getattr(displaced_sr, "kv_snapshot", None) is not None:
-                    fr._kv_snapshot = displaced_sr.kv_snapshot
+                    dsnap = displaced_sr.kv_snapshot
                     displaced_sr.kv_snapshot = None
-                    self.stats["migration_failover_reuse"] += 1
+                    if isinstance(dsnap, HostKVHandle):
+                        # a PARKED attempt (session tool stall) died with
+                        # its KV in the dead replica's HOST tier — host
+                        # memory survives the device loss, so resolve the
+                        # handle to the raw snapshot NOW and carry it to a
+                        # survivor's import path (the survivor needs no
+                        # tier of its own).  A None resolution (the entry
+                        # was LRU-evicted first) leaves _kv_snapshot unset:
+                        # recompute-on-resume, the ladder's never-wrong rung.
+                        dsnap = dsnap.tier.host.take_seq(dsnap.uid)
+                    if dsnap is not None:
+                        fr._kv_snapshot = dsnap
+                        self.stats["migration_failover_reuse"] += 1
                 fr.failovers += 1
                 self._taccount(fr.tenant)["failovers"] += 1
                 fr.to(FleetState.PENDING, now)

@@ -230,6 +230,88 @@ def diurnal_arrivals(seed: int, n_requests: int, base_rate: float,
     return arrivals
 
 
+def session_arrivals(seed: int, n_sessions: int, vocab: int,
+                     rate: Optional[float] = None,
+                     turns_min: int = 2, turns_max: int = 4,
+                     user_median: int = 12, user_sigma: float = 0.4,
+                     max_user: int = 48,
+                     new_median: int = 10, new_sigma: float = 0.3,
+                     min_new: int = 4, max_new: int = 24,
+                     think_median: float = 4.0, think_sigma: float = 0.6,
+                     max_think: float = 60.0,
+                     stall_prob: float = 0.35,
+                     stall_at: Optional[Tuple[int, ...]] = None,
+                     stall_median: float = 3.0, stall_sigma: float = 0.5,
+                     max_stall: float = 30.0, tool_len: int = 6) -> List[dict]:
+    """Agentic multi-turn session specs — the workload shape production
+    serving actually sees (ROADMAP "Scenario diversity"): sessions x
+    turns x lognormal think times x tool-stall probability, all seeded.
+    Consumed by the :mod:`~..sessions` drivers (``SessionManager`` for
+    one engine, ``FleetSessionCoordinator`` for a fleet) rather than
+    submitted directly: sessions are CLOSED-LOOP — turn N+1's arrival is
+    turn N's completion plus think time, and its prompt is the session's
+    full transcript, neither knowable up front.
+
+    Each element::
+
+        {"sid": int, "start_ts": float, "turns": [
+            {"user_tokens": [...], "max_new_tokens": int,
+             "think_s": float,
+             "stalls": [{"at_tokens": int, "stall_s": float,
+                         "tool_tokens": [...]}, ...]}, ...]}
+
+    ``rate``: Poisson session-start rate; None starts every session at
+    t=0 (the resident-capacity shape ``bench_serving --kv-tier`` uses).
+    ``stall_prob``: per-turn probability of ONE mid-generation tool
+    stall at a seeded token offset; ``stall_at`` instead fires a stall
+    at each of the given FIXED offsets in every turn (the deterministic
+    bench shape — the r22 kv-tier leg is ``turns_min=turns_max=1,
+    stall_at=(7, 14)``).  ``tool_len=0`` makes tool results empty (a
+    pure pause, transcript unchanged).  Sigma-zero lognormals pin any
+    length/duration to its median exactly.  Deterministic in ``seed``
+    like every generator here."""
+    assert 1 <= turns_min <= turns_max
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    sessions = []
+    for sid in range(n_sessions):
+        if rate is not None:
+            t += float(rng.exponential(1.0 / rate))
+        n_turns = int(rng.integers(turns_min, turns_max + 1))
+        turns = []
+        for _ in range(n_turns):
+            u_len = int(np.clip(rng.lognormal(np.log(user_median), user_sigma),
+                                2, max_user))
+            o_len = int(np.clip(rng.lognormal(np.log(new_median), new_sigma),
+                                min_new, max_new))
+            think = round(float(np.clip(
+                rng.lognormal(np.log(think_median), think_sigma),
+                0.1, max_think)), 6)
+            if stall_at is not None:
+                offsets = [a for a in stall_at if a < o_len]
+            else:
+                offsets = ([int(rng.integers(2, max(3, o_len - 1)))]
+                           if rng.random() < stall_prob else [])
+            stalls = []
+            for at in offsets:
+                stalls.append({
+                    "at_tokens": int(at),
+                    "stall_s": round(float(np.clip(
+                        rng.lognormal(np.log(stall_median), stall_sigma),
+                        0.1, max_stall)), 6),
+                    "tool_tokens": [int(x)
+                                    for x in rng.integers(1, vocab, tool_len)],
+                })
+            turns.append({
+                "user_tokens": [int(x) for x in rng.integers(1, vocab, u_len)],
+                "max_new_tokens": o_len,
+                "think_s": think,
+                "stalls": stalls,
+            })
+        sessions.append({"sid": sid, "start_ts": round(t, 6), "turns": turns})
+    return sessions
+
+
 @dataclasses.dataclass(frozen=True)
 class FleetEvent:
     ts: float
@@ -243,10 +325,19 @@ class FleetEvent:
 class FleetSimulator:
 
     def __init__(self, router: Router, max_rounds: int = 200_000,
-                 autoscaler=None):
+                 autoscaler=None, controller=None):
         self.router = router
         self.pool = router.pool
         self.clock = router.clock
+        #: optional closed-loop workload controller (duck-typed:
+        #: ``pending() -> bool``, ``poll(now)`` submits work due now,
+        #: ``next_wake(now) -> Optional[ts]`` joins the stall-guard wait
+        #: list, ``marker()`` joins the progress signature).  The sessions
+        #: ``FleetSessionCoordinator`` is the canonical one: open-loop
+        #: ``arrivals`` can be listed up front, but a session's turn N+1
+        #: arrives at turn N's completion + think time — only a controller
+        #: polled inside the round loop can submit it.
+        self.controller = controller
         # VirtualClock: deterministic rounds, time advances by max recorded
         # cost.  WallClock: the same round structure with real time (ticks
         # advance the clock themselves and there are no cost views to
@@ -312,11 +403,15 @@ class FleetSimulator:
             if self.autoscaler is not None:
                 self.autoscaler.step(now)
 
-            # 2. arrivals + dispatch
+            # 2. arrivals + dispatch (a controller's closed-loop arrivals —
+            # session turns due now — are polled in the same window, so
+            # they see the same dispatch the open-loop arrivals do)
             while a_i < len(pending_arrivals) and \
                     pending_arrivals[a_i]["arrival_ts"] <= now:
                 reqs.append(router.submit(**pending_arrivals[a_i]))
                 a_i += 1
+            if self.controller is not None:
+                self.controller.poll(now)
             router.dispatch_pending(now)
 
             # 3. one concurrent tick across the fleet
@@ -357,7 +452,9 @@ class FleetSimulator:
             self.replica_seconds += (clock.now() - now) * n_provisioned
 
             if a_i >= len(pending_arrivals) and e_i >= len(events) \
-                    and not deferred_restarts and router.outstanding == 0:
+                    and not deferred_restarts and router.outstanding == 0 \
+                    and (self.controller is None
+                         or not self.controller.pending()):
                 if self.autoscaler is not None:
                     self.autoscaler.finalize(clock.now())
                 return reqs
@@ -377,6 +474,14 @@ class FleetSimulator:
                     waits.append(events[e_i].ts)
                 if self.autoscaler is not None:
                     wake = self.autoscaler.wake_ts(clock.now())
+                    if wake is not None:
+                        waits.append(wake)
+                if self.controller is not None:
+                    # closed-loop wake-ups: think-time turn starts, tool-
+                    # stall resumes, prefetch leads — a fleet whose every
+                    # session is thinking must still wake to start the
+                    # next turn
+                    wake = self.controller.next_wake(clock.now())
                     if wake is not None:
                         waits.append(wake)
                 if not waits:
@@ -451,6 +556,10 @@ class FleetSimulator:
                 # advance no clock and deliver no tokens, but they ARE
                 # progress (a recover this round changes next round)
                 self.autoscaler.marker() if self.autoscaler is not None else None,
+                # closed-loop controller progress: a session state change
+                # (turn started, stall entered/resumed) advances no clock
+                # and may deliver no tokens this round, but it IS progress
+                self.controller.marker() if self.controller is not None else None,
                 # transport control transitions (lease/fence/resync) — same
                 # stance; raw send counters are deliberately excluded (see
                 # Router.control_marker)

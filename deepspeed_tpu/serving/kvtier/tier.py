@@ -77,6 +77,29 @@ class TierConfig:
     #: directory instead of host RAM (crcs and geometry stay in memory, so
     #: torn files are still rejected at promote).  None = CPU memory.
     spill_dir: Optional[str] = None
+    #: capacity-pressure demotion watermarks (ROADMAP kvtier depth item):
+    #: occupancy fractions in [0, 1].  When DEVICE arena occupancy
+    #: (allocated / usable pages) reaches ``device_watermark_hi``,
+    #: :meth:`TieredKVManager.enforce_watermarks` demotes coldest-first —
+    #: LRU-leaf prefix-cache pages, staged host-side via the demoter hook
+    #: — until occupancy is back at ``device_watermark_lo`` (hysteresis:
+    #: nothing happens between lo and hi, so the sweep never thrashes at
+    #: the boundary).  Likewise ``host_watermark_hi``/``lo`` bound the
+    #: HOST tier by dropping its LRU-coldest entries (a dropped parked
+    #: snapshot degrades that resume to recompute — slower, never wrong).
+    #: None (the default) disables that side entirely; every pre-existing
+    #: golden is unchanged.
+    device_watermark_hi: Optional[float] = None
+    device_watermark_lo: Optional[float] = None
+    host_watermark_hi: Optional[float] = None
+    host_watermark_lo: Optional[float] = None
+
+    def __post_init__(self):
+        for hi, lo in ((self.device_watermark_hi, self.device_watermark_lo),
+                       (self.host_watermark_hi, self.host_watermark_lo)):
+            if hi is not None:
+                assert lo is not None and 0.0 <= lo <= hi <= 1.0, \
+                    f"watermarks need 0 <= lo <= hi <= 1, got lo={lo} hi={hi}"
 
 
 class HostKVHandle:
@@ -303,7 +326,8 @@ class TieredKVManager:
         self.stats = {"demotions": 0, "promotions": 0, "demote_faults": 0,
                       "promote_faults": 0, "promote_fallbacks": 0,
                       "prefix_demotions": 0, "prefix_promotions": 0,
-                      "transfer_s": 0.0, "hidden_s": 0.0}
+                      "transfer_s": 0.0, "hidden_s": 0.0,
+                      "watermark_demotions": 0, "watermark_host_drops": 0}
         #: host-tier publish bus, mirroring ``PrefixCacheManager.listener``:
         #: ``listener(event, digest)`` with "host_publish" (a prefix page
         #: entered the host tier) / "host_evict" (it left) — the fleet
@@ -399,6 +423,59 @@ class TieredKVManager:
         reached a terminal without resuming)."""
         self.host.discard_seq(uid)
         self._prefetch.pop(uid, None)
+
+    def enforce_watermarks(self) -> Dict[str, int]:
+        """Capacity-pressure demotion: act when either tier's occupancy
+        crosses its configured HIGH watermark, demote/drop **coldest
+        first**, and stop once occupancy is back at the LOW watermark —
+        classic hysteresis, so a tier sitting between lo and hi is never
+        touched and the sweep cannot thrash at the boundary.  Called every
+        serving tick (``ServingEngine.tick``); a no-op with the default
+        (None) watermarks.
+
+        * **device side** — evicts LRU-leaf prefix-cache pages
+          (``PrefixCacheManager.evict``), which stages each page host-side
+          first via the demoter hook when ``demote_prefix`` is on: cold
+          chains leave the arena but stay warm-on-host.  Pages pinned by
+          live sequences are never touched (evict's refcount rule), so the
+          sweep may legitimately fall short of the low watermark.
+        * **host side** — drops the host tier's LRU-coldest entries
+          (sequence snapshots and prefix pages alike, one LRU); a dropped
+          parked snapshot degrades that resume to recompute (the ladder's
+          never-wrong fallback) and a dropped prefix page just loses
+          warmth.
+
+        Returns ``{"device_demoted": pages, "host_dropped": pages}``."""
+        cfg = self.config
+        out = {"device_demoted": 0, "host_dropped": 0}
+        if cfg.device_watermark_hi is not None:
+            alloc = self.engine.kv.allocator
+            usable = alloc.num_pages - 1          # page 0 is the null page
+            used = usable - alloc.free_pages
+            if usable > 0 and used / usable >= cfg.device_watermark_hi:
+                # free down to the low watermark: target_used = lo * usable
+                excess = used - int(cfg.device_watermark_lo * usable)
+                pc = self.engine.kv.prefix_cache
+                if pc is not None and excess > 0:
+                    freed = pc.evict(excess)
+                    out["device_demoted"] = freed
+                    self.stats["watermark_demotions"] += freed
+        if cfg.host_watermark_hi is not None:
+            cap = self.host.capacity_pages
+            if self.host.pages_used / cap >= cfg.host_watermark_hi:
+                target = int(cfg.host_watermark_lo * cap)
+                while self.host.pages_used > target:
+                    victim = next(iter(self.host._lru), None)
+                    if victim is None:
+                        break
+                    dropped = self.host._lru[victim]
+                    self.host._drop(victim)   # coldest-first: LRU head
+                    out["host_dropped"] += dropped
+                self.stats["watermark_host_drops"] += out["host_dropped"]
+        if out["device_demoted"] or out["host_dropped"]:
+            self._count("kv/watermark_demote",
+                        out["device_demoted"] + out["host_dropped"])
+        return out
 
     def _demote_prefix_page(self, digest: int, page_id: int, tokens: tuple,
                             parent: Optional[int]) -> None:

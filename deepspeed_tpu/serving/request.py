@@ -137,6 +137,12 @@ class ServingRequest:
     #: the surrounding QUEUED interval as ``phase/promote`` spans, so a
     #: resume's TTFT splits into queue wait vs h2d promotion
     promote_windows: List[Tuple[float, float]] = dataclasses.field(default_factory=list)
+    #: telemetry label for PARKED intervals: "parked" for an idle-session
+    #: park, "tool_stall" when a session parked this request MID-GENERATION
+    #: awaiting a tool result (serving/sessions).  A phase label, not a
+    #: state — the PARKED machinery (demote/promote/resume ladder) is
+    #: identical; only span/why_slow attribution differs.
+    park_phase: str = "parked"
 
     def __post_init__(self):
         self.prompt = list(self.prompt)

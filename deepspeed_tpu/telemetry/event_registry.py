@@ -127,6 +127,12 @@ EVENTS = {
     # ---- fleet router (serving/fleet/)
     "fleet/dispatch": ("event", "serving/fleet/router.py",
                        "request placed on a replica (value = rid)"),
+    "fleet/session_park": ("event", "serving/fleet/router.py",
+                           "session turn parked mid-generation for a tool "
+                           "stall (KV demoted host-side, serving/sessions)"),
+    "fleet/session_resume": ("event", "serving/fleet/router.py",
+                             "parked session turn resumed in place (tool "
+                             "result arrived; staged KV promotes back)"),
     "fleet/replica_dead": ("event", "serving/fleet/router.py",
                            "replica declared dead (value = rid)"),
     "fleet/failover_requeued": ("event", "serving/fleet/router.py",
@@ -306,6 +312,9 @@ EVENTS = {
     "kv/resume": ("event+counter", "serving/engine.py",
                   "parked session re-enqueued (PARKED -> QUEUED, promote "
                   "prefetch issued)"),
+    "kv/watermark_demote": ("counter", "serving/kvtier/tier.py",
+                            "pages moved by watermark enforcement (device "
+                            "high-water prefix demotion + host LRU drops)"),
     "kv/host_pages": ("gauge", "serving/engine.py",
                       "host-tier pages held (demoted sequences + "
                       "warm-on-host prefix pages)"),

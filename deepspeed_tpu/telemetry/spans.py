@@ -71,7 +71,7 @@ def _carve_promote(intervals: List[Tuple[str, float, float]],
             merged.append([w0, w1])
     out: List[Tuple[str, float, float]] = []
     for phase, t0, t1 in intervals:
-        if phase not in ("parked", "queued"):
+        if phase not in ("parked", "tool_stall", "queued"):
             out.append((phase, t0, t1))
             continue
         cur = t0
@@ -91,7 +91,8 @@ def _carve_promote(intervals: List[Tuple[str, float, float]],
 def phase_intervals(history: List[Tuple[RequestState, float]],
                     end_ts: Optional[float] = None,
                     clamp_start: Optional[float] = None,
-                    tail_phase: Optional[str] = None
+                    tail_phase: Optional[str] = None,
+                    park_phase: str = "parked"
                     ) -> List[Tuple[str, float, float]]:
     """Fold a state history into ``(phase, t0, t1)`` intervals.
 
@@ -109,7 +110,13 @@ def phase_intervals(history: List[Tuple[RequestState, float]],
     attempt window — work served outside the replica's lease, later
     discarded by the fence — to ``phase/fenced``, so transport-mode
     traces still tile [arrival, terminal] exactly
-    (scripts/trace_report.py)."""
+    (scripts/trace_report.py).
+
+    ``park_phase`` relabels PARKED intervals (``ServingRequest.
+    park_phase``): ``"tool_stall"`` when a session parked the request
+    mid-generation awaiting a tool result — same machinery, different
+    attribution (a tool stall is the AGENT's latency, an idle park the
+    user's think time)."""
     out: List[Tuple[str, float, float]] = []
     for i, (state, ts) in enumerate(history):
         if state.terminal:
@@ -123,8 +130,12 @@ def phase_intervals(history: List[Tuple[RequestState, float]],
             break  # open-ended non-terminal tail with no close time: skip
         t0 = ts if clamp_start is None else max(ts, clamp_start)
         if nxt > t0 and state in PHASE_OF_STATE:
-            phase = tail_phase if (open_tail and tail_phase is not None) \
-                else PHASE_OF_STATE[state]
+            if open_tail and tail_phase is not None:
+                phase = tail_phase
+            elif state is RequestState.PARKED:
+                phase = park_phase
+            else:
+                phase = PHASE_OF_STATE[state]
             out.append((phase, t0, nxt))
     return out
 
@@ -142,7 +153,9 @@ def emit_attempt_spans(tracer: Tracer, req: ServingRequest, trace_id: int,
     spans = []
     intervals = phase_intervals(req.history, end_ts=end_ts,
                                 clamp_start=clamp_start,
-                                tail_phase=tail_phase)
+                                tail_phase=tail_phase,
+                                park_phase=getattr(req, "park_phase",
+                                                   "parked"))
     intervals = _carve_promote(intervals,
                                getattr(req, "promote_windows", None) or [])
     for phase, t0, t1 in intervals:

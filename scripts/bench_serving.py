@@ -392,9 +392,11 @@ def run_kv_tier_leg(make_engine, clock_factory, dryrun, out_path, seed):
       <= usable pages, also capped by seq slots).  The leg runs exactly
       that many sessions start-to-finish and measures per-token delivery
       gaps (TPOT) from the stream callback.
-    * **on leg** — 3x the sessions WITH the tier attached.  A turn
-      controller parks each session at its turn boundaries (KV demoted to
-      crc-tagged host pages, device pages freed), issues
+    * **on leg** — 3x the sessions WITH the tier attached.  The shared
+      session driver (``serving/sessions``'s SessionManager, over a
+      ``session_arrivals`` workload pinned to this leg's deterministic
+      single-turn shape) parks each session at its seeded stall offsets
+      (KV demoted to crc-tagged host pages, device pages freed), issues
       ``prefetch_resume`` a lead interval BEFORE the scheduled resume so
       the h2d promotion hides under other sessions' device windows, then
       resumes.  Active-set TPOT counts only gaps WITHIN a turn segment
@@ -409,9 +411,10 @@ def run_kv_tier_leg(make_engine, clock_factory, dryrun, out_path, seed):
     """
     from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
     from deepspeed_tpu.models.llama_cache import PagedKVConfig
-    from deepspeed_tpu.serving import (RequestState, ServingConfig,
-                                       ServingEngine)
+    from deepspeed_tpu.serving import ServingConfig, ServingEngine
+    from deepspeed_tpu.serving.fleet import session_arrivals
     from deepspeed_tpu.serving.kvtier import TierConfig, TieredKVManager
+    from deepspeed_tpu.serving.sessions import SessionConfig, SessionManager
 
     if dryrun:
         # max_seqs raised past the page bound so the ARENA is the binding
@@ -433,12 +436,26 @@ def run_kv_tier_leg(make_engine, clock_factory, dryrun, out_path, seed):
     pps = -(-(prompt_len + new_tokens) // kv_cfg.page_size)  # pages/session
     n_off = min(usable // pps, sched_cfg.max_seqs)
     n_on = 3 * n_off
-    terminal = (RequestState.DONE, RequestState.TIMED_OUT,
-                RequestState.REJECTED)
 
-    rng = np.random.default_rng(seed + 19)
-    prompts = [[int(x) for x in rng.integers(1, 250, prompt_len)]
-               for _ in range(n_on)]
+    # the SHARED agentic-workload generator (serving/fleet/sim.py), pinned
+    # to this leg's deterministic single-turn shape: sigma-zero lognormals
+    # fix prompt/output lengths and the 'think' pause to their medians,
+    # stall_at fires the park at the exact r22 token boundaries, tool_len=0
+    # keeps the pause transcript-neutral.  (Values shifted vs the pre-r23
+    # record: prompts now come from session_arrivals' draw order, not this
+    # script's private rng — same distribution, different bytes.)
+    sessions_on = session_arrivals(
+        seed=seed + 19, n_sessions=n_on, vocab=250, rate=None,
+        turns_min=1, turns_max=1,
+        user_median=prompt_len, user_sigma=0.0, max_user=prompt_len,
+        new_median=new_tokens, new_sigma=0.0,
+        min_new=new_tokens, max_new=new_tokens,
+        stall_at=bounds, stall_median=think, stall_sigma=0.0,
+        max_stall=max(think, 1.0), tool_len=0)
+    # off leg: the SAME first n_off sessions, stall-free — resident
+    # capacity there means continuous decode, no parks
+    sessions_off = [{**s, "turns": [{**t, "stalls": []} for t in s["turns"]]}
+                    for s in sessions_on[:n_off]]
 
     def _pct(vals):
         if not vals:
@@ -450,23 +467,33 @@ def run_kv_tier_leg(make_engine, clock_factory, dryrun, out_path, seed):
             return round(s[min(len(s) - 1, max(0, rank - 1))], 6)
         return {"p50": q(50), "p95": q(95), "p99": q(99)}
 
+    def _gap_stream(last_ts, gaps):
+        """Per-token delivery gaps, baseline RESET across a park (the
+        manager parks AFTER a delivery, so the first post-resume delivery
+        sees ``stalls_fired`` moved and drops its gap: think time is the
+        agent's, not the system's)."""
+        seg_of = {}
+
+        def stream(sess, req, toks, now):
+            if seg_of.get(req.uid) != sess.stalls_fired:
+                seg_of[req.uid] = sess.stalls_fired
+                last_ts.pop(req.uid, None)
+            lt = last_ts.get(req.uid)
+            if lt is not None and toks:
+                gaps.append((now - lt) / len(toks))
+            last_ts[req.uid] = now
+        return stream
+
     def off_leg():
         eng = make_engine(kv_cfg=kv_cfg, sched_cfg=sched_cfg)
         _warm(eng, sched_cfg.max_seqs)
         serve = ServingEngine(eng, clock=clock_factory(), config=ServingConfig())
         last_ts, gaps = {}, []
-
-        def stream(req, toks, now):
-            lt = last_ts.get(req.uid)
-            if lt is not None and toks:
-                gaps.append((now - lt) / len(toks))
-            last_ts[req.uid] = now
-
-        reqs = [serve.submit(prompts[i], max_new_tokens=new_tokens, stream=stream)
-                for i in range(n_off)]
-        serve.drain()
+        mgr = SessionManager(serve, sessions_off,
+                             stream=_gap_stream(last_ts, gaps))
+        done = mgr.run()
         summ = serve.stats.summary(elapsed=serve.clock.now())
-        outs = [(r.state.value, list(r.tokens)) for r in reqs]
+        outs = [(s.state.value, list(s.transcript)) for s in done]
         return {
             "sessions": n_off,
             "completed": summ["completed"],
@@ -488,52 +515,22 @@ def run_kv_tier_leg(make_engine, clock_factory, dryrun, out_path, seed):
             demote_prefix=False))
         serve.attach_tier(tier)
         last_ts, gaps = {}, []
+        host_peak = [0]
+        orig_tick = serve.tick
 
-        def stream(req, toks, now):
-            lt = last_ts.get(req.uid)
-            if lt is not None and toks:
-                gaps.append((now - lt) / len(toks))
-            last_ts[req.uid] = now
-
-        sessions = [{"req": serve.submit(prompts[i], max_new_tokens=new_tokens,
-                                         stream=stream),
-                     "seg": 0, "parked": False, "resume_at": 0.0,
-                     "prefetched": False} for i in range(n_on)]
-        host_peak, guard = 0, 0
-        while any(s["req"].state not in terminal for s in sessions):
-            guard += 1
-            assert guard < 500_000, "kv_tier on-leg wedged"
-            live_parked = [s for s in sessions
-                           if s["parked"] and s["req"].state not in terminal]
-            if not serve._active and not serve._queue and live_parked:
-                # everyone is thinking: jump the clock to the next due
-                # controller action (prefetch lead first, then resume)
-                serve.clock.wait_until(min(
-                    (s["resume_at"] if s["prefetched"]
-                     else s["resume_at"] - lead) for s in live_parked))
-            serve.tick()
-            now = serve.clock.now()
-            host_peak = max(host_peak, tier.host.pages_used)
-            for s in sessions:
-                r = s["req"]
-                if r.state in terminal:
-                    continue
-                if s["parked"]:
-                    if not s["prefetched"] and now >= s["resume_at"] - lead:
-                        serve.prefetch_resume(r.uid)
-                        s["prefetched"] = True
-                    if now >= s["resume_at"]:
-                        serve.resume(r.uid)
-                        s["parked"] = False
-                elif s["seg"] < len(bounds) and \
-                        len(r.tokens) >= bounds[s["seg"]] and \
-                        r.state is RequestState.DECODE and serve.park(r.uid):
-                    last_ts.pop(r.uid, None)   # think time is not TPOT
-                    s["seg"] += 1
-                    s["parked"], s["prefetched"] = True, False
-                    s["resume_at"] = now + think
+        def tick():   # sample host occupancy at the driver's cadence
+            orig_tick()
+            host_peak[0] = max(host_peak[0], tier.host.pages_used)
+        serve.tick = tick
+        # the r22 inline turn controller, folded onto the shared session
+        # driver: SessionManager owns the park-at-stall / prefetch-lead /
+        # resume ladder and the idle clock jumps
+        mgr = SessionManager(serve, sessions_on,
+                             config=SessionConfig(prefetch_lead_s=lead),
+                             stream=_gap_stream(last_ts, gaps))
+        done = mgr.run()
         summ = serve.stats.summary(elapsed=serve.clock.now())
-        outs = [(s["req"].state.value, list(s["req"].tokens)) for s in sessions]
+        outs = [(s.state.value, list(s.transcript)) for s in done]
         return {
             "sessions": n_on,
             "completed": summ["completed"],
@@ -546,7 +543,7 @@ def run_kv_tier_leg(make_engine, clock_factory, dryrun, out_path, seed):
             "kv_import_fallbacks": serve.stats.kv_import_fallbacks,
             "prefetch_hidden_frac": (None if tier.hidden_frac is None
                                      else round(tier.hidden_frac, 6)),
-            "host_pages_peak": host_peak,
+            "host_pages_peak": host_peak[0],
             "tpot_active": _pct(gaps),
             "n_gaps": len(gaps),
             "elapsed": round(serve.clock.now(), 6),
@@ -584,7 +581,8 @@ def run_kv_tier_leg(make_engine, clock_factory, dryrun, out_path, seed):
         "value": ratio,
         "unit": "x",
         "schema_version": 1,
-        "workload": {"prompt_len": prompt_len, "new_tokens": new_tokens,
+        "workload": {"generator": "session_arrivals",
+                     "prompt_len": prompt_len, "new_tokens": new_tokens,
                      "turns": len(bounds) + 1, "think": think,
                      "prefetch_lead": lead, "h2d_page_s": h2d_page_s,
                      "seed": seed, "dryrun": bool(dryrun),

@@ -1,0 +1,259 @@
+#!/usr/bin/env python
+"""Agentic-session serving bench: sticky affinity + park-between-stalls
+vs a stateless fleet on the same multi-turn tool-calling workload.
+
+Drives ``deepspeed_tpu/serving/sessions`` over a 4-replica fleet
+(ReplicaPool + Router + FleetSimulator with the
+:class:`FleetSessionCoordinator` as the simulator's controller): a
+seeded population of agentic sessions (``session_arrivals``), each 2-4
+turns where turn N+1's prompt is turn N's FULL transcript, with
+mid-generation tool-call stalls that park the request through the host
+KV tier and think-time gaps between turns.  Served twice:
+
+* **baseline** — stateless ``round_robin`` routing and a deliberately
+  useless 1-page host tier (``demote_prefix`` off): every turn lands
+  wherever the wheel points with a cold cache, every stall park keeps no
+  snapshot so every resume is a full recompute.  This is what an
+  agent-oblivious serving stack does to a conversation.
+* **sessions** — ``session_affinity`` routing (sticky to the replica
+  holding the session's warm transcript pages, prefix-directory
+  failover when it saturates or dies) and a real host tier: stalls
+  demote to host and promote back prefetch-hidden
+  (``prefetch_lead_s``), and between turns the replica's prefix cache
+  keeps the transcript warm so the next turn's prefill skips the pages
+  it already has.
+
+The committed record must show the sessions leg beating the baseline on
+**p99 turn-TTFT** (submit of a turn -> its first token) at EQUAL
+goodput (every session closed, every turn completed, both legs), with
+ZERO transcript divergence against per-session goldens (a fresh single
+engine replaying each session turn by turn — parking, affinity, and
+failover may move WHERE and WHEN tokens are computed, never WHICH), and
+the sessions leg byte-identical when repeated.
+
+Clock modes as in bench_router.py:
+  --dryrun  CPU + one shared VirtualClock under a token-proportional
+            step cost: bit-reproducible (run twice, diff the JSON).
+            Latencies are in deterministic clock units ("steps").
+  default   the 125M bench model on the local accelerator, WallClock.
+
+Writes BENCH_SESSIONS.json (validated by scripts/check_bench_schema.py)
+and prints one JSON line.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+N_REPLICAS = 4
+
+
+def _build_factory(dryrun: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, build_engine
+    from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.models.llama_cache import PagedKVConfig
+
+    if dryrun:
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                          num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=512, rope_theta=1e4, dtype=jnp.float32,
+                          scan_layers=True, remat=False)
+        kv = PagedKVConfig(num_pages=96, page_size=8, max_pages_per_seq=24)
+        sched = SchedulerConfig(token_budget=128, max_seqs=8, prefill_chunk=16,
+                                decode_bucket=4)
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768, intermediate_size=2048,
+                          num_hidden_layers=12, num_attention_heads=12, num_key_value_heads=12,
+                          max_position_embeddings=2048, rope_theta=1e4, dtype=jnp.bfloat16,
+                          scan_layers=True, remat=False, attention_impl="flash")
+        kv = PagedKVConfig(num_pages=1024, page_size=16, max_pages_per_seq=32)
+        sched = SchedulerConfig(token_budget=2048, max_seqs=32, prefill_chunk=128,
+                                decode_bucket=8)
+    model = LlamaForCausalLM(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+    def factory():
+        return build_engine(cfg, params, RaggedInferenceEngineConfig(
+            kv=kv, scheduler=sched, kv_dtype=cfg.dtype, decode_steps_per_dispatch=1))
+
+    return factory, cfg.vocab_size, kv.page_size
+
+
+def _golden_transcripts(factory, sessions):
+    """Per-session goldens: a FRESH single engine replays each session
+    turn by turn — the divergence oracle for both legs."""
+    out = {}
+    for sess in sessions:
+        eng = factory()
+        transcript = []
+        for t in sess["turns"]:
+            transcript.extend(t["user_tokens"])
+            transcript.extend(eng.generate([list(transcript)],
+                                           max_new_tokens=t["max_new_tokens"])[0])
+            for st in t["stalls"]:
+                transcript.extend(st["tool_tokens"])
+        out[sess["sid"]] = transcript
+    return out
+
+
+def _session_point(factory, clock_factory, sessions, page_size, serving_config,
+                   sticky, tier_config, prefetch_lead_s):
+    """One fleet run over the session workload; returns (record, transcripts)."""
+    from deepspeed_tpu.serving.fleet import (FleetSimulator, PrefixDirectory,
+                                             ReplicaPool, Router, make_policy)
+    from deepspeed_tpu.serving.metrics import percentile_summary
+    from deepspeed_tpu.serving.sessions import (FleetSessionCoordinator,
+                                                SessionConfig)
+    clock = clock_factory()
+    directory = PrefixDirectory(page_size=page_size) if sticky else None
+    pool = ReplicaPool(factory, N_REPLICAS, clock=clock,
+                       serving_config=serving_config,
+                       prefix_directory=directory, kv_tier=tier_config)
+    pool.rebase_clock()
+    policy = (make_policy("session_affinity", directory=directory) if sticky
+              else make_policy("round_robin"))
+    router = Router(pool, policy)
+    coord = FleetSessionCoordinator(
+        router, sessions, SessionConfig(prefetch_lead_s=prefetch_lead_s))
+    FleetSimulator(router, controller=coord).run([])
+    ttfts = coord.turn_ttfts()
+    rec = {
+        "policy": policy.name,
+        "turn_ttft": percentile_summary(ttfts),
+        "turns_completed": coord.stats["turns_completed"],
+        "stalls": coord.stats["stalls"],
+        "tool_results": coord.stats["tool_results"],
+        "sessions_closed": sum(1 for s in coord.sessions if s.closed),
+        "abandoned": coord.stats["abandoned"],
+        "elapsed": round(clock.now(), 6),
+        "session_sticky_hits": router.stats["session_sticky_hits"],
+        "session_failovers": router.stats["session_failovers"],
+        "session_parks": router.stats["session_parks"],
+        "session_resumes": router.stats["session_resumes"],
+        "kv_imports": router.stats.get("kv_imports", 0),
+    }
+    return rec, coord.transcripts()
+
+
+def run_sessions_leg(factory, clock_factory, seed, vocab, page_size, n_sessions,
+                     dryrun):
+    from deepspeed_tpu.serving import ServingConfig
+    from deepspeed_tpu.serving.fleet import session_arrivals
+    from deepspeed_tpu.serving.kvtier import TierConfig
+
+    sessions = session_arrivals(
+        seed=seed, n_sessions=n_sessions, vocab=vocab, rate=1.5,
+        turns_min=2, turns_max=4, user_median=14, max_user=32,
+        new_median=10, min_new=6, max_new=16,
+        think_median=3.0, max_think=12.0,
+        stall_prob=0.5, stall_median=2.5, max_stall=8.0, tool_len=4)
+    n_turns = sum(len(s["turns"]) for s in sessions)
+    n_stalls = sum(len(t["stalls"]) for s in sessions for t in s["turns"])
+    golden = _golden_transcripts(factory, sessions)
+
+    # token-proportional step cost: a 16-token prefill chunk costs ~3x a
+    # decode step, so skipping warm chunks is visible in turn-TTFT
+    scfg = ServingConfig(step_cost=lambda toks: 0.25 + 0.015 * toks)
+    baseline, base_tx = _session_point(
+        factory, clock_factory, sessions, page_size, scfg, sticky=False,
+        # the agent-oblivious stack: no useful host tier (a park keeps no
+        # snapshot -> every stall resume recomputes), no affinity
+        tier_config=TierConfig(host_capacity_pages=1, demote_prefix=False),
+        prefetch_lead_s=0.0)
+    tier = TierConfig(host_capacity_pages=N_REPLICAS * 64, h2d_page_s=0.05)
+    sessioned, sess_tx = _session_point(
+        factory, clock_factory, sessions, page_size, scfg, sticky=True,
+        tier_config=tier, prefetch_lead_s=1.0)
+
+    divergence = sum(1 for sid, t in golden.items() if base_tx[sid] != t)
+    divergence += sum(1 for sid, t in golden.items() if sess_tx[sid] != t)
+
+    deterministic = None
+    if dryrun:
+        rec2, tx2 = _session_point(
+            factory, clock_factory, sessions, page_size, scfg, sticky=True,
+            tier_config=tier, prefetch_lead_s=1.0)
+        deterministic = (json.dumps(rec2, sort_keys=True)
+                         == json.dumps(sessioned, sort_keys=True)
+                         and tx2 == sess_tx)
+
+    rec = {
+        "workload": {"seed": seed, "n_sessions": n_sessions, "n_turns": n_turns,
+                     "n_stalls": n_stalls,
+                     "mean_turns_per_session": round(n_turns / n_sessions, 3)},
+        "baseline": baseline,
+        "sessions": sessioned,
+        "p99_turn_ttft_ratio": round(
+            baseline["turn_ttft"]["p99"] / sessioned["turn_ttft"]["p99"], 3),
+        "sticky_hit_rate": round(
+            sessioned["session_sticky_hits"]
+            / max(n_turns - n_sessions, 1), 4),
+        "divergence": divergence,
+        "deterministic": deterministic,
+    }
+
+    # the receipts the acceptance criteria pin
+    assert baseline["turns_completed"] == sessioned["turns_completed"] \
+        == n_turns, "goodput must be EQUAL before latency is compared"
+    assert baseline["sessions_closed"] == sessioned["sessions_closed"] \
+        == n_sessions
+    assert baseline["abandoned"] == 0 and sessioned["abandoned"] == 0
+    assert sessioned["session_parks"] == sessioned["session_resumes"] \
+        == n_stalls, "every stall must park through the tier and resume"
+    assert divergence == 0, "affinity/parking may move WHERE tokens are " \
+        "computed, never WHICH"
+    assert rec["p99_turn_ttft_ratio"] > 1.0, \
+        f"session serving must beat stateless p99 turn-TTFT: {rec}"
+    if dryrun:
+        assert deterministic, "dryrun repeat must be byte-identical"
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dryrun", action="store_true",
+                    help="CPU tiny model + VirtualClock (deterministic)")
+    ap.add_argument("--sessions", type=int, default=None,
+                    help="session count (default 24 dryrun / 48 full)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="BENCH_SESSIONS.json")
+    args = ap.parse_args()
+
+    if args.dryrun:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    factory, vocab, page_size = _build_factory(args.dryrun)
+    if args.dryrun:
+        from deepspeed_tpu.serving import VirtualClock
+        clock_factory = VirtualClock
+    else:
+        from deepspeed_tpu.serving import WallClock
+        clock_factory = WallClock
+    n_sessions = args.sessions or (24 if args.dryrun else 48)
+
+    result = {
+        "schema": 1,
+        "mode": "dryrun" if args.dryrun else "accelerator",
+        "units": "steps" if args.dryrun else "seconds",
+        "n_replicas": N_REPLICAS,
+        "agentic_mix": run_sessions_leg(factory, clock_factory, args.seed,
+                                        vocab, page_size, n_sessions,
+                                        args.dryrun),
+    }
+    from deepspeed_tpu.resilience.atomic_io import atomic_write_json
+    atomic_write_json(args.out, result, indent=1)
+    brief = {"mode": result["mode"],
+             "p99_ratio": result["agentic_mix"]["p99_turn_ttft_ratio"],
+             "sticky_hit_rate": result["agentic_mix"]["sticky_hit_rate"],
+             "divergence": result["agentic_mix"]["divergence"]}
+    print(json.dumps(brief))
+
+
+if __name__ == "__main__":
+    main()

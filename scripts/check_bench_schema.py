@@ -839,6 +839,83 @@ def _kv_tier_record(v):
     return None
 
 
+_SESSION_LEG = {
+    "policy": STR, "turn_ttft": DICT, "turns_completed": INT, "stalls": INT,
+    "tool_results": INT, "sessions_closed": INT, "abandoned": INT,
+    "elapsed": NUM, "session_sticky_hits": INT, "session_failovers": INT,
+    "session_parks": INT, "session_resumes": INT, "kv_imports": INT,
+}
+
+
+def _sessions_record(v):
+    """The agentic-session receipt (scripts/bench_sessions.py): the
+    session subsystem (sticky-with-failover affinity + park-between-
+    stalls) must beat the stateless round-robin baseline on p99
+    turn-TTFT on a >= 20-session multi-turn tool-calling mix, at EQUAL
+    goodput (every turn of every session completed in BOTH legs), with
+    every stall parked through the tier and resumed, zero transcript
+    divergence against per-session goldens, and byte-identical dryrun
+    regeneration.  A committed artifact where affinity lost turns or
+    parking changed bytes is a regression, not a benchmark."""
+    if not isinstance(v, dict):
+        return f"expected sessions object, got {type(v).__name__}"
+    errors = []
+    _check(v, {
+        "schema": lambda x: None if x == 1 else f"schema {x} != 1",
+        "mode": STR, "units": STR, "n_replicas": INT,
+        "agentic_mix": {
+            "workload": {"seed": INT, "n_sessions": INT, "n_turns": INT,
+                         "n_stalls": INT, "mean_turns_per_session": NUM},
+            "baseline": _SESSION_LEG,
+            "sessions": _SESSION_LEG,
+            "p99_turn_ttft_ratio": NUM,
+            "sticky_hit_rate": NUM,
+            "divergence": INT,
+            "deterministic": ("nullable", BOOL),
+        },
+    }, "sessions", errors)
+    if errors:
+        return "; ".join(errors)
+    mix = v["agentic_mix"]
+    w = mix["workload"]
+    if w["n_sessions"] < 20:
+        return f"only {w['n_sessions']} sessions (>= 20 required)"
+    if w["n_turns"] <= w["n_sessions"] or w["n_stalls"] <= 0:
+        return (f"workload not agentic: {w['n_turns']} turns / "
+                f"{w['n_sessions']} sessions, {w['n_stalls']} stalls")
+    for side in ("baseline", "sessions"):
+        leg = mix[side]
+        if leg["turns_completed"] != w["n_turns"] \
+                or leg["sessions_closed"] != w["n_sessions"] \
+                or leg["abandoned"] != 0:
+            return (f"{side} leg lost work: {leg['turns_completed']}/"
+                    f"{w['n_turns']} turns, {leg['sessions_closed']}/"
+                    f"{w['n_sessions']} sessions, {leg['abandoned']} abandoned"
+                    " — goodput must be EQUAL before latency is compared")
+    sess = mix["sessions"]
+    if sess["session_parks"] != sess["session_resumes"] \
+            or sess["session_parks"] != w["n_stalls"]:
+        return (f"unbalanced stall ledger: parks={sess['session_parks']} "
+                f"resumes={sess['session_resumes']} stalls={w['n_stalls']}")
+    if sess["session_sticky_hits"] <= 0:
+        return "affinity never stuck (session_sticky_hits == 0)"
+    p99_base = mix["baseline"]["turn_ttft"].get("p99")
+    p99_sess = sess["turn_ttft"].get("p99")
+    if not (isinstance(p99_base, (int, float))
+            and isinstance(p99_sess, (int, float))):
+        return f"missing p99 turn-TTFT: base={p99_base} sessions={p99_sess}"
+    if not (mix["p99_turn_ttft_ratio"] > 1.0 and p99_sess < p99_base):
+        return (f"session serving did not beat stateless p99 turn-TTFT: "
+                f"{p99_sess} vs {p99_base} "
+                f"(ratio {mix['p99_turn_ttft_ratio']})")
+    if mix["divergence"] != 0:
+        return (f"{mix['divergence']} transcript(s) diverged from the "
+                "per-session goldens")
+    if v["mode"] == "dryrun" and mix["deterministic"] is not True:
+        return "dryrun artifact not byte-identical across regenerations"
+    return None
+
+
 SCHEMAS = {
     # per-round driver transcripts
     "BENCH_r*.json": {"n": INT, "cmd": STR, "rc": INT, "tail": STR, "?parsed": DICT},
@@ -851,6 +928,8 @@ SCHEMAS = {
     "BENCH_STEP_ANATOMY.json": _validate_step_anatomy,
     # tiered-KV resident-session capacity receipt (bench_serving.py --kv-tier)
     "BENCH_KV_TIER.json": _kv_tier_record,
+    # agentic-session receipt (scripts/bench_sessions.py)
+    "BENCH_SESSIONS.json": _sessions_record,
     # single-metric bench artifacts (bench.py-style envelope)
     "BENCH_SCALE.json": {"metric": STR, "value": NUM, "unit": STR,
                          "?vs_baseline": NUM, "extra": DICT},

@@ -51,8 +51,13 @@ from deepspeed_tpu.serving.metrics import percentile_summary  # noqa: E402
 #: host-demoted idle windows and the unhidden slice of the h2d promote
 #: transfer a resume pays (telemetry/spans.py carves them out of
 #: parked/queued so the tiling still holds exactly)
+#: ``tool_stall`` is a PARKED interval relabeled by its session park
+#: phase (serving/sessions): a mid-generation wait for an agentic tool
+#: result; ``think_time`` is the session-level between-turn gap (only in
+#: session-root traces, which fold() skips — named for completeness)
 PHASES = ("pending", "queued", "prefill", "decode", "migrating", "evicted",
-          "fenced", "host_gap", "compile_wait", "parked", "promote")
+          "fenced", "host_gap", "compile_wait", "parked", "tool_stall",
+          "think_time", "promote")
 _US = 1e6
 
 

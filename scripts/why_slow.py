@@ -67,23 +67,26 @@ _US = 1e6
 #: pauses — named slowdowns, not baseline compute
 CAUSES = ("queue_wait", "partition_delay", "prefill", "decode",
           "migration_pause", "lease_expiry", "fenced", "eviction",
-          "host_gap", "compile_wait", "parked", "promote")
+          "host_gap", "compile_wait", "parked", "tool_stall", "promote")
 
 #: causes that are NOT baseline compute — the named slowdowns the tail
 #: receipt attributes the p99-p50 gap to.  ``parked`` is deliberate idle
-#: (the session slept between turns with its KV host-side) and
-#: ``promote`` is the h2d transfer a resume could not hide — the receipt
-#: separates resume-TTFT paid to the tier from recompute it avoided
+#: (the session slept between turns with its KV host-side),
+#: ``tool_stall`` is the mid-generation wait for an agentic session's
+#: tool result (serving/sessions — the agent's latency, parked through
+#: the same host tier), and ``promote`` is the h2d transfer a resume
+#: could not hide — the receipt separates resume-TTFT paid to the tier
+#: from recompute it avoided
 SLOWDOWN_CAUSES = ("queue_wait", "partition_delay", "migration_pause",
                    "lease_expiry", "fenced", "eviction", "host_gap",
-                   "compile_wait", "parked", "promote")
+                   "compile_wait", "parked", "tool_stall", "promote")
 
 #: phase -> cause for the phases that map 1:1
 _DIRECT = {"prefill": "prefill", "decode": "decode",
            "migrating": "migration_pause", "fenced": "fenced",
            "evicted": "eviction", "host_gap": "host_gap",
            "compile_wait": "compile_wait", "parked": "parked",
-           "promote": "promote"}
+           "tool_stall": "tool_stall", "promote": "promote"}
 
 
 def _overlap(t0, t1, w0, w1):

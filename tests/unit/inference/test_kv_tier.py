@@ -409,3 +409,73 @@ def test_property_audit_random_park_resume_preempt(trained_params, seed):
             assert req.state is RequestState.TIMED_OUT
             assert list(req.tokens) == gold[:len(req.tokens)]
     _assert_clean(serve, tier)
+
+
+# --------------------------------------------------- watermark enforcement
+
+
+def test_device_watermark_demotes_cold_prefix_with_hysteresis(trained_params):
+    """Capacity-pressure demotion (``enforce_watermarks``, run every
+    serving tick): crossing the device HIGH watermark demotes LRU-leaf
+    prefix pages down to the LOW watermark — staged warm-on-host — and the
+    hysteresis band means a tier sitting between lo and hi is untouched,
+    so back-to-back sweeps cannot thrash."""
+    cfg = TierConfig(host_capacity_pages=64,
+                     device_watermark_hi=0.08, device_watermark_lo=0.03)
+    serve, tier = _serve(trained_params, tier_config=cfg)
+    # three finished prompts leave ~6 cold prefix pages device-side
+    for i in range(3):
+        serve.submit(list(range(10 * i + 1, 10 * i + 2 * PAGE + 1)),
+                     max_new_tokens=2)
+    serve.drain()
+    pc = serve.engine.kv.prefix_cache
+    alloc = serve.engine.kv.allocator
+    usable = serve.engine.kv.num_pages - 1
+    used = usable - alloc.free_pages
+    assert used / usable >= cfg.device_watermark_hi   # above hi: must act
+    out = tier.enforce_watermarks()
+    assert out["device_demoted"] > 0
+    used_after = usable - alloc.free_pages
+    assert used_after <= int(cfg.device_watermark_lo * usable)
+    # demoted pages stayed warm — they landed in the host prefix tier
+    assert tier.stats["prefix_demotions"] >= out["device_demoted"]
+    assert tier.stats["watermark_demotions"] == out["device_demoted"]
+    # hysteresis: now below hi, an immediate second sweep is a no-op
+    assert tier.enforce_watermarks() == {"device_demoted": 0, "host_dropped": 0}
+    # ... and a tick runs the sweep implicitly without firing it again
+    serve.tick()
+    assert tier.stats["watermark_demotions"] == out["device_demoted"]
+    assert pc.cached_pages == used_after
+
+
+def test_host_watermark_drops_coldest_first(trained_params):
+    """Host-side watermark: crossing hi drops LRU-COLDEST entries (the
+    ledger's insertion/touch order) until occupancy is back at lo — the
+    newest snapshot survives, the stalest die first."""
+    from deepspeed_tpu.serving.kvtransfer import KVSnapshot
+
+    def snap(uid, n_pages=2):
+        s = KVSnapshot(tokens=[uid] * (n_pages * PAGE),
+                       seen_tokens=n_pages * PAGE, page_size=PAGE,
+                       block_shape=(2, PAGE, 2, 2, 4), dtype="float32",
+                       source="test")
+        s.add_chunk(np.zeros((2, n_pages, PAGE, 2, 2, 4), np.float32))
+        s.complete = True
+        return s
+
+    cfg = TierConfig(host_capacity_pages=8,
+                     host_watermark_hi=0.7, host_watermark_lo=0.3)
+    serve, tier = _serve(trained_params, tier_config=cfg)
+    for uid in (1, 2, 3):
+        assert tier.host.put_seq(uid, snap(uid))
+    assert tier.host.pages_used == 6                  # 6/8 = 0.75 >= hi
+    out = tier.enforce_watermarks()
+    assert out["host_dropped"] == 4
+    # coldest-first: uids 1 and 2 (stalest) died, 3 (newest) survives
+    assert tier.host.peek_seq(1) is None and tier.host.peek_seq(2) is None
+    assert tier.host.peek_seq(3) is not None
+    assert tier.host.pages_used == 2 <= int(cfg.host_watermark_lo * 8)
+    assert tier.stats["watermark_host_drops"] == 4
+    # hysteresis: below hi now — no further drops
+    assert tier.enforce_watermarks() == {"device_demoted": 0, "host_dropped": 0}
+    assert tier.host.pages_used == sum(tier.host._lru.values())

@@ -1,0 +1,210 @@
+"""Unit tests for the agentic-session subsystem (serving/sessions): the
+session state machine's transition table, tool-call detector semantics,
+and the single-engine ``SessionManager`` driving multi-turn sessions with
+tool-call stalls parked through the KV tier — transcripts byte-identical
+to a fresh engine replaying each session turn by turn."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, build_engine
+from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.models.llama_cache import PagedKVConfig
+from deepspeed_tpu.serving import RequestState, ServingConfig, ServingEngine, VirtualClock
+from deepspeed_tpu.serving.fleet import session_arrivals
+from deepspeed_tpu.serving.kvtier import TierConfig, TieredKVManager
+from deepspeed_tpu.serving.sessions import (Session, SessionConfig, SessionManager,
+                                            SessionState, ToolCallDetector)
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128,
+                  rope_theta=1e4, dtype=jnp.float32, scan_layers=True, remat=False)
+
+
+@pytest.fixture(scope="module")
+def trained_params():
+    model = LlamaForCausalLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def _engine(trained_params, num_pages=64, max_seqs=8):
+    kv = PagedKVConfig(num_pages=num_pages, page_size=8, max_pages_per_seq=8)
+    sched = SchedulerConfig(token_budget=64, max_seqs=max_seqs, prefill_chunk=8,
+                            decode_bucket=4)
+    return build_engine(CFG, trained_params, RaggedInferenceEngineConfig(
+        kv=kv, scheduler=sched, kv_dtype=jnp.float32, decode_steps_per_dispatch=1))
+
+
+def _serve(trained_params, host_capacity_pages=64):
+    serve = ServingEngine(_engine(trained_params), clock=VirtualClock(),
+                          config=ServingConfig())
+    tier = TieredKVManager(serve.engine,
+                           config=TierConfig(host_capacity_pages=host_capacity_pages))
+    serve.attach_tier(tier)
+    return serve, tier
+
+
+# ----------------------------------------------------------- state machine
+
+
+def test_session_state_machine_transitions():
+    """Every documented edge is accepted; every undocumented edge raises.
+    The table is the same one dslint validates into STATE_MACHINES.md."""
+    allowed = {
+        SessionState.PENDING: {SessionState.ACTIVE_TURN, SessionState.CLOSED},
+        SessionState.ACTIVE_TURN: {SessionState.TOOL_STALL, SessionState.THINKING,
+                                   SessionState.CLOSED},
+        SessionState.TOOL_STALL: {SessionState.ACTIVE_TURN, SessionState.CLOSED},
+        SessionState.THINKING: {SessionState.ACTIVE_TURN, SessionState.CLOSED},
+        SessionState.CLOSED: set(),
+    }
+    for src in SessionState:
+        for dst in SessionState:
+            sess = Session(sid=0, turns=[{"user_tokens": [1], "max_new_tokens": 2,
+                                          "think_s": 0.0, "stalls": []}],
+                           start_ts=0.0)
+            sess.state = src
+            if dst in allowed[src]:
+                sess.to(dst, 1.0)
+                assert sess.state is dst
+            else:
+                with pytest.raises(ValueError, match="illegal transition"):
+                    sess.to(dst, 1.0)
+
+
+def test_tool_call_detector_at_counts_and_marker():
+    # count-triggered: fires once per configured count, in order
+    det = ToolCallDetector(at_counts=(3, 5))
+    assert not det.due([1, 2])
+    assert det.due([1, 2, 3])
+    assert det.due([1, 2, 3])          # due() is a peek — no consumption
+    det.fire([1, 2, 3])
+    assert not det.due([1, 2, 3])      # consumed; next threshold is 5
+    assert det.due([1, 2, 3, 4, 5])
+    det.fire([1, 2, 3, 4, 5])
+    assert not det.due([1] * 50)       # exhausted
+    with pytest.raises(AssertionError):
+        det.fire([1] * 50)             # fire() without a due trigger
+    # marker-triggered: fires when the tail matches, and only on NEW tokens
+    det = ToolCallDetector(marker=(7, 8))
+    assert not det.due([7])
+    assert det.due([1, 7, 8])
+    det.fire([1, 7, 8])
+    assert not det.due([1, 7, 8])      # same tail already fired
+    assert det.due([1, 7, 8, 7, 8])
+
+
+def test_session_turn_bookkeeping():
+    spec = {"sid": 9, "start_ts": 0.0, "turns": [
+        {"user_tokens": [1, 2], "max_new_tokens": 4, "think_s": 1.5,
+         "stalls": [{"at_tokens": 2, "stall_s": 3.0, "tool_tokens": [50]}]},
+        {"user_tokens": [3], "max_new_tokens": 2, "think_s": 0.0, "stalls": []},
+    ]}
+    sess = Session(sid=spec["sid"], turns=spec["turns"], start_ts=0.0)
+    assert sess.begin_turn(0.0) == [1, 2]             # prompt = transcript so far
+    sess.note_first_token(0.4)
+    sess.note_first_token(9.9)                        # idempotent: first wins
+    assert sess.stall_due([10, 11])
+    stall = sess.enter_stall([10, 11], ts=1.0)
+    assert sess.state is SessionState.TOOL_STALL
+    assert stall["tool_tokens"] == [50] and sess.cur["resume_at"] == 4.0
+    sess.exit_stall(ts=4.0)
+    assert sess.state is SessionState.ACTIVE_TURN
+    think = sess.finish_turn([10, 11, 12], ts=5.0)
+    assert think == 1.5 and sess.state is SessionState.THINKING
+    # generated tokens AND the staged tool tokens joined the transcript
+    assert sess.transcript == [1, 2, 10, 11, 12, 50]
+    assert sess.turn_records[0]["turn_ttft"] == pytest.approx(0.4)
+    assert sess.begin_turn(6.5) == [1, 2, 10, 11, 12, 50, 3]
+    assert sess.finish_turn([20], ts=7.0) is None     # last turn -> CLOSED
+    assert sess.closed and sess.completed_turns == 2
+    assert sess.transcript == [1, 2, 10, 11, 12, 50, 3, 20]
+
+
+# --------------------------------------------------- manager + engine runs
+
+
+def test_session_manager_transcripts_match_fresh_engine_golden(trained_params):
+    """ACCEPTANCE (single engine): generated agentic traffic — multi-turn,
+    think gaps, tool stalls parked through the host tier — finishes with
+    every transcript byte-identical to a fresh engine replaying the same
+    turns, and the park/resume ledgers balanced."""
+    sessions = session_arrivals(seed=7, n_sessions=3, vocab=CFG.vocab_size,
+                                turns_min=2, turns_max=3, user_median=6,
+                                max_user=10, new_median=6, min_new=4, max_new=8,
+                                think_median=2.0, stall_prob=0.6,
+                                stall_median=1.5, tool_len=3)
+    serve, tier = _serve(trained_params)
+    mgr = SessionManager(serve, sessions, SessionConfig(prefetch_lead_s=0.5))
+    out = mgr.run()
+
+    assert all(s.state is SessionState.CLOSED for s in out)
+    n_turns = sum(len(s["turns"]) for s in sessions)
+    assert mgr.stats["turns_completed"] == n_turns
+    n_stalls = sum(len(t["stalls"]) for s in sessions for t in s["turns"])
+    assert mgr.stats["stalls"] == n_stalls == mgr.stats["tool_results"]
+    assert serve.stats.parks == serve.stats.resumes == n_stalls
+    assert tier.stats["demotions"] == tier.stats["promotions"] == n_stalls
+    assert serve.stats.kv_import_fallbacks == 0
+    # every completed turn carries a TTFT receipt
+    for s in out:
+        assert len(s.turn_ttfts()) == len(s.turns)
+
+    for spec in sessions:
+        eng = _engine(trained_params)
+        transcript = []
+        for t in spec["turns"]:
+            transcript.extend(t["user_tokens"])
+            transcript.extend(eng.generate([list(transcript)],
+                                           max_new_tokens=t["max_new_tokens"])[0])
+            for st in t["stalls"]:
+                transcript.extend(st["tool_tokens"])
+        assert mgr.transcripts()[spec["sid"]] == transcript
+
+
+def test_tool_stall_park_phase_labels_the_parked_request(trained_params):
+    """A stall park is telemetry-distinguishable from a capacity park: the
+    serving request carries ``park_phase == 'tool_stall'`` while PARKED, so
+    trace spans attribute the wait to the AGENT, not the serving system."""
+    sessions = [{"sid": 0, "start_ts": 0.0, "turns": [
+        {"user_tokens": [5, 9, 2, 7], "max_new_tokens": 8, "think_s": 0.0,
+         "stalls": [{"at_tokens": 3, "stall_s": 2.0, "tool_tokens": [42]}]}]}]
+    serve, _ = _serve(trained_params)
+    seen = []
+    orig_park = serve.park
+
+    def spy_park(uid, phase="parked"):
+        ok = orig_park(uid, phase=phase)
+        if ok:
+            req = serve._parked[uid]
+            seen.append((req.park_phase, req.state))
+        return ok
+
+    serve.park = spy_park
+    mgr = SessionManager(serve, sessions, SessionConfig())
+    mgr.run()
+    assert seen == [("tool_stall", RequestState.PARKED)]
+    assert mgr.transcripts()[0] == mgr.sessions[0].transcript
+
+
+def test_park_stalls_disabled_keeps_request_active(trained_params):
+    """``park_stalls=False``: the stall still gates turn completion (tool
+    tokens still appended on schedule) but the request keeps its device
+    pages — the policy knob for latency-critical sessions.  Transcript is
+    identical either way."""
+    sessions = session_arrivals(seed=3, n_sessions=1, vocab=CFG.vocab_size,
+                                turns_min=2, turns_max=2, user_median=6,
+                                max_user=10, new_median=6, min_new=4, max_new=8,
+                                stall_prob=1.0, stall_median=1.5, tool_len=2)
+    serve, _ = _serve(trained_params)
+    mgr = SessionManager(serve, sessions, SessionConfig(park_stalls=True))
+    parked = mgr.run()
+    serve2, _ = _serve(trained_params)
+    mgr2 = SessionManager(serve2, sessions, SessionConfig(park_stalls=False))
+    unparked = mgr2.run()
+    assert serve.stats.parks >= 1 and serve2.stats.parks == 0
+    assert mgr.transcripts() == mgr2.transcripts()
+    assert [s.state for s in parked] == [s.state for s in unparked] \
+        == [SessionState.CLOSED]

@@ -1,0 +1,261 @@
+"""Chaos tests for the agentic-session subsystem (serving/sessions):
+kill the session's sticky replica BETWEEN turns and MID-STALL and prove
+the conversation survives byte-identically — the next turn re-homes via
+``session_affinity`` failover, a parked turn's host KV snapshot is
+harvested from the dead replica's host tier and re-imported on the
+survivor (or recomputed when the snapshot is gone), and the coordinator
+re-parks the resumed turn for the remainder of its stall window.  Plus
+the two session fault-injection edges (``session.route``,
+``session.tool_result``): transient faults degrade gracefully (stateless
+resubmit / stall extension), never corrupt a transcript."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig, build_engine
+from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.models.llama_cache import PagedKVConfig
+from deepspeed_tpu.resilience.fault_injection import (INJECTION_SITES, FaultSpec,
+                                                      configure_fault_injection)
+from deepspeed_tpu.serving import ServingConfig, VirtualClock
+from deepspeed_tpu.serving.fleet import (FleetSimulator, PrefixDirectory,
+                                         ReplicaPool, Router,
+                                         SessionAffinityPolicy, session_arrivals)
+from deepspeed_tpu.serving.kvtier import TierConfig
+from deepspeed_tpu.serving.sessions import (FleetSessionCoordinator,
+                                            SessionConfig, SessionState)
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=256,
+                  rope_theta=1e4, dtype=jnp.float32, scan_layers=True, remat=False)
+
+
+@pytest.fixture(scope="module")
+def trained_params():
+    model = LlamaForCausalLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    configure_fault_injection(None)
+
+
+def _factory(trained_params):
+    def make():
+        kv = PagedKVConfig(num_pages=72, page_size=8, max_pages_per_seq=16)
+        sched = SchedulerConfig(token_budget=128, max_seqs=8, prefill_chunk=32,
+                                decode_bucket=4)
+        return build_engine(CFG, trained_params, RaggedInferenceEngineConfig(
+            kv=kv, scheduler=sched, kv_dtype=jnp.float32, decode_steps_per_dispatch=1))
+    return make
+
+
+def _golden_transcripts(trained_params, sessions):
+    """Per-session goldens: a FRESH single engine replays each session
+    turn by turn (prompt = full transcript so far; generated tokens and
+    then any tool-result tokens join the transcript) — the byte-identity
+    oracle every chaos run below is compared against."""
+    out = {}
+    for sess in sessions:
+        eng = _factory(trained_params)()
+        transcript = []
+        for t in sess["turns"]:
+            transcript.extend(t["user_tokens"])
+            transcript.extend(eng.generate([list(transcript)],
+                                           max_new_tokens=t["max_new_tokens"])[0])
+            for st in t["stalls"]:
+                transcript.extend(st["tool_tokens"])
+        out[sess["sid"]] = transcript
+    return out
+
+
+def _fleet(trained_params, host_capacity_pages=128):
+    clock = VirtualClock()
+    directory = PrefixDirectory(page_size=8)
+    pool = ReplicaPool(
+        _factory(trained_params), 2, clock=clock,
+        serving_config=ServingConfig(step_cost=lambda toks: 0.25 + 0.015 * toks),
+        prefix_directory=directory,
+        kv_tier=TierConfig(host_capacity_pages=host_capacity_pages,
+                           h2d_page_s=0.05))
+    pool.rebase_clock()
+    return Router(pool, SessionAffinityPolicy(directory=directory))
+
+
+def _run(router, sessions, schedule=(), config=None):
+    coord = FleetSessionCoordinator(
+        router, sessions, config or SessionConfig(prefetch_lead_s=0.5))
+    FleetSimulator(router, controller=coord).run([], schedule=list(schedule))
+    return coord
+
+
+def _assert_clean_fleet(router):
+    """Zero page drift on every SURVIVING replica: all sessions closed, so
+    no engine seq, no device page (beyond the allocator's reserved null
+    page), and the host tier's LRU ledger is self-consistent."""
+    for rep in router.pool.replicas.values():
+        if rep.serve is None:
+            continue                      # killed and never recovered
+        eng = rep.serve.engine
+        assert not eng.state.seqs
+        if eng.kv.prefix_cache is not None:
+            eng.kv.prefix_cache.evict(eng.kv.num_pages)
+        assert eng.kv.allocator.free_pages == eng.kv.num_pages - 1
+        tier = rep.serve.tier
+        assert tier.host.pages_used == sum(tier.host._lru.values())
+        assert tier.host.pages_used <= tier.host.capacity_pages
+
+
+def _assert_exactly_once(coord):
+    """Terminal accounting: every session closed exactly once, every turn
+    produced exactly one receipt, and turn counters balance — a failover
+    that re-delivered or dropped a turn would break one of these."""
+    for sess in coord.sessions:
+        assert sess.state is SessionState.CLOSED
+        assert len(sess.turn_records) == len(sess.turns)
+        assert [r["turn"] for r in sess.turn_records] == list(range(len(sess.turns)))
+    n_turns = sum(len(s.turns) for s in coord.sessions)
+    assert coord.stats["turns_completed"] == n_turns
+    assert coord.stats["turns_submitted"] >= n_turns
+    assert coord.stats["abandoned"] == 0
+
+
+# one session, 2 turns; turn 0 stalls at 4 tokens for 6 s, then thinks 4 s
+SESS_ONE = [{"sid": 0, "start_ts": 0.0, "turns": [
+    {"user_tokens": [5, 9, 2, 7, 1, 3], "max_new_tokens": 10, "think_s": 4.0,
+     "stalls": [{"at_tokens": 4, "stall_s": 6.0, "tool_tokens": [42, 43]}]},
+    {"user_tokens": [8, 8, 1], "max_new_tokens": 8, "think_s": 0.0, "stalls": []},
+]}]
+
+
+# ------------------------------------------------------- fault-site registry
+
+
+def test_session_sites_registered():
+    for site in ("session.route", "session.tool_result"):
+        assert site in INJECTION_SITES
+        FaultSpec(site=site, kind="os_error")     # validates
+    with pytest.raises(ValueError, match="unknown injection site"):
+        FaultSpec(site="session.routee", kind="crash")
+
+
+# ------------------------------------------------------------ scripted kills
+
+
+def test_kill_sticky_replica_mid_stall_harvests_and_reparks(trained_params):
+    """ACCEPTANCE: the sticky replica dies while the turn is PARKED in a
+    tool stall.  The parked attempt's host KV snapshot survives the device
+    loss — the router resolves the handle against the dead replica's host
+    tier at harvest time — so the survivor IMPORTS the partial generation
+    instead of recomputing, the coordinator re-parks for the remaining
+    stall window, and the finished transcript is byte-identical."""
+    golden = _golden_transcripts(trained_params, SESS_ONE)
+    router = _fleet(trained_params)
+    # t=3.0 is inside turn 0's stall window (stall fires ~t=1.5, resume at
+    # +6 s) — the request is PARKED on its sticky replica when it dies
+    coord = _run(router, SESS_ONE, schedule=[(3.0, "kill", 0), (3.0, "kill", 1)][:1])
+    assert coord.transcripts() == golden
+    _assert_exactly_once(coord)
+    assert router.stats["failovers"] == 1
+    assert router.stats["session_failovers"] == 1
+    assert router.stats["migration_failover_reuse"] == 1   # host snapshot harvested
+    assert coord.stats["reparks"] >= 1                     # stall window re-honored
+    assert router.stats["session_parks"] > router.stats["session_resumes"] - 1
+    _assert_clean_fleet(router)
+
+
+def test_kill_sticky_replica_between_turns_rehomes(trained_params):
+    """Between turns nothing is in flight — the warm transcript pages die
+    with the replica, but the next turn simply re-homes (session_failover)
+    and recomputes its prefix from the prompt.  Output identical."""
+    golden = _golden_transcripts(trained_params, SESS_ONE)
+    router = _fleet(trained_params)
+    # turn 0 completes ~t=9.3 (stall resume +6 s, then finish); think 4 s
+    # puts turn 1's submit ~t=13.3 — kill at 11.5 lands in the think gap
+    coord = _run(router, SESS_ONE, schedule=[(11.5, "kill", 0)])
+    assert coord.transcripts() == golden
+    _assert_exactly_once(coord)
+    assert router.stats["session_failovers"] == 1
+    assert router.stats["failovers"] == 0       # nothing in flight to displace
+    _assert_clean_fleet(router)
+
+
+def test_kill_mid_stall_without_host_snapshot_recomputes(trained_params):
+    """The degraded leg: a 1-page host tier can't hold the demoted pages,
+    so the park keeps no snapshot and the harvest finds nothing — failover
+    falls back to full recompute.  Slower, still byte-identical."""
+    golden = _golden_transcripts(trained_params, SESS_ONE)
+    router = _fleet(trained_params, host_capacity_pages=1)
+    coord = _run(router, SESS_ONE, schedule=[(3.0, "kill", 0)])
+    assert coord.transcripts() == golden
+    _assert_exactly_once(coord)
+    assert router.stats["migration_failover_reuse"] == 0   # nothing to harvest
+    assert router.stats["session_failovers"] == 1
+    _assert_clean_fleet(router)
+
+
+# -------------------------------------------------- session fault injection
+
+
+def test_route_fault_degrades_to_stateless_resubmit(trained_params):
+    """A transient fault at the ``session.route`` edge (turn submit) is
+    absorbed: the coordinator counts it and resubmits the SAME prompt, so
+    affinity may be lost for that turn but the transcript is not."""
+    golden = _golden_transcripts(trained_params, SESS_ONE)
+    configure_fault_injection(
+        {"sites": [{"site": "session.route", "kind": "os_error", "at": 2}]})
+    router = _fleet(trained_params)
+    coord = _run(router, SESS_ONE)
+    assert coord.stats["route_faults"] == 1
+    assert coord.transcripts() == golden
+    _assert_exactly_once(coord)
+    _assert_clean_fleet(router)
+
+
+def test_tool_result_fault_extends_stall(trained_params):
+    """A transient fault delivering the tool result does NOT resume the
+    turn with a missing result — the stall is extended by ``tool_retry_s``
+    and the delivery retried.  The transcript still matches the golden
+    (the tool tokens land exactly once, just later)."""
+    golden = _golden_transcripts(trained_params, SESS_ONE)
+    configure_fault_injection(
+        {"sites": [{"site": "session.tool_result", "kind": "os_error", "at": 1}]})
+    router = _fleet(trained_params)
+    coord = _run(router, SESS_ONE, config=SessionConfig(prefetch_lead_s=0.5,
+                                                        tool_retry_s=1.0))
+    assert coord.stats["tool_result_faults"] == 1
+    assert coord.stats["tool_results"] == 1        # delivered exactly once
+    assert coord.transcripts() == golden
+    _assert_exactly_once(coord)
+    _assert_clean_fleet(router)
+
+
+# --------------------------------------------------------- property audit
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_session_chaos_property_audit(trained_params, seed):
+    """Three seeds of generated agentic traffic, each with a kill landing
+    wherever the seed puts it: whatever mix of in-flight, parked, and
+    thinking sessions the kill catches, every transcript must match its
+    golden, terminals must balance exactly once, and surviving replicas
+    must end with zero page drift."""
+    sessions = session_arrivals(seed=seed, n_sessions=3, vocab=CFG.vocab_size,
+                                turns_min=2, turns_max=3, user_median=8,
+                                max_user=16, new_median=8, min_new=4, max_new=12,
+                                think_median=2.0, max_think=6.0,
+                                stall_prob=0.6, stall_median=3.0, max_stall=8.0,
+                                tool_len=3)
+    golden = _golden_transcripts(trained_params, sessions)
+    router = _fleet(trained_params)
+    # kill time varies with the seed so the fault lands in different
+    # session states across the three runs
+    coord = _run(router, sessions, schedule=[(2.0 + 3.0 * (seed - 11), "kill", 0)])
+    assert coord.transcripts() == golden
+    _assert_exactly_once(coord)
+    assert router.stats["session_resumes"] <= router.stats["session_parks"]
+    _assert_clean_fleet(router)
